@@ -1,0 +1,515 @@
+"""Configuration system.
+
+TPU-native re-design of the reference config stack
+(reference: src/neuronx_distributed_inference/models/config.py:81-1064):
+
+- :class:`TpuConfig` — the flat runtime/feature config (reference ``NeuronConfig``,
+  config.py:81-652). Every serving feature is a field here; validation of feature
+  interactions happens in ``__post_init__`` (reference scatters it through
+  ``NeuronConfig.__init__``).
+- :class:`InferenceConfig` — wraps a ``TpuConfig`` plus the HF model attributes,
+  with ``attribute_map`` aliasing and JSON round-trip
+  (reference config.py:716-909).
+- Sub-configs: :class:`OnDeviceSamplingConfig` (config.py:931),
+  :class:`FusedSpecConfig` (config.py:912), :class:`ChunkedPrefillConfig`
+  (config.py:944), :class:`MoETpuConfig` (config.py:665-713),
+  :class:`LoraServingConfig` (modules/lora_serving/config.py).
+
+Differences by design (TPU-first):
+- dtypes are jnp dtypes serialized as strings.
+- Parallel degrees map onto named ``jax.sharding.Mesh`` axes instead of process
+  groups; ``world_size`` is derived identically (config.py:353-355).
+- No compiler-flag strings: XLA options are set via jit/compilation-cache.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# dtype handling
+# ---------------------------------------------------------------------------
+
+def to_dtype(name_or_dtype) -> Any:
+    """Resolve a dtype name (or dtype) to a jnp dtype."""
+    if isinstance(name_or_dtype, str):
+        key = name_or_dtype.replace("torch.", "")
+        table = {
+            "float32": jnp.float32,
+            "fp32": jnp.float32,
+            "bfloat16": jnp.bfloat16,
+            "bf16": jnp.bfloat16,
+            "float16": jnp.float16,
+            "fp16": jnp.float16,
+            "int8": jnp.int8,
+            "fp8": jnp.float8_e4m3fn,
+            "float8_e4m3": jnp.float8_e4m3fn,
+            "float8_e5m2": jnp.float8_e5m2,
+        }
+        if key not in table:
+            raise ValueError(f"Unknown dtype name: {name_or_dtype}")
+        return table[key]
+    return name_or_dtype
+
+
+def dtype_name(dtype) -> str:
+    return jnp.dtype(dtype).name
+
+
+# ---------------------------------------------------------------------------
+# Sub-configs
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class OnDeviceSamplingConfig:
+    """On-device sampler settings (reference config.py:931-941)."""
+
+    do_sample: bool = False
+    top_k: int = 1
+    top_p: float = 1.0
+    temperature: float = 1.0
+    dynamic: bool = True  # per-request (top_k, top_p, temperature) tensor
+    global_topk: int = 256  # stage-1 topk width for distributed sampling
+    deterministic: bool = False
+    on_device_sampling: bool = True
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**{k: v for k, v in d.items() if k in _field_names(cls)})
+
+
+@dataclass
+class FusedSpecConfig:
+    """Fused speculation: draft + target compiled into one graph
+    (reference config.py:912-928, model_base.py:1656)."""
+
+    draft_model_name: str = ""
+    draft_config: Optional["InferenceConfig"] = None
+    worker_cls_name: str = ""
+
+    def to_dict(self):
+        d = {"draft_model_name": self.draft_model_name, "worker_cls_name": self.worker_cls_name}
+        if self.draft_config is not None:
+            d["draft_config"] = self.draft_config.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        draft = d.get("draft_config")
+        return cls(
+            draft_model_name=d.get("draft_model_name", ""),
+            draft_config=InferenceConfig.from_dict(draft) if draft else None,
+            worker_cls_name=d.get("worker_cls_name", ""),
+        )
+
+
+@dataclass
+class ChunkedPrefillConfig:
+    """Chunked prefill settings (reference config.py:944-959)."""
+
+    max_num_seqs: int = 8
+    tkg_model_enabled: bool = True
+    kernel_q_tile_size: int = 128
+    kernel_kv_tile_size: int = 512
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**{k: v for k, v in d.items() if k in _field_names(cls)})
+
+
+@dataclass
+class LoraServingConfig:
+    """Multi-adapter LoRA serving (reference modules/lora_serving/config.py)."""
+
+    max_loras: int = 1
+    max_lora_rank: int = 16
+    max_loras_on_cpu: int = 2
+    lora_ckpt_paths: Optional[Dict[str, str]] = None
+    lora_dtype: str = "bfloat16"
+    target_modules: Tuple[str, ...] = ("q_proj", "k_proj", "v_proj", "o_proj")
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["target_modules"] = list(self.target_modules)
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d)
+        if "target_modules" in d:
+            d["target_modules"] = tuple(d["target_modules"])
+        return cls(**{k: v for k, v in d.items() if k in _field_names(cls)})
+
+
+def _field_names(cls) -> set:
+    return {f.name for f in dataclasses.fields(cls)}
+
+
+# ---------------------------------------------------------------------------
+# TpuConfig (reference NeuronConfig)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TpuConfig:
+    """Flat runtime/feature config (reference NeuronConfig, config.py:81-652).
+
+    One instance per compiled sub-model; the application deep-copies and
+    specializes it per sub-model tag (reference model_base.py:3099-3222).
+    """
+
+    # --- core shapes -----------------------------------------------------
+    batch_size: int = 1
+    max_batch_size: Optional[int] = None  # defaults to batch_size
+    ctx_batch_size: Optional[int] = None
+    tkg_batch_size: Optional[int] = None
+    seq_len: int = 128
+    max_context_length: Optional[int] = None  # defaults to seq_len
+    n_active_tokens: Optional[int] = None  # tokens processed per step (CTE: bucket len)
+    max_new_tokens: Optional[int] = None
+    max_length: Optional[int] = None
+
+    # --- dtypes ----------------------------------------------------------
+    dtype: str = "bfloat16"  # compute/weight dtype
+    rpl_reduce_dtype: Optional[str] = None  # dtype for cross-shard reductions
+    cast_logits_fp32: bool = True
+    attention_softmax_fp32: bool = True
+
+    # --- bucketing (reference modules/autobucketing.py) ------------------
+    enable_bucketing: bool = True
+    buckets: Optional[List[int]] = None  # resolved at build
+    context_encoding_buckets: Optional[List[int]] = None
+    token_generation_buckets: Optional[List[int]] = None
+
+    # --- batching --------------------------------------------------------
+    is_continuous_batching: bool = False
+    padding_side: str = "right"
+
+    # --- sampling --------------------------------------------------------
+    on_device_sampling_config: Optional[OnDeviceSamplingConfig] = None
+    max_topk: int = 256
+    output_logits: bool = False
+
+    # --- KV cache --------------------------------------------------------
+    kv_cache_dtype: Optional[str] = None  # e.g. "fp8" for quantized KV
+    is_block_kv_layout: bool = False  # paged KV cache
+    pa_num_blocks: Optional[int] = None
+    pa_block_size: int = 16
+    is_prefix_caching: bool = False
+    is_chunked_prefill: bool = False
+    chunked_prefill_config: Optional[ChunkedPrefillConfig] = None
+    kv_cache_batch_size: Optional[int] = None
+    kv_cache_padding_size: int = 0
+
+    # --- attention -------------------------------------------------------
+    fused_qkv: bool = False
+    sliding_window: Optional[int] = None
+    attention_chunk_size: Optional[int] = None  # chunked attention (llama4)
+    flash_decoding_enabled: bool = False
+    num_cores_per_group: int = 1
+    attn_kernel_enabled: Optional[bool] = None  # None = auto (pallas flash attn on TPU)
+    attn_block_tkg_kernel_enabled: bool = False
+    k_cache_transposed: bool = False
+    qk_norm: bool = False
+
+    # --- speculation -----------------------------------------------------
+    speculation_length: int = 0
+    enable_fused_speculation: bool = False
+    enable_eagle_speculation: bool = False
+    enable_eagle_draft_input_norm: bool = False
+    is_eagle_target: bool = False
+    is_eagle_draft: bool = False
+    medusa_speculation_length: int = 0
+    num_medusa_heads: int = 0
+    token_tree_config: Optional[dict] = None
+
+    # --- parallelism (mesh axes; reference config.py:333-361) ------------
+    tp_degree: int = 1
+    cp_degree: int = 1  # context parallel (prefill attention)
+    attention_dp_degree: int = 1  # data parallel decode attention
+    pp_degree: int = 1
+    ep_degree: int = 1
+    moe_tp_degree: Optional[int] = None
+    moe_ep_degree: Optional[int] = None
+    start_rank_id: int = 0
+    local_ranks_size: Optional[int] = None
+    sequence_parallel_enabled: bool = False
+    vocab_parallel: bool = False
+    is_prefill_stage: Optional[bool] = None
+
+    # --- quantization ----------------------------------------------------
+    quantized: bool = False
+    quantization_type: str = "per_channel_symmetric"  # or per_tensor_symmetric, blockwise
+    quantization_dtype: str = "int8"
+    modules_to_not_convert: Optional[List[str]] = None
+
+    # --- LoRA ------------------------------------------------------------
+    lora_config: Optional[LoraServingConfig] = None
+
+    # --- misc ------------------------------------------------------------
+    seed: int = 0
+    async_mode: bool = False
+    weights_to_skip_layout_optimization: Optional[List[str]] = None
+    logical_nc_config: int = 1  # kept for config-surface parity; no-op on TPU
+    skip_warmup: bool = False
+    save_sharded_checkpoint: bool = False
+    compilation_cache_dir: Optional[str] = None
+    scratchpad_page_size: Optional[int] = None  # parity no-op
+
+    def __post_init__(self):
+        if self.max_batch_size is None:
+            self.max_batch_size = self.batch_size
+        if self.ctx_batch_size is None:
+            self.ctx_batch_size = self.max_batch_size
+        if self.tkg_batch_size is None:
+            self.tkg_batch_size = self.max_batch_size
+        if self.max_context_length is None:
+            self.max_context_length = self.seq_len
+        if self.max_length is None:
+            self.max_length = self.seq_len
+        if self.n_active_tokens is None:
+            self.n_active_tokens = self.seq_len
+        if self.moe_tp_degree is None:
+            self.moe_tp_degree = self.tp_degree // self.ep_degree if self.ep_degree > 1 else self.tp_degree
+        if self.moe_ep_degree is None:
+            self.moe_ep_degree = self.ep_degree
+        if self.local_ranks_size is None:
+            self.local_ranks_size = self.world_size
+        self.validate()
+
+    # world size identical to reference config.py:353-355
+    @property
+    def world_size(self) -> int:
+        return self.tp_degree * self.pp_degree * self.ep_degree
+
+    @property
+    def torch_dtype(self):  # name kept for API familiarity; returns jnp dtype
+        return to_dtype(self.dtype)
+
+    @property
+    def jax_dtype(self):
+        return to_dtype(self.dtype)
+
+    @property
+    def kv_dtype(self):
+        return to_dtype(self.kv_cache_dtype) if self.kv_cache_dtype else to_dtype(self.dtype)
+
+    def validate(self):
+        """Feature-interaction validation (reference config.py:567-594)."""
+        if self.attention_dp_degree > 1 and not self.is_continuous_batching:
+            raise ValueError("attention_dp_degree > 1 requires is_continuous_batching")
+        if self.attention_dp_degree > 1 and self.max_batch_size % self.attention_dp_degree != 0:
+            raise ValueError("batch size must divide evenly across attention DP ranks")
+        if self.cp_degree > 1 and self.tp_degree % self.cp_degree != 0:
+            raise ValueError("cp_degree must divide tp_degree (cp splits the tp group)")
+        if self.is_chunked_prefill and not self.is_block_kv_layout:
+            raise ValueError("chunked prefill requires block KV layout")
+        if self.is_prefix_caching and not self.is_block_kv_layout:
+            raise ValueError("prefix caching requires block KV layout")
+        if self.is_block_kv_layout and self.pa_num_blocks is None:
+            self.pa_num_blocks = max(
+                1, (self.max_batch_size * self.seq_len + self.pa_block_size - 1) // self.pa_block_size
+            )
+        if self.enable_eagle_speculation and not self.enable_fused_speculation:
+            raise ValueError("EAGLE speculation requires fused speculation")
+        if self.medusa_speculation_length and self.num_medusa_heads <= 0:
+            raise ValueError("medusa requires num_medusa_heads > 0")
+        if self.padding_side not in ("right", "left"):
+            raise ValueError("padding_side must be 'right' or 'left'")
+        if self.quantization_type not in (
+            "per_channel_symmetric",
+            "per_tensor_symmetric",
+            "blockwise",
+        ):
+            raise ValueError(f"unknown quantization_type {self.quantization_type}")
+
+    # --- serialization ---------------------------------------------------
+
+    def to_dict(self) -> dict:
+        d = {}
+        for f in dataclasses.fields(self):
+            v = getattr(self, f.name)
+            if v is None:
+                d[f.name] = None
+            elif hasattr(v, "to_dict"):
+                d[f.name] = v.to_dict()
+            else:
+                d[f.name] = v
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TpuConfig":
+        d = dict(d)
+        if d.get("on_device_sampling_config"):
+            d["on_device_sampling_config"] = OnDeviceSamplingConfig.from_dict(
+                d["on_device_sampling_config"]
+            )
+        if d.get("chunked_prefill_config"):
+            d["chunked_prefill_config"] = ChunkedPrefillConfig.from_dict(d["chunked_prefill_config"])
+        if d.get("lora_config"):
+            d["lora_config"] = LoraServingConfig.from_dict(d["lora_config"])
+        known = _field_names(cls)
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+
+@dataclass
+class MoETpuConfig(TpuConfig):
+    """MoE extras (reference MoENeuronConfig, config.py:665-713)."""
+
+    capacity_factor: Optional[float] = None  # None = dropless
+    glu_mlp: bool = True
+    glu_type: str = "glu"
+    hidden_act_scaling_factor: float = 1.0
+    hidden_act_bias: float = 0.0
+    normalize_top_k_affinities: bool = True
+    early_expert_affinity_modulation: bool = False
+    fused_shared_experts: bool = False
+    router_dtype: str = "float32"
+    moe_fused_kernel_enabled: Optional[bool] = None
+    hybrid_sharding_config: Optional[dict] = None
+    blockwise_matmul_block_size: int = 128
+
+
+# ---------------------------------------------------------------------------
+# InferenceConfig
+# ---------------------------------------------------------------------------
+
+CONFIG_FILE = "tpu_config.json"  # reference: neuron_config.json (config.py:22)
+
+
+class InferenceConfig:
+    """TpuConfig + HF model attributes (reference config.py:716-909).
+
+    Model attributes (hidden_size, num_attention_heads, ...) live as instance
+    attributes; ``attribute_map`` aliases alternate names onto canonical ones
+    (reference config.py:736-758). JSON round-trip embeds the class path so a
+    saved artifact reloads the right subclass (reference config.py:823-905).
+    """
+
+    # subclasses may list attrs that must exist post-init
+    _REQUIRED_ATTRS: Tuple[str, ...] = ()
+
+    def __init__(self, tpu_config: TpuConfig, load_config=None, metadata: dict = None, **kwargs):
+        self.tpu_config = tpu_config
+        self.attribute_map: Dict[str, str] = {}
+        self.metadata = metadata or {}
+        if load_config is not None:
+            load_config(self)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self.add_derived_config()
+        self.validate_config()
+
+    # alias for reference-API familiarity
+    @property
+    def neuron_config(self) -> TpuConfig:
+        return self.tpu_config
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        amap = self.__dict__.get("attribute_map", {})
+        if name in amap:
+            return getattr(self, amap[name])
+        raise AttributeError(f"{type(self).__name__} has no attribute {name!r}")
+
+    def __setattr__(self, name, value):
+        amap = self.__dict__.get("attribute_map", {})
+        if name in amap:
+            super().__setattr__(amap[name], value)
+        else:
+            super().__setattr__(name, value)
+
+    def add_derived_config(self):
+        """Hook for model plugins to derive attrs (reference modeling_llama.py:311)."""
+
+    def get_required_attributes(self) -> Tuple[str, ...]:
+        return self._REQUIRED_ATTRS
+
+    def validate_config(self):
+        missing = [a for a in self.get_required_attributes() if not hasattr(self, a)]
+        if missing:
+            raise ValueError(f"Config missing required attributes: {missing}")
+
+    # --- serialization ---------------------------------------------------
+
+    def to_dict(self) -> dict:
+        d = {}
+        for k, v in self.__dict__.items():
+            if k in ("tpu_config", "attribute_map", "metadata"):
+                continue
+            if hasattr(v, "to_dict"):
+                d[k] = v.to_dict()
+            elif _json_safe(v):
+                d[k] = v
+        d["tpu_config"] = self.tpu_config.to_dict()
+        d["_config_class"] = {"module": type(self).__module__, "name": type(self).__name__}
+        if isinstance(self.tpu_config, MoETpuConfig):
+            d["tpu_config"]["_moe"] = True
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "InferenceConfig":
+        d = dict(d)
+        cls_info = d.pop("_config_class", None)
+        config_cls = cls
+        if cls_info:
+            try:
+                import importlib
+
+                mod = importlib.import_module(cls_info["module"])
+                config_cls = getattr(mod, cls_info["name"])
+            except Exception:
+                config_cls = cls
+        tc = d.pop("tpu_config", {})
+        moe = tc.pop("_moe", False) if isinstance(tc, dict) else False
+        tpu_config = (MoETpuConfig if moe else TpuConfig).from_dict(tc)
+        obj = config_cls.__new__(config_cls)
+        obj.tpu_config = tpu_config
+        obj.attribute_map = {}
+        obj.metadata = {}
+        for k, v in d.items():
+            if isinstance(v, dict) and "_config_class" in v:
+                v = InferenceConfig.from_dict(v)
+            setattr(obj, k, v)
+        return obj
+
+    def save(self, path: str):
+        """Save next to the compiled artifact (reference application_base.py:299)."""
+        os.makedirs(path, exist_ok=True)
+        with open(os.path.join(path, CONFIG_FILE), "w") as f:
+            json.dump(self.to_dict(), f, indent=2, default=_default_json)
+
+    @classmethod
+    def load(cls, path: str) -> "InferenceConfig":
+        fname = path if path.endswith(".json") else os.path.join(path, CONFIG_FILE)
+        with open(fname) as f:
+            return cls.from_dict(json.load(f))
+
+
+def _json_safe(v) -> bool:
+    if isinstance(v, (str, int, float, bool, type(None))):
+        return True
+    if isinstance(v, (list, tuple)):
+        return all(_json_safe(x) for x in v)
+    if isinstance(v, dict):
+        return all(isinstance(k, str) and _json_safe(x) for k, x in v.items())
+    return False
+
+
+def _default_json(v):
+    if hasattr(v, "to_dict"):
+        return v.to_dict()
+    return str(v)

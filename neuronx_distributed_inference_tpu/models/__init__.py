@@ -1,0 +1,11 @@
+"""Model hub. Each model plugin registers a builder keyed by HF model_type
+(reference: utils/constants.py:42-53 model-type registry)."""
+
+from neuronx_distributed_inference_tpu.models.registry import (  # noqa: F401
+    MODEL_REGISTRY,
+    get_model_builder,
+    register_model,
+)
+
+# import plugins so they self-register
+from neuronx_distributed_inference_tpu.models import llama  # noqa: F401

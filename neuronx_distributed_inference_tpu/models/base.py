@@ -1,0 +1,277 @@
+"""The traced decoder core.
+
+TPU-native re-design of ``NeuronBaseModel.forward``
+(reference: models/model_base.py:86-1653) — the ONE function that is compiled
+per (sub-model tag, bucket). Here it is a pure function over pytrees:
+
+    forward(params, cache, inputs, rng) -> StepOutput(tokens, logits?, cache)
+
+specialized by a static :class:`ModelSpec` + phase. Layers run under
+``lax.scan`` over stacked layer params (instead of the reference's unrolled
+python loop) — one compiled layer body, fast XLA compiles, same math.
+
+Phases (reference sub-model tags, model_wrapper.py:32-37):
+- ``context_encoding``: S = context bucket; causal mask; writes KV at
+  position_ids; gathers the last valid token's hidden state for the lm head
+  (reference model_base.py:1038-1060).
+- ``token_generation``: S = 1 (or speculation_length); attends the populated
+  cache region sliced to the TKG bucket.
+
+The KV cache is donated by the runner so XLA updates it in place
+(reference input/output aliasing, model_wrapper.py:1673-1743).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_inference_tpu.modules import masks
+from neuronx_distributed_inference_tpu.modules.attention import (
+    AttnSpec,
+    attention_decode,
+    attention_prefill,
+    o_project,
+    qkv_project,
+)
+from neuronx_distributed_inference_tpu.modules.kvcache import (
+    KVCache,
+    read_layer_cache,
+    slot_ids_from_seq_ids,
+    update_layer_cache,
+)
+from neuronx_distributed_inference_tpu.modules.norm import rms_norm
+from neuronx_distributed_inference_tpu.modules.rope import rope_cos_sin
+from neuronx_distributed_inference_tpu.modules.sampling import (
+    mask_padded_logits,
+    sample_tokens,
+)
+
+PHASE_CONTEXT_ENCODING = "context_encoding"
+PHASE_TOKEN_GENERATION = "token_generation"
+PHASE_SPECULATION = "speculation"
+
+
+@dataclass(frozen=True)
+class ModelSpec:
+    """Static model hyperparams (global, post-GQA-transform head counts)."""
+
+    num_layers: int
+    hidden_size: int
+    vocab_size: int
+    padded_vocab_size: int
+    intermediate_size: int
+    attn: AttnSpec
+    rms_eps: float = 1e-6
+    act: str = "silu"
+    tie_word_embeddings: bool = False
+    # attention flavor
+    sliding_window: Optional[int] = None
+    attention_chunk_size: Optional[int] = None
+    # sampling
+    on_device_sampling: bool = True
+    do_sample: bool = False
+    max_topk: int = 256
+    output_logits: bool = False
+    cast_logits_fp32: bool = True
+    # rope
+    attention_scaling: float = 1.0
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class StepInputs:
+    """Per-step device inputs (reference forward args, model_base.py:3373)."""
+
+    input_ids: jax.Array  # (B, S) int32
+    attention_mask: jax.Array  # CTE: (B, S); TKG: (B, S_bucket) cache-valid mask
+    position_ids: jax.Array  # (B, S) int32
+    seq_ids: jax.Array  # (B,) int32 cache-line ids (invalid -> garbage)
+    sampling_params: jax.Array  # (B, 3) float32
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class StepOutput:
+    tokens: jax.Array  # (B, K) int32
+    logits: Optional[jax.Array]  # (B, K, V) or None
+    cache: KVCache
+
+
+def act_fn(name: str) -> Callable:
+    return {
+        "silu": jax.nn.silu,
+        "gelu": jax.nn.gelu,
+        "gelu_pytorch_tanh": partial(jax.nn.gelu, approximate=True),
+        "relu": jax.nn.relu,
+    }[name]
+
+
+def gated_mlp(params: dict, hidden: jax.Array, spec: ModelSpec) -> jax.Array:
+    """SwiGLU MLP (reference NeuronLlamaMLP, modeling_llama.py:338-971)."""
+    act = act_fn(spec.act)
+    gate = act(hidden @ params["gate_proj"]["weight"])
+    up = hidden @ params["up_proj"]["weight"]
+    return (gate * up) @ params["down_proj"]["weight"]
+
+
+def decoder_layer(
+    layer_params: dict,
+    hidden: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    k_cache_l: jax.Array,
+    v_cache_l: jax.Array,
+    mask: jax.Array,
+    slot_ids: jax.Array,
+    positions: jax.Array,
+    spec: ModelSpec,
+    phase: str,
+    mlp_fn: Callable,
+    key_valid: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decoder layer (reference NeuronLlamaDecoderLayer, modeling_llama.py:1188).
+
+    Returns (hidden, k_cache_l, v_cache_l) with the cache slice updated.
+    """
+    aspec = spec.attn
+    residual = hidden
+    hidden = rms_norm(hidden, layer_params["input_layernorm"]["weight"], spec.rms_eps)
+    q, k, v = qkv_project(layer_params["self_attn"], hidden, cos, sin, aspec)
+
+    # write-then-attend: scatter new KV into this layer's cache first
+    # (reference updates via kv_mgr.update_cache per layer, model_base.py:1449)
+    k_cache_l, v_cache_l = update_layer_cache(k_cache_l, v_cache_l, k, v, slot_ids, positions)
+
+    sink = layer_params["self_attn"].get("sink", {}).get("weight") if aspec.has_sink else None
+    if phase == PHASE_CONTEXT_ENCODING:
+        attn_out = attention_prefill(q, k, v, mask, aspec, sink=sink, key_valid=key_valid)
+    else:
+        B = q.shape[0]
+        bucket = mask.shape[-1]
+        k_r, v_r = read_layer_cache(k_cache_l, v_cache_l, B, bucket)
+        attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
+
+    hidden = o_project(layer_params["self_attn"], attn_out, aspec)
+    hidden = residual + hidden
+
+    residual = hidden
+    hidden = rms_norm(hidden, layer_params["post_attention_layernorm"]["weight"], spec.rms_eps)
+    hidden = residual + mlp_fn(layer_params["mlp"], hidden, spec)
+    return hidden, k_cache_l, v_cache_l
+
+
+def build_mask(inputs: StepInputs, spec: ModelSpec, phase: str) -> jax.Array:
+    """Mask dispatch per attention flavor/phase (reference model_base.py:211-449)."""
+    n_active = inputs.input_ids.shape[1]
+    if phase == PHASE_CONTEXT_ENCODING:
+        if spec.attention_chunk_size:
+            return masks.chunked_mask(
+                inputs.attention_mask, inputs.position_ids, spec.attention_chunk_size
+            )
+        if spec.sliding_window:
+            return masks.windowed_mask(
+                inputs.attention_mask, inputs.position_ids, spec.sliding_window
+            )
+        return masks.causal_mask(inputs.attention_mask)
+    # token generation: base cache-validity mask, then attention-flavor bounds
+    if n_active > 1:  # speculation: multi-token decode
+        mask = masks.spec_token_gen_mask(inputs.attention_mask, inputs.position_ids)
+    else:
+        mask = masks.token_gen_mask(inputs.attention_mask, n_active)
+    cols = jnp.arange(mask.shape[-1])[None, None, None, :]
+    pos = inputs.position_ids[:, None, :, None]  # (B, 1, K, 1)
+    if spec.sliding_window:
+        # decode attends only (pos - window, pos] (reference windowed TKG mask,
+        # model_base.py:319-340)
+        mask = mask & (cols > pos - spec.sliding_window)
+    if spec.attention_chunk_size:
+        # chunked attention: same-chunk positions only (reference
+        # model_base.py:304-318 chunked TKG mask)
+        mask = mask & ((cols // spec.attention_chunk_size) == (pos // spec.attention_chunk_size))
+    return mask
+
+
+def embed(params: dict, input_ids: jax.Array) -> jax.Array:
+    return jnp.take(params["embed_tokens"]["weight"], input_ids, axis=0)
+
+
+def lm_head(params: dict, hidden: jax.Array, spec: ModelSpec) -> jax.Array:
+    w = params["lm_head"]["weight"] if "lm_head" in params else params["embed_tokens"]["weight"].T
+    logits = hidden @ w
+    if spec.cast_logits_fp32:
+        logits = logits.astype(jnp.float32)
+    return mask_padded_logits(logits, spec.vocab_size)
+
+
+def gather_last_token(hidden: jax.Array, attention_mask: jax.Array) -> jax.Array:
+    """(B, S, H) -> (B, 1, H) at the last valid position per row
+    (reference last-token gather, model_base.py:1038-1060)."""
+    idx = jnp.maximum(jnp.sum(attention_mask.astype(jnp.int32), axis=1) - 1, 0)
+    return jnp.take_along_axis(hidden, idx[:, None, None], axis=1)
+
+
+def forward(
+    params: dict,
+    cache: KVCache,
+    inputs: StepInputs,
+    rng: Optional[jax.Array],
+    *,
+    spec: ModelSpec,
+    phase: str,
+    mlp_fn: Callable = gated_mlp,
+) -> StepOutput:
+    """The traced step function (reference NeuronBaseModel.forward, model_base.py:732)."""
+    hidden = embed(params, inputs.input_ids)
+
+    inv_freq = params["rope"]["inv_freq"]
+    cos, sin = rope_cos_sin(inputs.position_ids, inv_freq, spec.attention_scaling)
+
+    mask = build_mask(inputs, spec, phase)
+    slot_ids = slot_ids_from_seq_ids(inputs.seq_ids, cache.batch_size)
+    positions = inputs.position_ids
+    # plain-causal prefill exposes key validity so the flash kernel can run
+    key_valid = None
+    if (
+        phase == PHASE_CONTEXT_ENCODING
+        and not spec.sliding_window
+        and not spec.attention_chunk_size
+    ):
+        key_valid = inputs.attention_mask
+
+    def scan_body(h, xs):
+        layer_params, k_l, v_l = xs
+        h, k_l, v_l = decoder_layer(
+            layer_params, h, cos, sin, k_l, v_l, mask, slot_ids, positions, spec, phase,
+            mlp_fn, key_valid=key_valid,
+        )
+        return h, (k_l, v_l)
+
+    hidden, (new_k, new_v) = jax.lax.scan(scan_body, hidden, (params["layers"], cache.k, cache.v))
+    new_cache = KVCache(k=new_k, v=new_v)
+
+    hidden = rms_norm(hidden, params["norm"]["weight"], spec.rms_eps)
+
+    if phase == PHASE_CONTEXT_ENCODING:
+        hidden = gather_last_token(hidden, inputs.attention_mask)
+    # TKG: all n_active positions produce logits
+
+    logits = lm_head(params, hidden, spec)  # (B, K, V_padded)
+
+    if spec.on_device_sampling:
+        tokens = sample_tokens(
+            logits[..., : spec.vocab_size],
+            inputs.sampling_params,
+            rng if spec.do_sample else None,
+            spec.max_topk,
+            spec.do_sample,
+        )
+    else:
+        tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    out_logits = logits[..., : spec.vocab_size] if spec.output_logits else None
+    return StepOutput(tokens=tokens, logits=out_logits, cache=new_cache)

@@ -1,0 +1,312 @@
+"""Generic decoder model builder: HF config/checkpoint -> (ModelSpec, params, shardings).
+
+Plays the role of the reference's per-model ``NeuronXxxForCausalLM`` +
+state-dict conversion hooks (reference: modeling_llama.py:1441-1505
+``convert_hf_to_neuron_state_dict``; gqa.py preshard hooks :159-266).
+
+A builder knows how to:
+- derive a :class:`~..models.base.ModelSpec` from an InferenceConfig + model
+  parallel degree (GQA head padding/replication accounting),
+- convert an HF state dict (numpy arrays) into the stacked-layer param pytree,
+- produce the matching PartitionSpec tree for GSPMD sharding,
+- randomly initialize params for tests (reference test harness random
+  checkpoints, utils/testing.py:292).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig, to_dtype
+from neuronx_distributed_inference_tpu.models.base import ModelSpec
+from neuronx_distributed_inference_tpu.modules.attention import AttnSpec
+from neuronx_distributed_inference_tpu.modules.rope import (
+    compute_inv_freq,
+    rope_attention_scaling,
+)
+from neuronx_distributed_inference_tpu.parallel.sharding import GQASharding, TENSOR
+
+
+def pad_vocab(vocab_size: int, degree: int) -> int:
+    return math.ceil(vocab_size / degree) * degree
+
+
+class DecoderModelBuilder:
+    """Base builder for llama-family decoder-only models."""
+
+    qkv_bias = False
+    o_bias = False
+    qk_norm = False
+
+    def __init__(self, config: InferenceConfig):
+        self.config = config
+        tc = config.tpu_config
+        self.degree = tc.tp_degree * tc.ep_degree  # full model-parallel degree
+        hf = config
+        self.head_dim = getattr(hf, "head_dim", None) or hf.hidden_size // hf.num_attention_heads
+        self.gqa = GQASharding(
+            hf.num_attention_heads,
+            getattr(hf, "num_key_value_heads", hf.num_attention_heads),
+            self.degree,
+        )
+        self.padded_vocab = pad_vocab(hf.vocab_size, self.degree)
+
+    # ---- spec ------------------------------------------------------------
+
+    def attn_spec(self) -> AttnSpec:
+        tc = self.config.tpu_config
+        return AttnSpec(
+            num_heads=self.gqa.q_heads,
+            num_kv_heads=self.gqa.kv_heads,
+            head_dim=self.head_dim,
+            scale=getattr(self.config, "attention_scale", None),
+            qk_norm=self.qk_norm or tc.qk_norm,
+            qkv_bias=self.qkv_bias,
+            o_bias=self.o_bias,
+            softmax_fp32=tc.attention_softmax_fp32,
+            has_sink=bool(getattr(self.config, "attention_sink", False)),
+            rms_norm_eps=getattr(self.config, "rms_norm_eps", 1e-6),
+            use_flash_kernel=tc.attn_kernel_enabled,
+        )
+
+    def model_spec(self) -> ModelSpec:
+        cfg = self.config
+        tc = cfg.tpu_config
+        ods = tc.on_device_sampling_config
+        return ModelSpec(
+            num_layers=cfg.num_hidden_layers,
+            hidden_size=cfg.hidden_size,
+            vocab_size=cfg.vocab_size,
+            padded_vocab_size=self.padded_vocab,
+            intermediate_size=cfg.intermediate_size,
+            attn=self.attn_spec(),
+            rms_eps=getattr(cfg, "rms_norm_eps", 1e-6),
+            act=getattr(cfg, "hidden_act", "silu"),
+            tie_word_embeddings=getattr(cfg, "tie_word_embeddings", False),
+            sliding_window=tc.sliding_window,
+            attention_chunk_size=tc.attention_chunk_size,
+            on_device_sampling=ods is not None,
+            do_sample=bool(ods and ods.do_sample),
+            max_topk=tc.max_topk,
+            output_logits=tc.output_logits,
+            cast_logits_fp32=tc.cast_logits_fp32,
+            attention_scaling=rope_attention_scaling(cfg),
+        )
+
+    # ---- param pytree ----------------------------------------------------
+
+    def param_shapes(self) -> Dict:
+        cfg = self.config
+        L, H, I = cfg.num_hidden_layers, cfg.hidden_size, cfg.intermediate_size
+        D = self.head_dim
+        Hq, Hkv = self.gqa.q_heads, self.gqa.kv_heads
+        V = self.padded_vocab
+        shapes = {
+            "embed_tokens": {"weight": (V, H)},
+            "rope": {"inv_freq": (D // 2,)},
+            "layers": {
+                "input_layernorm": {"weight": (L, H)},
+                "post_attention_layernorm": {"weight": (L, H)},
+                "self_attn": {
+                    "q_proj": {"weight": (L, H, Hq * D)},
+                    "k_proj": {"weight": (L, H, Hkv * D)},
+                    "v_proj": {"weight": (L, H, Hkv * D)},
+                    "o_proj": {"weight": (L, Hq * D, H)},
+                },
+                "mlp": {
+                    "gate_proj": {"weight": (L, H, I)},
+                    "up_proj": {"weight": (L, H, I)},
+                    "down_proj": {"weight": (L, I, H)},
+                },
+            },
+            "norm": {"weight": (H,)},
+        }
+        if self.qkv_bias:
+            for p in ("q_proj", "k_proj", "v_proj"):
+                n = Hq if p == "q_proj" else Hkv
+                shapes["layers"]["self_attn"][p]["bias"] = (L, n * D)
+        if self.qk_norm:
+            shapes["layers"]["self_attn"]["q_norm"] = {"weight": (L, D)}
+            shapes["layers"]["self_attn"]["k_norm"] = {"weight": (L, D)}
+        if not getattr(self.config, "tie_word_embeddings", False):
+            shapes["lm_head"] = {"weight": (H, V)}
+        return shapes
+
+    def param_pspecs(self) -> Dict:
+        """PartitionSpec tree matching :meth:`param_shapes`.
+
+        Replaces the reference's Column/RowParallelLinear + ParallelEmbedding
+        rank slicing (gqa.py:344,1151; modeling_llama.py:30-34).
+        """
+        t = TENSOR
+        specs = {
+            "embed_tokens": {"weight": P(t, None)},  # vocab-sharded embedding
+            "rope": {"inv_freq": P()},
+            "layers": {
+                "input_layernorm": {"weight": P()},
+                "post_attention_layernorm": {"weight": P()},
+                "self_attn": {
+                    "q_proj": {"weight": P(None, None, t)},  # column parallel
+                    "k_proj": {"weight": P(None, None, t)},
+                    "v_proj": {"weight": P(None, None, t)},
+                    "o_proj": {"weight": P(None, t, None)},  # row parallel
+                },
+                "mlp": {
+                    "gate_proj": {"weight": P(None, None, t)},
+                    "up_proj": {"weight": P(None, None, t)},
+                    "down_proj": {"weight": P(None, t, None)},
+                },
+            },
+            "norm": {"weight": P()},
+        }
+        if self.qkv_bias:
+            for p in ("q_proj", "k_proj", "v_proj"):
+                specs["layers"]["self_attn"][p]["bias"] = P(None, t)
+        if self.qk_norm:
+            specs["layers"]["self_attn"]["q_norm"] = {"weight": P()}
+            specs["layers"]["self_attn"]["k_norm"] = {"weight": P()}
+        if "lm_head" in self.param_shapes():
+            specs["lm_head"] = {"weight": P(None, t)}  # column parallel lm head
+        return specs
+
+    # ---- weights ---------------------------------------------------------
+
+    def random_params(self, key: Optional[jax.Array] = None, dtype=None) -> Dict:
+        """Random init for tests (reference utils/testing.py:292)."""
+        dtype = dtype or to_dtype(self.config.tpu_config.dtype)
+        key = key if key is not None else jax.random.PRNGKey(self.config.tpu_config.seed)
+        shapes = self.param_shapes()
+        leaves, treedef = jax.tree.flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
+        keys = jax.random.split(key, len(leaves))
+        vals = [
+            (0.02 * jax.random.normal(k, s)).astype(dtype) for k, s in zip(keys, leaves)
+        ]
+        params = jax.tree.unflatten(treedef, vals)
+        params["rope"]["inv_freq"] = compute_inv_freq(self.config)
+        # norms init to 1
+        params["layers"]["input_layernorm"]["weight"] = jnp.ones_like(
+            params["layers"]["input_layernorm"]["weight"]
+        )
+        params["layers"]["post_attention_layernorm"]["weight"] = jnp.ones_like(
+            params["layers"]["post_attention_layernorm"]["weight"]
+        )
+        params["norm"]["weight"] = jnp.ones_like(params["norm"]["weight"])
+        if self.qk_norm:
+            params["layers"]["self_attn"]["q_norm"]["weight"] = jnp.ones_like(
+                params["layers"]["self_attn"]["q_norm"]["weight"]
+            )
+            params["layers"]["self_attn"]["k_norm"]["weight"] = jnp.ones_like(
+                params["layers"]["self_attn"]["k_norm"]["weight"]
+            )
+        return params
+
+    # HF param name templates; subclasses override if the arch differs
+    HF_LAYER_PREFIX = "model.layers.{i}."
+    HF_EMBED = "model.embed_tokens.weight"
+    HF_NORM = "model.norm.weight"
+    HF_LM_HEAD = "lm_head.weight"
+
+    def convert_hf_state_dict(self, sd: Dict[str, np.ndarray], dtype=None) -> Dict:
+        """HF checkpoint -> stacked param pytree with GQA/vocab transforms.
+
+        Reference: convert_hf_to_neuron_state_dict + GQA preshard hooks
+        (modeling_llama.py:1441-1505, gqa.py:159-266).
+        """
+        cfg = self.config
+        dtype = dtype or to_dtype(cfg.tpu_config.dtype)
+        L = cfg.num_hidden_layers
+        D = self.head_dim
+        g = self.gqa
+
+        def get(name):
+            if name not in sd:
+                raise KeyError(f"missing HF weight {name}; have e.g. {list(sd)[:5]}")
+            return np.asarray(sd[name])
+
+        def linear_t(name):  # HF (out, in) -> (in, out)
+            return get(name).T
+
+        def stack(fn):
+            return jnp.asarray(
+                np.stack([fn(self.HF_LAYER_PREFIX.format(i=i)) for i in range(L)]), dtype
+            )
+
+        embed = get(self.HF_EMBED)
+        vpad = self.padded_vocab - embed.shape[0]
+        if vpad:
+            embed = np.pad(embed, ((0, vpad), (0, 0)))
+
+        params = {
+            "embed_tokens": {"weight": jnp.asarray(embed, dtype)},
+            "rope": {"inv_freq": compute_inv_freq(cfg)},
+            "layers": {
+                "input_layernorm": {
+                    "weight": stack(lambda p: get(p + "input_layernorm.weight"))
+                },
+                "post_attention_layernorm": {
+                    "weight": stack(lambda p: get(p + "post_attention_layernorm.weight"))
+                },
+                "self_attn": {
+                    "q_proj": {
+                        "weight": stack(
+                            lambda p: g.pad_q(linear_t(p + "self_attn.q_proj.weight"), D)
+                        )
+                    },
+                    "k_proj": {
+                        "weight": stack(
+                            lambda p: g.replicate_kv(linear_t(p + "self_attn.k_proj.weight"), D)
+                        )
+                    },
+                    "v_proj": {
+                        "weight": stack(
+                            lambda p: g.replicate_kv(linear_t(p + "self_attn.v_proj.weight"), D)
+                        )
+                    },
+                    "o_proj": {
+                        "weight": stack(
+                            lambda p: g.pad_o(linear_t(p + "self_attn.o_proj.weight"), D)
+                        )
+                    },
+                },
+                "mlp": {
+                    "gate_proj": {"weight": stack(lambda p: linear_t(p + "mlp.gate_proj.weight"))},
+                    "up_proj": {"weight": stack(lambda p: linear_t(p + "mlp.up_proj.weight"))},
+                    "down_proj": {"weight": stack(lambda p: linear_t(p + "mlp.down_proj.weight"))},
+                },
+            },
+            "norm": {"weight": jnp.asarray(get(self.HF_NORM), dtype)},
+        }
+        if self.qkv_bias:
+            for p, rep in (("q_proj", False), ("k_proj", True), ("v_proj", True)):
+                def bias_fn(pre, p=p, rep=rep):
+                    b = get(pre + f"self_attn.{p}.bias")
+                    if rep:
+                        b = np.asarray(g.replicate_kv(b, D))
+                    else:
+                        b = np.asarray(g.pad_q(b, D))
+                    return b
+                params["layers"]["self_attn"][p]["bias"] = stack(bias_fn)
+        if self.qk_norm:
+            params["layers"]["self_attn"]["q_norm"] = {
+                "weight": stack(lambda p: get(p + "self_attn.q_norm.weight"))
+            }
+            params["layers"]["self_attn"]["k_norm"] = {
+                "weight": stack(lambda p: get(p + "self_attn.k_norm.weight"))
+            }
+        if not getattr(cfg, "tie_word_embeddings", False):
+            lm = linear_t(self.HF_LM_HEAD) if self.HF_LM_HEAD in sd else get(self.HF_EMBED).T
+            if vpad:
+                lm = np.pad(lm, ((0, 0), (0, vpad)))
+            params["lm_head"] = {"weight": jnp.asarray(lm, dtype)}
+        return params
+
+    def mlp_fn(self):
+        from neuronx_distributed_inference_tpu.models.base import gated_mlp
+
+        return gated_mlp

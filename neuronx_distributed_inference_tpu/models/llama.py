@@ -1,0 +1,46 @@
+"""Llama model plugin (Llama 2 / 3.x families).
+
+Reference: models/llama/modeling_llama.py — the canonical model plugin.
+On TPU the plugin is just a builder: config mapping + weight conversion; the
+compute graph is the shared decoder core (models/base.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.builder import DecoderModelBuilder
+from neuronx_distributed_inference_tpu.models.registry import register_model
+
+
+class LlamaInferenceConfig(InferenceConfig):
+    """Reference: LlamaInferenceConfig (modeling_llama.py:305-335)."""
+
+    _REQUIRED_ATTRS = (
+        "hidden_size",
+        "num_attention_heads",
+        "num_hidden_layers",
+        "num_key_value_heads",
+        "vocab_size",
+        "intermediate_size",
+    )
+
+
+@register_model("llama")
+class LlamaModelBuilder(DecoderModelBuilder):
+    config_cls = LlamaInferenceConfig
+
+
+@register_model("mistral")
+class MistralModelBuilder(DecoderModelBuilder):
+    """Mistral shares the llama graph with sliding-window attention."""
+
+    config_cls = LlamaInferenceConfig
+
+    def model_spec(self):
+        spec = super().model_spec()
+        sw = getattr(self.config, "sliding_window", None)
+        if sw and spec.sliding_window is None:
+            spec = dataclasses.replace(spec, sliding_window=sw)
+        return spec

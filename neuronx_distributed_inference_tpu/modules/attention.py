@@ -1,0 +1,181 @@
+"""Functional attention core — the single attention implementation all models use.
+
+TPU-native re-design of the reference attention stack
+(reference: modules/attention/attention_base.py — NeuronAttentionBase).
+
+Structure:
+- :func:`qkv_project` / :func:`o_project` — projections (+ optional bias,
+  QK-norm pre/post RoPE). The head dims are GLOBAL (padded/replicated by
+  :class:`~..parallel.sharding.GQASharding` at load time) and sharded over the
+  model mesh axes by GSPMD — replacing GroupQueryAttention_QKV/O (gqa.py:344,1151).
+- :func:`attention_prefill` — context-encoding attention. Dispatches to the
+  Pallas flash kernel on TPU or a native masked-softmax path elsewhere
+  (reference get_flash_attention_strategy / perform_prefill,
+  attention_base.py:1314,720).
+- :func:`attention_decode` — token-gen attention over the populated cache
+  (reference compute_for_token_gen, attention_base.py:1909). The cache is
+  updated first, then attended with a position mask — numerically identical
+  to the reference's prior/active decomposition but a single fused softmax.
+- Learned attention sinks (GPT-OSS) supported in both phases
+  (reference attention_base.py:879-889,1964-1980).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_inference_tpu.modules.norm import rms_norm
+from neuronx_distributed_inference_tpu.modules.rope import apply_rope
+
+
+@dataclass(frozen=True)
+class AttnSpec:
+    """Static attention hyperparams (global, post-GQA-padding counts)."""
+
+    num_heads: int
+    num_kv_heads: int
+    head_dim: int
+    scale: Optional[float] = None
+    qk_norm: bool = False  # rmsnorm on per-head q/k before rope (qwen3)
+    qkv_bias: bool = False
+    o_bias: bool = False
+    softmax_fp32: bool = True
+    has_sink: bool = False
+    rms_norm_eps: float = 1e-6
+    use_flash_kernel: Optional[bool] = None  # None = auto by platform
+
+    @property
+    def softmax_scale(self) -> float:
+        return self.scale if self.scale is not None else self.head_dim**-0.5
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """(B, S, H_kv, D) -> (B, S, H_kv*n_rep, D) (reference utils.py:210)."""
+    if n_rep == 1:
+        return x
+    b, s, h, d = x.shape
+    x = jnp.broadcast_to(x[:, :, :, None, :], (b, s, h, n_rep, d))
+    return x.reshape(b, s, h * n_rep, d)
+
+
+def qkv_project(
+    params: dict,
+    hidden: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    spec: AttnSpec,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """hidden (B,S,H) -> q (B,S,Hq,D), k,v (B,S,Hkv,D), with RoPE applied.
+
+    Reference: prep_qkv_tensors (attention_base.py:555-629).
+    """
+    B, S, _ = hidden.shape
+    q = hidden @ params["q_proj"]["weight"]
+    k = hidden @ params["k_proj"]["weight"]
+    v = hidden @ params["v_proj"]["weight"]
+    if spec.qkv_bias:
+        q = q + params["q_proj"]["bias"]
+        k = k + params["k_proj"]["bias"]
+        v = v + params["v_proj"]["bias"]
+    q = q.reshape(B, S, spec.num_heads, spec.head_dim)
+    k = k.reshape(B, S, spec.num_kv_heads, spec.head_dim)
+    v = v.reshape(B, S, spec.num_kv_heads, spec.head_dim)
+    if spec.qk_norm:  # per-head rmsnorm before rope (reference qwen3, qk norm)
+        q = rms_norm(q, params["q_norm"]["weight"], spec.rms_norm_eps)
+        k = rms_norm(k, params["k_norm"]["weight"], spec.rms_norm_eps)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    return q, k, v
+
+
+def o_project(params: dict, attn_out: jnp.ndarray, spec: AttnSpec) -> jnp.ndarray:
+    """(B,S,Hq,D) -> (B,S,H). Reference: GroupQueryAttention_O (gqa.py:1151)."""
+    B, S, Hq, D = attn_out.shape
+    out = attn_out.reshape(B, S, Hq * D) @ params["o_proj"]["weight"]
+    if spec.o_bias:
+        out = out + params["o_proj"]["bias"]
+    return out
+
+
+def _masked_softmax_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: jnp.ndarray,
+    spec: AttnSpec,
+    sink: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Native attention: q (B,Sq,Hq,D), k/v (B,Sk,Hq,D), mask (B,1,Sq,Sk)."""
+    dtype = jnp.float32 if spec.softmax_fp32 else q.dtype
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * spec.softmax_scale
+    scores = jnp.where(mask, scores.astype(dtype), jnp.finfo(dtype).min)
+    if sink is not None:
+        # learned per-head sink logit participates in the softmax denominator
+        # (reference attention_base.py:879-889)
+        B, H, Sq, Sk = scores.shape
+        sink_col = jnp.broadcast_to(sink.astype(dtype)[None, :, None, None], (B, H, Sq, 1))
+        full = jnp.concatenate([scores, sink_col], axis=-1)
+        probs = jax.nn.softmax(full, axis=-1)[..., :Sk]
+    else:
+        probs = jax.nn.softmax(scores, axis=-1)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32).astype(
+        q.dtype
+    )
+
+
+def _use_flash(spec: AttnSpec, seq_len: int) -> bool:
+    if spec.use_flash_kernel is not None:
+        return spec.use_flash_kernel
+    if seq_len < 128 or seq_len % 128 != 0 or spec.head_dim % 128 != 0:
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def attention_prefill(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: jnp.ndarray,
+    spec: AttnSpec,
+    sink: Optional[jnp.ndarray] = None,
+    causal: bool = True,
+    key_valid: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Context-encoding attention (reference perform_prefill, attention_base.py:720).
+
+    ``key_valid`` (B, S) marks valid key positions; when provided (plain causal
+    masks only) the Pallas flash kernel is eligible.
+    """
+    n_rep = spec.num_heads // spec.num_kv_heads
+    if key_valid is not None and sink is None and causal and _use_flash(spec, q.shape[1]):
+        from neuronx_distributed_inference_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), key_valid, spec)
+    return _masked_softmax_attention(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), mask, spec, sink)
+
+
+def attention_decode(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    mask: jnp.ndarray,
+    spec: AttnSpec,
+    sink: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Token-gen attention over the (already updated) cache.
+
+    q: (B, K, Hq, D); k_cache/v_cache: (B, S_bucket, Hkv, D); mask
+    (B, 1, K, S_bucket). Reference: compute_for_token_gen
+    (attention_base.py:1909-1987) — decomposed prior/active softmax; here a
+    single masked softmax over the cache, same math.
+    """
+    n_rep = spec.num_heads // spec.num_kv_heads
+    return _masked_softmax_attention(
+        q, repeat_kv(k_cache, n_rep), repeat_kv(v_cache, n_rep), mask, spec, sink
+    )

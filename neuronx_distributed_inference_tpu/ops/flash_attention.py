@@ -1,0 +1,166 @@
+"""Pallas flash attention (causal prefill) for TPU.
+
+TPU-native replacement for the reference's NKI flash-attention kernels
+(reference: neuronxcc ``attention_isa_kernel`` used at
+modules/attention/attention_base.py:54,720; in-tree core
+modules/chunked_prefill/flash_attn_core.py:70).
+
+Design: classic online-softmax flash attention tiled for the MXU.
+Grid = (batch, heads, q_blocks, kv_blocks); the kv_blocks axis is the
+innermost sequential loop; running max/denominator/accumulator live in VMEM
+scratch across kv steps. Causal tiles entirely above the diagonal are skipped
+(reference's tile scheduler skips fully-masked tiles,
+modules/sliding_window/attention.py:61-233).
+
+Falls back to an XLA masked-softmax path off-TPU or for shapes the kernel
+doesn't support (the reference similarly keeps a native softmax path,
+attention_base.py:720-891).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref,  # (1, 1, bq, D)
+    k_ref,  # (1, 1, bkv, D)
+    v_ref,  # (1, 1, bkv, D)
+    valid_ref,  # (1, bkv) int32 key-validity
+    o_ref,  # (1, 1, bq, D)
+    m_scr,  # (bq, 1) f32 running max
+    l_scr,  # (bq, 1) f32 running denom
+    acc_scr,  # (bq, D) f32 accumulator
+    *,
+    scale: float,
+    bq: int,
+    bkv: int,
+    nkv: int,
+    causal: bool,
+):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = iq * bq
+    kv_start = ik * bkv
+
+    # skip tiles entirely above the causal diagonal
+    run = (not causal) or (kv_start <= q_start + bq - 1)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * scale  # (bq, bkv)
+
+        valid = valid_ref[0, :] > 0  # (bkv,)
+        mask = jnp.broadcast_to(valid[None, :], (bq, bkv))
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+            cols = kv_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+            mask = mask & (cols <= rows)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:]  # (bq, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)  # (bq, bkv)
+        alpha = jnp.exp(m_prev - m_new)  # (bq, 1)
+
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:] = m_new
+
+    @pl.when(ik == nkv - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0, 0, :, :] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "bq", "bkv", "interpret"))
+def flash_attention_bhsd(
+    q: jax.Array,  # (B, H, S, D)
+    k: jax.Array,  # (B, H, S, D)
+    v: jax.Array,
+    key_valid: jax.Array,  # (B, S) int32
+    *,
+    scale: float,
+    causal: bool = True,
+    bq: int = 128,
+    bkv: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    B, H, S, D = q.shape
+    bq = min(bq, S)
+    bkv = min(bkv, S)
+    nq = pl.cdiv(S, bq)
+    nkv = pl.cdiv(S, bkv)
+
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, bq=bq, bkv=bkv, nkv=nkv, causal=causal
+    )
+    grid = (B, H, nq, nkv)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bkv, D), lambda b, h, iq, ik: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, bkv, D), lambda b, h, iq, ik: (b, h, ik, 0)),
+            pl.BlockSpec((1, bkv), lambda b, h, iq, ik: (b, ik)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(q, k, v, key_valid)
+
+
+def flash_attention(q, k, v, key_valid, spec, causal: bool = True):
+    """Flash attention entry. q/k/v: (B, S, H, D) with H already GQA-repeated;
+    key_valid: (B, S). Returns (B, S, H, D)."""
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = flash_attention_bhsd(
+        qt,
+        kt,
+        vt,
+        key_valid.astype(jnp.int32),
+        scale=spec.softmax_scale,
+        causal=causal,
+        interpret=jax.default_backend() != "tpu",
+    )
+    return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,9 @@
+from neuronx_distributed_inference_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_DP,
+    AXIS_EP,
+    AXIS_CP,
+    AXIS_TP,
+    MODEL_AXES,
+    build_mesh,
+    single_device_mesh,
+)

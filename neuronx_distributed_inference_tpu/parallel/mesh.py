@@ -1,0 +1,95 @@
+"""Device mesh construction.
+
+TPU-native replacement for the reference's process-group machinery
+(reference: modules/attention/attention_process_groups.py, models/config.py:333-361).
+
+The reference builds torch.distributed process groups per parallelism flavor
+(TP, TP×CP for prefill attention, TP×DP for decode attention, moe_tp×moe_ep)
+with hand-written TRN2 "8x8" physical-mesh tables. On TPU all of that collapses
+into ONE ``jax.sharding.Mesh`` with named axes; GSPMD emits the ICI/DCN
+collectives. Axis layout:
+
+    (dp, ep, cp, tp)   sizes: (dp_degree, ep_degree, cp_degree, tp_degree/cp_degree)
+
+- Weight tensor-parallel dims are sharded over the *combined* ``(ep, cp, tp)``
+  axes (= full tp_degree × ep_degree model group).
+- Context-parallel prefill shards sequence over ``cp`` while heads shard over
+  ``tp`` — same devices, different view (reference attention_base.py:245-257).
+- Expert-parallel shards the expert dim over ``ep``.
+- ``dp`` is whole-model data parallel (multi-slice / batch).
+
+``mesh_utils.create_device_mesh`` picks an ICI-aware device ordering — the
+equivalent of the reference's hand-coded physical mesh tables
+(attention_process_groups.py:14-23).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DP = "dp"
+AXIS_EP = "ep"
+AXIS_CP = "cp"
+AXIS_TP = "tp"
+
+#: Axes that together form the model-parallel group (weights sharded over all).
+MODEL_AXES = (AXIS_EP, AXIS_CP, AXIS_TP)
+ALL_AXES = (AXIS_DP, AXIS_EP, AXIS_CP, AXIS_TP)
+
+
+def build_mesh(
+    tp_degree: int = 1,
+    cp_degree: int = 1,
+    ep_degree: int = 1,
+    dp_degree: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build the global device mesh.
+
+    ``tp_degree`` is the FULL tensor-parallel degree; internally the mesh
+    factors it as (cp, tp//cp) so context-parallel attention can address the
+    ``cp`` sub-axis (reference: CP groups split the TP group,
+    attention_process_groups.py:80-123).
+    """
+    if tp_degree % cp_degree != 0:
+        raise ValueError(f"cp_degree={cp_degree} must divide tp_degree={tp_degree}")
+    shape = (dp_degree, ep_degree, cp_degree, tp_degree // cp_degree)
+    n = int(np.prod(shape))
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) < n:
+        raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
+    devices = devices[:n]
+    try:
+        dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except Exception:
+        dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, ALL_AXES)
+
+
+def single_device_mesh(device=None) -> Mesh:
+    dev = device if device is not None else jax.devices()[0]
+    return Mesh(np.asarray([dev]).reshape(1, 1, 1, 1), ALL_AXES)
+
+
+def mesh_from_config(tpu_config, devices=None) -> Mesh:
+    return build_mesh(
+        tp_degree=tpu_config.tp_degree,
+        cp_degree=tpu_config.cp_degree,
+        ep_degree=tpu_config.ep_degree,
+        dp_degree=1,
+        devices=devices,
+    )
+
+
+def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

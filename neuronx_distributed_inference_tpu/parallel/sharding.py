@@ -1,0 +1,133 @@
+"""Sharding rules: logical axes -> mesh PartitionSpecs, plus GQA head accounting.
+
+TPU-native replacement for the reference's parallel layers + GQA sharding
+strategies (reference: modules/attention/gqa.py:54-266, nxd parallel_layers).
+
+The reference physically pre-shards weights per rank into
+``tp{rank}_sharded_checkpoint.safetensors`` and pads/replicates GQA heads in
+state-dict hooks. Here weights are GLOBAL arrays annotated with
+``NamedSharding``; GSPMD splits them. GQA head accounting survives as array
+transforms applied once at load time:
+
+- ``REPLICATE_TO_TP_DEGREE`` (gqa.py:54-123): when num_kv_heads < model
+  parallel degree, repeat each KV head so every shard owns one.
+- Q-head padding: pad num_attention_heads up to a multiple of the degree with
+  zero heads; the output projection ignores the pads (zero rows).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from neuronx_distributed_inference_tpu.parallel.mesh import MODEL_AXES
+
+# Logical axis names used in model param spec trees.
+TENSOR = MODEL_AXES  # shard over full model-parallel group (ep, cp, tp)
+EXPERT = "ep"
+
+
+class GQASharding:
+    """Head accounting for tensor-parallel GQA (reference gqa.py:54-123).
+
+    Given (num_attention_heads q, num_key_value_heads kv, degree d):
+
+    - KV heads are replicated ``r = lcm(kv, d) / kv`` times so the replicated
+      count divides the degree (REPLICATE_TO_TP_DEGREE, gqa.py:54-123).
+    - Q heads are padded PER REPLICATED KV HEAD: each original kv group's
+      ``q/kv`` query heads are distributed over its ``r`` replicas, ``m =
+      ceil((q/kv)/r)`` slots each, zero-padded at each replica's tail
+      (reference interleaved Q padding, gqa.py:88-123). This keeps
+      ``repeat_kv`` pairing correct: padded q slot j attends replicated kv
+      head ``j // m``, which is a replica of original kv head ``j // (q/kv)``.
+
+    When ``r`` divides ``q/kv`` the permutation is the identity and no padding
+    happens (all common llama/qwen configs).
+    """
+
+    def __init__(self, num_attention_heads: int, num_key_value_heads: int, degree: int):
+        q, kv, d = num_attention_heads, num_key_value_heads, degree
+        if q % kv != 0:
+            raise ValueError(f"num_attention_heads={q} must be a multiple of kv heads={kv}")
+        self.degree = d
+        self.orig_q_heads = q
+        self.orig_kv_heads = kv
+        self.kv_repeat = math.lcm(kv, d) // kv
+        self.kv_heads = kv * self.kv_repeat
+        qg = q // kv  # q heads per kv group
+        r = self.kv_repeat
+        self.q_per_slot = math.ceil(qg / r)  # m: q heads per replicated kv head
+        self.q_heads = self.kv_heads * self.q_per_slot
+        self.q_pad = self.q_heads - q
+        # slot_map[j] = padded slot of original q head j
+        m = self.q_per_slot
+        self.slot_map = np.array(
+            [(j // qg * r + (j % qg) // m) * m + (j % qg) % m for j in range(q)],
+            dtype=np.int64,
+        )
+        self.identity = self.q_pad == 0 and (self.slot_map == np.arange(q)).all()
+
+    @property
+    def needs_transform(self) -> bool:
+        return self.kv_repeat > 1 or not self.identity
+
+    def replicate_kv(self, w, head_dim: int):
+        """Repeat KV projection output columns per head (weight (..., kv*D))."""
+        if self.kv_repeat == 1:
+            return w
+        w = np.asarray(w)
+        shape = w.shape
+        w = w.reshape(shape[:-1] + (self.orig_kv_heads, head_dim))
+        w = np.repeat(w, self.kv_repeat, axis=-2)
+        return w.reshape(shape[:-1] + (self.kv_heads * head_dim,))
+
+    def pad_q(self, w, head_dim: int):
+        """Scatter Q projection output columns (..., q*D) into padded
+        interleaved slots (..., q_heads*D)."""
+        if self.identity:
+            return w
+        w = np.asarray(w)
+        shape = w.shape
+        w = w.reshape(shape[:-1] + (self.orig_q_heads, head_dim))
+        out = np.zeros(shape[:-1] + (self.q_heads, head_dim), w.dtype)
+        out[..., self.slot_map, :] = w
+        return out.reshape(shape[:-1] + (self.q_heads * head_dim,))
+
+    def pad_o(self, w, head_dim: int):
+        """Scatter O projection input rows (..., q*D, H) into padded slots."""
+        if self.identity:
+            return w
+        w = np.asarray(w)
+        shape = w.shape
+        w = w.reshape(shape[:-2] + (self.orig_q_heads, head_dim, shape[-1]))
+        out = np.zeros(shape[:-2] + (self.q_heads, head_dim, shape[-1]), w.dtype)
+        out[..., self.slot_map, :, :] = w
+        return out.reshape(shape[:-2] + (self.q_heads * head_dim, shape[-1]))
+
+
+def make_sharding_fn(mesh: Mesh):
+    """Return spec -> NamedSharding resolver for this mesh."""
+
+    def to_sharding(spec: P) -> NamedSharding:
+        return NamedSharding(mesh, spec)
+
+    return to_sharding
+
+
+def shard_pytree(params, spec_tree, mesh: Mesh):
+    """Device-put a param pytree with its PartitionSpec tree onto the mesh."""
+    def _put(x, spec):
+        if spec is None:
+            spec = P()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(_put, params, spec_tree)
+
+
+def pspec_tree_like(params, default=None):
+    return jax.tree.map(lambda _: default if default is not None else P(), params)

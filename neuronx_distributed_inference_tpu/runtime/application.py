@@ -1,0 +1,264 @@
+"""Application layer: compile / load / generate lifecycle.
+
+TPU-native re-design of the reference application stack
+(reference: models/application_base.py:68 ``NeuronApplicationBase``,
+models/model_base.py:3069 ``NeuronBaseForCausalLM``, and the host sampling
+loop of utils/hf_adapter.py:101-916).
+
+Lifecycle mapping (SURVEY §3.1-3.3):
+- ``compile()``   = AOT-build all (sub-model, bucket) programs via jit +
+  persistent XLA compilation cache; save ``tpu_config.json``
+  (reference: ModelBuilder.trace -> neuronx-cc -> model.pt).
+- ``load()``      = load HF checkpoint -> GSPMD-sharded global arrays on the
+  mesh; allocate the donated KV cache
+  (reference: nxd_model.initialize(weights) per rank).
+- ``generate()``  = host loop: CTE once, then TKG steps with bucketed cache
+  masks — the reference's per-token host dispatch (model_base.py:3656-3854).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig, to_dtype
+from neuronx_distributed_inference_tpu.models.base import (
+    PHASE_CONTEXT_ENCODING,
+    PHASE_TOKEN_GENERATION,
+)
+from neuronx_distributed_inference_tpu.models.registry import get_model_builder
+from neuronx_distributed_inference_tpu.modules import autobucketing
+from neuronx_distributed_inference_tpu.modules.kvcache import KVCache, cache_spec, init_cache
+from neuronx_distributed_inference_tpu.modules.sampling import (
+    prepare_sampling_params,
+    validate_sampling_params,
+)
+from neuronx_distributed_inference_tpu.parallel.mesh import mesh_from_config
+from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
+from neuronx_distributed_inference_tpu.runtime.model_runner import (
+    SubModelRunner,
+    TAG_CONTEXT_ENCODING,
+    TAG_TOKEN_GENERATION,
+)
+from neuronx_distributed_inference_tpu.utils.hf_checkpoint import load_state_dict
+
+
+@dataclass
+class GenerationOutput:
+    sequences: np.ndarray  # (B, S_in + new)
+    logits: Optional[np.ndarray] = None  # (B, new, V) when output_logits
+    num_generated: int = 0
+
+
+class TpuModelForCausalLM:
+    """The causal-LM application (reference NeuronBaseForCausalLM)."""
+
+    def __init__(self, model_path: Optional[str], config: InferenceConfig, mesh=None):
+        self.model_path = model_path
+        self.config = config
+        tc = config.tpu_config
+        model_type = getattr(config, "model_type", "llama")
+        self.builder = get_model_builder(model_type)(config)
+        self.spec = self.builder.model_spec()
+        self.mesh = mesh if mesh is not None else mesh_from_config(tc)
+        self.params = None
+        self.kv_cache: Optional[KVCache] = None
+        self._rng_key = jax.random.PRNGKey(tc.seed)
+        self._call_key = self._rng_key
+
+        cte_buckets = autobucketing.generate_context_encoding_buckets(tc)
+        tkg_buckets = autobucketing.generate_token_generation_buckets(tc)
+        pspecs = self.builder.param_pspecs()
+        mlp_fn = self.builder.mlp_fn()
+        # per-sub-model specialized config (reference deep-copied configs,
+        # model_base.py:3099-3222)
+        self.context_encoding_model = SubModelRunner(
+            TAG_CONTEXT_ENCODING,
+            PHASE_CONTEXT_ENCODING,
+            self.spec,
+            cte_buckets,
+            tc.ctx_batch_size,
+            self.mesh,
+            pspecs,
+            mlp_fn,
+        )
+        self.token_generation_model = SubModelRunner(
+            TAG_TOKEN_GENERATION,
+            PHASE_TOKEN_GENERATION,
+            self.spec,
+            tkg_buckets,
+            tc.tkg_batch_size,
+            self.mesh,
+            pspecs,
+            mlp_fn,
+        )
+        self.runners = [self.context_encoding_model, self.token_generation_model]
+
+    # ---- weights / cache -------------------------------------------------
+
+    def load(self, model_path: Optional[str] = None, state_dict=None, random_weights=False):
+        """Load weights onto the mesh + allocate the KV cache
+        (reference application_base.py:317-419)."""
+        tc = self.config.tpu_config
+        if random_weights:
+            params = self.builder.random_params()
+        else:
+            sd = state_dict if state_dict is not None else load_state_dict(
+                model_path or self.model_path
+            )
+            params = self.builder.convert_hf_state_dict(sd)
+        self.params = shard_pytree(params, self.builder.param_pspecs(), self.mesh)
+        self.init_kv_cache()
+        return self
+
+    def init_kv_cache(self):
+        tc = self.config.tpu_config
+        kv_batch = tc.kv_cache_batch_size or tc.max_batch_size
+        cache = init_cache(
+            self.spec.num_layers,
+            kv_batch,
+            tc.seq_len,
+            self.spec.attn.num_kv_heads,
+            self.spec.attn.head_dim,
+            dtype=to_dtype(tc.kv_cache_dtype or tc.dtype),
+        )
+        self.kv_cache = shard_pytree(cache, cache_spec(), self.mesh)
+
+    def compile(self, compiled_model_path: Optional[str] = None):
+        """AOT-compile every (sub-model, bucket) program
+        (reference application_base.py:292-315). With the persistent XLA
+        compilation cache this also serves as the on-disk artifact."""
+        tc = self.config.tpu_config
+        if compiled_model_path:
+            os.makedirs(compiled_model_path, exist_ok=True)
+            self.config.save(compiled_model_path)
+            cache_dir = tc.compilation_cache_dir or os.path.join(
+                compiled_model_path, "xla_cache"
+            )
+            try:
+                from jax.experimental.compilation_cache import compilation_cache
+
+                compilation_cache.set_cache_dir(cache_dir)
+            except Exception:
+                pass
+        if self.params is None:
+            self.load(random_weights=self.model_path is None, model_path=self.model_path)
+        if not tc.skip_warmup:
+            self.warmup()
+        return self
+
+    def warmup(self):
+        for runner in self.runners:
+            self.kv_cache = runner.warmup(self.params, self.kv_cache, self._sample_key(0))
+
+    def _sample_key(self, step: int):
+        if not self.spec.do_sample:
+            return None
+        return jax.random.fold_in(self._call_key, step)
+
+    def _advance_rng(self):
+        """Fresh key per generate() call so successive calls draw different
+        samples; deterministic mode keeps the seeded sequence reproducible
+        from construction (reference deterministic flag, sampling.py)."""
+        self._rng_key, self._call_key = jax.random.split(self._rng_key)
+
+    # ---- generation loop -------------------------------------------------
+
+    def generate(
+        self,
+        input_ids: np.ndarray,
+        attention_mask: Optional[np.ndarray] = None,
+        max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None,
+        top_k=None,
+        top_p=None,
+        temperature=None,
+        seq_ids: Optional[np.ndarray] = None,
+    ) -> GenerationOutput:
+        """Host generation loop (reference hf_adapter _sample, hf_adapter.py:129).
+
+        input_ids: (B, S) RIGHT-padded; attention_mask: (B, S) 1=valid.
+        """
+        tc = self.config.tpu_config
+        self._advance_rng()
+        input_ids = np.asarray(input_ids)
+        B, S_in = input_ids.shape
+        if attention_mask is None:
+            attention_mask = np.ones_like(input_ids)
+        attention_mask = np.asarray(attention_mask)
+        if seq_ids is None:
+            seq_ids = np.arange(B, dtype=np.int32)
+        sampling_params = prepare_sampling_params(B, top_k, top_p, temperature)
+        validate_sampling_params(sampling_params, tc.max_topk)
+
+        if S_in > tc.max_context_length:
+            raise ValueError(
+                f"prompt length {S_in} exceeds max_context_length "
+                f"{tc.max_context_length} (reference: bucket overflow, "
+                f"autobucketing get_target_bucket)"
+            )
+        max_total = min(tc.seq_len, S_in + max_new_tokens)
+        n_new = max_total - S_in
+        if n_new <= 0:
+            return GenerationOutput(sequences=input_ids, num_generated=0)
+
+        ctx_lens = attention_mask.sum(axis=1).astype(np.int32)
+        # CTE: positions are slot indices [0, S) — padded slots write into the
+        # masked tail (reference fill_prefix semantics, kvcache/utils.py)
+        position_ids = np.tile(np.arange(S_in, dtype=np.int32), (B, 1))
+        inputs, _ = self.context_encoding_model.prepare(
+            input_ids, attention_mask, position_ids, seq_ids, sampling_params
+        )
+        out = self.context_encoding_model(self.params, self.kv_cache, inputs, self._sample_key(0))
+        self.kv_cache = out.cache
+        tokens = np.asarray(jax.device_get(out.tokens))[:B]  # (B, 1)
+        logits_acc: List[np.ndarray] = []
+        if self.spec.output_logits:
+            logits_acc.append(np.asarray(jax.device_get(out.logits))[:B])
+
+        generated = [tokens[:, -1]]
+        pos = ctx_lens.copy()  # next write position per row
+        done = np.zeros(B, bool)
+        if eos_token_id is not None:
+            done |= generated[-1] == eos_token_id
+
+        for step in range(1, n_new):
+            if done.all():
+                break
+            last = generated[-1][:, None].astype(np.int32)
+            width = int(pos.max()) + 1
+            mask = (np.arange(width)[None, :] <= pos[:, None]).astype(np.int32)
+            inputs, _ = self.token_generation_model.prepare(
+                last, mask, pos[:, None].astype(np.int32), seq_ids, sampling_params
+            )
+            out = self.token_generation_model(
+                self.params, self.kv_cache, inputs, self._sample_key(step)
+            )
+            self.kv_cache = out.cache
+            step_tokens = np.asarray(jax.device_get(out.tokens))[:B, -1]
+            if self.spec.output_logits:
+                logits_acc.append(np.asarray(jax.device_get(out.logits))[:B])
+            pos = pos + 1
+            if eos_token_id is not None:
+                step_tokens = np.where(done, eos_token_id, step_tokens)
+                done |= step_tokens == eos_token_id
+            generated.append(step_tokens)
+
+        gen = np.stack(generated, axis=1).astype(np.int64)  # (B, n)
+        sequences = np.concatenate([input_ids, gen], axis=1)
+        logits = np.concatenate(logits_acc, axis=1) if logits_acc else None
+        return GenerationOutput(sequences=sequences, logits=logits, num_generated=gen.shape[1])
+
+
+def load_model(compiled_model_path: str, model_path: Optional[str] = None) -> TpuModelForCausalLM:
+    """Reload an application from a saved artifact dir (reference
+    application_base.py:82-83 — reloadable by path alone)."""
+    config = InferenceConfig.load(compiled_model_path)
+    app = TpuModelForCausalLM(model_path, config)
+    app.compile(compiled_model_path)
+    return app

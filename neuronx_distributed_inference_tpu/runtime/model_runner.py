@@ -1,0 +1,177 @@
+"""Per-sub-model runner: bucket dispatch, host padding, jit execution.
+
+TPU-native re-design of the reference ``ModelWrapper``
+(reference: models/model_wrapper.py:45-1574).
+
+One :class:`SubModelRunner` per compiled sub-model tag (context_encoding,
+token_generation, ...; reference model_wrapper.py:32-37). Responsibilities:
+
+- hold ONE jitted step function; each (bucket, batch) shape is a separate XLA
+  program in the jit cache — the analogue of the reference's per-bucket NEFFs.
+- pad inputs to the bucket (reference pad_inputs, model_wrapper.py:778-1013)
+  and the batch to the compiled batch size with the sorted-seq_id convention
+  (reference _forward_with_pad, model_wrapper.py:582-751).
+- donate the KV cache so XLA updates it in place (reference aliasing,
+  model_wrapper.py:1673-1743).
+- warmup() runs every bucket once to populate the compile cache
+  (reference application_base.py:348-372).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from neuronx_distributed_inference_tpu.models.base import (
+    PHASE_CONTEXT_ENCODING,
+    PHASE_TOKEN_GENERATION,
+    ModelSpec,
+    StepInputs,
+    forward,
+)
+from neuronx_distributed_inference_tpu.modules.autobucketing import get_target_bucket
+from neuronx_distributed_inference_tpu.modules.kvcache import KVCache, cache_spec
+from neuronx_distributed_inference_tpu.modules.sampling import prepare_sampling_params
+
+TAG_CONTEXT_ENCODING = "context_encoding_model"
+TAG_TOKEN_GENERATION = "token_generation_model"
+TAG_SPECULATION = "speculation_model"
+TAG_FUSED_SPECULATION = "fused_speculation_model"
+
+
+class SubModelRunner:
+    def __init__(
+        self,
+        tag: str,
+        phase: str,
+        spec: ModelSpec,
+        buckets: List[int],
+        batch_size: int,
+        mesh,
+        param_pspecs,
+        mlp_fn: Callable,
+        n_active_tokens: int = 1,
+    ):
+        self.tag = tag
+        self.phase = phase
+        self.spec = spec
+        self.buckets = sorted(buckets)
+        self.batch_size = batch_size
+        self.mesh = mesh
+        self.n_active_tokens = n_active_tokens
+
+        replicated = NamedSharding(mesh, P())
+        param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), param_pspecs)
+        cache_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), cache_spec())
+        in_sh = StepInputs(
+            input_ids=replicated,
+            attention_mask=replicated,
+            position_ids=replicated,
+            seq_ids=replicated,
+            sampling_params=replicated,
+        )
+        step = partial(forward, spec=spec, phase=phase, mlp_fn=mlp_fn)
+        self._fn = jax.jit(
+            step,
+            donate_argnums=(1,),  # cache in-place (reference KV aliasing)
+            in_shardings=(param_sh, cache_sh, in_sh, replicated),
+        )
+
+    # ---- host-side padding (reference model_wrapper.py:582-1013) ---------
+
+    def _pad_batch(self, arrs: Dict[str, np.ndarray], batch: int) -> Dict[str, np.ndarray]:
+        out = {}
+        for name, a in arrs.items():
+            if a.shape[0] == batch:
+                out[name] = a
+                continue
+            pad = batch - a.shape[0]
+            if pad < 0:
+                raise ValueError(
+                    f"{self.tag}: input batch {a.shape[0]} > compiled batch {batch}"
+                )
+            fill = -1 if name == "seq_ids" else 0
+            out[name] = np.concatenate(
+                [a, np.full((pad,) + a.shape[1:], fill, a.dtype)], axis=0
+            )
+        return out
+
+    def prepare(
+        self,
+        input_ids: np.ndarray,
+        attention_mask: np.ndarray,
+        position_ids: np.ndarray,
+        seq_ids: np.ndarray,
+        sampling_params: Optional[np.ndarray] = None,
+    ) -> Tuple[StepInputs, int]:
+        """Pad to (compiled batch, bucket) and build StepInputs."""
+        B, S = input_ids.shape
+        if self.phase == PHASE_CONTEXT_ENCODING:
+            bucket = get_target_bucket(self.buckets, S)
+            pad_s = bucket - S
+            if pad_s:
+                input_ids = np.pad(input_ids, ((0, 0), (0, pad_s)))
+                attention_mask = np.pad(attention_mask, ((0, 0), (0, pad_s)))
+                # pad positions continue the sequence so padded K/V lands in
+                # the masked tail, not on real slots
+                tail = position_ids[:, -1:] + 1 + np.arange(pad_s)[None, :]
+                position_ids = np.concatenate([position_ids, tail], axis=1)
+        else:
+            # TKG: bucket over cache length = attention_mask width
+            bucket = get_target_bucket(self.buckets, attention_mask.shape[1])
+            pad_s = bucket - attention_mask.shape[1]
+            if pad_s:
+                attention_mask = np.pad(attention_mask, ((0, 0), (0, pad_s)))
+
+        if sampling_params is None:
+            sampling_params = prepare_sampling_params(B)
+        arrs = {
+            "input_ids": input_ids.astype(np.int32),
+            "attention_mask": attention_mask.astype(np.int32),
+            "position_ids": position_ids.astype(np.int32),
+            "seq_ids": seq_ids.astype(np.int32),
+            "sampling_params": sampling_params.astype(np.float32),
+        }
+        arrs = self._pad_batch(arrs, self.batch_size)
+        return StepInputs(**{k: jnp.asarray(v) for k, v in arrs.items()}), B
+
+    def __call__(self, params, cache: KVCache, inputs: StepInputs, rng=None):
+        """Run one step. Returns StepOutput (tokens/logits device arrays + new cache)."""
+        return self._fn(params, cache, inputs, rng)
+
+    # ---- warmup ----------------------------------------------------------
+
+    def example_inputs(self, bucket: int) -> StepInputs:
+        """Reference: input_generator (model_wrapper.py:203-367)."""
+        B = self.batch_size
+        if self.phase == PHASE_CONTEXT_ENCODING:
+            S = bucket
+            ids = np.zeros((B, S), np.int32)
+            mask = np.ones((B, S), np.int32)
+            pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+        else:
+            S = self.n_active_tokens
+            ids = np.zeros((B, S), np.int32)
+            mask = np.ones((B, bucket), np.int32)
+            pos = np.zeros((B, S), np.int32)
+        return StepInputs(
+            input_ids=jnp.asarray(ids),
+            attention_mask=jnp.asarray(mask),
+            position_ids=jnp.asarray(pos),
+            seq_ids=jnp.asarray(np.arange(B, dtype=np.int32)),
+            sampling_params=jnp.asarray(prepare_sampling_params(B)),
+        )
+
+    def warmup(self, params, cache: KVCache, rng=None) -> KVCache:
+        """Compile + execute every bucket once (reference warmup,
+        application_base.py:348-372)."""
+        for bucket in self.buckets:
+            out = self._fn(params, cache, self.example_inputs(bucket), rng)
+            out.tokens.block_until_ready()
+            cache = out.cache
+        return cache

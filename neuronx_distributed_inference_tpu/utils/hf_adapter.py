@@ -1,0 +1,35 @@
+"""HF config adapter.
+
+Reference: utils/hf_adapter.py:33-99 ``load_pretrained_config`` — copies HF
+``config.json`` attributes onto the InferenceConfig instance.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Optional
+
+
+def load_pretrained_config(model_path: Optional[str] = None, hf_config: Optional[dict] = None) -> Callable:
+    """Return a load_config hook for InferenceConfig.__init__
+    (reference hf_adapter.py:33)."""
+
+    if hf_config is None:
+        cfg_file = os.path.join(model_path, "config.json")
+        with open(cfg_file) as f:
+            hf_config = json.load(f)
+
+    def load_config(inference_config):
+        for k, v in hf_config.items():
+            if k in ("torch_dtype",):
+                inference_config.metadata[k] = v
+                continue
+            # nested sub-configs (multimodal text/vision) kept as dicts
+            setattr(inference_config, k, v)
+        if not hasattr(inference_config, "num_key_value_heads") and hasattr(
+            inference_config, "num_attention_heads"
+        ):
+            inference_config.num_key_value_heads = inference_config.num_attention_heads
+
+    return load_config

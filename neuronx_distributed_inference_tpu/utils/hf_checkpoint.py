@@ -1,0 +1,87 @@
+"""HF checkpoint loading: safetensors / torch .bin -> numpy state dict.
+
+Reference: modules/checkpoint.py:23-167 (load_state_dict supporting
+safetensors, sharded safetensors via index, .pt, .bin).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Dict
+
+import numpy as np
+
+
+def load_state_dict(model_path: str) -> Dict[str, np.ndarray]:
+    """Load an HF checkpoint directory into a flat numpy state dict."""
+    st_index = os.path.join(model_path, "model.safetensors.index.json")
+    st_single = os.path.join(model_path, "model.safetensors")
+    if os.path.exists(st_index):
+        with open(st_index) as f:
+            index = json.load(f)
+        files = sorted(set(index["weight_map"].values()))
+        sd: Dict[str, np.ndarray] = {}
+        for fname in files:
+            sd.update(_load_safetensors(os.path.join(model_path, fname)))
+        return sd
+    if os.path.exists(st_single):
+        return _load_safetensors(st_single)
+    st_files = sorted(glob.glob(os.path.join(model_path, "*.safetensors")))
+    if st_files:
+        sd = {}
+        for fname in st_files:
+            sd.update(_load_safetensors(fname))
+        return sd
+    bin_files = sorted(
+        glob.glob(os.path.join(model_path, "pytorch_model*.bin"))
+        + glob.glob(os.path.join(model_path, "*.pt"))
+    )
+    if bin_files:
+        import torch
+
+        sd = {}
+        for fname in bin_files:
+            state = torch.load(fname, map_location="cpu", weights_only=True)
+            for k, v in state.items():
+                sd[k] = _torch_to_numpy(v)
+        return sd
+    raise FileNotFoundError(f"no checkpoint files found under {model_path}")
+
+
+def _load_safetensors(path: str) -> Dict[str, np.ndarray]:
+    from safetensors import safe_open
+
+    out = {}
+    with safe_open(path, framework="np") as f:
+        for k in f.keys():
+            try:
+                out[k] = f.get_tensor(k)
+            except (TypeError, ValueError):
+                out[k] = _safetensors_torch_fallback(path, k)
+    return out
+
+
+def _safetensors_torch_fallback(path: str, key: str) -> np.ndarray:
+    # bf16 tensors can't load with framework="np" in older safetensors
+    from safetensors import safe_open
+
+    with safe_open(path, framework="pt") as f:
+        return _torch_to_numpy(f.get_tensor(key))
+
+
+def _torch_to_numpy(t) -> np.ndarray:
+    import torch
+
+    if t.dtype == torch.bfloat16:
+        # numpy has no bf16; round-trip via float32 (values preserved exactly)
+        return t.to(torch.float32).numpy()
+    return t.numpy()
+
+
+def save_state_dict(sd: Dict[str, np.ndarray], path: str, filename: str = "model.safetensors"):
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    save_file(dict(sd), os.path.join(path, filename))

@@ -1,0 +1,95 @@
+"""Test env: run on CPU with 8 virtual devices so real SPMD collectives are
+exercised without TPU hardware (SURVEY §4.5 — better than the reference's
+gloo-CPU special path: same code path as device runs)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+# a site plugin may have pinned jax_platforms at interpreter start; the config
+# override (not the env var) is what actually wins
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    # reference: autouse constant seed (test/integration/conftest.py:6-23)
+    np.random.seed(0)
+
+
+def make_tiny_config(**overrides):
+    """A 2-layer tiny llama config (reference checked-in 4-layer config.json
+    pattern, SURVEY §4.3)."""
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.llama import LlamaInferenceConfig
+
+    tpu_kwargs = overrides.pop("tpu", {})
+    hf = dict(
+        model_type="llama",
+        hidden_size=64,
+        intermediate_size=128,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_hidden_layers=2,
+        vocab_size=128,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        max_position_embeddings=256,
+        hidden_act="silu",
+        tie_word_embeddings=False,
+    )
+    hf.update(overrides)
+    tc = TpuConfig(batch_size=2, seq_len=64, dtype="float32", **tpu_kwargs)
+
+    def load_config(cfg):
+        for k, v in hf.items():
+            setattr(cfg, k, v)
+
+    return LlamaInferenceConfig(tc, load_config=load_config)
+
+
+@pytest.fixture
+def tiny_config():
+    return make_tiny_config()
+
+
+def make_random_hf_state_dict(cfg, seed=0):
+    """Random weights in HF llama layout/names — the degree-independent
+    source checkpoint for cross-degree comparisons."""
+    rng = np.random.RandomState(seed)
+    H = cfg.hidden_size
+    I = cfg.intermediate_size
+    L = cfg.num_hidden_layers
+    D = getattr(cfg, "head_dim", None) or H // cfg.num_attention_heads
+    Hq = cfg.num_attention_heads
+    Hkv = cfg.num_key_value_heads
+    V = cfg.vocab_size
+
+    def w(*shape):
+        return (rng.randn(*shape) * 0.05).astype(np.float32)
+
+    sd = {
+        "model.embed_tokens.weight": w(V, H),
+        "model.norm.weight": np.ones(H, np.float32),
+        "lm_head.weight": w(V, H),
+    }
+    for i in range(L):
+        p = f"model.layers.{i}."
+        sd[p + "self_attn.q_proj.weight"] = w(Hq * D, H)
+        sd[p + "self_attn.k_proj.weight"] = w(Hkv * D, H)
+        sd[p + "self_attn.v_proj.weight"] = w(Hkv * D, H)
+        sd[p + "self_attn.o_proj.weight"] = w(H, Hq * D)
+        sd[p + "mlp.gate_proj.weight"] = w(I, H)
+        sd[p + "mlp.up_proj.weight"] = w(I, H)
+        sd[p + "mlp.down_proj.weight"] = w(H, I)
+        sd[p + "input_layernorm.weight"] = np.ones(H, np.float32)
+        sd[p + "post_attention_layernorm.weight"] = np.ones(H, np.float32)
+    return sd

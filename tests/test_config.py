@@ -1,0 +1,58 @@
+"""Config system tests (reference: test/unit/models/test_config.py)."""
+
+import pytest
+
+from neuronx_distributed_inference_tpu.config import (
+    InferenceConfig,
+    OnDeviceSamplingConfig,
+    TpuConfig,
+)
+
+
+def test_defaults_derive():
+    tc = TpuConfig(batch_size=4, seq_len=256)
+    assert tc.max_batch_size == 4
+    assert tc.ctx_batch_size == 4
+    assert tc.max_context_length == 256
+    assert tc.world_size == 1
+
+
+def test_world_size():
+    tc = TpuConfig(tp_degree=8, ep_degree=2)
+    assert tc.world_size == 16
+
+
+def test_validation_dp_requires_continuous_batching():
+    with pytest.raises(ValueError):
+        TpuConfig(tp_degree=8, attention_dp_degree=2, is_continuous_batching=False)
+
+
+def test_validation_cp_divides_tp():
+    with pytest.raises(ValueError):
+        TpuConfig(tp_degree=8, cp_degree=3)
+
+
+def test_chunked_prefill_requires_block_kv():
+    with pytest.raises(ValueError):
+        TpuConfig(is_chunked_prefill=True, is_block_kv_layout=False)
+
+
+def test_json_round_trip(tmp_path, tiny_config):
+    tiny_config.tpu_config.on_device_sampling_config = OnDeviceSamplingConfig(
+        do_sample=True, top_k=5
+    )
+    tiny_config.save(str(tmp_path))
+    loaded = InferenceConfig.load(str(tmp_path))
+    assert type(loaded).__name__ == "LlamaInferenceConfig"
+    assert loaded.hidden_size == tiny_config.hidden_size
+    assert loaded.tpu_config.on_device_sampling_config.top_k == 5
+    assert loaded.tpu_config.batch_size == tiny_config.tpu_config.batch_size
+
+
+def test_attribute_map():
+    tc = TpuConfig()
+    cfg = InferenceConfig(tc, n_positions=42)
+    cfg.attribute_map = {"max_len_alias": "n_positions"}
+    assert cfg.max_len_alias == 42
+    cfg.max_len_alias = 99
+    assert cfg.n_positions == 99

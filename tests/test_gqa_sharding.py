@@ -1,0 +1,88 @@
+"""GQA head replication/padding correctness
+(reference: test coverage of gqa.py preshard hooks)."""
+
+import numpy as np
+import pytest
+
+from neuronx_distributed_inference_tpu.parallel.sharding import GQASharding
+
+
+def test_identity_common_configs():
+    # llama3-8B tp8: 32q/8kv; llama3-70B tp8: 64q/8kv; qwen2-7B tp4: 28q/4kv
+    for q, kv, d in [(32, 8, 8), (64, 8, 8), (28, 4, 4), (32, 8, 16), (8, 1, 8)]:
+        g = GQASharding(q, kv, d)
+        assert g.q_heads % d == 0 and g.kv_heads % d == 0
+        assert g.q_heads // g.kv_heads == g.q_per_slot
+
+
+def test_pairing_preserved_exotic():
+    """Padded q slot j must pair (via repeat_kv) with a replica of the
+    original kv head of q head j."""
+    q, kv, d = 12, 2, 8
+    g = GQASharding(q, kv, d)
+    assert g.kv_heads % d == 0
+    assert g.q_heads % d == 0
+    m = g.q_heads // g.kv_heads
+    qg = q // kv
+    for j in range(q):
+        slot = g.slot_map[j]
+        # replicated kv index for this slot under repeat_kv
+        rep_kv = slot // m
+        orig_kv = rep_kv // g.kv_repeat
+        assert orig_kv == j // qg, (j, slot, rep_kv, orig_kv)
+
+
+def test_attention_equivalence_after_transform():
+    """Full numeric check: attention with transformed weights == attention
+    with original grouped heads."""
+    from neuronx_distributed_inference_tpu.modules.attention import (
+        AttnSpec,
+        _masked_softmax_attention,
+        repeat_kv,
+    )
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    B, S, H, D = 1, 6, 24, 4
+    q_heads, kv_heads, degree = 6, 2, 8
+    x = rng.randn(B, S, H).astype(np.float32)
+    wq = rng.randn(H, q_heads * D).astype(np.float32) * 0.3
+    wk = rng.randn(H, kv_heads * D).astype(np.float32) * 0.3
+    wv = rng.randn(H, kv_heads * D).astype(np.float32) * 0.3
+    wo = rng.randn(q_heads * D, H).astype(np.float32) * 0.3
+
+    mask = np.tril(np.ones((S, S), bool))[None, None]
+    spec_ref = AttnSpec(num_heads=q_heads, num_kv_heads=kv_heads, head_dim=D)
+
+    def attn(x, wq, wk, wv, wo, spec):
+        q = (x @ wq).reshape(B, S, spec.num_heads, D)
+        k = (x @ wk).reshape(B, S, spec.num_kv_heads, D)
+        v = (x @ wv).reshape(B, S, spec.num_kv_heads, D)
+        n_rep = spec.num_heads // spec.num_kv_heads
+        o = _masked_softmax_attention(
+            jnp.asarray(q),
+            repeat_kv(jnp.asarray(k), n_rep),
+            repeat_kv(jnp.asarray(v), n_rep),
+            jnp.asarray(mask),
+            spec,
+        )
+        return np.asarray(o).reshape(B, S, spec.num_heads * D) @ wo
+
+    ref = attn(x, wq, wk, wv, wo, spec_ref)
+
+    g = GQASharding(q_heads, kv_heads, degree)
+    spec_t = AttnSpec(num_heads=g.q_heads, num_kv_heads=g.kv_heads, head_dim=D)
+    out = attn(
+        x,
+        g.pad_q(wq, D),
+        g.replicate_kv(wk, D),
+        g.replicate_kv(wv, D),
+        g.pad_o(wo, D),
+        spec_t,
+    )
+    np.testing.assert_allclose(ref, out, atol=1e-5, rtol=1e-5)
+
+
+def test_q_not_multiple_of_kv_rejected():
+    with pytest.raises(ValueError):
+        GQASharding(10, 4, 8)

@@ -1,0 +1,119 @@
+"""Token/logit parity vs HuggingFace transformers on CPU — the accuracy oracle
+(reference: utils/accuracy.py check_accuracy / check_accuracy_logits; CPU-mode
+parity path, application_base.py:554-626)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from tests.conftest import make_tiny_config  # noqa: E402
+
+PROMPTS = np.array(
+    [
+        [5, 17, 92, 41, 33, 88, 2, 11],
+        [64, 3, 27, 9, 0, 0, 0, 0],
+    ]
+)
+MASK = np.array(
+    [
+        [1, 1, 1, 1, 1, 1, 1, 1],
+        [1, 1, 1, 1, 0, 0, 0, 0],
+    ]
+)
+
+
+def _hf_model_and_sd(cfg):
+    hf_config = transformers.LlamaConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.num_key_value_heads,
+        rms_norm_eps=cfg.rms_norm_eps,
+        rope_theta=cfg.rope_theta,
+        max_position_embeddings=cfg.max_position_embeddings,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+        eos_token_id=None,
+        bos_token_id=None,
+    )
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_config).eval().to(torch.float32)
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    return hf, sd
+
+
+@pytest.fixture(scope="module")
+def apps():
+    from neuronx_distributed_inference_tpu.runtime.application import TpuModelForCausalLM
+
+    cfg = make_tiny_config(tpu={"output_logits": True})
+    hf, sd = _hf_model_and_sd(cfg)
+    app = TpuModelForCausalLM(None, cfg)
+    app.load(state_dict=sd)
+    return app, hf
+
+
+def test_token_match_greedy(apps):
+    """Exact greedy token matching (reference check_accuracy, accuracy.py:240).
+
+    The HF golden runs per-row UNPADDED (HF's own right-padded generate feeds
+    the pad slot into the lm head and is wrong — it warns about it); ours must
+    match the unpadded result for every row, padded or not.
+    """
+    app, hf = apps
+    n_new = 12
+    out = app.generate(PROMPTS, MASK, max_new_tokens=n_new)
+
+    for b in range(PROMPTS.shape[0]):
+        valid = int(MASK[b].sum())
+        hf_out = hf.generate(
+            input_ids=torch.tensor(PROMPTS[b : b + 1, :valid]),
+            max_new_tokens=n_new,
+            do_sample=False,
+            pad_token_id=0,
+        )
+        np.testing.assert_array_equal(out.sequences[b, 8:], hf_out[0, valid:].numpy())
+
+
+def test_logit_match(apps):
+    """Logit matching within the reference divergence tolerance
+    (reference check_accuracy_logits, accuracy.py:474; tol inference_demo.py:107)."""
+    app, hf = apps
+    n_new = 8
+    out = app.generate(PROMPTS, MASK, max_new_tokens=n_new)
+    seq = out.sequences  # (B, 8 + n_new)
+    ours = out.logits  # (B, n_new, V); ours[b, i] predicts seq[b, 8+i]
+
+    for b in range(PROMPTS.shape[0]):
+        valid = int(MASK[b].sum())
+        # teacher-forced HF forward over this row's unpadded sequence
+        row = np.concatenate([PROMPTS[b, :valid], seq[b, 8:]])
+        with torch.no_grad():
+            hf_logits = hf(input_ids=torch.tensor(row[None, :])).logits[0].numpy()
+        for i in range(n_new):
+            np.testing.assert_allclose(
+                ours[b, i], hf_logits[valid + i - 1], atol=1e-3, rtol=1e-3
+            )
+
+
+def test_batch_one_vs_batch_two(apps):
+    """Each row of a batch must generate what it generates alone (batch
+    padding correctness, reference _forward_with_pad, model_wrapper.py:582)."""
+    app, _ = apps
+    out_batch = app.generate(PROMPTS, MASK, max_new_tokens=6).sequences
+    for b in range(2):
+        cfg = make_tiny_config(tpu={"output_logits": True})
+        from neuronx_distributed_inference_tpu.runtime.application import (
+            TpuModelForCausalLM,
+        )
+
+        _, sd = _hf_model_and_sd(cfg)
+        cfg.tpu_config.batch_size = 1
+        app1 = TpuModelForCausalLM(None, cfg)
+        app1.load(state_dict=sd)
+        out1 = app1.generate(PROMPTS[b : b + 1], MASK[b : b + 1], max_new_tokens=6).sequences
+        np.testing.assert_array_equal(out_batch[b], out1[0])
