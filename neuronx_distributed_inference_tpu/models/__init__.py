@@ -9,3 +9,5 @@ from neuronx_distributed_inference_tpu.models.registry import (  # noqa: F401
 
 # import plugins so they self-register
 from neuronx_distributed_inference_tpu.models import llama  # noqa: F401
+from neuronx_distributed_inference_tpu.models import qwen  # noqa: F401
+from neuronx_distributed_inference_tpu.models import mixtral  # noqa: F401

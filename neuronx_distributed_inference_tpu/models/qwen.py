@@ -1,0 +1,39 @@
+"""Qwen2 / Qwen3 model plugins.
+
+Reference: models/qwen2/modeling_qwen2.py (qkv bias),
+models/qwen3/modeling_qwen3.py (per-head qk rmsnorm). Both share the llama
+decoder graph; the deltas are builder flags.
+"""
+
+from __future__ import annotations
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig
+from neuronx_distributed_inference_tpu.models.builder import DecoderModelBuilder
+from neuronx_distributed_inference_tpu.models.registry import register_model
+
+
+class QwenInferenceConfig(InferenceConfig):
+    _REQUIRED_ATTRS = (
+        "hidden_size",
+        "num_attention_heads",
+        "num_hidden_layers",
+        "num_key_value_heads",
+        "vocab_size",
+        "intermediate_size",
+    )
+
+
+@register_model("qwen2")
+class Qwen2ModelBuilder(DecoderModelBuilder):
+    """Qwen2: attention projections carry bias (reference modeling_qwen2.py)."""
+
+    config_cls = QwenInferenceConfig
+    qkv_bias = True
+
+
+@register_model("qwen3")
+class Qwen3ModelBuilder(DecoderModelBuilder):
+    """Qwen3: per-head RMSNorm on q/k before RoPE (reference modeling_qwen3.py)."""
+
+    config_cls = QwenInferenceConfig
+    qk_norm = True

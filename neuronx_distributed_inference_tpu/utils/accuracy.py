@@ -1,0 +1,166 @@
+"""Accuracy harness: token and logit matching against a golden model.
+
+TPU-native re-design of the reference accuracy stack
+(reference: utils/accuracy.py — check_accuracy :240, check_accuracy_logits
+:474/:685 with divergence-index reporting and per-position tolerance maps;
+LogitMatchingValidationError in utils/exceptions.py).
+
+The golden is any callable producing HF-style outputs (typically a
+``transformers`` model on CPU — the same oracle the reference uses via its
+gloo CPU mode, application_base.py:554-626).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+DEFAULT_DIVERGENCE_TOL = 0.001  # reference inference_demo.py:107-108
+
+
+class LogitMatchingValidationError(AssertionError):
+    """Raised when logits diverge beyond tolerance; carries the divergence
+    index and captured data (reference utils/exceptions.py)."""
+
+    def __init__(self, message, divergence_index=None, details=None):
+        super().__init__(message)
+        self.divergence_index = divergence_index
+        self.details = details or {}
+
+
+@dataclass
+class AccuracyReport:
+    passed: bool
+    token_match_rate: float = 1.0
+    first_divergence_index: Optional[int] = None
+    max_error_per_position: List[float] = field(default_factory=list)
+    message: str = ""
+
+
+def check_token_match(
+    actual_sequences: np.ndarray,
+    golden_sequences: np.ndarray,
+    prompt_len: int = 0,
+) -> AccuracyReport:
+    """Exact token matching (reference check_accuracy, accuracy.py:240)."""
+    actual = np.asarray(actual_sequences)[:, prompt_len:]
+    golden = np.asarray(golden_sequences)[:, prompt_len:]
+    n = min(actual.shape[1], golden.shape[1])
+    actual, golden = actual[:, :n], golden[:, :n]
+    match = actual == golden
+    rate = float(match.mean()) if match.size else 1.0
+    if rate == 1.0:
+        return AccuracyReport(passed=True, token_match_rate=1.0, message="tokens match")
+    div = int(np.argmin(match.all(axis=0)))
+    return AccuracyReport(
+        passed=False,
+        token_match_rate=rate,
+        first_divergence_index=div,
+        message=f"token mismatch from position {div}: "
+        f"actual={actual[:, div].tolist()} golden={golden[:, div].tolist()}",
+    )
+
+
+def check_logit_match(
+    actual_logits: np.ndarray,
+    golden_logits: np.ndarray,
+    divergence_tol: float = DEFAULT_DIVERGENCE_TOL,
+    tol_map: Optional[Dict[int, float]] = None,
+    raise_on_fail: bool = True,
+) -> AccuracyReport:
+    """Logit matching with per-position tolerance and divergence-index
+    reporting (reference check_accuracy_logits, accuracy.py:474-683).
+
+    actual/golden: (B, N, V) logits for the N generated positions.
+    ``tol_map`` maps position -> tolerance override (reference per-index tol
+    maps, accuracy.py:474-500).
+    """
+    actual = np.asarray(actual_logits, np.float32)
+    golden = np.asarray(golden_logits, np.float32)
+    n = min(actual.shape[1], golden.shape[1])
+    errors = []
+    divergence = None
+    for i in range(n):
+        tol = tol_map.get(i, divergence_tol) if tol_map else divergence_tol
+        # relative-to-range error like the reference's logit check
+        scale = max(float(np.max(np.abs(golden[:, i]))), 1.0)
+        err = float(np.max(np.abs(actual[:, i] - golden[:, i]))) / scale
+        errors.append(err)
+        if err > tol and divergence is None:
+            divergence = i
+    if divergence is None:
+        return AccuracyReport(passed=True, max_error_per_position=errors, message="logits match")
+    report = AccuracyReport(
+        passed=False,
+        first_divergence_index=divergence,
+        max_error_per_position=errors,
+        message=(
+            f"logit divergence at generated position {divergence}: "
+            f"rel-err {errors[divergence]:.5f} > tol"
+        ),
+    )
+    if raise_on_fail:
+        raise LogitMatchingValidationError(
+            report.message,
+            divergence_index=divergence,
+            details={"errors": errors},
+        )
+    return report
+
+
+def get_generate_outputs_hf(hf_model, input_ids, attention_mask, max_new_tokens: int):
+    """Golden generation via transformers (greedy) returning (sequences,
+    per-step logits) — per-row unpadded, the semantics our right-padded batch
+    reproduces (see tests/test_hf_parity.py)."""
+    import torch
+
+    sequences = []
+    logits = []
+    B = input_ids.shape[0]
+    for b in range(B):
+        valid = int(attention_mask[b].sum())
+        ids = torch.tensor(input_ids[b : b + 1, :valid])
+        out = hf_model.generate(
+            input_ids=ids,
+            max_new_tokens=max_new_tokens,
+            do_sample=False,
+            pad_token_id=0,
+            output_scores=True,
+            return_dict_in_generate=True,
+        )
+        sequences.append(out.sequences[0, valid:].numpy())
+        logits.append(np.stack([s[0].numpy() for s in out.scores]))
+    # a row hitting EOS early yields fewer steps; truncate to the common
+    # length so comparisons stay rectangular
+    n = min(len(s) for s in sequences)
+    return (
+        np.stack([s[:n] for s in sequences]),
+        np.stack([l[:n] for l in logits]),
+    )
+
+
+def check_accuracy(
+    app,
+    input_ids: np.ndarray,
+    attention_mask: np.ndarray,
+    hf_model,
+    max_new_tokens: int = 32,
+    divergence_tol: float = DEFAULT_DIVERGENCE_TOL,
+) -> AccuracyReport:
+    """End-to-end accuracy gate: greedy token match + logit match vs an HF
+    golden (reference inference_demo accuracy-check flow, :458-614)."""
+    out = app.generate(input_ids, attention_mask, max_new_tokens=max_new_tokens)
+    golden_seq, golden_logits = get_generate_outputs_hf(
+        hf_model, input_ids, attention_mask, out.num_generated
+    )
+    prompt_len = input_ids.shape[1]
+    report = check_token_match(out.sequences[:, prompt_len:], golden_seq)
+    if out.logits is not None:
+        logit_report = check_logit_match(
+            out.logits, golden_logits, divergence_tol, raise_on_fail=False
+        )
+        if not logit_report.passed:
+            return logit_report
+    return report

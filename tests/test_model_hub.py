@@ -1,0 +1,105 @@
+"""Model-hub parity tests: each family vs its HF implementation
+(reference: per-model integration logit checks, SURVEY §4.3)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from neuronx_distributed_inference_tpu.config import TpuConfig  # noqa: E402
+from neuronx_distributed_inference_tpu.runtime.application import (  # noqa: E402
+    TpuModelForCausalLM,
+)
+from neuronx_distributed_inference_tpu.models.llama import LlamaInferenceConfig  # noqa: E402
+
+PROMPTS = np.array([[5, 17, 92, 41, 33, 88, 2, 11]])
+
+
+def run_parity(hf_model, hf_config, model_type, n_new=10, extra_attrs=None, atol=1e-3):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    attrs = dict(
+        model_type=model_type,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=getattr(hf_config, "intermediate_size", 0),
+        num_attention_heads=hf_config.num_attention_heads,
+        num_key_value_heads=getattr(
+            hf_config, "num_key_value_heads", hf_config.num_attention_heads
+        ),
+        num_hidden_layers=hf_config.num_hidden_layers,
+        vocab_size=hf_config.vocab_size,
+        rms_norm_eps=getattr(hf_config, "rms_norm_eps", 1e-6),
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        hidden_act=getattr(hf_config, "hidden_act", "silu"),
+        tie_word_embeddings=hf_config.tie_word_embeddings,
+    )
+    if getattr(hf_config, "head_dim", None):
+        attrs["head_dim"] = hf_config.head_dim
+    attrs.update(extra_attrs or {})
+
+    def load_cfg(c):
+        for k, v in attrs.items():
+            setattr(c, k, v)
+
+    tc = TpuConfig(batch_size=1, seq_len=64, dtype="float32", output_logits=True)
+    cfg = LlamaInferenceConfig(tc, load_config=load_cfg)
+    app = TpuModelForCausalLM(None, cfg)
+    app.load(state_dict=sd)
+
+    out = app.generate(PROMPTS, np.ones_like(PROMPTS), max_new_tokens=n_new)
+    hf_out = hf_model.generate(
+        input_ids=torch.tensor(PROMPTS),
+        max_new_tokens=n_new,
+        do_sample=False,
+        pad_token_id=0,
+    )
+    np.testing.assert_array_equal(out.sequences, hf_out.numpy())
+
+    # teacher-forced logit check
+    with torch.no_grad():
+        hf_logits = hf_model(input_ids=torch.tensor(out.sequences)).logits[0].numpy()
+    S = PROMPTS.shape[1]
+    for i in range(n_new):
+        np.testing.assert_allclose(
+            out.logits[0, i], hf_logits[S + i - 1], atol=atol, rtol=atol
+        )
+    return app
+
+
+COMMON = dict(
+    vocab_size=128,
+    hidden_size=64,
+    intermediate_size=128,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    rms_norm_eps=1e-5,
+    max_position_embeddings=256,
+    tie_word_embeddings=False,
+    attn_implementation="eager",
+    eos_token_id=None,
+    bos_token_id=None,
+)
+
+
+def test_qwen2_parity():
+    torch.manual_seed(0)
+    hf_config = transformers.Qwen2Config(**COMMON)
+    hf = transformers.Qwen2ForCausalLM(hf_config).eval().float()
+    run_parity(hf, hf_config, "qwen2")
+
+
+def test_qwen3_parity():
+    torch.manual_seed(0)
+    hf_config = transformers.Qwen3Config(**COMMON, head_dim=16)
+    hf = transformers.Qwen3ForCausalLM(hf_config).eval().float()
+    run_parity(hf, hf_config, "qwen3")
+
+
+def test_tied_embeddings_parity():
+    torch.manual_seed(0)
+    kwargs = dict(COMMON)
+    kwargs["tie_word_embeddings"] = True
+    hf_config = transformers.LlamaConfig(**kwargs)
+    hf = transformers.LlamaForCausalLM(hf_config).eval().float()
+    run_parity(hf, hf_config, "llama")

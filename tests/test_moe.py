@@ -1,0 +1,144 @@
+"""MoE parity tests: Mixtral / Qwen3-MoE vs HF, and expert-parallel sharding
+(reference: tiny_model MoE EP feature tests, SURVEY §4.3)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from neuronx_distributed_inference_tpu.config import TpuConfig  # noqa: E402
+from neuronx_distributed_inference_tpu.models.llama import LlamaInferenceConfig  # noqa: E402
+from neuronx_distributed_inference_tpu.runtime.application import (  # noqa: E402
+    TpuModelForCausalLM,
+)
+
+PROMPTS = np.array([[5, 17, 92, 41, 33, 88, 2, 11]])
+
+MIXTRAL_KW = dict(
+    vocab_size=128,
+    hidden_size=64,
+    intermediate_size=128,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    num_local_experts=4,
+    num_experts_per_tok=2,
+    rms_norm_eps=1e-5,
+    max_position_embeddings=256,
+    tie_word_embeddings=False,
+    attn_implementation="eager",
+    eos_token_id=None,
+    bos_token_id=None,
+)
+
+
+def _mixtral():
+    torch.manual_seed(0)
+    hf_config = transformers.MixtralConfig(**MIXTRAL_KW)
+    return transformers.MixtralForCausalLM(hf_config).eval().float(), hf_config
+
+
+def _attrs_from(hf_config, model_type):
+    a = dict(
+        model_type=model_type,
+        hidden_size=hf_config.hidden_size,
+        intermediate_size=getattr(hf_config, "intermediate_size", None)
+        or getattr(hf_config, "moe_intermediate_size"),
+        num_attention_heads=hf_config.num_attention_heads,
+        num_key_value_heads=hf_config.num_key_value_heads,
+        num_hidden_layers=hf_config.num_hidden_layers,
+        vocab_size=hf_config.vocab_size,
+        rms_norm_eps=hf_config.rms_norm_eps,
+        rope_theta=getattr(hf_config, "rope_theta", 10000.0),
+        hidden_act="silu",
+        tie_word_embeddings=False,
+    )
+    for k in (
+        "num_local_experts",
+        "num_experts",
+        "num_experts_per_tok",
+        "moe_intermediate_size",
+        "norm_topk_prob",
+        "head_dim",
+    ):
+        if getattr(hf_config, k, None) is not None:
+            a[k] = getattr(hf_config, k)
+    return a
+
+
+def _build_app(hf, hf_config, model_type, tp=1, ep=1, output_logits=True):
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    attrs = _attrs_from(hf_config, model_type)
+
+    def load_cfg(c):
+        for k, v in attrs.items():
+            setattr(c, k, v)
+
+    tc = TpuConfig(
+        batch_size=1, seq_len=64, dtype="float32", tp_degree=tp, ep_degree=ep,
+        output_logits=output_logits,
+    )
+    cfg = LlamaInferenceConfig(tc, load_config=load_cfg)
+    app = TpuModelForCausalLM(None, cfg)
+    app.load(state_dict=sd)
+    return app
+
+
+def _check_parity(app, hf, n_new=8, atol=1e-3):
+    out = app.generate(PROMPTS, np.ones_like(PROMPTS), max_new_tokens=n_new)
+    hf_out = hf.generate(
+        input_ids=torch.tensor(PROMPTS), max_new_tokens=n_new, do_sample=False,
+        pad_token_id=0,
+    )
+    np.testing.assert_array_equal(out.sequences, hf_out.numpy())
+    with torch.no_grad():
+        hf_logits = hf(input_ids=torch.tensor(out.sequences)).logits[0].numpy()
+    S = PROMPTS.shape[1]
+    for i in range(n_new):
+        np.testing.assert_allclose(out.logits[0, i], hf_logits[S + i - 1], atol=atol, rtol=atol)
+    return out
+
+
+def test_mixtral_parity():
+    hf, hf_config = _mixtral()
+    app = _build_app(hf, hf_config, "mixtral")
+    _check_parity(app, hf)
+
+
+def test_mixtral_expert_parallel():
+    """tp=2 × ep=2 over the virtual mesh must match single-device logits
+    (reference: expert-parallel feature tests, test_expert_mlp_ep.py)."""
+    hf, hf_config = _mixtral()
+    ref = _build_app(hf, hf_config, "mixtral", tp=1, ep=1)
+    out_ref = ref.generate(PROMPTS, np.ones_like(PROMPTS), max_new_tokens=4)
+    ep = _build_app(hf, hf_config, "mixtral", tp=2, ep=2)
+    out_ep = ep.generate(PROMPTS, np.ones_like(PROMPTS), max_new_tokens=4)
+    np.testing.assert_allclose(out_ref.logits, out_ep.logits, atol=2e-3, rtol=2e-3)
+
+
+def test_qwen3_moe_parity():
+    torch.manual_seed(0)
+    hf_config = transformers.Qwen3MoeConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        moe_intermediate_size=96,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_experts=4,
+        num_experts_per_tok=2,
+        norm_topk_prob=True,
+        head_dim=16,
+        decoder_sparse_step=1,
+        rms_norm_eps=1e-5,
+        max_position_embeddings=256,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+        eos_token_id=None,
+        bos_token_id=None,
+    )
+    hf = transformers.Qwen3MoeForCausalLM(hf_config).eval().float()
+    app = _build_app(hf, hf_config, "qwen3_moe")
+    _check_parity(app, hf)
