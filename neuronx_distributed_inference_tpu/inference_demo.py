@@ -1,0 +1,240 @@
+"""inference_demo-style CLI: compile / load / generate / accuracy / benchmark.
+
+TPU-native re-design of the reference CLI
+(reference: src/neuronx_distributed_inference/inference_demo.py — argparse
+flags map 1:1 onto config fields :94-389; orchestration run_inference :458).
+
+Usage:
+    python -m neuronx_distributed_inference_tpu.inference_demo \
+        --model-type llama --task-type causal-lm run \
+        --model-path /path/to/hf/checkpoint \
+        --compiled-model-path /tmp/compiled \
+        --batch-size 1 --seq-len 1024 --tp-degree 1 \
+        --prompt "I believe the meaning of life is" \
+        --benchmark --check-accuracy-mode token-matching
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import (
+    InferenceConfig,
+    OnDeviceSamplingConfig,
+    TpuConfig,
+)
+from neuronx_distributed_inference_tpu.models.registry import MODEL_REGISTRY
+from neuronx_distributed_inference_tpu.runtime.application import TpuModelForCausalLM
+from neuronx_distributed_inference_tpu.utils.hf_adapter import load_pretrained_config
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="inference_demo", description=__doc__)
+    p.add_argument("--model-type", default="llama", choices=sorted(MODEL_REGISTRY))
+    p.add_argument("--task-type", default="causal-lm", choices=["causal-lm"])
+    sub = p.add_subparsers(dest="action", required=True)
+    run = sub.add_parser("run", help="compile, load, and generate")
+
+    # paths
+    run.add_argument("--model-path", required=True)
+    run.add_argument("--compiled-model-path", default=None)
+    run.add_argument("--random-weights", action="store_true",
+                     help="skip checkpoint load; random weights (perf/testing)")
+
+    # core shapes (reference inference_demo.py:94-180)
+    run.add_argument("--batch-size", type=int, default=1)
+    run.add_argument("--seq-len", type=int, default=1024)
+    run.add_argument("--max-context-length", type=int, default=None)
+    run.add_argument("--dtype", default="bfloat16",
+                     choices=["bfloat16", "float32", "float16"])
+
+    # parallelism (reference config.py:333-361)
+    run.add_argument("--tp-degree", type=int, default=1)
+    run.add_argument("--cp-degree", type=int, default=1)
+    run.add_argument("--ep-degree", type=int, default=1)
+    run.add_argument("--attention-dp-degree", type=int, default=1)
+
+    # bucketing
+    run.add_argument("--enable-bucketing", action="store_true", default=True)
+    run.add_argument("--no-bucketing", dest="enable_bucketing", action="store_false")
+    run.add_argument("--context-encoding-buckets", type=int, nargs="+", default=None)
+    run.add_argument("--token-generation-buckets", type=int, nargs="+", default=None)
+
+    # sampling
+    run.add_argument("--on-device-sampling", action="store_true")
+    run.add_argument("--do-sample", action="store_true")
+    run.add_argument("--top-k", type=int, default=1)
+    run.add_argument("--top-p", type=float, default=1.0)
+    run.add_argument("--temperature", type=float, default=1.0)
+
+    # quantization (reference --quantized*)
+    run.add_argument("--quantized", action="store_true")
+    run.add_argument("--quantization-type", default="per_channel_symmetric")
+    run.add_argument("--quantization-dtype", default="int8")
+    run.add_argument("--kv-cache-dtype", default=None)
+
+    # speculation
+    run.add_argument("--draft-model-path", default=None)
+    run.add_argument("--speculation-length", type=int, default=0)
+    run.add_argument("--enable-fused-speculation", action="store_true")
+
+    # generation
+    run.add_argument("--prompt", action="append", dest="prompts", default=None)
+    run.add_argument("--max-new-tokens", type=int, default=64)
+
+    # eval
+    run.add_argument("--benchmark", action="store_true")
+    run.add_argument("--check-accuracy-mode", default="skip",
+                     choices=["skip", "token-matching", "logit-matching"])
+    run.add_argument("--divergence-difference-tol", type=float, default=0.001)
+    run.add_argument("--num-runs", type=int, default=5)
+    run.add_argument("--skip-warmup", action="store_true")
+    return p
+
+
+def create_tpu_config(args) -> TpuConfig:
+    """CLI flags -> TpuConfig (reference create_neuron_config,
+    inference_demo.py:416-422)."""
+    ods = None
+    if args.on_device_sampling or args.do_sample:
+        ods = OnDeviceSamplingConfig(
+            do_sample=args.do_sample,
+            top_k=args.top_k,
+            top_p=args.top_p,
+            temperature=args.temperature,
+        )
+    return TpuConfig(
+        batch_size=args.batch_size,
+        seq_len=args.seq_len,
+        max_context_length=args.max_context_length,
+        dtype=args.dtype,
+        tp_degree=args.tp_degree,
+        cp_degree=args.cp_degree,
+        ep_degree=args.ep_degree,
+        attention_dp_degree=args.attention_dp_degree,
+        enable_bucketing=args.enable_bucketing,
+        context_encoding_buckets=args.context_encoding_buckets,
+        token_generation_buckets=args.token_generation_buckets,
+        on_device_sampling_config=ods,
+        quantized=args.quantized,
+        quantization_type=args.quantization_type,
+        quantization_dtype=args.quantization_dtype,
+        kv_cache_dtype=args.kv_cache_dtype,
+        speculation_length=args.speculation_length,
+        enable_fused_speculation=args.enable_fused_speculation,
+        skip_warmup=args.skip_warmup,
+        output_logits=args.check_accuracy_mode == "logit-matching",
+    )
+
+
+def run_inference(args) -> int:
+    """Orchestration (reference run_inference, inference_demo.py:458)."""
+    from neuronx_distributed_inference_tpu.models.registry import get_model_builder
+
+    tpu_config = create_tpu_config(args)
+    builder_cls = get_model_builder(args.model_type)
+    config_cls = getattr(builder_cls, "config_cls", InferenceConfig)
+    load_config = load_pretrained_config(args.model_path)
+    config = config_cls(tpu_config, load_config=load_config)
+
+    fused_spec = args.enable_fused_speculation or (
+        args.draft_model_path and args.speculation_length >= 2
+    )
+    print(f"[inference_demo] building {args.model_type} app "
+          f"(tp={args.tp_degree} ep={args.ep_degree} fused_spec={bool(fused_spec)})",
+          file=sys.stderr)
+    t0 = time.time()
+    if fused_spec:
+        from neuronx_distributed_inference_tpu.config import FusedSpecConfig
+        from neuronx_distributed_inference_tpu.runtime.fused_spec import (
+            TpuFusedSpecModelForCausalLM,
+        )
+
+        if not args.draft_model_path:
+            raise ValueError("--enable-fused-speculation requires --draft-model-path")
+        tpu_config.enable_fused_speculation = True
+        draft_config = config_cls(
+            create_tpu_config(args), load_config=load_pretrained_config(args.draft_model_path)
+        )
+        config.fused_spec_config = FusedSpecConfig(
+            draft_model_name=args.draft_model_path, draft_config=draft_config
+        )
+        app = TpuFusedSpecModelForCausalLM(
+            args.model_path, config, draft_model_path=args.draft_model_path
+        )
+        app.load(random_weights=args.random_weights)
+    else:
+        app = TpuModelForCausalLM(args.model_path, config)
+        app.load(random_weights=args.random_weights)
+    print(f"[inference_demo] load: {time.time()-t0:.1f}s", file=sys.stderr)
+    if not fused_spec:
+        t0 = time.time()
+        app.compile(args.compiled_model_path)
+        print(f"[inference_demo] compile+warmup: {time.time()-t0:.1f}s", file=sys.stderr)
+
+    # tokenize prompts
+    prompts = args.prompts or ["I believe the meaning of life is"]
+    try:
+        from transformers import AutoTokenizer
+
+        tok = AutoTokenizer.from_pretrained(args.model_path)
+        enc = tok(prompts, return_tensors="np", padding=True, padding_side="right")
+        input_ids = enc["input_ids"]
+        attention_mask = enc["attention_mask"]
+    except Exception as e:
+        print(f"[inference_demo] tokenizer unavailable ({e}); using raw ids",
+              file=sys.stderr)
+        input_ids = np.array([[1] + [i % 100 + 2 for i in range(15)]] * len(prompts))
+        attention_mask = np.ones_like(input_ids)
+        tok = None
+
+    eos_token_id = getattr(tok, "eos_token_id", None) if tok else None
+    gen_kwargs = dict(max_new_tokens=args.max_new_tokens, eos_token_id=eos_token_id)
+    if not fused_spec and args.do_sample:
+        gen_kwargs.update(
+            top_k=args.top_k, top_p=args.top_p, temperature=args.temperature
+        )
+    out = app.generate(input_ids, attention_mask, **gen_kwargs)
+    for i, seq in enumerate(out.sequences):
+        text = tok.decode(seq, skip_special_tokens=True) if tok else seq.tolist()
+        print(f"--- output {i} ---\n{text}")
+
+    if args.check_accuracy_mode != "skip":
+        from neuronx_distributed_inference_tpu.utils.accuracy import check_accuracy
+
+        import transformers
+
+        hf = transformers.AutoModelForCausalLM.from_pretrained(args.model_path).eval().float()
+        report = check_accuracy(
+            app, input_ids, attention_mask, hf,
+            max_new_tokens=args.max_new_tokens,
+            divergence_tol=args.divergence_difference_tol,
+        )
+        print(f"[accuracy] passed={report.passed} {report.message}")
+        if not report.passed:
+            return 1
+
+    if args.benchmark:
+        from neuronx_distributed_inference_tpu.utils.benchmark import benchmark_sampling
+
+        report = benchmark_sampling(
+            app, input_ids, attention_mask,
+            max_new_tokens=args.max_new_tokens, num_runs=args.num_runs,
+            report_path="benchmark_report.json",
+        )
+        print(json.dumps(report, indent=2))
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return run_inference(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

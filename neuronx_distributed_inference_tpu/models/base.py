@@ -113,10 +113,12 @@ def act_fn(name: str) -> Callable:
 
 def gated_mlp(params: dict, hidden: jax.Array, spec: ModelSpec) -> jax.Array:
     """SwiGLU MLP (reference NeuronLlamaMLP, modeling_llama.py:338-971)."""
+    from neuronx_distributed_inference_tpu.ops.quant import linear
+
     act = act_fn(spec.act)
-    gate = act(hidden @ params["gate_proj"]["weight"])
-    up = hidden @ params["up_proj"]["weight"]
-    return (gate * up) @ params["down_proj"]["weight"]
+    gate = act(linear(params["gate_proj"], hidden))
+    up = linear(params["up_proj"], hidden)
+    return linear(params["down_proj"], gate * up)
 
 
 def decoder_layer(
@@ -215,17 +217,20 @@ def gather_last_token(hidden: jax.Array, attention_mask: jax.Array) -> jax.Array
     return jnp.take_along_axis(hidden, idx[:, None, None], axis=1)
 
 
-def forward(
+def model_logits(
     params: dict,
     cache: KVCache,
     inputs: StepInputs,
-    rng: Optional[jax.Array],
     *,
     spec: ModelSpec,
     phase: str,
     mlp_fn: Callable = gated_mlp,
-) -> StepOutput:
-    """The traced step function (reference NeuronBaseModel.forward, model_base.py:732)."""
+) -> Tuple[jax.Array, KVCache]:
+    """Backbone + lm head, no sampling: returns (logits (B, K, V), new cache).
+
+    The composable core — fused speculation chains several of these in one
+    graph (reference NeuronFusedSpecModel, model_base.py:1656).
+    """
     hidden = embed(params, inputs.input_ids)
 
     inv_freq = params["rope"]["inv_freq"]
@@ -261,10 +266,26 @@ def forward(
     # TKG: all n_active positions produce logits
 
     logits = lm_head(params, hidden, spec)  # (B, K, V_padded)
+    return logits[..., : spec.vocab_size], new_cache
 
+
+def forward(
+    params: dict,
+    cache: KVCache,
+    inputs: StepInputs,
+    rng: Optional[jax.Array],
+    *,
+    spec: ModelSpec,
+    phase: str,
+    mlp_fn: Callable = gated_mlp,
+) -> StepOutput:
+    """The traced step function (reference NeuronBaseModel.forward, model_base.py:732)."""
+    logits, new_cache = model_logits(
+        params, cache, inputs, spec=spec, phase=phase, mlp_fn=mlp_fn
+    )
     if spec.on_device_sampling:
         tokens = sample_tokens(
-            logits[..., : spec.vocab_size],
+            logits,
             inputs.sampling_params,
             rng if spec.do_sample else None,
             spec.max_topk,
@@ -273,5 +294,5 @@ def forward(
     else:
         tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    out_logits = logits[..., : spec.vocab_size] if spec.output_logits else None
+    out_logits = logits if spec.output_logits else None
     return StepOutput(tokens=tokens, logits=out_logits, cache=new_cache)

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from neuronx_distributed_inference_tpu.modules.norm import rms_norm
 from neuronx_distributed_inference_tpu.modules.rope import apply_rope
+from neuronx_distributed_inference_tpu.ops.quant import linear
 
 
 @dataclass(frozen=True)
@@ -74,9 +75,9 @@ def qkv_project(
     Reference: prep_qkv_tensors (attention_base.py:555-629).
     """
     B, S, _ = hidden.shape
-    q = hidden @ params["q_proj"]["weight"]
-    k = hidden @ params["k_proj"]["weight"]
-    v = hidden @ params["v_proj"]["weight"]
+    q = linear(params["q_proj"], hidden)
+    k = linear(params["k_proj"], hidden)
+    v = linear(params["v_proj"], hidden)
     if spec.qkv_bias:
         q = q + params["q_proj"]["bias"]
         k = k + params["k_proj"]["bias"]
@@ -95,7 +96,7 @@ def qkv_project(
 def o_project(params: dict, attn_out: jnp.ndarray, spec: AttnSpec) -> jnp.ndarray:
     """(B,S,Hq,D) -> (B,S,H). Reference: GroupQueryAttention_O (gqa.py:1151)."""
     B, S, Hq, D = attn_out.shape
-    out = attn_out.reshape(B, S, Hq * D) @ params["o_proj"]["weight"]
+    out = linear(params["o_proj"], attn_out.reshape(B, S, Hq * D))
     if spec.o_bias:
         out = out + params["o_proj"]["bias"]
     return out
@@ -110,6 +111,10 @@ def _masked_softmax_attention(
     sink: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Native attention: q (B,Sq,Hq,D), k/v (B,Sk,Hq,D), mask (B,1,Sq,Sk)."""
+    # fp8-quantized KV caches arrive in their storage dtype; compute in q's
+    # dtype (reference fp8 KV dequant, kv_cache_manager.py:137-160)
+    k = k.astype(q.dtype)
+    v = v.astype(q.dtype)
     dtype = jnp.float32 if spec.softmax_fp32 else q.dtype
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     scores = scores * spec.softmax_scale

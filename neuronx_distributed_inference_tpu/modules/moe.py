@@ -71,21 +71,27 @@ def expert_mlps_dense(
     from neuronx_distributed_inference_tpu.models.base import act_fn as get_act
 
     act = get_act(spec.act)
-    gw = params["gate_proj"]["weight"]
-    uw = params["up_proj"]["weight"]
-    dw = params["down_proj"]["weight"]
+
+    def expert_mm(entry, x_in, eq):
+        """Expert batched matmul with optional dequant scale (E, out)."""
+        w = entry["weight"]
+        y = jnp.einsum(eq, x_in, w.astype(x_in.dtype))
+        if "scale" in entry:
+            y = y * entry["scale"].astype(y.dtype)[:, None, :]
+        return y
+
     aff = affinities.astype(x.dtype)
     if spec.early_affinity_modulation:
         # scale expert inputs, combine unweighted (reference
         # early_expert_affinity_modulation)
         xe = jnp.einsum("te,th->eth", aff, x)
-        gate = act(jnp.einsum("eth,ehi->eti", xe, gw))
-        up = jnp.einsum("eth,ehi->eti", xe, uw)
-        y = jnp.einsum("eti,eih->eth", gate * up, dw)
+        gate = act(expert_mm(params["gate_proj"], xe, "eth,ehi->eti"))
+        up = expert_mm(params["up_proj"], xe, "eth,ehi->eti")
+        y = expert_mm(params["down_proj"], gate * up, "eti,eih->eth")
         return jnp.sum(y, axis=0)
-    gate = act(jnp.einsum("th,ehi->eti", x, gw))
-    up = jnp.einsum("th,ehi->eti", x, uw)
-    y = jnp.einsum("eti,eih->eth", gate * up, dw)  # (E, T, H)
+    gate = act(expert_mm(params["gate_proj"], x, "th,ehi->eti"))
+    up = expert_mm(params["up_proj"], x, "th,ehi->eti")
+    y = expert_mm(params["down_proj"], gate * up, "eti,eih->eth")  # (E, T, H)
     return jnp.einsum("te,eth->th", aff, y)
 
 

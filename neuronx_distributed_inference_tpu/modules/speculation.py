@@ -1,0 +1,191 @@
+"""Fused speculative decoding: draft + target compiled into ONE graph.
+
+TPU-native re-design of the reference fused-speculation model
+(reference: models/model_base.py:1656-3066 ``NeuronFusedSpecModel``).
+
+One jitted step per phase:
+- :func:`fused_spec_context_encoding` — target CTE then draft CTE over the
+  prompt (reference _eagle_context_encoding_forward shape, :2082, minus the
+  EAGLE shift), both caches populated, target's next token returned.
+- :func:`fused_spec_token_gen` — the k-token decode step
+  (reference _token_gen_forward, :1861): k-1 greedy draft iterations are
+  UNROLLED AT TRACE TIME (the reference unrolls the same way, SURVEY §3.4),
+  the target verifies all k candidates in one pass, and a contiguous-match
+  postprocessor emits (accepted tokens, counts) (reference _tkg_postprocessor
+  :2844).
+
+Cache discipline (write-then-attend at exact positions) makes REJECTION
+cleanup free: entries beyond the accepted prefix are stale but masked, and
+are overwritten when those positions are genuinely generated. The one case
+that does need work is full ACCEPTANCE: the last draft candidate d_{k-1} is
+emitted but never processed by the draft, so a final draft step feeds it
+through to fill draft-cache position p+k-1 (the reference's final draft
+cache-update run, model_base.py:2708-2746).
+
+Greedy draft + greedy verify reproduces plain greedy decoding EXACTLY (the
+invariant the tests pin). Multinomial accept/reject sampling
+(reference _speculative_token_selection :1727) is the planned extension.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_inference_tpu.models.base import (
+    PHASE_CONTEXT_ENCODING,
+    PHASE_TOKEN_GENERATION,
+    ModelSpec,
+    StepInputs,
+    model_logits,
+)
+from neuronx_distributed_inference_tpu.modules.kvcache import KVCache
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class FusedSpecOutput:
+    tokens: jax.Array  # (B, K) accepted tokens, padded with 0 beyond counts
+    counts: jax.Array  # (B,) number of valid tokens in `tokens` (1..K)
+    draft_cache: KVCache
+    target_cache: KVCache
+
+
+def _row_mask(bucket: int, pos: jax.Array) -> jax.Array:
+    """In-graph cache-validity row mask: (B, 1) pos -> (B, bucket) int32."""
+    return (jnp.arange(bucket)[None, :] <= pos).astype(jnp.int32)
+
+
+def fused_spec_token_gen(
+    draft_params: dict,
+    target_params: dict,
+    draft_cache: KVCache,
+    target_cache: KVCache,
+    inputs: StepInputs,
+    *,
+    spec_len: int,
+    draft_spec: ModelSpec,
+    target_spec: ModelSpec,
+    draft_mlp_fn: Callable,
+    target_mlp_fn: Callable,
+) -> FusedSpecOutput:
+    """One fused decode step producing up to ``spec_len`` tokens.
+
+    inputs.input_ids: (B, 1) last accepted token; inputs.position_ids: (B, 1)
+    its position p; inputs.attention_mask: (B, bucket) (width defines the
+    compiled bucket; validity is recomputed in-graph from positions).
+    """
+    k = spec_len
+    bucket = inputs.attention_mask.shape[1]
+    B = inputs.input_ids.shape[0]
+    seq_ids = inputs.seq_ids
+    sp = inputs.sampling_params
+
+    # ---- draft loop: k-1 greedy single-token steps + one cache-fill step
+    # (unrolled at trace time) --------------------------------------------
+    cur = inputs.input_ids  # (B, 1)
+    pos = inputs.position_ids  # (B, 1)
+    candidates = [cur]
+    for i in range(k):
+        step_inputs = StepInputs(
+            input_ids=cur,
+            attention_mask=_row_mask(bucket, pos),
+            position_ids=pos,
+            seq_ids=seq_ids,
+            sampling_params=sp,
+        )
+        dlogits, draft_cache = model_logits(
+            draft_params,
+            draft_cache,
+            step_inputs,
+            spec=draft_spec,
+            phase=PHASE_TOKEN_GENERATION,
+            mlp_fn=draft_mlp_fn,
+        )
+        if i == k - 1:
+            # final step only fills draft-cache position p+k-1 for the last
+            # candidate (needed after a fully-accepted round; reference final
+            # draft run, model_base.py:2708-2746)
+            break
+        cur = jnp.argmax(dlogits[:, -1:, :], axis=-1).astype(jnp.int32)  # (B, 1)
+        pos = pos + 1
+        candidates.append(cur)
+
+    cand = jnp.concatenate(candidates, axis=1)  # (B, k)
+    cand_pos = inputs.position_ids + jnp.arange(k, dtype=jnp.int32)[None, :]  # (B, k)
+
+    # ---- target verify: one k-token pass ---------------------------------
+    target_inputs = StepInputs(
+        input_ids=cand,
+        attention_mask=(jnp.arange(bucket)[None, :] <= cand_pos[:, -1:]).astype(jnp.int32),
+        position_ids=cand_pos,
+        seq_ids=seq_ids,
+        sampling_params=sp,
+    )
+    tlogits, target_cache = model_logits(
+        target_params,
+        target_cache,
+        target_inputs,
+        spec=target_spec,
+        phase=PHASE_TOKEN_GENERATION,
+        mlp_fn=target_mlp_fn,
+    )  # (B, k, V): tlogits[:, i] predicts the token at cand_pos[:, i] + 1
+    greedy = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (B, k) = g_0..g_{k-1}
+
+    # ---- contiguous-match acceptance (reference _tkg_postprocessor :2844) -
+    # draft token d_{i+1} = cand[:, i+1] must equal target g_i
+    matches = (cand[:, 1:] == greedy[:, :-1]).astype(jnp.int32)  # (B, k-1)
+    accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # (B,) in [0, k-1]
+    counts = accepted + 1  # accepted drafts + bonus token
+
+    # output tokens are g_0..g_a then zero-padding
+    idx = jnp.arange(k, dtype=jnp.int32)[None, :]
+    tokens = jnp.where(idx < counts[:, None], greedy, 0)
+
+    return FusedSpecOutput(
+        tokens=tokens, counts=counts, draft_cache=draft_cache, target_cache=target_cache
+    )
+
+
+def fused_spec_context_encoding(
+    draft_params: dict,
+    target_params: dict,
+    draft_cache: KVCache,
+    target_cache: KVCache,
+    inputs: StepInputs,
+    *,
+    draft_spec: ModelSpec,
+    target_spec: ModelSpec,
+    draft_mlp_fn: Callable,
+    target_mlp_fn: Callable,
+) -> FusedSpecOutput:
+    """Fused prefill: target CTE (produces the first token) + draft CTE
+    (populates the draft cache) in one graph
+    (reference fused CTE, model_base.py:2082)."""
+    tlogits, target_cache = model_logits(
+        target_params,
+        target_cache,
+        inputs,
+        spec=target_spec,
+        phase=PHASE_CONTEXT_ENCODING,
+        mlp_fn=target_mlp_fn,
+    )
+    _, draft_cache = model_logits(
+        draft_params,
+        draft_cache,
+        inputs,
+        spec=draft_spec,
+        phase=PHASE_CONTEXT_ENCODING,
+        mlp_fn=draft_mlp_fn,
+    )
+    token = jnp.argmax(tlogits[:, -1:, :], axis=-1).astype(jnp.int32)  # (B, 1)
+    B = token.shape[0]
+    return FusedSpecOutput(
+        tokens=token,
+        counts=jnp.ones((B,), jnp.int32),
+        draft_cache=draft_cache,
+        target_cache=target_cache,
+    )

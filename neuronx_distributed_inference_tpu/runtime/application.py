@@ -73,7 +73,6 @@ class TpuModelForCausalLM:
 
         cte_buckets = autobucketing.generate_context_encoding_buckets(tc)
         tkg_buckets = autobucketing.generate_token_generation_buckets(tc)
-        pspecs = self.builder.param_pspecs()
         mlp_fn = self.builder.mlp_fn()
         # per-sub-model specialized config (reference deep-copied configs,
         # model_base.py:3099-3222)
@@ -84,7 +83,6 @@ class TpuModelForCausalLM:
             cte_buckets,
             tc.ctx_batch_size,
             self.mesh,
-            pspecs,
             mlp_fn,
         )
         self.token_generation_model = SubModelRunner(
@@ -94,7 +92,6 @@ class TpuModelForCausalLM:
             tkg_buckets,
             tc.tkg_batch_size,
             self.mesh,
-            pspecs,
             mlp_fn,
         )
         self.runners = [self.context_encoding_model, self.token_generation_model]
@@ -112,7 +109,13 @@ class TpuModelForCausalLM:
                 model_path or self.model_path
             )
             params = self.builder.convert_hf_state_dict(sd)
-        self.params = shard_pytree(params, self.builder.param_pspecs(), self.mesh)
+        pspecs = self.builder.param_pspecs()
+        if tc.quantized:
+            from neuronx_distributed_inference_tpu.ops.quant import prepare_quantized_params
+
+            params, pspecs = prepare_quantized_params(params, pspecs, tc)
+        self._pspecs = pspecs
+        self.params = shard_pytree(params, pspecs, self.mesh)
         self.init_kv_cache()
         return self
 

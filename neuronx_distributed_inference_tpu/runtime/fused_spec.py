@@ -1,0 +1,216 @@
+"""Fused-speculation application: compile/load/generate for draft+target.
+
+Reference: the fused-spec sub-model path of NeuronBaseForCausalLM
+(model_base.py:3136, enable_fused_spec) + the host-side multi-token consumer
+in HuggingFaceGenerationAdapter (hf_adapter.py:468-607).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig, to_dtype
+from neuronx_distributed_inference_tpu.models.base import StepInputs
+from neuronx_distributed_inference_tpu.models.registry import get_model_builder
+from neuronx_distributed_inference_tpu.modules import autobucketing
+from neuronx_distributed_inference_tpu.modules.autobucketing import get_target_bucket
+from neuronx_distributed_inference_tpu.modules.kvcache import cache_spec, init_cache
+from neuronx_distributed_inference_tpu.modules.sampling import prepare_sampling_params
+from neuronx_distributed_inference_tpu.modules.speculation import (
+    fused_spec_context_encoding,
+    fused_spec_token_gen,
+)
+from neuronx_distributed_inference_tpu.parallel.mesh import mesh_from_config
+from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
+from neuronx_distributed_inference_tpu.runtime.application import GenerationOutput
+from neuronx_distributed_inference_tpu.utils.hf_checkpoint import load_state_dict
+
+
+class TpuFusedSpecModelForCausalLM:
+    """Draft + target compiled together (reference NeuronFusedSpecModel)."""
+
+    def __init__(
+        self,
+        model_path: Optional[str],
+        config: InferenceConfig,
+        draft_model_path: Optional[str] = None,
+        mesh=None,
+    ):
+        tc = config.tpu_config
+        if tc.speculation_length < 2:
+            raise ValueError("fused speculation needs speculation_length >= 2")
+        fsc = getattr(config, "fused_spec_config", None)
+        draft_config = fsc.draft_config if fsc else None
+        if draft_config is None:
+            raise ValueError("config.fused_spec_config.draft_config required")
+
+        self.config = config
+        self.draft_config = draft_config
+        self.model_path = model_path
+        self.draft_model_path = draft_model_path
+        self.k = tc.speculation_length
+
+        self.target_builder = get_model_builder(getattr(config, "model_type", "llama"))(config)
+        self.draft_builder = get_model_builder(
+            getattr(draft_config, "model_type", "llama")
+        )(draft_config)
+        self.target_spec = self.target_builder.model_spec()
+        self.draft_spec = self.draft_builder.model_spec()
+        self.mesh = mesh if mesh is not None else mesh_from_config(tc)
+
+        self.cte_buckets = autobucketing.generate_context_encoding_buckets(tc)
+        self.tkg_buckets = autobucketing.generate_token_generation_buckets(tc)
+
+        common = dict(
+            draft_spec=self.draft_spec,
+            target_spec=self.target_spec,
+            draft_mlp_fn=self.draft_builder.mlp_fn(),
+            target_mlp_fn=self.target_builder.mlp_fn(),
+        )
+        self._cte_fn = jax.jit(
+            partial(fused_spec_context_encoding, **common),
+            donate_argnums=(2, 3),
+        )
+        self._tkg_fn = jax.jit(
+            partial(fused_spec_token_gen, spec_len=self.k, **common),
+            donate_argnums=(2, 3),
+        )
+        self.draft_params = None
+        self.target_params = None
+        self.draft_cache = None
+        self.target_cache = None
+
+    def load(
+        self,
+        target_state_dict=None,
+        draft_state_dict=None,
+        random_weights: bool = False,
+    ):
+        tc = self.config.tpu_config
+        if random_weights:
+            tparams = self.target_builder.random_params()
+            dparams = self.draft_builder.random_params(key=jax.random.PRNGKey(tc.seed + 1))
+        else:
+            tsd = target_state_dict if target_state_dict is not None else load_state_dict(
+                self.model_path
+            )
+            dsd = draft_state_dict if draft_state_dict is not None else load_state_dict(
+                self.draft_model_path
+            )
+            tparams = self.target_builder.convert_hf_state_dict(tsd)
+            dparams = self.draft_builder.convert_hf_state_dict(dsd)
+        t_pspecs = self.target_builder.param_pspecs()
+        d_pspecs = self.draft_builder.param_pspecs()
+        if tc.quantized:
+            from neuronx_distributed_inference_tpu.ops.quant import prepare_quantized_params
+
+            tparams, t_pspecs = prepare_quantized_params(tparams, t_pspecs, tc)
+            dparams, d_pspecs = prepare_quantized_params(dparams, d_pspecs, tc)
+        self.target_params = shard_pytree(tparams, t_pspecs, self.mesh)
+        self.draft_params = shard_pytree(dparams, d_pspecs, self.mesh)
+
+        kv_batch = tc.kv_cache_batch_size or tc.max_batch_size
+        dt = to_dtype(tc.kv_cache_dtype or tc.dtype)
+        self.target_cache = shard_pytree(
+            init_cache(
+                self.target_spec.num_layers, kv_batch, tc.seq_len,
+                self.target_spec.attn.num_kv_heads, self.target_spec.attn.head_dim, dt,
+            ),
+            cache_spec(), self.mesh,
+        )
+        self.draft_cache = shard_pytree(
+            init_cache(
+                self.draft_spec.num_layers, kv_batch, tc.seq_len,
+                self.draft_spec.attn.num_kv_heads, self.draft_spec.attn.head_dim, dt,
+            ),
+            cache_spec(), self.mesh,
+        )
+        return self
+
+    # ---- host loop -------------------------------------------------------
+
+    def generate(
+        self,
+        input_ids: np.ndarray,
+        attention_mask: Optional[np.ndarray] = None,
+        max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None,
+    ) -> GenerationOutput:
+        tc = self.config.tpu_config
+        input_ids = np.asarray(input_ids)
+        B, S_in = input_ids.shape
+        if attention_mask is None:
+            attention_mask = np.ones_like(input_ids)
+        seq_ids = np.arange(B, dtype=np.int32)
+        sp = prepare_sampling_params(B)
+
+        # --- fused CTE ---
+        bucket = get_target_bucket(self.cte_buckets, S_in)
+        pad_s = bucket - S_in
+        ids_p = np.pad(input_ids, ((0, 0), (0, pad_s)))
+        mask_p = np.pad(attention_mask, ((0, 0), (0, pad_s)))
+        pos_p = np.tile(np.arange(bucket, dtype=np.int32), (B, 1))
+        inputs = StepInputs(
+            input_ids=jnp.asarray(ids_p, jnp.int32),
+            attention_mask=jnp.asarray(mask_p, jnp.int32),
+            position_ids=jnp.asarray(pos_p),
+            seq_ids=jnp.asarray(seq_ids),
+            sampling_params=jnp.asarray(sp, jnp.float32),
+        )
+        out = self._cte_fn(
+            self.draft_params, self.target_params, self.draft_cache, self.target_cache, inputs
+        )
+        self.draft_cache, self.target_cache = out.draft_cache, out.target_cache
+        first = np.asarray(jax.device_get(out.tokens))[:, 0]  # (B,)
+
+        collected = [[int(first[b])] for b in range(B)]
+        done = np.zeros(B, bool)
+        if eos_token_id is not None:
+            done |= first == eos_token_id
+        pos = attention_mask.sum(axis=1).astype(np.int32)  # position of `first`
+        last = first.copy()
+
+        done |= np.array([len(c) >= max_new_tokens for c in collected])
+        while not done.all() and int(pos.max()) + self.k <= tc.seq_len:
+            width = int(pos.max()) + self.k
+            bucket = get_target_bucket(self.tkg_buckets, width)
+            inputs = StepInputs(
+                input_ids=jnp.asarray(last[:, None], jnp.int32),
+                attention_mask=jnp.zeros((B, bucket), jnp.int32),  # width carrier
+                position_ids=jnp.asarray(pos[:, None]),
+                seq_ids=jnp.asarray(seq_ids),
+                sampling_params=jnp.asarray(sp, jnp.float32),
+            )
+            out = self._tkg_fn(
+                self.draft_params, self.target_params, self.draft_cache, self.target_cache, inputs
+            )
+            self.draft_cache, self.target_cache = out.draft_cache, out.target_cache
+            tokens = np.asarray(jax.device_get(out.tokens))
+            counts = np.asarray(jax.device_get(out.counts))
+            for b in range(B):
+                if done[b]:
+                    continue
+                accepted = tokens[b, : counts[b]].tolist()
+                if eos_token_id is not None and eos_token_id in accepted:
+                    accepted = accepted[: accepted.index(eos_token_id) + 1]
+                    done[b] = True
+                collected[b].extend(accepted)
+                if len(collected[b]) >= max_new_tokens:
+                    done[b] = True
+            last = tokens[np.arange(B), counts - 1]
+            pos = pos + counts
+
+        n_new = min(max_new_tokens, max(len(c) for c in collected))
+        pad_tok = eos_token_id if eos_token_id is not None else 0
+        gen = np.full((B, n_new), pad_tok, np.int64)
+        for b in range(B):
+            row = collected[b][:n_new]
+            gen[b, : len(row)] = row
+        sequences = np.concatenate([input_ids, gen], axis=1)
+        return GenerationOutput(sequences=sequences, logits=None, num_generated=n_new)

@@ -25,8 +25,6 @@ from typing import Callable, Dict, List, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
-
 from neuronx_distributed_inference_tpu.models.base import (
     PHASE_CONTEXT_ENCODING,
     PHASE_TOKEN_GENERATION,
@@ -53,7 +51,6 @@ class SubModelRunner:
         buckets: List[int],
         batch_size: int,
         mesh,
-        param_pspecs,
         mlp_fn: Callable,
         n_active_tokens: int = 1,
     ):
@@ -65,21 +62,14 @@ class SubModelRunner:
         self.mesh = mesh
         self.n_active_tokens = n_active_tokens
 
-        replicated = NamedSharding(mesh, P())
-        param_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), param_pspecs)
-        cache_sh = jax.tree.map(lambda s: NamedSharding(mesh, s), cache_spec())
-        in_sh = StepInputs(
-            input_ids=replicated,
-            attention_mask=replicated,
-            position_ids=replicated,
-            seq_ids=replicated,
-            sampling_params=replicated,
-        )
+        # params/cache arrive as committed GSPMD-sharded arrays (device_put in
+        # load()); jit follows their shardings, so no in_shardings needed —
+        # and the param tree can change shape (e.g. quantization adds scale
+        # leaves) without invalidating the runner
         step = partial(forward, spec=spec, phase=phase, mlp_fn=mlp_fn)
         self._fn = jax.jit(
             step,
             donate_argnums=(1,),  # cache in-place (reference KV aliasing)
-            in_shardings=(param_sh, cache_sh, in_sh, replicated),
         )
 
     # ---- host-side padding (reference model_wrapper.py:582-1013) ---------

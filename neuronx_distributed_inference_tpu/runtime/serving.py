@@ -1,0 +1,150 @@
+"""Continuous-batching serving session.
+
+TPU-native re-design of the reference continuous-batching runtime behavior
+(reference: is_continuous_batching config; seq_id-addressed KV lines with
+batch padding + sorting in ModelWrapper._forward_with_pad,
+model_wrapper.py:582-751; vLLM-style request lifecycle).
+
+A :class:`ServingSession` owns the KV cache slot table:
+- ``add_request`` assigns a free cache line (seq_id), runs context encoding
+  for just that request (batch padded to the compiled CTE batch; other rows
+  carry seq_id=-1 so their writes land in the garbage line), and queues the
+  request for decoding.
+- ``step`` advances ALL active requests by one token in a single TKG call
+  (rows ordered slot-aligned per the sorted-full-batch convention).
+- finished requests free their slot immediately — a new request can claim it
+  on the next ``add_request`` (continuous batching).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.modules.sampling import prepare_sampling_params
+
+
+@dataclass
+class Request:
+    req_id: str
+    input_ids: np.ndarray  # (S,)
+    max_new_tokens: int = 64
+    eos_token_id: Optional[int] = None
+    slot: int = -1
+    pos: int = 0  # next write position
+    generated: List[int] = field(default_factory=list)
+    finished: bool = False
+
+    @property
+    def last_token(self) -> int:
+        return self.generated[-1] if self.generated else int(self.input_ids[-1])
+
+
+class ServingSession:
+    def __init__(self, app):
+        self.app = app
+        tc = app.config.tpu_config
+        if not tc.is_continuous_batching:
+            raise ValueError("ServingSession requires is_continuous_batching=True")
+        self.num_slots = tc.kv_cache_batch_size or tc.max_batch_size
+        self.slots: List[Optional[Request]] = [None] * self.num_slots
+        self.requests: Dict[str, Request] = {}
+
+    @property
+    def free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self.slots) if r is None]
+
+    def add_request(
+        self,
+        req_id: str,
+        input_ids: np.ndarray,
+        max_new_tokens: int = 64,
+        eos_token_id: Optional[int] = None,
+    ) -> bool:
+        """Prefill one request into a free KV line. Returns False if full."""
+        free = self.free_slots
+        if not free:
+            return False
+        slot = free[0]
+        req = Request(
+            req_id=req_id,
+            input_ids=np.asarray(input_ids, np.int32).reshape(-1),
+            max_new_tokens=max_new_tokens,
+            eos_token_id=eos_token_id,
+            slot=slot,
+        )
+        S = req.input_ids.shape[0]
+        ids = req.input_ids[None, :]
+        mask = np.ones((1, S), np.int32)
+        pos = np.arange(S, dtype=np.int32)[None, :]
+        seq_ids = np.array([slot], np.int32)
+        inputs, _ = self.app.context_encoding_model.prepare(ids, mask, pos, seq_ids)
+        out = self.app.context_encoding_model(
+            self.app.params, self.app.kv_cache, inputs, None
+        )
+        self.app.kv_cache = out.cache
+        first = int(np.asarray(out.tokens)[0, -1])
+        req.generated.append(first)
+        req.pos = S
+        if eos_token_id is not None and first == eos_token_id:
+            req.finished = True
+        self.slots[slot] = req
+        self.requests[req_id] = req
+        if req.finished or len(req.generated) >= req.max_new_tokens:
+            self._finish(req)
+        return True
+
+    def _finish(self, req: Request):
+        req.finished = True
+        if req.slot >= 0:
+            self.slots[req.slot] = None
+            req.slot = -1
+
+    @property
+    def active(self) -> List[Request]:
+        return [r for r in self.slots if r is not None]
+
+    def step(self) -> Dict[str, int]:
+        """One decode step for every active request. Returns {req_id: token}."""
+        active = self.active
+        if not active:
+            return {}
+        B = self.num_slots
+        last = np.zeros((B, 1), np.int32)
+        pos = np.zeros((B, 1), np.int32)
+        seq_ids = np.full((B,), -1, np.int32)
+        for r in active:
+            last[r.slot, 0] = r.last_token
+            pos[r.slot, 0] = r.pos
+            seq_ids[r.slot] = r.slot
+        width = int(pos.max()) + 1
+        mask = (np.arange(width)[None, :] <= pos).astype(np.int32)
+        # inactive rows: mask garbage anyway
+        inputs, _ = self.app.token_generation_model.prepare(
+            last, mask, pos, seq_ids, prepare_sampling_params(B)
+        )
+        out = self.app.token_generation_model(self.app.params, self.app.kv_cache, inputs, None)
+        self.app.kv_cache = out.cache
+        tokens = np.asarray(out.tokens)[:, -1]
+
+        results = {}
+        for r in active:
+            tok = int(tokens[r.slot])
+            r.generated.append(tok)
+            r.pos += 1
+            results[r.req_id] = tok
+            done = (
+                (r.eos_token_id is not None and tok == r.eos_token_id)
+                or len(r.generated) >= r.max_new_tokens
+                or r.pos + 1 >= self.app.config.tpu_config.seq_len
+            )
+            if done:
+                self._finish(r)
+        return results
+
+    def run_to_completion(self) -> Dict[str, List[int]]:
+        while self.active:
+            self.step()
+        return {rid: r.generated for rid, r in self.requests.items()}

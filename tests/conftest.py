@@ -47,7 +47,9 @@ def make_tiny_config(**overrides):
         tie_word_embeddings=False,
     )
     hf.update(overrides)
-    tc = TpuConfig(batch_size=2, seq_len=64, dtype="float32", **tpu_kwargs)
+    tc_kwargs = dict(batch_size=2, seq_len=64, dtype="float32")
+    tc_kwargs.update(tpu_kwargs)
+    tc = TpuConfig(**tc_kwargs)
 
     def load_config(cfg):
         for k, v in hf.items():

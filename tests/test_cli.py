@@ -1,0 +1,77 @@
+"""CLI end-to-end test: a tiny HF checkpoint on disk through inference_demo
+(reference: inference_demo run flow, SURVEY §3.1)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def tiny_checkpoint(tmp_path_factory):
+    path = tmp_path_factory.mktemp("tiny_llama_ckpt")
+    hf_config = transformers.LlamaConfig(
+        vocab_size=128,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        rms_norm_eps=1e-5,
+        max_position_embeddings=256,
+        tie_word_embeddings=False,
+        eos_token_id=None,
+        bos_token_id=None,
+    )
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_config).eval()
+    hf.save_pretrained(str(path), safe_serialization=True)
+    return str(path)
+
+
+def test_cli_run_with_accuracy(tiny_checkpoint, tmp_path):
+    from neuronx_distributed_inference_tpu.inference_demo import main
+
+    rc = main(
+        [
+            "--model-type", "llama", "run",
+            "--model-path", tiny_checkpoint,
+            "--compiled-model-path", str(tmp_path / "compiled"),
+            "--batch-size", "1",
+            "--seq-len", "64",
+            "--dtype", "float32",
+            "--max-new-tokens", "8",
+            "--check-accuracy-mode", "logit-matching",
+            "--skip-warmup",
+        ]
+    )
+    assert rc == 0
+    # compiled artifact dir has the saved config (reference tpu_config.json)
+    assert os.path.exists(tmp_path / "compiled" / "tpu_config.json")
+
+
+def test_cli_reload_from_artifact(tiny_checkpoint, tmp_path):
+    """Config JSON round-trips through the compiled-artifact dir
+    (reference: reloadable by path alone, application_base.py:82-83)."""
+    from neuronx_distributed_inference_tpu.config import InferenceConfig
+
+    from neuronx_distributed_inference_tpu.inference_demo import main
+
+    compiled = str(tmp_path / "compiled2")
+    rc = main(
+        [
+            "--model-type", "llama", "run",
+            "--model-path", tiny_checkpoint,
+            "--compiled-model-path", compiled,
+            "--batch-size", "2", "--seq-len", "64", "--dtype", "float32",
+            "--max-new-tokens", "4", "--skip-warmup",
+        ]
+    )
+    assert rc == 0
+    cfg = InferenceConfig.load(compiled)
+    assert cfg.tpu_config.batch_size == 2
+    assert cfg.hidden_size == 64

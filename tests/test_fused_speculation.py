@@ -1,0 +1,58 @@
+"""Fused speculative decoding: greedy spec output must EXACTLY equal plain
+greedy decoding (the core speculation invariant; reference
+NeuronFusedSpecModel tests, SURVEY §2.4)."""
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_tiny_config, make_random_hf_state_dict
+
+from neuronx_distributed_inference_tpu.config import FusedSpecConfig
+from neuronx_distributed_inference_tpu.runtime.application import TpuModelForCausalLM
+from neuronx_distributed_inference_tpu.runtime.fused_spec import (
+    TpuFusedSpecModelForCausalLM,
+)
+
+PROMPTS = np.array([[5, 17, 92, 41, 33, 88, 2, 11], [64, 3, 27, 9, 14, 0, 0, 0]])
+MASK = np.array([[1, 1, 1, 1, 1, 1, 1, 1], [1, 1, 1, 1, 1, 0, 0, 0]])
+
+
+def _target_and_draft(k=4, draft_seed=7):
+    target_cfg = make_tiny_config()
+    target_sd = make_random_hf_state_dict(target_cfg, seed=0)
+    draft_cfg = make_tiny_config()
+    draft_sd = make_random_hf_state_dict(draft_cfg, seed=draft_seed)
+    spec_cfg = make_tiny_config()
+    spec_cfg.tpu_config.speculation_length = k
+    spec_cfg.tpu_config.enable_fused_speculation = True
+    spec_cfg.fused_spec_config = FusedSpecConfig(
+        draft_model_name="tiny-draft", draft_config=draft_cfg
+    )
+    return target_cfg, target_sd, spec_cfg, draft_sd
+
+
+@pytest.mark.parametrize("draft_seed", [7, 0])  # 0 = draft IS the target
+def test_fused_spec_matches_greedy(draft_seed):
+    target_cfg, target_sd, spec_cfg, draft_sd = _target_and_draft(k=4, draft_seed=draft_seed)
+
+    plain = TpuModelForCausalLM(None, target_cfg)
+    plain.load(state_dict=target_sd)
+    ref = plain.generate(PROMPTS, MASK, max_new_tokens=12).sequences
+
+    app = TpuFusedSpecModelForCausalLM(None, spec_cfg)
+    app.load(target_state_dict=target_sd, draft_state_dict=draft_sd)
+    out = app.generate(PROMPTS, MASK, max_new_tokens=12)
+
+    np.testing.assert_array_equal(out.sequences[:, : ref.shape[1]], ref)
+
+
+def test_fused_spec_full_acceptance_when_draft_is_target():
+    """Draft == target => every draft token accepted (counts == k)."""
+    target_cfg, target_sd, spec_cfg, _ = _target_and_draft(k=4, draft_seed=0)
+    app = TpuFusedSpecModelForCausalLM(None, spec_cfg)
+    app.load(target_state_dict=target_sd, draft_state_dict=target_sd)
+
+    # run one fused TKG step directly after CTE
+    out = app.generate(PROMPTS[:, :4], MASK[:, :4] * 0 + 1, max_new_tokens=9)
+    # with full acceptance, 9 tokens need 1 (CTE) + 2 fused steps of k=4
+    assert out.num_generated >= 9
