@@ -72,6 +72,9 @@ class ModelSpec:
     # attention flavor
     sliding_window: Optional[int] = None
     attention_chunk_size: Optional[int] = None
+    # context/sequence parallelism (reference CP/SP, SURVEY §2.9)
+    cp_enabled: bool = False
+    sequence_parallel: bool = False
     # sampling
     on_device_sampling: bool = True
     do_sample: bool = False
@@ -85,13 +88,18 @@ class ModelSpec:
 @jax.tree_util.register_dataclass
 @dataclass
 class StepInputs:
-    """Per-step device inputs (reference forward args, model_base.py:3373)."""
+    """Per-step device inputs (reference forward args, model_base.py:3373;
+    the block-KV fields mirror the vLLM kwargs the reference accepts,
+    model_base.py:3392-3396)."""
 
     input_ids: jax.Array  # (B, S) int32
     attention_mask: jax.Array  # CTE: (B, S); TKG: (B, S_bucket) cache-valid mask
     position_ids: jax.Array  # (B, S) int32
     seq_ids: jax.Array  # (B,) int32 cache-line ids (invalid -> garbage)
     sampling_params: jax.Array  # (B, 3) float32
+    slot_mapping: Optional[jax.Array] = None  # (B, S) block-KV flat slots
+    block_table: Optional[jax.Array] = None  # (B, MB) block-KV block ids
+    adapter_ids: Optional[jax.Array] = None  # (B,) LoRA adapter per request
 
 
 @jax.tree_util.register_dataclass
@@ -135,6 +143,8 @@ def decoder_layer(
     phase: str,
     mlp_fn: Callable,
     key_valid: Optional[jax.Array] = None,
+    block_inputs: Optional[Tuple[jax.Array, jax.Array]] = None,
+    adapter_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decoder layer (reference NeuronLlamaDecoderLayer, modeling_llama.py:1188).
 
@@ -143,27 +153,61 @@ def decoder_layer(
     aspec = spec.attn
     residual = hidden
     hidden = rms_norm(hidden, layer_params["input_layernorm"]["weight"], spec.rms_eps)
-    q, k, v = qkv_project(layer_params["self_attn"], hidden, cos, sin, aspec)
+    q, k, v = qkv_project(
+        layer_params["self_attn"], hidden, cos, sin, aspec, adapter_ids=adapter_ids
+    )
 
     # write-then-attend: scatter new KV into this layer's cache first
     # (reference updates via kv_mgr.update_cache per layer, model_base.py:1449)
-    k_cache_l, v_cache_l = update_layer_cache(k_cache_l, v_cache_l, k, v, slot_ids, positions)
+    is_block = block_inputs is not None
+    if is_block:
+        from neuronx_distributed_inference_tpu.modules.block_kvcache import (
+            read_layer_block_cache,
+            update_layer_block_cache,
+        )
+
+        slot_mapping, block_table = block_inputs
+        k_cache_l, v_cache_l = update_layer_block_cache(
+            k_cache_l, v_cache_l, k, v, slot_mapping
+        )
+    else:
+        k_cache_l, v_cache_l = update_layer_cache(
+            k_cache_l, v_cache_l, k, v, slot_ids, positions
+        )
 
     sink = layer_params["self_attn"].get("sink", {}).get("weight") if aspec.has_sink else None
     if phase == PHASE_CONTEXT_ENCODING:
+        if spec.cp_enabled:
+            # CP prefill: Q keeps its seq stripe; KV constrained replicated so
+            # GSPMD all-gathers it over the cp axis (reference all-gather-KV
+            # CP, attention_base.py:614-627)
+            from neuronx_distributed_inference_tpu.parallel import context_parallel as cpx
+
+            q = cpx.shard_q(q)
+            k = cpx.gather_kv(k)
+            v = cpx.gather_kv(v)
         attn_out = attention_prefill(q, k, v, mask, aspec, sink=sink, key_valid=key_valid)
+        if spec.cp_enabled:
+            attn_out = cpx.shard_attn_out(attn_out)
+    elif is_block:
+        k_r, v_r = read_layer_block_cache(k_cache_l, v_cache_l, block_table)
+        attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
     else:
         B = q.shape[0]
         bucket = mask.shape[-1]
         k_r, v_r = read_layer_cache(k_cache_l, v_cache_l, B, bucket)
         attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
 
-    hidden = o_project(layer_params["self_attn"], attn_out, aspec)
+    hidden = o_project(layer_params["self_attn"], attn_out, aspec, adapter_ids=adapter_ids)
     hidden = residual + hidden
 
     residual = hidden
     hidden = rms_norm(hidden, layer_params["post_attention_layernorm"]["weight"], spec.rms_eps)
     hidden = residual + mlp_fn(layer_params["mlp"], hidden, spec)
+    if spec.cp_enabled and phase == PHASE_CONTEXT_ENCODING:
+        from neuronx_distributed_inference_tpu.parallel import context_parallel as cpx
+
+        hidden = cpx.shard_seq(hidden)
     return hidden, k_cache_l, v_cache_l
 
 
@@ -237,27 +281,46 @@ def model_logits(
     cos, sin = rope_cos_sin(inputs.position_ids, inv_freq, spec.attention_scaling)
 
     mask = build_mask(inputs, spec, phase)
-    slot_ids = slot_ids_from_seq_ids(inputs.seq_ids, cache.batch_size)
+    if (spec.cp_enabled or spec.sequence_parallel) and phase == PHASE_CONTEXT_ENCODING:
+        # SP: activations sharded along S over the cp axis (reference SP
+        # reduce-scatter of embeddings, model_base.py:1524-1575)
+        from neuronx_distributed_inference_tpu.parallel import context_parallel as cpx
+
+        hidden = cpx.shard_seq(hidden)
+        if spec.cp_enabled:
+            mask = cpx.shard_prefill_mask(mask)
+    if inputs.slot_mapping is not None:
+        slot_ids = inputs.seq_ids  # block layout: writes go via slot_mapping
+    else:
+        slot_ids = slot_ids_from_seq_ids(inputs.seq_ids, cache.batch_size)
     positions = inputs.position_ids
     # plain-causal prefill exposes key validity so the flash kernel can run
+    # (not under CP: pallas custom calls don't auto-partition — the CP path
+    # uses the GSPMD-partitioned native attention)
     key_valid = None
     if (
         phase == PHASE_CONTEXT_ENCODING
         and not spec.sliding_window
         and not spec.attention_chunk_size
+        and not spec.cp_enabled
     ):
         key_valid = inputs.attention_mask
+
+    block_inputs = None
+    if inputs.slot_mapping is not None:
+        block_inputs = (inputs.slot_mapping, inputs.block_table)
 
     def scan_body(h, xs):
         layer_params, k_l, v_l = xs
         h, k_l, v_l = decoder_layer(
             layer_params, h, cos, sin, k_l, v_l, mask, slot_ids, positions, spec, phase,
-            mlp_fn, key_valid=key_valid,
+            mlp_fn, key_valid=key_valid, block_inputs=block_inputs,
+            adapter_ids=inputs.adapter_ids,
         )
         return h, (k_l, v_l)
 
     hidden, (new_k, new_v) = jax.lax.scan(scan_body, hidden, (params["layers"], cache.k, cache.v))
-    new_cache = KVCache(k=new_k, v=new_v)
+    new_cache = type(cache)(k=new_k, v=new_v)
 
     hidden = rms_norm(hidden, params["norm"]["weight"], spec.rms_eps)
 

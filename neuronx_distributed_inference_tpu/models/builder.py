@@ -91,6 +91,8 @@ class DecoderModelBuilder:
             tie_word_embeddings=getattr(cfg, "tie_word_embeddings", False),
             sliding_window=tc.sliding_window,
             attention_chunk_size=tc.attention_chunk_size,
+            cp_enabled=tc.cp_degree > 1,
+            sequence_parallel=tc.sequence_parallel_enabled,
             on_device_sampling=ods is not None,
             do_sample=bool(ods and ods.do_sample),
             max_topk=tc.max_topk,

@@ -69,15 +69,18 @@ def qkv_project(
     cos: jnp.ndarray,
     sin: jnp.ndarray,
     spec: AttnSpec,
+    adapter_ids=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """hidden (B,S,H) -> q (B,S,Hq,D), k,v (B,S,Hkv,D), with RoPE applied.
 
     Reference: prep_qkv_tensors (attention_base.py:555-629).
     """
+    from neuronx_distributed_inference_tpu.modules.lora import apply_lora
+
     B, S, _ = hidden.shape
-    q = linear(params["q_proj"], hidden)
-    k = linear(params["k_proj"], hidden)
-    v = linear(params["v_proj"], hidden)
+    q = apply_lora(params["q_proj"], hidden, linear(params["q_proj"], hidden), adapter_ids)
+    k = apply_lora(params["k_proj"], hidden, linear(params["k_proj"], hidden), adapter_ids)
+    v = apply_lora(params["v_proj"], hidden, linear(params["v_proj"], hidden), adapter_ids)
     if spec.qkv_bias:
         q = q + params["q_proj"]["bias"]
         k = k + params["k_proj"]["bias"]
@@ -93,10 +96,15 @@ def qkv_project(
     return q, k, v
 
 
-def o_project(params: dict, attn_out: jnp.ndarray, spec: AttnSpec) -> jnp.ndarray:
+def o_project(
+    params: dict, attn_out: jnp.ndarray, spec: AttnSpec, adapter_ids=None
+) -> jnp.ndarray:
     """(B,S,Hq,D) -> (B,S,H). Reference: GroupQueryAttention_O (gqa.py:1151)."""
+    from neuronx_distributed_inference_tpu.modules.lora import apply_lora
+
     B, S, Hq, D = attn_out.shape
-    out = linear(params["o_proj"], attn_out.reshape(B, S, Hq * D))
+    flat = attn_out.reshape(B, S, Hq * D)
+    out = apply_lora(params["o_proj"], flat, linear(params["o_proj"], flat), adapter_ids)
     if spec.o_bias:
         out = out + params["o_proj"]["bias"]
     return out

@@ -62,3 +62,7 @@ def get_target_bucket(buckets: List[int], length: int) -> int:
 
 def pad_length_to_bucket(length: int, buckets: List[int]) -> int:
     return get_target_bucket(buckets, length)
+
+
+def round_up(n: int, multiple: int) -> int:
+    return -(-n // multiple) * multiple

@@ -1,0 +1,154 @@
+"""Paged (block) KV cache + host-side block allocator.
+
+TPU-native re-design of the reference paged KV stack
+(reference: modules/kvcache/block_kv_cache_manager.py — layout
+``(num_blocks+1, block_size, H/tp, d)`` with one reserved garbage block;
+gather-by-block-table reads, scatter-by-slot-mapping writes; vLLM
+``get_active_block_table`` in modules/kvcache/utils.py).
+
+Device side (pure functions used inside the jitted step):
+- writes scatter token K/V through a flat ``slot_mapping`` (block *
+  block_size + offset); invalid slots (< 0) land in the reserved garbage
+  block 0 (reference's reserved block, block_kv_cache_manager.py:11-80).
+- decode reads gather blocks by the per-sequence ``block_table`` and view
+  them as a contiguous (B, max_blocks*block_size) cache — logical position
+  order is preserved, so the normal decode masks apply unchanged.
+
+Host side: :class:`BlockAllocator` manages the free-block pool and builds
+slot mappings / block tables (the role vLLM plays for the reference).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GARBAGE_BLOCK = 0  # block id 0 reserved for invalid-slot writes
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class BlockKVCache:
+    """k/v: (L, num_blocks+1, block_size, H_kv, D)."""
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def num_layers(self):
+        return self.k.shape[0]
+
+    @property
+    def num_blocks(self):
+        return self.k.shape[1] - 1
+
+    @property
+    def block_size(self):
+        return self.k.shape[2]
+
+
+def init_block_cache(
+    num_layers: int,
+    num_blocks: int,
+    block_size: int,
+    num_kv_heads: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+) -> BlockKVCache:
+    shape = (num_layers, num_blocks + 1, block_size, num_kv_heads, head_dim)
+    return BlockKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def block_cache_spec():
+    from jax.sharding import PartitionSpec as P
+
+    from neuronx_distributed_inference_tpu.parallel.mesh import MODEL_AXES
+
+    return BlockKVCache(
+        k=P(None, None, None, MODEL_AXES, None), v=P(None, None, None, MODEL_AXES, None)
+    )
+
+
+def update_layer_block_cache(
+    k_cache_l: jax.Array,  # (NB+1, bs, H, D)
+    v_cache_l: jax.Array,
+    k_new: jax.Array,  # (B, S, H, D)
+    v_new: jax.Array,
+    slot_mapping: jax.Array,  # (B, S) global slots; < 0 -> garbage block
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter token K/V into the paged cache (reference scatter-by-slot,
+    block_kv_cache_manager.py)."""
+    NB1, bs, H, D = k_cache_l.shape
+    flat_k = k_cache_l.reshape(NB1 * bs, H, D)
+    flat_v = v_cache_l.reshape(NB1 * bs, H, D)
+    B, S = slot_mapping.shape
+    slots = jnp.where(slot_mapping >= 0, slot_mapping, slot_mapping % bs).reshape(B * S)
+    flat_k = flat_k.at[slots].set(k_new.reshape(B * S, H, D).astype(flat_k.dtype), mode="drop")
+    flat_v = flat_v.at[slots].set(v_new.reshape(B * S, H, D).astype(flat_v.dtype), mode="drop")
+    return flat_k.reshape(NB1, bs, H, D), flat_v.reshape(NB1, bs, H, D)
+
+
+def read_layer_block_cache(
+    k_cache_l: jax.Array,  # (NB+1, bs, H, D)
+    v_cache_l: jax.Array,
+    block_table: jax.Array,  # (B, MB) block ids; 0 for unused tail entries
+) -> Tuple[jax.Array, jax.Array]:
+    """Gather the active blocks into a contiguous per-sequence view
+    (reference gather-by-active-block-table reads)."""
+    B, MB = block_table.shape
+    _, bs, H, D = k_cache_l.shape
+    k = k_cache_l[block_table]  # (B, MB, bs, H, D)
+    v = v_cache_l[block_table]
+    return k.reshape(B, MB * bs, H, D), v.reshape(B, MB * bs, H, D)
+
+
+# ---------------------------------------------------------------------------
+# Host-side allocator
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BlockAllocator:
+    """Free-block pool + per-sequence block lists (the vLLM role for the
+    reference; here in-framework so serving works standalone)."""
+
+    num_blocks: int
+    block_size: int
+    free: List[int] = field(default_factory=list)
+    seq_blocks: Dict[int, List[int]] = field(default_factory=dict)
+
+    def __post_init__(self):
+        # block 0 reserved as garbage
+        self.free = list(range(1, self.num_blocks + 1))
+
+    def alloc_seq(self, seq_id: int, num_tokens: int) -> List[int]:
+        """Ensure seq has blocks covering num_tokens positions."""
+        blocks = self.seq_blocks.setdefault(seq_id, [])
+        needed = -(-num_tokens // self.block_size) - len(blocks)
+        if needed > len(self.free):
+            raise RuntimeError(
+                f"out of KV blocks: need {needed}, free {len(self.free)}"
+            )
+        for _ in range(max(0, needed)):
+            blocks.append(self.free.pop(0))
+        return blocks
+
+    def free_seq(self, seq_id: int):
+        self.free.extend(self.seq_blocks.pop(seq_id, []))
+
+    def slot_mapping(self, seq_id: int, positions: np.ndarray) -> np.ndarray:
+        """Logical positions -> global flat slots for this sequence."""
+        blocks = self.seq_blocks[seq_id]
+        block_ids = np.asarray([blocks[p // self.block_size] for p in positions])
+        return block_ids * self.block_size + (np.asarray(positions) % self.block_size)
+
+    def block_table(self, seq_id: int, max_blocks: int) -> np.ndarray:
+        blocks = self.seq_blocks.get(seq_id, [])
+        table = np.zeros(max_blocks, np.int32)
+        n = min(len(blocks), max_blocks)
+        table[:n] = blocks[:n]
+        return table

@@ -63,18 +63,23 @@ def init_cache(
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
-def cache_spec(kv_head_axis=None):
-    """PartitionSpec for the cache: shard KV heads over the model axes.
+def cache_spec(cp_enabled: bool = False):
+    """PartitionSpec for the cache — identical for the CTE and TKG programs so
+    the cache never reshards between phases (SURVEY §7 hard-part 5).
 
-    Used identically by the CTE and TKG programs so the cache never reshards
-    between phases (SURVEY §7 hard-part 5).
-    """
+    Default: KV heads sharded over the full model axes. With context
+    parallelism the SEQUENCE dim shards over ``cp`` instead (heads over
+    (ep, tp)): decode reductions over the key axis then become a
+    GSPMD-distributed softmax — flash decoding (reference flashdecode/)."""
     from jax.sharding import PartitionSpec as P
 
-    from neuronx_distributed_inference_tpu.parallel.mesh import MODEL_AXES
+    from neuronx_distributed_inference_tpu.parallel.mesh import AXIS_CP, AXIS_EP, AXIS_TP, MODEL_AXES
 
-    axis = kv_head_axis if kv_head_axis is not None else MODEL_AXES
-    return KVCache(k=P(None, None, None, axis, None), v=P(None, None, None, axis, None))
+    if cp_enabled:
+        spec = P(None, None, AXIS_CP, (AXIS_EP, AXIS_TP), None)
+    else:
+        spec = P(None, None, None, MODEL_AXES, None)
+    return KVCache(k=spec, v=spec)
 
 
 def slot_ids_from_seq_ids(seq_ids: jax.Array, batch_size: int) -> jax.Array:

@@ -1,0 +1,164 @@
+"""Multi-adapter LoRA serving.
+
+TPU-native re-design of the reference LoRA serving stack
+(reference: modules/lora_serving/ — LoraModel.inject_adapter swaps parallel
+layers for multi-adapter LoRA layers (lora_model.py:35-201);
+LoraWeightManager selects adapter weights by per-sequence ``adapter_ids``
+(lora_model.py:203-260); sharded adapter checkpoints loaded at
+application_base.py:256-260).
+
+Design: adapters live STACKED in the param tree next to their base weight::
+
+    entry = {"weight": (in, out), "lora_A": (N, in, r), "lora_B": (N, r, out),
+             "lora_scaling": (N,)}
+
+``adapter_ids (B,)`` gathers each request's adapter; adapter id 0 is reserved
+as the zero (no-op) adapter so base-model requests batch freely with LoRA
+requests. The delta is two small per-row einsums — XLA batches them on the
+MXU; no layer swapping needed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: adapter id 0 = zero adapter (base model behavior)
+BASE_ADAPTER_ID = 0
+
+
+def lora_delta(entry: dict, x: jax.Array, adapter_ids: jax.Array) -> jax.Array:
+    """Per-request LoRA delta: x (B, S, in) -> (B, S, out).
+
+    Reference: multi-adapter forward in lora_layer.py.
+    """
+    A = entry["lora_A"][adapter_ids]  # (B, in, r)
+    Bm = entry["lora_B"][adapter_ids]  # (B, r, out)
+    scale = entry["lora_scaling"][adapter_ids]  # (B,)
+    xa = jnp.einsum("bsi,bir->bsr", x, A.astype(x.dtype))
+    delta = jnp.einsum("bsr,bro->bso", xa, Bm.astype(x.dtype))
+    return delta * scale.astype(x.dtype)[:, None, None]
+
+
+def apply_lora(entry: dict, x: jax.Array, base_out: jax.Array, adapter_ids) -> jax.Array:
+    """base_out + LoRA delta when this entry carries adapters."""
+    if adapter_ids is None or "lora_A" not in entry:
+        return base_out
+    return base_out + lora_delta(entry, x, adapter_ids)
+
+
+class LoraWeightManager:
+    """Host-side adapter registry: loads PEFT-format checkpoints, stacks them
+    per target module, and resolves adapter names -> ids
+    (reference LoraWeightManager, lora_model.py:203-260; AdapterCache
+    :262-392 — here all adapters stay device-resident up to max_loras)."""
+
+    def __init__(self, lora_config):
+        self.config = lora_config
+        self.adapter_ids: Dict[str, int] = {}  # name -> id (0 reserved)
+
+    def register(self, name: str) -> int:
+        if name in self.adapter_ids:
+            return self.adapter_ids[name]
+        idx = len(self.adapter_ids) + 1  # 0 = zero adapter
+        if idx > self.config.max_loras:
+            raise RuntimeError(f"max_loras={self.config.max_loras} exceeded")
+        self.adapter_ids[name] = idx
+        return idx
+
+    def resolve(self, names) -> np.ndarray:
+        return np.asarray(
+            [BASE_ADAPTER_ID if n is None else self.adapter_ids[n] for n in names],
+            np.int32,
+        )
+
+
+def attach_lora_params(
+    params: dict,
+    adapters: Dict[str, dict],
+    manager: LoraWeightManager,
+    num_layers: int,
+    dtype=jnp.float32,
+) -> dict:
+    """Stack adapter checkpoints into the param tree.
+
+    ``adapters``: {adapter_name: PEFT state dict} with keys like
+    ``base_model.model.model.layers.{i}.self_attn.q_proj.lora_A.weight``
+    (shape (r, in)) / ``...lora_B.weight`` ((out, r)).
+    """
+    cfg = manager.config
+    N = cfg.max_loras + 1  # slot 0 = zeros
+    r_max = cfg.max_lora_rank
+    target = set(cfg.target_modules)
+
+    def find_key(sd, layer, module, piece):
+        for pattern in (
+            f"base_model.model.model.layers.{layer}.self_attn.{module}.{piece}.weight",
+            f"base_model.model.model.layers.{layer}.mlp.{module}.{piece}.weight",
+            f"model.layers.{layer}.self_attn.{module}.{piece}.weight",
+            f"model.layers.{layer}.mlp.{module}.{piece}.weight",
+        ):
+            if pattern in sd:
+                return sd[pattern]
+        return None
+
+    for group in ("self_attn", "mlp"):
+        node = params["layers"].get(group, {}) if group == "mlp" else params["layers"][group]
+        for module, entry in list(node.items()):
+            if module not in target or "weight" not in entry:
+                continue
+            w = entry["weight"]  # (L, in, out)
+            L, d_in, d_out = w.shape
+            A = np.zeros((N, L, d_in, r_max), np.float32)
+            B = np.zeros((N, L, r_max, d_out), np.float32)
+            scaling = np.zeros((N,), np.float32)
+            found_any = False
+            for name, sd in adapters.items():
+                idx = manager.register(name)
+                alpha = sd.get("lora_alpha", None)
+                for layer in range(num_layers):
+                    a = find_key(sd, layer, module, "lora_A")
+                    b = find_key(sd, layer, module, "lora_B")
+                    if a is None or b is None:
+                        continue
+                    found_any = True
+                    r = a.shape[0]
+                    if r > r_max:
+                        raise ValueError(f"adapter {name} rank {r} > max_lora_rank {r_max}")
+                    A[idx, layer, :, :r] = np.asarray(a).T
+                    B[idx, layer, :r, :] = np.asarray(b).T
+                    scaling[idx] = (alpha or r) / r
+            if found_any:
+                # layer-stacked layout to ride the lax.scan: (L, N, in, r)
+                entry["lora_A"] = jnp.asarray(A.transpose(1, 0, 2, 3), dtype)
+                entry["lora_B"] = jnp.asarray(B.transpose(1, 0, 2, 3), dtype)
+                entry["lora_scaling"] = jnp.asarray(
+                    np.tile(scaling[None, :], (L, 1)), jnp.float32
+                )
+    return params
+
+
+def lora_pspecs(pspecs: dict, params: dict) -> dict:
+    """PartitionSpecs for adapter leaves: replicate A, shard B's output dim
+    like the base weight (small tensors; replication is fine at these sizes —
+    reference keeps adapters replicated too)."""
+    from jax.sharding import PartitionSpec as P
+
+    def walk(spec_node, param_node):
+        if isinstance(param_node, dict) and "lora_A" in param_node:
+            out = dict(spec_node)
+            out["lora_A"] = P()
+            out["lora_B"] = P()
+            out["lora_scaling"] = P()
+            return out
+        if isinstance(param_node, dict):
+            return {
+                k: walk(spec_node.get(k, {}) if isinstance(spec_node, dict) else spec_node, v)
+                for k, v in param_node.items()
+            }
+        return spec_node
+
+    return walk(pspecs, params)

@@ -1,0 +1,65 @@
+"""Context-parallel / sequence-parallel sharding constraints.
+
+TPU-native re-design of the reference CP/SP machinery (reference: CP process
+groups + per-rank sequence splits + KV all-gather,
+attention_base.py:245-257,555-629,2497; SP reduce-scatter of embeddings,
+model_base.py:1524-1575; flash decoding Q-allgather + distributed softmax,
+attention_base.py:2148-2165).
+
+On TPU none of that is hand-written: the mesh factors the model group as
+``(cp, tp)`` and these functions drop ``with_sharding_constraint`` hints so
+GSPMD emits the collectives:
+
+- prefill activations sharded along S over ``cp`` (== the reference's SP
+  reduce-scatter + CP input split);
+- attention Q keeps its sequence stripe while K/V are constrained
+  seq-replicated — GSPMD inserts the KV all-gather over the cp ICI ring
+  (== the reference's all-gather-KV CP, NOT ring attention);
+- the KV cache itself stays S-sharded over ``cp`` in BOTH phases, so decode
+  reductions over the key axis become a GSPMD-distributed softmax — the
+  flash-decoding pattern (reference flashdecode/) with zero custom code.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_inference_tpu.parallel.mesh import AXIS_CP, AXIS_EP, AXIS_TP
+
+HEADS = (AXIS_EP, AXIS_TP)  # head sharding when cp is active (cp shards seq)
+
+
+def _constrain(x, spec):
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, TypeError):
+        # no mesh context (single-device path) — constraint is advisory only
+        return x
+
+
+def shard_seq(hidden):
+    """(B, S, H): shard S over cp — SP activations
+    (reference model_base.py:1524-1575)."""
+    return _constrain(hidden, P(None, AXIS_CP, None))
+
+
+def shard_q(q):
+    """(B, S, Hq, D): Q keeps its sequence stripe, heads over (ep, tp)."""
+    return _constrain(q, P(None, AXIS_CP, HEADS, None))
+
+
+def gather_kv(kv):
+    """(B, S, Hkv, D): constrain seq-replicated -> GSPMD all-gathers KV over
+    cp (reference KV all-gather, attention_base.py:614-627)."""
+    return _constrain(kv, P(None, None, HEADS, None))
+
+
+def shard_prefill_mask(mask):
+    """(B, 1, Sq, Sk): query rows sharded over cp, full key axis."""
+    return _constrain(mask, P(None, None, AXIS_CP, None))
+
+
+def shard_attn_out(out):
+    """(B, S, Hq, D) attention output back to the seq-sharded layout."""
+    return _constrain(out, P(None, AXIS_CP, HEADS, None))

@@ -70,10 +70,17 @@ class TpuModelForCausalLM:
         self.kv_cache: Optional[KVCache] = None
         self._rng_key = jax.random.PRNGKey(tc.seed)
         self._call_key = self._rng_key
+        self.lora_manager = None
 
         cte_buckets = autobucketing.generate_context_encoding_buckets(tc)
         tkg_buckets = autobucketing.generate_token_generation_buckets(tc)
+        if tc.is_block_kv_layout:
+            # block-table gathers need bucket % block_size == 0
+            tkg_buckets = sorted(
+                {autobucketing.round_up(b, tc.pa_block_size) for b in tkg_buckets}
+            )
         mlp_fn = self.builder.mlp_fn()
+        block_kwargs = dict(block_kv=tc.is_block_kv_layout, block_size=tc.pa_block_size)
         # per-sub-model specialized config (reference deep-copied configs,
         # model_base.py:3099-3222)
         self.context_encoding_model = SubModelRunner(
@@ -84,6 +91,7 @@ class TpuModelForCausalLM:
             tc.ctx_batch_size,
             self.mesh,
             mlp_fn,
+            **block_kwargs,
         )
         self.token_generation_model = SubModelRunner(
             TAG_TOKEN_GENERATION,
@@ -93,6 +101,7 @@ class TpuModelForCausalLM:
             tc.tkg_batch_size,
             self.mesh,
             mlp_fn,
+            **block_kwargs,
         )
         self.runners = [self.context_encoding_model, self.token_generation_model]
 
@@ -121,6 +130,23 @@ class TpuModelForCausalLM:
 
     def init_kv_cache(self):
         tc = self.config.tpu_config
+        dt = to_dtype(tc.kv_cache_dtype or tc.dtype)
+        if tc.is_block_kv_layout:
+            from neuronx_distributed_inference_tpu.modules.block_kvcache import (
+                block_cache_spec,
+                init_block_cache,
+            )
+
+            cache = init_block_cache(
+                self.spec.num_layers,
+                tc.pa_num_blocks,
+                tc.pa_block_size,
+                self.spec.attn.num_kv_heads,
+                self.spec.attn.head_dim,
+                dtype=dt,
+            )
+            self.kv_cache = shard_pytree(cache, block_cache_spec(), self.mesh)
+            return
         kv_batch = tc.kv_cache_batch_size or tc.max_batch_size
         cache = init_cache(
             self.spec.num_layers,
@@ -128,9 +154,42 @@ class TpuModelForCausalLM:
             tc.seq_len,
             self.spec.attn.num_kv_heads,
             self.spec.attn.head_dim,
-            dtype=to_dtype(tc.kv_cache_dtype or tc.dtype),
+            dtype=dt,
         )
-        self.kv_cache = shard_pytree(cache, cache_spec(), self.mesh)
+        self.kv_cache = shard_pytree(cache, cache_spec(tc.cp_degree > 1), self.mesh)
+
+    def load_lora_adapters(self, adapters):
+        """Attach multi-adapter LoRA weights (reference LoraModel.inject_adapter
+        + LoraWeightManager, lora_serving/lora_model.py:35-260).
+
+        ``adapters``: {adapter_name: PEFT-format state dict}.
+        """
+        from neuronx_distributed_inference_tpu.modules.lora import (
+            LoraWeightManager,
+            attach_lora_params,
+            lora_pspecs,
+        )
+
+        tc = self.config.tpu_config
+        if tc.lora_config is None:
+            raise ValueError("lora_config must be set to serve LoRA adapters")
+        if self.params is None:
+            raise RuntimeError("call load() before load_lora_adapters()")
+        self.lora_manager = LoraWeightManager(tc.lora_config)
+        params = attach_lora_params(
+            self.params, adapters, self.lora_manager, self.spec.num_layers,
+            dtype=to_dtype(tc.dtype),
+        )
+        self._pspecs = lora_pspecs(self._pspecs, params)
+        self.params = shard_pytree(params, self._pspecs, self.mesh)
+        return self
+
+    def resolve_adapter_ids(self, adapter_names) -> Optional[np.ndarray]:
+        if adapter_names is None:
+            return None
+        if self.lora_manager is None:
+            raise RuntimeError("no LoRA adapters loaded (call load_lora_adapters)")
+        return self.lora_manager.resolve(adapter_names)
 
     def compile(self, compiled_model_path: Optional[str] = None):
         """AOT-compile every (sub-model, bucket) program
@@ -182,12 +241,18 @@ class TpuModelForCausalLM:
         top_p=None,
         temperature=None,
         seq_ids: Optional[np.ndarray] = None,
+        lora_adapter_names=None,
     ) -> GenerationOutput:
         """Host generation loop (reference hf_adapter _sample, hf_adapter.py:129).
 
         input_ids: (B, S) RIGHT-padded; attention_mask: (B, S) 1=valid.
         """
         tc = self.config.tpu_config
+        if tc.is_block_kv_layout:
+            raise NotImplementedError(
+                "block-KV layout generation runs through ServingSession "
+                "(runtime/serving.py) or the low-level forward API"
+            )
         self._advance_rng()
         input_ids = np.asarray(input_ids)
         B, S_in = input_ids.shape
@@ -210,12 +275,14 @@ class TpuModelForCausalLM:
         if n_new <= 0:
             return GenerationOutput(sequences=input_ids, num_generated=0)
 
+        adapter_ids = self.resolve_adapter_ids(lora_adapter_names)
         ctx_lens = attention_mask.sum(axis=1).astype(np.int32)
         # CTE: positions are slot indices [0, S) — padded slots write into the
         # masked tail (reference fill_prefix semantics, kvcache/utils.py)
         position_ids = np.tile(np.arange(S_in, dtype=np.int32), (B, 1))
         inputs, _ = self.context_encoding_model.prepare(
-            input_ids, attention_mask, position_ids, seq_ids, sampling_params
+            input_ids, attention_mask, position_ids, seq_ids, sampling_params,
+            adapter_ids=adapter_ids,
         )
         out = self.context_encoding_model(self.params, self.kv_cache, inputs, self._sample_key(0))
         self.kv_cache = out.cache
@@ -237,7 +304,8 @@ class TpuModelForCausalLM:
             width = int(pos.max()) + 1
             mask = (np.arange(width)[None, :] <= pos[:, None]).astype(np.int32)
             inputs, _ = self.token_generation_model.prepare(
-                last, mask, pos[:, None].astype(np.int32), seq_ids, sampling_params
+                last, mask, pos[:, None].astype(np.int32), seq_ids, sampling_params,
+                adapter_ids=adapter_ids,
             )
             out = self.token_generation_model(
                 self.params, self.kv_cache, inputs, self._sample_key(step)

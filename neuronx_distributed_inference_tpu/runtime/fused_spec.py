@@ -117,19 +117,20 @@ class TpuFusedSpecModelForCausalLM:
 
         kv_batch = tc.kv_cache_batch_size or tc.max_batch_size
         dt = to_dtype(tc.kv_cache_dtype or tc.dtype)
+        cspec = cache_spec(tc.cp_degree > 1)  # same layout as the model graph's
         self.target_cache = shard_pytree(
             init_cache(
                 self.target_spec.num_layers, kv_batch, tc.seq_len,
                 self.target_spec.attn.num_kv_heads, self.target_spec.attn.head_dim, dt,
             ),
-            cache_spec(), self.mesh,
+            cspec, self.mesh,
         )
         self.draft_cache = shard_pytree(
             init_cache(
                 self.draft_spec.num_layers, kv_batch, tc.seq_len,
                 self.draft_spec.attn.num_kv_heads, self.draft_spec.attn.head_dim, dt,
             ),
-            cache_spec(), self.mesh,
+            cspec, self.mesh,
         )
         return self
 
@@ -163,9 +164,10 @@ class TpuFusedSpecModelForCausalLM:
             seq_ids=jnp.asarray(seq_ids),
             sampling_params=jnp.asarray(sp, jnp.float32),
         )
-        out = self._cte_fn(
-            self.draft_params, self.target_params, self.draft_cache, self.target_cache, inputs
-        )
+        with jax.set_mesh(self.mesh):
+            out = self._cte_fn(
+                self.draft_params, self.target_params, self.draft_cache, self.target_cache, inputs
+            )
         self.draft_cache, self.target_cache = out.draft_cache, out.target_cache
         first = np.asarray(jax.device_get(out.tokens))[:, 0]  # (B,)
 
@@ -187,9 +189,11 @@ class TpuFusedSpecModelForCausalLM:
                 seq_ids=jnp.asarray(seq_ids),
                 sampling_params=jnp.asarray(sp, jnp.float32),
             )
-            out = self._tkg_fn(
-                self.draft_params, self.target_params, self.draft_cache, self.target_cache, inputs
-            )
+            with jax.set_mesh(self.mesh):
+                out = self._tkg_fn(
+                    self.draft_params, self.target_params, self.draft_cache,
+                    self.target_cache, inputs,
+                )
             self.draft_cache, self.target_cache = out.draft_cache, out.target_cache
             tokens = np.asarray(jax.device_get(out.tokens))
             counts = np.asarray(jax.device_get(out.counts))

@@ -53,6 +53,8 @@ class SubModelRunner:
         mesh,
         mlp_fn: Callable,
         n_active_tokens: int = 1,
+        block_kv: bool = False,
+        block_size: int = 16,
     ):
         self.tag = tag
         self.phase = phase
@@ -61,6 +63,8 @@ class SubModelRunner:
         self.batch_size = batch_size
         self.mesh = mesh
         self.n_active_tokens = n_active_tokens
+        self.block_kv = block_kv
+        self.block_size = block_size
 
         # params/cache arrive as committed GSPMD-sharded arrays (device_put in
         # load()); jit follows their shardings, so no in_shardings needed —
@@ -85,7 +89,7 @@ class SubModelRunner:
                 raise ValueError(
                     f"{self.tag}: input batch {a.shape[0]} > compiled batch {batch}"
                 )
-            fill = -1 if name == "seq_ids" else 0
+            fill = -1 if name in ("seq_ids", "slot_mapping") else 0
             out[name] = np.concatenate(
                 [a, np.full((pad,) + a.shape[1:], fill, a.dtype)], axis=0
             )
@@ -98,6 +102,9 @@ class SubModelRunner:
         position_ids: np.ndarray,
         seq_ids: np.ndarray,
         sampling_params: Optional[np.ndarray] = None,
+        slot_mapping: Optional[np.ndarray] = None,
+        block_table: Optional[np.ndarray] = None,
+        adapter_ids: Optional[np.ndarray] = None,
     ) -> Tuple[StepInputs, int]:
         """Pad to (compiled batch, bucket) and build StepInputs."""
         B, S = input_ids.shape
@@ -111,6 +118,11 @@ class SubModelRunner:
                 # the masked tail, not on real slots
                 tail = position_ids[:, -1:] + 1 + np.arange(pad_s)[None, :]
                 position_ids = np.concatenate([position_ids, tail], axis=1)
+                if slot_mapping is not None:
+                    # padded tokens write to the garbage block
+                    slot_mapping = np.pad(
+                        slot_mapping, ((0, 0), (0, pad_s)), constant_values=-1
+                    )
         else:
             # TKG: bucket over cache length = attention_mask width
             bucket = get_target_bucket(self.buckets, attention_mask.shape[1])
@@ -127,12 +139,22 @@ class SubModelRunner:
             "seq_ids": seq_ids.astype(np.int32),
             "sampling_params": sampling_params.astype(np.float32),
         }
+        if slot_mapping is not None:
+            arrs["slot_mapping"] = slot_mapping.astype(np.int32)
+        if block_table is not None:
+            arrs["block_table"] = block_table.astype(np.int32)
+        if adapter_ids is not None:
+            arrs["adapter_ids"] = adapter_ids.astype(np.int32)
         arrs = self._pad_batch(arrs, self.batch_size)
         return StepInputs(**{k: jnp.asarray(v) for k, v in arrs.items()}), B
 
     def __call__(self, params, cache: KVCache, inputs: StepInputs, rng=None):
-        """Run one step. Returns StepOutput (tokens/logits device arrays + new cache)."""
-        return self._fn(params, cache, inputs, rng)
+        """Run one step. Returns StepOutput (tokens/logits device arrays + new cache).
+
+        Runs under the mesh context so in-graph sharding constraints
+        (CP/SP hints) resolve against the right axes."""
+        with jax.set_mesh(self.mesh):
+            return self._fn(params, cache, inputs, rng)
 
     # ---- warmup ----------------------------------------------------------
 
@@ -149,19 +171,31 @@ class SubModelRunner:
             ids = np.zeros((B, S), np.int32)
             mask = np.ones((B, bucket), np.int32)
             pos = np.zeros((B, S), np.int32)
+        kwargs = {}
+        if self.block_kv:
+            # warmup writes go to the garbage block; table reads block 0.
+            # Field presence must match real serving calls (CTE: slots only;
+            # TKG: slots + table) or the warmup program is never reused.
+            kwargs["slot_mapping"] = jnp.full((B, ids.shape[1]), -1, jnp.int32)
+            if self.phase != PHASE_CONTEXT_ENCODING:
+                kwargs["block_table"] = jnp.zeros(
+                    (B, max(1, bucket // self.block_size)), jnp.int32
+                )
         return StepInputs(
             input_ids=jnp.asarray(ids),
             attention_mask=jnp.asarray(mask),
             position_ids=jnp.asarray(pos),
             seq_ids=jnp.asarray(np.arange(B, dtype=np.int32)),
             sampling_params=jnp.asarray(prepare_sampling_params(B)),
+            **kwargs,
         )
 
     def warmup(self, params, cache: KVCache, rng=None) -> KVCache:
         """Compile + execute every bucket once (reference warmup,
         application_base.py:348-372)."""
-        for bucket in self.buckets:
-            out = self._fn(params, cache, self.example_inputs(bucket), rng)
-            out.tokens.block_until_ready()
-            cache = out.cache
+        with jax.set_mesh(self.mesh):
+            for bucket in self.buckets:
+                out = self._fn(params, cache, self.example_inputs(bucket), rng)
+                out.tokens.block_until_ready()
+                cache = out.cache
         return cache

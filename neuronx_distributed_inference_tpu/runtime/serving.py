@@ -36,6 +36,7 @@ class Request:
     pos: int = 0  # next write position
     generated: List[int] = field(default_factory=list)
     finished: bool = False
+    preempted: bool = False  # evicted mid-decode (KV pool exhausted)
 
     @property
     def last_token(self) -> int:
@@ -51,6 +52,14 @@ class ServingSession:
         self.num_slots = tc.kv_cache_batch_size or tc.max_batch_size
         self.slots: List[Optional[Request]] = [None] * self.num_slots
         self.requests: Dict[str, Request] = {}
+        self.block_mode = tc.is_block_kv_layout
+        self.allocator = None
+        if self.block_mode:
+            from neuronx_distributed_inference_tpu.modules.block_kvcache import (
+                BlockAllocator,
+            )
+
+            self.allocator = BlockAllocator(tc.pa_num_blocks, tc.pa_block_size)
 
     @property
     def free_slots(self) -> List[int]:
@@ -80,7 +89,16 @@ class ServingSession:
         mask = np.ones((1, S), np.int32)
         pos = np.arange(S, dtype=np.int32)[None, :]
         seq_ids = np.array([slot], np.int32)
-        inputs, _ = self.app.context_encoding_model.prepare(ids, mask, pos, seq_ids)
+        slot_mapping = None
+        if self.block_mode:
+            try:
+                self.allocator.alloc_seq(slot, S)
+            except RuntimeError:
+                return False  # out of KV blocks
+            slot_mapping = self.allocator.slot_mapping(slot, np.arange(S))[None, :]
+        inputs, _ = self.app.context_encoding_model.prepare(
+            ids, mask, pos, seq_ids, slot_mapping=slot_mapping
+        )
         out = self.app.context_encoding_model(
             self.app.params, self.app.kv_cache, inputs, None
         )
@@ -99,6 +117,8 @@ class ServingSession:
     def _finish(self, req: Request):
         req.finished = True
         if req.slot >= 0:
+            if self.block_mode:
+                self.allocator.free_seq(req.slot)
             self.slots[req.slot] = None
             req.slot = -1
 
@@ -119,11 +139,42 @@ class ServingSession:
             last[r.slot, 0] = r.last_token
             pos[r.slot, 0] = r.pos
             seq_ids[r.slot] = r.slot
-        width = int(pos.max()) + 1
+        slot_mapping = None
+        block_table = None
+        if self.block_mode:
+            bs = self.allocator.block_size
+            from neuronx_distributed_inference_tpu.modules.autobucketing import (
+                get_target_bucket,
+            )
+
+            width = get_target_bucket(
+                self.app.token_generation_model.buckets, int(pos.max()) + 1
+            )
+            mb = width // bs
+            slot_mapping = np.full((B, 1), -1, np.int32)
+            block_table = np.zeros((B, mb), np.int32)
+            for r in list(active):
+                try:
+                    self.allocator.alloc_seq(r.slot, r.pos + 1)
+                except RuntimeError:
+                    # pool exhausted mid-decode: preempt this request so the
+                    # others keep running (vLLM-style preemption; the caller
+                    # can re-submit with the tokens generated so far)
+                    r.preempted = True
+                    self._finish(r)
+                    active.remove(r)
+                    continue
+                slot_mapping[r.slot, 0] = self.allocator.slot_mapping(r.slot, [r.pos])[0]
+                block_table[r.slot] = self.allocator.block_table(r.slot, mb)
+            if not active:
+                return {}
+        else:
+            width = int(pos.max()) + 1
         mask = (np.arange(width)[None, :] <= pos).astype(np.int32)
         # inactive rows: mask garbage anyway
         inputs, _ = self.app.token_generation_model.prepare(
-            last, mask, pos, seq_ids, prepare_sampling_params(B)
+            last, mask, pos, seq_ids, prepare_sampling_params(B),
+            slot_mapping=slot_mapping, block_table=block_table,
         )
         out = self.app.token_generation_model(self.app.params, self.app.kv_cache, inputs, None)
         self.app.kv_cache = out.cache
