@@ -261,8 +261,9 @@ def gather_last_token(hidden: jax.Array, attention_mask: jax.Array) -> jax.Array
     return jnp.take_along_axis(hidden, idx[:, None, None], axis=1)
 
 
-def model_logits(
+def run_decoder_layers(
     params: dict,
+    hidden: jax.Array,
     cache: KVCache,
     inputs: StepInputs,
     *,
@@ -270,13 +271,10 @@ def model_logits(
     phase: str,
     mlp_fn: Callable = gated_mlp,
 ) -> Tuple[jax.Array, KVCache]:
-    """Backbone + lm head, no sampling: returns (logits (B, K, V), new cache).
+    """Layer stack + final norm over an already-embedded hidden state.
 
-    The composable core — fused speculation chains several of these in one
-    graph (reference NeuronFusedSpecModel, model_base.py:1656).
-    """
-    hidden = embed(params, inputs.input_ids)
-
+    Split out so variants that replace the embedding (EAGLE's fc-fused draft
+    input, reference model_base.py:1643-1650) reuse the whole decoder."""
     inv_freq = params["rope"]["inv_freq"]
     cos, sin = rope_cos_sin(inputs.position_ids, inv_freq, spec.attention_scaling)
 
@@ -323,13 +321,105 @@ def model_logits(
     new_cache = type(cache)(k=new_k, v=new_v)
 
     hidden = rms_norm(hidden, params["norm"]["weight"], spec.rms_eps)
+    return hidden, new_cache
+
+
+def model_logits(
+    params: dict,
+    cache: KVCache,
+    inputs: StepInputs,
+    *,
+    spec: ModelSpec,
+    phase: str,
+    mlp_fn: Callable = gated_mlp,
+    return_hidden: bool = False,
+):
+    """Backbone + lm head, no sampling: returns (logits (B, K, V), new cache)
+    [, full-sequence hidden states when ``return_hidden``].
+
+    The composable core — fused speculation chains several of these in one
+    graph (reference NeuronFusedSpecModel, model_base.py:1656).
+    """
+    hidden = embed(params, inputs.input_ids)
+    hidden, new_cache = run_decoder_layers(
+        params, hidden, cache, inputs, spec=spec, phase=phase, mlp_fn=mlp_fn
+    )
+    full_hidden = hidden
 
     if phase == PHASE_CONTEXT_ENCODING:
         hidden = gather_last_token(hidden, inputs.attention_mask)
     # TKG: all n_active positions produce logits
 
-    logits = lm_head(params, hidden, spec)  # (B, K, V_padded)
-    return logits[..., : spec.vocab_size], new_cache
+    logits = lm_head(params, hidden, spec)[..., : spec.vocab_size]  # (B, K, V)
+    if return_hidden:
+        return logits, new_cache, full_hidden
+    return logits, new_cache
+
+
+def decode_steps(
+    params: dict,
+    cache: KVCache,
+    last_tokens: jax.Array,  # (B, 1) int32
+    positions: jax.Array,  # (B, 1) int32 write position of last_tokens
+    seq_ids: jax.Array,  # (B,)
+    sampling_params: jax.Array,  # (B, 3)
+    rng: Optional[jax.Array],
+    *,
+    spec: ModelSpec,
+    num_steps: int,
+    bucket: int,
+    mlp_fn: Callable = gated_mlp,
+    adapter_ids: Optional[jax.Array] = None,
+):
+    """Run ``num_steps`` whole decode iterations in ONE compiled program.
+
+    TPU-native improvement over the reference's per-token host dispatch
+    (model_base.py:3656 hot loop + async_execution.py): a ``lax.scan`` over
+    steps keeps tokens, positions, masks, and the donated KV cache entirely
+    device-resident, so the host pays one dispatch per CHUNK instead of per
+    token — this is what async/1-ahead execution approximates on Neuron.
+
+    Returns (tokens (B, num_steps), logits (B, num_steps, V) | None, cache).
+    """
+    cols = jnp.arange(bucket, dtype=jnp.int32)[None, :]
+
+    def body(carry, step_rng):
+        cache, last, pos = carry
+        inputs = StepInputs(
+            input_ids=last,
+            attention_mask=(cols <= pos).astype(jnp.int32),
+            position_ids=pos,
+            seq_ids=seq_ids,
+            sampling_params=sampling_params,
+            adapter_ids=adapter_ids,
+        )
+        logits, cache = model_logits(
+            params, cache, inputs, spec=spec, phase=PHASE_TOKEN_GENERATION, mlp_fn=mlp_fn
+        )
+        if spec.on_device_sampling and spec.do_sample:
+            tok = sample_tokens(logits, sampling_params, step_rng, spec.max_topk, True)
+        else:
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out_logits = logits[:, 0] if spec.output_logits else jnp.zeros((), logits.dtype)
+        return (cache, tok, pos + 1), (tok[:, 0], out_logits)
+
+    step_rngs = (
+        jax.random.split(rng, num_steps) if (rng is not None and spec.do_sample) else
+        jnp.zeros((num_steps,), jnp.uint32)
+    )
+    if not (rng is not None and spec.do_sample):
+        step_rngs = None
+        (cache, last, pos), (tokens, logits) = jax.lax.scan(
+            lambda c, _: body(c, None), (cache, last_tokens, positions), None,
+            length=num_steps,
+        )
+    else:
+        (cache, last, pos), (tokens, logits) = jax.lax.scan(
+            body, (cache, last_tokens, positions), step_rngs
+        )
+    tokens = jnp.swapaxes(tokens, 0, 1)  # (B, num_steps)
+    out_logits = jnp.swapaxes(logits, 0, 1) if spec.output_logits else None
+    return tokens, out_logits, cache
 
 
 def forward(

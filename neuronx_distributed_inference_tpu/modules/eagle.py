@@ -1,0 +1,216 @@
+"""EAGLE fused speculation: feature-level draft chained with the target.
+
+TPU-native re-design of the reference EAGLE stack
+(reference: models/model_base.py:2082 ``_eagle_context_encoding_forward``,
+:2562 ``_eagle_token_gen_forward``; draft ``fc`` fusing [embed, prev_hidden]
+modeling_llama.py:260-308 + model_base.py:1643-1650;
+modules/eagle/hidden_state.py ``HiddenStateRollingBuffer``).
+
+EAGLE's draft consumes the TARGET's pre-lm-head hidden states: the draft
+input at position i is ``fc([embed(token_i), hidden_{i-1}])`` where
+``hidden_{i-1}`` came from the target for accepted tokens and from the
+draft's own outputs for in-flight speculative tokens.
+
+State across steps: one hidden vector per cache line in a donated
+``(B_kv+G, H)`` buffer indexed by slot — the synchronous-serving reduction of
+the reference's (seq_id, position)-keyed rolling ring buffer
+(hidden_state.py:4-83); the ring generality is only needed for async.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_inference_tpu.models.base import (
+    PHASE_CONTEXT_ENCODING,
+    PHASE_TOKEN_GENERATION,
+    ModelSpec,
+    StepInputs,
+    embed,
+    gather_last_token,
+    lm_head,
+    model_logits,
+    run_decoder_layers,
+)
+from neuronx_distributed_inference_tpu.modules.kvcache import (
+    KVCache,
+    slot_ids_from_seq_ids,
+)
+from neuronx_distributed_inference_tpu.modules.norm import rms_norm
+from neuronx_distributed_inference_tpu.modules.speculation import _row_mask
+from neuronx_distributed_inference_tpu.ops.quant import linear
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class EagleOutput:
+    tokens: jax.Array  # (B, K)
+    counts: jax.Array  # (B,)
+    draft_cache: KVCache
+    target_cache: KVCache
+    hidden_buffer: jax.Array  # (B_kv+G, H) prev-hidden per cache line
+
+
+def eagle_draft_hidden(
+    draft_params: dict,
+    token_ids: jax.Array,  # (B, S)
+    prev_hidden: jax.Array,  # (B, S, H) feature inputs (shifted target/draft hiddens)
+    cache: KVCache,
+    inputs: StepInputs,
+    *,
+    spec: ModelSpec,
+    phase: str,
+    mlp_fn: Callable,
+    input_norm: bool = False,
+) -> Tuple[jax.Array, KVCache]:
+    """Draft forward with fc-fused input; returns (hidden (B,S,H), cache).
+
+    Reference: EAGLE draft fusion (model_base.py:1584-1650).
+    """
+    emb = embed(draft_params, token_ids)
+    if input_norm:  # optional draft input norm (reference enable_eagle_draft_input_norm)
+        emb = rms_norm(emb, draft_params["input_norm"]["weight"], spec.rms_eps)
+    fused = jnp.concatenate([emb, prev_hidden.astype(emb.dtype)], axis=-1)
+    hidden = linear(draft_params["fc"], fused)
+    return run_decoder_layers(
+        draft_params, hidden, cache, inputs, spec=spec, phase=phase, mlp_fn=mlp_fn
+    )
+
+
+def init_hidden_buffer(kv_batch: int, hidden_size: int, dtype, garbage: int = 1):
+    return jnp.zeros((kv_batch + garbage, hidden_size), dtype)
+
+
+def eagle_context_encoding(
+    draft_params: dict,
+    target_params: dict,
+    draft_cache: KVCache,
+    target_cache: KVCache,
+    hidden_buffer: jax.Array,
+    inputs: StepInputs,
+    *,
+    draft_spec: ModelSpec,
+    target_spec: ModelSpec,
+    draft_mlp_fn: Callable,
+    target_mlp_fn: Callable,
+    draft_input_norm: bool = False,
+) -> EagleOutput:
+    """Fused EAGLE prefill: target CTE (keeps all hiddens), draft CTE fed the
+    1-shifted target hiddens (reference _eagle_context_encoding_forward,
+    model_base.py:2082)."""
+    tlogits, target_cache, t_hidden = model_logits(
+        target_params, target_cache, inputs,
+        spec=target_spec, phase=PHASE_CONTEXT_ENCODING, mlp_fn=target_mlp_fn,
+        return_hidden=True,
+    )
+    # draft input hidden_{i-1}: shift right, position 0 gets zeros
+    shifted = jnp.pad(t_hidden[:, :-1, :], ((0, 0), (1, 0), (0, 0)))
+    _, draft_cache = eagle_draft_hidden(
+        draft_params, inputs.input_ids, shifted, draft_cache, inputs,
+        spec=draft_spec, phase=PHASE_CONTEXT_ENCODING, mlp_fn=draft_mlp_fn,
+        input_norm=draft_input_norm,
+    )
+    token = jnp.argmax(tlogits[:, -1:, :], axis=-1).astype(jnp.int32)
+    # stash the hidden that produced the first token, keyed by cache line
+    last_hidden = gather_last_token(t_hidden, inputs.attention_mask)[:, 0, :]
+    slots = slot_ids_from_seq_ids(inputs.seq_ids, hidden_buffer.shape[0] - 1)
+    hidden_buffer = hidden_buffer.at[slots].set(last_hidden.astype(hidden_buffer.dtype))
+    B = token.shape[0]
+    return EagleOutput(
+        tokens=token,
+        counts=jnp.ones((B,), jnp.int32),
+        draft_cache=draft_cache,
+        target_cache=target_cache,
+        hidden_buffer=hidden_buffer,
+    )
+
+
+def eagle_token_gen(
+    draft_params: dict,
+    target_params: dict,
+    draft_cache: KVCache,
+    target_cache: KVCache,
+    hidden_buffer: jax.Array,
+    inputs: StepInputs,
+    *,
+    spec_len: int,
+    draft_spec: ModelSpec,
+    target_spec: ModelSpec,
+    draft_mlp_fn: Callable,
+    target_mlp_fn: Callable,
+    draft_input_norm: bool = False,
+) -> EagleOutput:
+    """Fused EAGLE decode step (reference _eagle_token_gen_forward,
+    model_base.py:2562): k-1 draft iterations chaining DRAFT hiddens, target
+    verify returning hiddens, contiguous-match acceptance, buffer update."""
+    k = spec_len
+    bucket = inputs.attention_mask.shape[1]
+    seq_ids = inputs.seq_ids
+    sp = inputs.sampling_params
+    slots = slot_ids_from_seq_ids(seq_ids, hidden_buffer.shape[0] - 1)
+
+    cur = inputs.input_ids  # (B, 1)
+    pos = inputs.position_ids
+    prev_h = hidden_buffer[slots][:, None, :]  # (B, 1, H)
+    candidates = [cur]
+    for i in range(k - 1):
+        step_inputs = StepInputs(
+            input_ids=cur,
+            attention_mask=_row_mask(bucket, pos),
+            position_ids=pos,
+            seq_ids=seq_ids,
+            sampling_params=sp,
+        )
+        d_hidden, draft_cache = eagle_draft_hidden(
+            draft_params, cur, prev_h, draft_cache, step_inputs,
+            spec=draft_spec, phase=PHASE_TOKEN_GENERATION, mlp_fn=draft_mlp_fn,
+            input_norm=draft_input_norm,
+        )
+        dlogits = lm_head(draft_params, d_hidden, draft_spec)[..., : draft_spec.vocab_size]
+        cur = jnp.argmax(dlogits[:, -1:, :], axis=-1).astype(jnp.int32)
+        prev_h = d_hidden[:, -1:, :]  # chain the draft's own feature
+        pos = pos + 1
+        candidates.append(cur)
+
+    cand = jnp.concatenate(candidates, axis=1)  # (B, k)
+    cand_pos = inputs.position_ids + jnp.arange(k, dtype=jnp.int32)[None, :]
+
+    target_inputs = StepInputs(
+        input_ids=cand,
+        attention_mask=(jnp.arange(bucket)[None, :] <= cand_pos[:, -1:]).astype(jnp.int32),
+        position_ids=cand_pos,
+        seq_ids=seq_ids,
+        sampling_params=sp,
+    )
+    tlogits, target_cache, t_hidden = model_logits(
+        target_params, target_cache, target_inputs,
+        spec=target_spec, phase=PHASE_TOKEN_GENERATION, mlp_fn=target_mlp_fn,
+        return_hidden=True,
+    )  # logits/hiddens (B, k, ·)
+    greedy = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)
+
+    matches = (cand[:, 1:] == greedy[:, :-1]).astype(jnp.int32)
+    accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # (B,)
+    counts = accepted + 1
+
+    idx = jnp.arange(k, dtype=jnp.int32)[None, :]
+    tokens = jnp.where(idx < counts[:, None], greedy, 0)
+
+    # next step's draft input feature = target hidden that produced the bonus
+    # token g_a (position index a = counts-1)
+    bonus_hidden = jnp.take_along_axis(
+        t_hidden, (counts - 1)[:, None, None], axis=1
+    )[:, 0, :]
+    hidden_buffer = hidden_buffer.at[slots].set(bonus_hidden.astype(hidden_buffer.dtype))
+
+    return EagleOutput(
+        tokens=tokens,
+        counts=counts,
+        draft_cache=draft_cache,
+        target_cache=target_cache,
+        hidden_buffer=hidden_buffer,
+    )

@@ -297,28 +297,45 @@ class TpuModelForCausalLM:
         if eos_token_id is not None:
             done |= generated[-1] == eos_token_id
 
-        for step in range(1, n_new):
-            if done.all():
-                break
-            last = generated[-1][:, None].astype(np.int32)
-            width = int(pos.max()) + 1
-            mask = (np.arange(width)[None, :] <= pos[:, None]).astype(np.int32)
-            inputs, _ = self.token_generation_model.prepare(
-                last, mask, pos[:, None].astype(np.int32), seq_ids, sampling_params,
+        # chunked multi-step decode: whole chunks of the token loop run as one
+        # device program (models/base.py decode_steps); EOS is checked at
+        # chunk boundaries (the reference's per-token dispatch is the thing
+        # this design removes)
+        last = generated[-1][:, None].astype(np.int32)
+        remaining = n_new - 1
+        step = 1
+        while remaining > 0 and not done.all():
+            chunk = _pick_chunk(remaining, eos_token_id is not None)
+            # ensure positions stay inside a compiled bucket
+            bucket = autobucketing.get_target_bucket(
+                self.token_generation_model.buckets, int(pos.max()) + chunk
+            )
+            tokens_c, logits_c, cache = self.token_generation_model.decode_chunk(
+                self.params,
+                self.kv_cache,
+                last,
+                pos[:, None],
+                seq_ids,
+                sampling_params,
+                self._sample_key(step),
+                num_steps=chunk,
+                bucket=bucket,
                 adapter_ids=adapter_ids,
             )
-            out = self.token_generation_model(
-                self.params, self.kv_cache, inputs, self._sample_key(step)
-            )
-            self.kv_cache = out.cache
-            step_tokens = np.asarray(jax.device_get(out.tokens))[:B, -1]
+            self.kv_cache = cache
+            tokens_c = np.asarray(jax.device_get(tokens_c))[:B]  # (B, chunk)
             if self.spec.output_logits:
-                logits_acc.append(np.asarray(jax.device_get(out.logits))[:B])
-            pos = pos + 1
-            if eos_token_id is not None:
-                step_tokens = np.where(done, eos_token_id, step_tokens)
-                done |= step_tokens == eos_token_id
-            generated.append(step_tokens)
+                logits_acc.append(np.asarray(jax.device_get(logits_c))[:B])
+            for j in range(chunk):
+                step_tokens = tokens_c[:, j]
+                if eos_token_id is not None:
+                    step_tokens = np.where(done, eos_token_id, step_tokens)
+                    done |= step_tokens == eos_token_id
+                generated.append(step_tokens)
+            last = tokens_c[:, -1:].astype(np.int32)
+            pos = pos + chunk
+            remaining -= chunk
+            step += 1
 
         gen = np.stack(generated, axis=1).astype(np.int64)  # (B, n)
         sequences = np.concatenate([input_ids, gen], axis=1)

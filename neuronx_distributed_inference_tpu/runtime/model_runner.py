@@ -65,6 +65,8 @@ class SubModelRunner:
         self.n_active_tokens = n_active_tokens
         self.block_kv = block_kv
         self.block_size = block_size
+        self.mlp_fn = mlp_fn
+        self._decode_fns = {}  # (num_steps, bucket) -> jitted multi-step program
 
         # params/cache arrive as committed GSPMD-sharded arrays (device_put in
         # load()); jit follows their shardings, so no in_shardings needed —
@@ -155,6 +157,66 @@ class SubModelRunner:
         (CP/SP hints) resolve against the right axes."""
         with jax.set_mesh(self.mesh):
             return self._fn(params, cache, inputs, rng)
+
+    def decode_chunk(
+        self,
+        params,
+        cache,
+        last: np.ndarray,  # (B, 1)
+        pos: np.ndarray,  # (B, 1)
+        seq_ids: np.ndarray,
+        sampling_params: np.ndarray,
+        rng,
+        num_steps: int,
+        bucket: int,
+        adapter_ids: Optional[np.ndarray] = None,
+    ):
+        """Multi-step decode: num_steps tokens in one device dispatch
+        (models/base.py decode_steps). Host pays one call per chunk."""
+        from neuronx_distributed_inference_tpu.models.base import decode_steps
+
+        B = self.batch_size
+        arrs = self._pad_batch(
+            {
+                "last": last.astype(np.int32),
+                "pos": pos.astype(np.int32),
+                "seq_ids": seq_ids.astype(np.int32),
+                "sampling_params": sampling_params.astype(np.float32),
+                **(
+                    {"adapter_ids": adapter_ids.astype(np.int32)}
+                    if adapter_ids is not None
+                    else {}
+                ),
+            },
+            B,
+        )
+        key = (num_steps, bucket, adapter_ids is not None)
+        fn = self._decode_fns.get(key)
+        if fn is None:
+            fn = jax.jit(
+                partial(
+                    decode_steps,
+                    spec=self.spec,
+                    num_steps=num_steps,
+                    bucket=bucket,
+                    mlp_fn=self.mlp_fn,
+                ),
+                donate_argnums=(1,),
+            )
+            self._decode_fns[key] = fn
+        with jax.set_mesh(self.mesh):
+            return fn(
+                params,
+                cache,
+                jnp.asarray(arrs["last"]),
+                jnp.asarray(arrs["pos"]),
+                jnp.asarray(arrs["seq_ids"]),
+                jnp.asarray(arrs["sampling_params"]),
+                rng,
+                adapter_ids=jnp.asarray(arrs["adapter_ids"])
+                if adapter_ids is not None
+                else None,
+            )
 
     # ---- warmup ----------------------------------------------------------
 
