@@ -48,6 +48,28 @@ from neuronx_distributed_inference_tpu.runtime.model_runner import (
 from neuronx_distributed_inference_tpu.utils.hf_checkpoint import load_state_dict
 
 
+# tokens per device dispatch when EOS is requested: small enough that a
+# finished batch wastes little compute past EOS, large enough to amortize the
+# host round-trip (reference: per-token host dispatch, model_base.py:3656)
+_EOS_CHUNK = 8
+
+
+def _pick_chunk(remaining: int, has_eos: bool, headroom: int) -> int:
+    """Decode-chunk size (device steps) for the host loop.
+
+    Without an EOS the whole remaining budget runs as one device program;
+    with an EOS we dispatch fixed-size chunks so termination is observed at
+    chunk boundaries. The size stays _EOS_CHUNK even for the budget tail
+    (surplus tokens are discarded on the host) so decode programs are
+    normally keyed by a single num_steps; the one exception is the last
+    ``headroom`` positions of the cache window, where the chunk shrinks to
+    fit and a residue-sized program may compile once.
+    """
+    if not has_eos:
+        return remaining
+    return min(_EOS_CHUNK, headroom)
+
+
 @dataclass
 class GenerationOutput:
     sequences: np.ndarray  # (B, S_in + new)
@@ -305,7 +327,9 @@ class TpuModelForCausalLM:
         remaining = n_new - 1
         step = 1
         while remaining > 0 and not done.all():
-            chunk = _pick_chunk(remaining, eos_token_id is not None)
+            headroom = tc.seq_len - int(pos.max())
+            chunk = _pick_chunk(remaining, eos_token_id is not None, headroom)
+            take = min(chunk, remaining)
             # ensure positions stay inside a compiled bucket
             bucket = autobucketing.get_target_bucket(
                 self.token_generation_model.buckets, int(pos.max()) + chunk
@@ -325,16 +349,16 @@ class TpuModelForCausalLM:
             self.kv_cache = cache
             tokens_c = np.asarray(jax.device_get(tokens_c))[:B]  # (B, chunk)
             if self.spec.output_logits:
-                logits_acc.append(np.asarray(jax.device_get(logits_c))[:B])
-            for j in range(chunk):
+                logits_acc.append(np.asarray(jax.device_get(logits_c))[:B, :take])
+            for j in range(take):
                 step_tokens = tokens_c[:, j]
                 if eos_token_id is not None:
                     step_tokens = np.where(done, eos_token_id, step_tokens)
                     done |= step_tokens == eos_token_id
                 generated.append(step_tokens)
-            last = tokens_c[:, -1:].astype(np.int32)
-            pos = pos + chunk
-            remaining -= chunk
+            last = tokens_c[:, take - 1 : take].astype(np.int32)
+            pos = pos + take
+            remaining -= take
             step += 1
 
         gen = np.stack(generated, axis=1).astype(np.int64)  # (B, n)
