@@ -85,9 +85,11 @@ def main():
     ids = rng.randint(0, 120000, size=(batch, prompt_len))
     mask = np.ones_like(ids)
 
-    # warmup / compile
+    # warmup / compile — run the SAME programs the measured runs use
+    # (gen_len-sized decode chunk and the 1-token TTFT path)
     t0 = time.time()
-    app.generate(ids, mask, max_new_tokens=4)
+    app.generate(ids, mask, max_new_tokens=gen_len)
+    app.generate(ids, mask, max_new_tokens=1)
     print(f"compile+warmup: {time.time()-t0:.1f}s", file=sys.stderr)
 
     # TTFT: context encoding only

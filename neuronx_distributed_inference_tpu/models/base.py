@@ -40,9 +40,9 @@ from neuronx_distributed_inference_tpu.modules.attention import (
 )
 from neuronx_distributed_inference_tpu.modules.kvcache import (
     KVCache,
-    read_layer_cache,
+    read_cache_at_layer,
     slot_ids_from_seq_ids,
-    update_layer_cache,
+    update_cache_at_layer,
 )
 from neuronx_distributed_inference_tpu.modules.norm import rms_norm
 from neuronx_distributed_inference_tpu.modules.rope import rope_cos_sin
@@ -134,8 +134,9 @@ def decoder_layer(
     hidden: jax.Array,
     cos: jax.Array,
     sin: jax.Array,
-    k_cache_l: jax.Array,
-    v_cache_l: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    layer_idx: jax.Array,
     mask: jax.Array,
     slot_ids: jax.Array,
     positions: jax.Array,
@@ -148,7 +149,9 @@ def decoder_layer(
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decoder layer (reference NeuronLlamaDecoderLayer, modeling_llama.py:1188).
 
-    Returns (hidden, k_cache_l, v_cache_l) with the cache slice updated.
+    ``k_cache``/``v_cache`` are the FULL stacked caches (all layers); this
+    layer's slice is updated in place via ``layer_idx`` (see
+    kvcache.update_cache_at_layer). Returns (hidden, k_cache, v_cache).
     """
     aspec = spec.attn
     residual = hidden
@@ -162,17 +165,17 @@ def decoder_layer(
     is_block = block_inputs is not None
     if is_block:
         from neuronx_distributed_inference_tpu.modules.block_kvcache import (
-            read_layer_block_cache,
-            update_layer_block_cache,
+            read_block_cache_at_layer,
+            update_block_cache_at_layer,
         )
 
         slot_mapping, block_table = block_inputs
-        k_cache_l, v_cache_l = update_layer_block_cache(
-            k_cache_l, v_cache_l, k, v, slot_mapping
+        k_cache, v_cache = update_block_cache_at_layer(
+            k_cache, v_cache, k, v, layer_idx, slot_mapping
         )
     else:
-        k_cache_l, v_cache_l = update_layer_cache(
-            k_cache_l, v_cache_l, k, v, slot_ids, positions
+        k_cache, v_cache = update_cache_at_layer(
+            k_cache, v_cache, k, v, layer_idx, slot_ids, positions
         )
 
     sink = layer_params["self_attn"].get("sink", {}).get("weight") if aspec.has_sink else None
@@ -190,12 +193,12 @@ def decoder_layer(
         if spec.cp_enabled:
             attn_out = cpx.shard_attn_out(attn_out)
     elif is_block:
-        k_r, v_r = read_layer_block_cache(k_cache_l, v_cache_l, block_table)
+        k_r, v_r = read_block_cache_at_layer(k_cache, v_cache, layer_idx, block_table)
         attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
     else:
         B = q.shape[0]
         bucket = mask.shape[-1]
-        k_r, v_r = read_layer_cache(k_cache_l, v_cache_l, B, bucket)
+        k_r, v_r = read_cache_at_layer(k_cache, v_cache, layer_idx, B, bucket)
         attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
 
     hidden = o_project(layer_params["self_attn"], attn_out, aspec, adapter_ids=adapter_ids)
@@ -208,7 +211,7 @@ def decoder_layer(
         from neuronx_distributed_inference_tpu.parallel import context_parallel as cpx
 
         hidden = cpx.shard_seq(hidden)
-    return hidden, k_cache_l, v_cache_l
+    return hidden, k_cache, v_cache
 
 
 def build_mask(inputs: StepInputs, spec: ModelSpec, phase: str) -> jax.Array:
@@ -247,7 +250,9 @@ def embed(params: dict, input_ids: jax.Array) -> jax.Array:
 
 
 def lm_head(params: dict, hidden: jax.Array, spec: ModelSpec) -> jax.Array:
-    w = params["lm_head"]["weight"] if "lm_head" in params else params["embed_tokens"]["weight"].T
+    # always (H, V): tied models carry a materialized transposed copy of the
+    # embedding (builder.py) so no per-step transpose of the vocab matrix
+    w = params["lm_head"]["weight"]
     logits = hidden @ w
     if spec.cast_logits_fp32:
         logits = logits.astype(jnp.float32)
@@ -308,16 +313,25 @@ def run_decoder_layers(
     if inputs.slot_mapping is not None:
         block_inputs = (inputs.slot_mapping, inputs.block_table)
 
-    def scan_body(h, xs):
-        layer_params, k_l, v_l = xs
-        h, k_l, v_l = decoder_layer(
-            layer_params, h, cos, sin, k_l, v_l, mask, slot_ids, positions, spec, phase,
-            mlp_fn, key_valid=key_valid, block_inputs=block_inputs,
+    num_layers = jax.tree.leaves(params["layers"])[0].shape[0]
+
+    def scan_body(carry, xs):
+        h, k_cache, v_cache = carry
+        layer_params, li = xs
+        h, k_cache, v_cache = decoder_layer(
+            layer_params, h, cos, sin, k_cache, v_cache, li, mask, slot_ids, positions,
+            spec, phase, mlp_fn, key_valid=key_valid, block_inputs=block_inputs,
             adapter_ids=inputs.adapter_ids,
         )
-        return h, (k_l, v_l)
+        return (h, k_cache, v_cache), None
 
-    hidden, (new_k, new_v) = jax.lax.scan(scan_body, hidden, (params["layers"], cache.k, cache.v))
+    # the full cache rides the CARRY (updated in place per layer); only the
+    # layer params are scanned xs — no stacked-ys cache rebuild per step
+    (hidden, new_k, new_v), _ = jax.lax.scan(
+        scan_body,
+        (hidden, cache.k, cache.v),
+        (params["layers"], jnp.arange(num_layers, dtype=jnp.int32)),
+    )
     new_cache = type(cache)(k=new_k, v=new_v)
 
     hidden = rms_norm(hidden, params["norm"]["weight"], spec.rms_eps)

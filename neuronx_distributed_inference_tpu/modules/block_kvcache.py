@@ -73,36 +73,46 @@ def block_cache_spec():
     )
 
 
-def update_layer_block_cache(
-    k_cache_l: jax.Array,  # (NB+1, bs, H, D)
-    v_cache_l: jax.Array,
+def update_block_cache_at_layer(
+    k_cache: jax.Array,  # (L, NB+1, bs, H, D)
+    v_cache: jax.Array,
     k_new: jax.Array,  # (B, S, H, D)
     v_new: jax.Array,
+    layer_idx: jax.Array,
     slot_mapping: jax.Array,  # (B, S) global slots; < 0 -> garbage block
 ) -> Tuple[jax.Array, jax.Array]:
-    """Scatter token K/V into the paged cache (reference scatter-by-slot,
-    block_kv_cache_manager.py)."""
-    NB1, bs, H, D = k_cache_l.shape
-    flat_k = k_cache_l.reshape(NB1 * bs, H, D)
-    flat_v = v_cache_l.reshape(NB1 * bs, H, D)
+    """Scatter token K/V into the paged cache at one layer (reference
+    scatter-by-slot, block_kv_cache_manager.py). The full stacked cache is
+    carried through the layer scan and updated in place (see
+    kvcache.update_cache_at_layer for why)."""
+    L, NB1, bs, H, D = k_cache.shape
+    flat_k = k_cache.reshape(L, NB1 * bs, H, D)
+    flat_v = v_cache.reshape(L, NB1 * bs, H, D)
     B, S = slot_mapping.shape
     slots = jnp.where(slot_mapping >= 0, slot_mapping, slot_mapping % bs).reshape(B * S)
-    flat_k = flat_k.at[slots].set(k_new.reshape(B * S, H, D).astype(flat_k.dtype), mode="drop")
-    flat_v = flat_v.at[slots].set(v_new.reshape(B * S, H, D).astype(flat_v.dtype), mode="drop")
-    return flat_k.reshape(NB1, bs, H, D), flat_v.reshape(NB1, bs, H, D)
+    flat_k = flat_k.at[layer_idx, slots].set(
+        k_new.reshape(B * S, H, D).astype(flat_k.dtype), mode="drop"
+    )
+    flat_v = flat_v.at[layer_idx, slots].set(
+        v_new.reshape(B * S, H, D).astype(flat_v.dtype), mode="drop"
+    )
+    return flat_k.reshape(L, NB1, bs, H, D), flat_v.reshape(L, NB1, bs, H, D)
 
 
-def read_layer_block_cache(
-    k_cache_l: jax.Array,  # (NB+1, bs, H, D)
-    v_cache_l: jax.Array,
+def read_block_cache_at_layer(
+    k_cache: jax.Array,  # (L, NB+1, bs, H, D)
+    v_cache: jax.Array,
+    layer_idx: jax.Array,
     block_table: jax.Array,  # (B, MB) block ids; 0 for unused tail entries
 ) -> Tuple[jax.Array, jax.Array]:
-    """Gather the active blocks into a contiguous per-sequence view
+    """Gather one layer's active blocks into a contiguous per-sequence view
     (reference gather-by-active-block-table reads)."""
     B, MB = block_table.shape
-    _, bs, H, D = k_cache_l.shape
-    k = k_cache_l[block_table]  # (B, MB, bs, H, D)
-    v = v_cache_l[block_table]
+    _, _, bs, H, D = k_cache.shape
+    k_l = jax.lax.dynamic_index_in_dim(k_cache, layer_idx, axis=0, keepdims=False)
+    v_l = jax.lax.dynamic_index_in_dim(v_cache, layer_idx, axis=0, keepdims=False)
+    k = k_l[block_table]  # (B, MB, bs, H, D)
+    v = v_l[block_table]
     return k.reshape(B, MB * bs, H, D), v.reshape(B, MB * bs, H, D)
 
 

@@ -66,7 +66,13 @@ def _pick_chunk(remaining: int, has_eos: bool, headroom: int) -> int:
     fit and a residue-sized program may compile once.
     """
     if not has_eos:
-        return remaining
+        # round up to a power of two so the jit cache holds at most
+        # log2(seq_len) decode programs instead of one per budget; surplus
+        # steps are computed and discarded (cheaper than an XLA recompile)
+        chunk = 8
+        while chunk < remaining:
+            chunk *= 2
+        return min(chunk, headroom)
     return min(_EOS_CHUNK, headroom)
 
 
@@ -308,29 +314,89 @@ class TpuModelForCausalLM:
         )
         out = self.context_encoding_model(self.params, self.kv_cache, inputs, self._sample_key(0))
         self.kv_cache = out.cache
+        pos = ctx_lens.copy()  # next write position per row
+        remaining = n_new - 1
+        step = 1
+
+        # chunked multi-step decode: whole chunks of the token loop run as one
+        # device program (models/base.py decode_steps). Syncing with the
+        # device costs a full round trip, so:
+        # - no EOS: chain CTE -> chunks entirely with device-resident tokens
+        #   (async dispatch) and fetch everything in ONE sync at the end;
+        # - EOS: fetch tokens at each chunk boundary to test termination —
+        #   that per-chunk sync is the feature being paid for.
+        if eos_token_id is None:
+            # chunks are sliced to the true batch B on device: the CTE and
+            # TKG runners may be compiled at different batch sizes
+            token_chunks = [out.tokens[:B]]  # device (B, 1)
+            logit_chunks = [out.logits[:B]] if self.spec.output_logits else []
+            last = out.tokens[:B, -1:].astype(jnp.int32)
+            # positions must stay inside the largest compiled TKG bucket as
+            # well as the cache window — pow2 rounding must not push past it
+            pos_limit = min(tc.seq_len, self.token_generation_model.buckets[-1])
+            while remaining > 0:
+                headroom = pos_limit - int(pos.max())
+                if headroom < 1:
+                    raise ValueError(
+                        f"generation needs positions past the largest TKG "
+                        f"bucket/cache window ({pos_limit}); raise "
+                        f"token_generation_buckets or seq_len"
+                    )
+                chunk = _pick_chunk(remaining, False, headroom)
+                take = min(chunk, remaining)
+                bucket = autobucketing.get_target_bucket(
+                    self.token_generation_model.buckets, int(pos.max()) + chunk
+                )
+                tokens_c, logits_c, cache = self.token_generation_model.decode_chunk(
+                    self.params,
+                    self.kv_cache,
+                    last,
+                    pos[:, None],
+                    seq_ids,
+                    sampling_params,
+                    self._sample_key(step),
+                    num_steps=chunk,
+                    bucket=bucket,
+                    adapter_ids=adapter_ids,
+                )
+                self.kv_cache = cache
+                token_chunks.append(tokens_c[:B, :take])
+                if self.spec.output_logits:
+                    logit_chunks.append(logits_c[:B, :take])
+                last = tokens_c[:B, take - 1 : take]
+                pos = pos + take
+                remaining -= take
+                step += 1
+            gen = np.asarray(jax.device_get(jnp.concatenate(token_chunks, axis=1)))
+            sequences = np.concatenate([input_ids, gen.astype(np.int64)], axis=1)
+            logits = (
+                np.asarray(jax.device_get(jnp.concatenate(logit_chunks, axis=1)))
+                if logit_chunks
+                else None
+            )
+            return GenerationOutput(
+                sequences=sequences, logits=logits, num_generated=gen.shape[1]
+            )
+
         tokens = np.asarray(jax.device_get(out.tokens))[:B]  # (B, 1)
         logits_acc: List[np.ndarray] = []
         if self.spec.output_logits:
             logits_acc.append(np.asarray(jax.device_get(out.logits))[:B])
-
         generated = [tokens[:, -1]]
-        pos = ctx_lens.copy()  # next write position per row
         done = np.zeros(B, bool)
-        if eos_token_id is not None:
-            done |= generated[-1] == eos_token_id
-
-        # chunked multi-step decode: whole chunks of the token loop run as one
-        # device program (models/base.py decode_steps); EOS is checked at
-        # chunk boundaries (the reference's per-token dispatch is the thing
-        # this design removes)
+        done |= generated[-1] == eos_token_id
         last = generated[-1][:, None].astype(np.int32)
-        remaining = n_new - 1
-        step = 1
+        pos_limit = min(tc.seq_len, self.token_generation_model.buckets[-1])
         while remaining > 0 and not done.all():
-            headroom = tc.seq_len - int(pos.max())
-            chunk = _pick_chunk(remaining, eos_token_id is not None, headroom)
+            headroom = pos_limit - int(pos.max())
+            if headroom < 1:
+                raise ValueError(
+                    f"generation needs positions past the largest TKG "
+                    f"bucket/cache window ({pos_limit}); raise "
+                    f"token_generation_buckets or seq_len"
+                )
+            chunk = _pick_chunk(remaining, True, headroom)
             take = min(chunk, remaining)
-            # ensure positions stay inside a compiled bucket
             bucket = autobucketing.get_target_bucket(
                 self.token_generation_model.buckets, int(pos.max()) + chunk
             )
@@ -352,9 +418,8 @@ class TpuModelForCausalLM:
                 logits_acc.append(np.asarray(jax.device_get(logits_c))[:B, :take])
             for j in range(take):
                 step_tokens = tokens_c[:, j]
-                if eos_token_id is not None:
-                    step_tokens = np.where(done, eos_token_id, step_tokens)
-                    done |= step_tokens == eos_token_id
+                step_tokens = np.where(done, eos_token_id, step_tokens)
+                done |= step_tokens == eos_token_id
                 generated.append(step_tokens)
             last = tokens_c[:, take - 1 : take].astype(np.int32)
             pos = pos + take

@@ -92,9 +92,16 @@ class SubModelRunner:
                     f"{self.tag}: input batch {a.shape[0]} > compiled batch {batch}"
                 )
             fill = -1 if name in ("seq_ids", "slot_mapping") else 0
-            out[name] = np.concatenate(
-                [a, np.full((pad,) + a.shape[1:], fill, a.dtype)], axis=0
-            )
+            if isinstance(a, jax.Array):
+                # device-resident input (async-chained from a previous step):
+                # pad on device so the chain stays sync-free
+                out[name] = jnp.concatenate(
+                    [a, jnp.full((pad,) + a.shape[1:], fill, a.dtype)], axis=0
+                )
+            else:
+                out[name] = np.concatenate(
+                    [a, np.full((pad,) + a.shape[1:], fill, a.dtype)], axis=0
+                )
         return out
 
     def prepare(

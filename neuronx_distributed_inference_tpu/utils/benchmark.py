@@ -80,6 +80,38 @@ class SubmodelTimer:
         self.runner._fn = self._orig
 
 
+class DecodeChunkTimer:
+    """Per-token decode latency from real decode_chunk dispatches (replaces
+    the generate(2)-generate(1) subtraction proxy; reference hooks each
+    submodel forward, benchmark.py:380-430)."""
+
+    def __init__(self, runner):
+        self.runner = runner
+        self.per_token_latencies: List[float] = []
+        self._orig = runner.decode_chunk
+
+    def __enter__(self):
+        timer = self
+
+        def timed(params, cache, last, pos, seq_ids, sampling_params, rng,
+                  num_steps, bucket, adapter_ids=None):
+            t0 = time.perf_counter()
+            tokens, logits, new_cache = timer._orig(
+                params, cache, last, pos, seq_ids, sampling_params, rng,
+                num_steps=num_steps, bucket=bucket, adapter_ids=adapter_ids,
+            )
+            tokens.block_until_ready()
+            dt = time.perf_counter() - t0
+            timer.per_token_latencies.extend([dt / num_steps] * num_steps)
+            return tokens, logits, new_cache
+
+        self.runner.decode_chunk = timed
+        return self
+
+    def __exit__(self, *exc):
+        self.runner.decode_chunk = self._orig
+
+
 def benchmark_sampling(
     app,
     input_ids: np.ndarray,
@@ -112,18 +144,22 @@ def benchmark_sampling(
         }
     }
 
-    # per-submodel: time CTE and one TKG step separately (TTFT / ITL proxies,
-    # reference benchmark.py:415-430)
-    cte_lat, tkg_lat = [], []
-    for _ in range(num_runs):
-        t0 = time.perf_counter()
-        app.generate(input_ids, attention_mask, max_new_tokens=1)
-        cte_lat.append(time.perf_counter() - t0)
-        t0 = time.perf_counter()
-        app.generate(input_ids, attention_mask, max_new_tokens=2)
-        tkg_lat.append(time.perf_counter() - t0 - cte_lat[-1])
-    report["context_encoding_model"] = percentile_report(cte_lat)
-    report["token_generation_model"] = percentile_report([max(t, 0.0) for t in tkg_lat])
+    # per-submodel: REAL dispatch hooks on each runner (reference pre/post
+    # forward hooks, benchmark.py:380-430) — CTE latency ≈ TTFT, TKG
+    # per-token latency ≈ ITL. Hooked runs sync per dispatch, so they are
+    # measured separately from the e2e (async-chained) runs above.
+    with SubmodelTimer(app.context_encoding_model) as cte_t, DecodeChunkTimer(
+        app.token_generation_model
+    ) as tkg_t:
+        for _ in range(num_runs):
+            app.generate(input_ids, attention_mask, max_new_tokens=max_new_tokens)
+    report["context_encoding_model"] = percentile_report(cte_t.latencies)
+    report["token_generation_model"] = (
+        percentile_report(tkg_t.per_token_latencies)
+        if tkg_t.per_token_latencies
+        # key always present (schema parity); max_new_tokens=1 runs CTE only
+        else {"note": "no token-generation steps ran"}
+    )
 
     if report_path:
         with open(report_path, "w") as f:
