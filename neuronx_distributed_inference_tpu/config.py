@@ -465,12 +465,18 @@ class InferenceConfig:
         d = dict(d)
         cls_info = d.pop("_config_class", None)
         config_cls = cls
-        if cls_info:
+        # only resolve config classes from inside this package: a JSON artifact
+        # is untrusted input and must not trigger arbitrary module imports
+        if isinstance(cls_info, dict) and str(cls_info.get("module", "")).startswith(
+            "neuronx_distributed_inference_tpu."
+        ):
             try:
                 import importlib
 
                 mod = importlib.import_module(cls_info["module"])
-                config_cls = getattr(mod, cls_info["name"])
+                candidate = getattr(mod, cls_info["name"])
+                if isinstance(candidate, type) and issubclass(candidate, InferenceConfig):
+                    config_cls = candidate
             except Exception:
                 config_cls = cls
         tc = d.pop("tpu_config", {})

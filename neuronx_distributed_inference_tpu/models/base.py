@@ -68,7 +68,6 @@ class ModelSpec:
     attn: AttnSpec
     rms_eps: float = 1e-6
     act: str = "silu"
-    tie_word_embeddings: bool = False
     # attention flavor
     sliding_window: Optional[int] = None
     attention_chunk_size: Optional[int] = None

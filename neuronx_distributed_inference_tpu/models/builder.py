@@ -88,7 +88,6 @@ class DecoderModelBuilder:
             attn=self.attn_spec(),
             rms_eps=getattr(cfg, "rms_norm_eps", 1e-6),
             act=getattr(cfg, "hidden_act", "silu"),
-            tie_word_embeddings=getattr(cfg, "tie_word_embeddings", False),
             sliding_window=tc.sliding_window,
             attention_chunk_size=tc.attention_chunk_size,
             cp_enabled=tc.cp_degree > 1,

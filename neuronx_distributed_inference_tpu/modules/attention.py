@@ -142,12 +142,29 @@ def _masked_softmax_attention(
     )
 
 
+def _flash_shape_ok(spec: AttnSpec, seq_len: int) -> bool:
+    # q/k tiles are (128, D): seq must tile evenly; D must be a lane-aligned
+    # multiple of 64 (64 is padded to a full lane by Mosaic — slight waste,
+    # but it keeps head_dim-64 models like Llama-3.2-1B on the kernel)
+    return seq_len >= 128 and seq_len % 128 == 0 and spec.head_dim % 64 == 0
+
+
 def _use_flash(spec: AttnSpec, seq_len: int) -> bool:
-    if spec.use_flash_kernel is not None:
-        return spec.use_flash_kernel
-    if seq_len < 128 or seq_len % 128 != 0 or spec.head_dim % 128 != 0:
+    if spec.use_flash_kernel is False:
         return False
-    return jax.default_backend() == "tpu"
+    ok = _flash_shape_ok(spec, seq_len)
+    if spec.use_flash_kernel:  # force-enabled still honors shape guards
+        if not ok:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "attn_kernel_enabled=True but shape (seq=%d, head_dim=%d) is "
+                "unsupported by the flash kernel; falling back to native path",
+                seq_len,
+                spec.head_dim,
+            )
+        return ok
+    return ok and jax.default_backend() == "tpu"
 
 
 def attention_prefill(

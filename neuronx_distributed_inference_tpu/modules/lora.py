@@ -20,11 +20,17 @@ MXU; no layer swapping needed.
 
 from __future__ import annotations
 
+import json
+import logging
+import math
+import os
 from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 #: adapter id 0 = zero adapter (base model behavior)
 BASE_ADAPTER_ID = 0
@@ -76,6 +82,60 @@ class LoraWeightManager:
         )
 
 
+def load_peft_adapter(path: str) -> Tuple[dict, dict]:
+    """Load a PEFT adapter directory -> (state_dict, adapter_config).
+
+    PEFT checkpoints keep ``lora_alpha``/``use_rslora`` in
+    ``adapter_config.json``, not in the weights file (reference
+    lora_serving/lora_checkpoint.py:61 reads the json the same way).
+    """
+    config: dict = {}
+    cfg_path = os.path.join(path, "adapter_config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            config = json.load(f)
+    st = os.path.join(path, "adapter_model.safetensors")
+    binp = os.path.join(path, "adapter_model.bin")
+    if os.path.exists(st):
+        from safetensors.numpy import load_file
+
+        sd = dict(load_file(st))
+    elif os.path.exists(binp):
+        import torch
+
+        sd = {k: v.float().numpy() for k, v in torch.load(binp, map_location="cpu").items()}
+    else:
+        raise FileNotFoundError(f"no adapter_model.[safetensors|bin] under {path}")
+    return sd, config
+
+
+def _normalize_adapter(name: str, value) -> Tuple[dict, Optional[float], bool]:
+    """Resolve an adapter entry to (state_dict, lora_alpha, use_rslora).
+
+    Accepts a PEFT directory path, an explicit ``(state_dict, config)`` pair,
+    ``{"state_dict": ..., "config": ...}``, or a bare state dict (in which
+    case alpha may ride in the dict under ``lora_alpha`` for convenience).
+    """
+    if isinstance(value, str):
+        sd, cfg = load_peft_adapter(value)
+    elif isinstance(value, tuple):
+        sd, cfg = value
+    elif isinstance(value, dict) and "state_dict" in value:
+        sd, cfg = value["state_dict"], value.get("config", {})
+    else:
+        sd, cfg = value, {}
+    alpha = cfg.get("lora_alpha", sd.get("lora_alpha"))
+    use_rslora = bool(cfg.get("use_rslora", False))
+    if alpha is None:
+        logger.warning(
+            "LoRA adapter %r: lora_alpha not found in adapter_config.json or "
+            "state dict; defaulting scaling to 1.0 (alpha=r). Pass the PEFT "
+            "directory path or (state_dict, adapter_config) to fix.",
+            name,
+        )
+    return sd, alpha, use_rslora
+
+
 def attach_lora_params(
     params: dict,
     adapters: Dict[str, dict],
@@ -93,6 +153,8 @@ def attach_lora_params(
     N = cfg.max_loras + 1  # slot 0 = zeros
     r_max = cfg.max_lora_rank
     target = set(cfg.target_modules)
+    # normalize once up front: directory adapters hit the filesystem here
+    normalized = {name: _normalize_adapter(name, value) for name, value in adapters.items()}
 
     def find_key(sd, layer, module, piece):
         for pattern in (
@@ -116,9 +178,8 @@ def attach_lora_params(
             B = np.zeros((N, L, r_max, d_out), np.float32)
             scaling = np.zeros((N,), np.float32)
             found_any = False
-            for name, sd in adapters.items():
+            for name, (sd, alpha, use_rslora) in normalized.items():
                 idx = manager.register(name)
-                alpha = sd.get("lora_alpha", None)
                 for layer in range(num_layers):
                     a = find_key(sd, layer, module, "lora_A")
                     b = find_key(sd, layer, module, "lora_B")
@@ -130,7 +191,8 @@ def attach_lora_params(
                         raise ValueError(f"adapter {name} rank {r} > max_lora_rank {r_max}")
                     A[idx, layer, :, :r] = np.asarray(a).T
                     B[idx, layer, :r, :] = np.asarray(b).T
-                    scaling[idx] = (alpha or r) / r
+                    denom = math.sqrt(r) if use_rslora else r
+                    scaling[idx] = (alpha if alpha is not None else r) / denom
             if found_any:
                 # layer-stacked layout to ride the lax.scan: (L, N, in, r)
                 entry["lora_A"] = jnp.asarray(A.transpose(1, 0, 2, 3), dtype)

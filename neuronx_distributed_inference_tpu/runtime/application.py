@@ -72,8 +72,16 @@ def _pick_chunk(remaining: int, has_eos: bool, headroom: int) -> int:
         chunk = 8
         while chunk < remaining:
             chunk *= 2
-        return min(chunk, headroom)
-    return min(_EOS_CHUNK, headroom)
+    else:
+        chunk = _EOS_CHUNK
+    if chunk > headroom:
+        # clamp to the largest power of two that fits, so the cache-window
+        # tail also reuses pow2-keyed programs instead of compiling a
+        # residue-sized one per distinct headroom
+        chunk = 1
+        while chunk * 2 <= headroom:
+            chunk *= 2
+    return chunk
 
 
 @dataclass

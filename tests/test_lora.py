@@ -91,6 +91,60 @@ def test_unknown_adapter_rejected(lora_app):
         )
 
 
+def test_alpha_resolution_sources(tmp_path):
+    """lora_alpha must come from adapter_config.json / explicit config, not the
+    weights state dict (ADVICE r1 medium; reference lora_checkpoint.py:61)."""
+    import math
+
+    from safetensors.numpy import save_file
+
+    from neuronx_distributed_inference_tpu.modules.lora import _normalize_adapter
+
+    sd = {"w": np.zeros((2, 2), np.float32)}
+    # explicit (sd, config) pair
+    _, alpha, rs = _normalize_adapter("a", (sd, {"lora_alpha": 16}))
+    assert alpha == 16 and not rs
+    # dict form with rslora
+    _, alpha, rs = _normalize_adapter(
+        "a", {"state_dict": sd, "config": {"lora_alpha": 8, "use_rslora": True}}
+    )
+    assert alpha == 8 and rs
+    # bare state dict without alpha -> warn, alpha None (scaling 1.0)
+    _, alpha, _ = _normalize_adapter("a", sd)
+    assert alpha is None
+    # PEFT directory: adapter_config.json + adapter_model.safetensors
+    d = tmp_path / "peft_adapter"
+    d.mkdir()
+    (d / "adapter_config.json").write_text('{"lora_alpha": 32, "r": 8}')
+    save_file(sd, str(d / "adapter_model.safetensors"))
+    got_sd, alpha, rs = _normalize_adapter("a", str(d))
+    assert alpha == 32 and not rs and "w" in got_sd
+
+
+def test_rslora_scaling(lora_app):
+    """use_rslora scales by alpha/sqrt(r) instead of alpha/r."""
+    app, cfg = lora_app
+    from neuronx_distributed_inference_tpu.config import LoraServingConfig
+    from neuronx_distributed_inference_tpu.modules.lora import (
+        LoraWeightManager,
+        attach_lora_params,
+    )
+    import jax.numpy as jnp
+    import math
+
+    sd = _make_adapter(cfg, r=4, seed=3)
+    sd.pop("lora_alpha")
+    params = {"layers": {"self_attn": {"q_proj": {"weight": jnp.zeros(
+        (cfg.num_hidden_layers, cfg.hidden_size, cfg.hidden_size))}, "k_proj": {}, "v_proj": {}, "o_proj": {}}, "mlp": {}}}
+    mgr = LoraWeightManager(LoraServingConfig(max_loras=1, max_lora_rank=8))
+    out = attach_lora_params(
+        params, {"a": (sd, {"lora_alpha": 8, "use_rslora": True})}, mgr,
+        cfg.num_hidden_layers,
+    )
+    scaling = np.asarray(out["layers"]["self_attn"]["q_proj"]["lora_scaling"])
+    np.testing.assert_allclose(scaling[:, 1], 8 / math.sqrt(4), rtol=1e-6)
+
+
 def test_max_loras_enforced():
     from neuronx_distributed_inference_tpu.modules.lora import LoraWeightManager
 
