@@ -73,6 +73,7 @@ class DecoderModelBuilder:
             has_sink=bool(getattr(self.config, "attention_sink", False)),
             rms_norm_eps=getattr(self.config, "rms_norm_eps", 1e-6),
             use_flash_kernel=tc.attn_kernel_enabled,
+            qkv_shards=self.degree if tc.fused_qkv else 1,
         )
 
     def model_spec(self) -> ModelSpec:
@@ -108,18 +109,28 @@ class DecoderModelBuilder:
         D = self.head_dim
         Hq, Hkv = self.gqa.q_heads, self.gqa.kv_heads
         V = self.padded_vocab
+        fused = self.config.tpu_config.fused_qkv
+        if fused:
+            # single (H, (Hq+2Hkv)·D) projection, split after the matmul
+            # (reference fused_qkv, gqa.py GroupQueryAttention_QKV fused path)
+            attn_shapes = {
+                "qkv_proj": {"weight": (L, H, (Hq + 2 * Hkv) * D)},
+                "o_proj": {"weight": (L, Hq * D, H)},
+            }
+        else:
+            attn_shapes = {
+                "q_proj": {"weight": (L, H, Hq * D)},
+                "k_proj": {"weight": (L, H, Hkv * D)},
+                "v_proj": {"weight": (L, H, Hkv * D)},
+                "o_proj": {"weight": (L, Hq * D, H)},
+            }
         shapes = {
             "embed_tokens": {"weight": (V, H)},
             "rope": {"inv_freq": (D // 2,)},
             "layers": {
                 "input_layernorm": {"weight": (L, H)},
                 "post_attention_layernorm": {"weight": (L, H)},
-                "self_attn": {
-                    "q_proj": {"weight": (L, H, Hq * D)},
-                    "k_proj": {"weight": (L, H, Hkv * D)},
-                    "v_proj": {"weight": (L, H, Hkv * D)},
-                    "o_proj": {"weight": (L, Hq * D, H)},
-                },
+                "self_attn": attn_shapes,
                 "mlp": {
                     "gate_proj": {"weight": (L, H, I)},
                     "up_proj": {"weight": (L, H, I)},
@@ -129,9 +140,12 @@ class DecoderModelBuilder:
             "norm": {"weight": (H,)},
         }
         if self.qkv_bias:
-            for p in ("q_proj", "k_proj", "v_proj"):
-                n = Hq if p == "q_proj" else Hkv
-                shapes["layers"]["self_attn"][p]["bias"] = (L, n * D)
+            if fused:
+                shapes["layers"]["self_attn"]["qkv_proj"]["bias"] = (L, (Hq + 2 * Hkv) * D)
+            else:
+                for p in ("q_proj", "k_proj", "v_proj"):
+                    n = Hq if p == "q_proj" else Hkv
+                    shapes["layers"]["self_attn"][p]["bias"] = (L, n * D)
         if self.qk_norm:
             shapes["layers"]["self_attn"]["q_norm"] = {"weight": (L, D)}
             shapes["layers"]["self_attn"]["k_norm"] = {"weight": (L, D)}
@@ -146,18 +160,30 @@ class DecoderModelBuilder:
         rank slicing (gqa.py:344,1151; modeling_llama.py:30-34).
         """
         t = TENSOR
+        tc = self.config.tpu_config
+        fused = tc.fused_qkv
+        if fused:
+            attn_specs = {
+                "qkv_proj": {"weight": P(None, None, t)},  # column parallel
+                "o_proj": {"weight": P(None, t, None)},  # row parallel
+            }
+        else:
+            attn_specs = {
+                "q_proj": {"weight": P(None, None, t)},  # column parallel
+                "k_proj": {"weight": P(None, None, t)},
+                "v_proj": {"weight": P(None, None, t)},
+                "o_proj": {"weight": P(None, t, None)},  # row parallel
+            }
         specs = {
-            "embed_tokens": {"weight": P(t, None)},  # vocab-sharded embedding
+            # vocab_parallel shards the embedding over the vocab dim (reference
+            # modeling_llama.py:1349 shard_across_embedding=not vocab_parallel);
+            # either way GSPMD inserts the gather/reduce
+            "embed_tokens": {"weight": P(t, None) if tc.vocab_parallel else P(None, t)},
             "rope": {"inv_freq": P()},
             "layers": {
                 "input_layernorm": {"weight": P()},
                 "post_attention_layernorm": {"weight": P()},
-                "self_attn": {
-                    "q_proj": {"weight": P(None, None, t)},  # column parallel
-                    "k_proj": {"weight": P(None, None, t)},
-                    "v_proj": {"weight": P(None, None, t)},
-                    "o_proj": {"weight": P(None, t, None)},  # row parallel
-                },
+                "self_attn": attn_specs,
                 "mlp": {
                     "gate_proj": {"weight": P(None, None, t)},
                     "up_proj": {"weight": P(None, None, t)},
@@ -167,8 +193,11 @@ class DecoderModelBuilder:
             "norm": {"weight": P()},
         }
         if self.qkv_bias:
-            for p in ("q_proj", "k_proj", "v_proj"):
-                specs["layers"]["self_attn"][p]["bias"] = P(None, t)
+            if fused:
+                specs["layers"]["self_attn"]["qkv_proj"]["bias"] = P(None, t)
+            else:
+                for p in ("q_proj", "k_proj", "v_proj"):
+                    specs["layers"]["self_attn"][p]["bias"] = P(None, t)
         if self.qk_norm:
             specs["layers"]["self_attn"]["q_norm"] = {"weight": P()}
             specs["layers"]["self_attn"]["k_norm"] = {"weight": P()}
@@ -312,6 +341,36 @@ class DecoderModelBuilder:
             if vpad:
                 lm = np.pad(lm, ((0, 0), (0, vpad)))
             params["lm_head"] = {"weight": jnp.asarray(lm, dtype)}
+        if cfg.tpu_config.fused_qkv:
+            params = self._fuse_qkv(params)
+        return params
+
+    def _fuse_qkv(self, params: Dict) -> Dict:
+        """Concat q/k/v into one column-parallel projection (fused_qkv).
+
+        The fused output axis is laid out RANK-INTERLEAVED —
+        [q_0|k_0|v_0|q_1|k_1|v_1|...] where x_i is model-parallel rank i's
+        slice — so uniform GSPMD sharding of the axis gives each rank exactly
+        its own [q|k|v] slab and the post-matmul split stays shard-local (the
+        reference preshard hook does the same interleave, gqa.py:159-266).
+        """
+        g = self.degree
+        sa = params["layers"]["self_attn"]
+        parts = [sa.pop("q_proj"), sa.pop("k_proj"), sa.pop("v_proj")]
+
+        def interleave(arrs):
+            # each (L, ..., N_j) -> (L, ..., g, N_j/g); concat on last axis;
+            # flatten (g, sum_j N_j/g) back into one axis
+            chunked = [
+                a.reshape(*a.shape[:-1], g, a.shape[-1] // g) for a in arrs
+            ]
+            cat = jnp.concatenate(chunked, axis=-1)
+            return cat.reshape(*cat.shape[:-2], cat.shape[-2] * cat.shape[-1])
+
+        entry = {"weight": interleave([p["weight"] for p in parts])}
+        if self.qkv_bias:
+            entry["bias"] = interleave([p["bias"] for p in parts])
+        sa["qkv_proj"] = entry
         return params
 
     def mlp_fn(self):

@@ -87,6 +87,7 @@ class MoEDecoderModelBuilder(DecoderModelBuilder):
             num_experts=self.num_experts,
             top_k=getattr(cfg, "num_experts_per_tok", 2),
             normalize_top_k_affinities=bool(getattr(cfg, "norm_topk_prob", True)),
+            router_dtype=getattr(tc, "router_dtype", "float32"),
             act=getattr(cfg, "hidden_act", "silu"),
             early_affinity_modulation=bool(
                 getattr(tc, "early_expert_affinity_modulation", False)

@@ -48,6 +48,9 @@ class AttnSpec:
     has_sink: bool = False
     rms_norm_eps: float = 1e-6
     use_flash_kernel: Optional[bool] = None  # None = auto by platform
+    # model-parallel degree of the rank-interleaved fused-qkv layout
+    # (builder._fuse_qkv); 1 when fused_qkv is off
+    qkv_shards: int = 1
 
     @property
     def softmax_scale(self) -> float:
@@ -78,13 +81,30 @@ def qkv_project(
     from neuronx_distributed_inference_tpu.modules.lora import apply_lora
 
     B, S, _ = hidden.shape
-    q = apply_lora(params["q_proj"], hidden, linear(params["q_proj"], hidden), adapter_ids)
-    k = apply_lora(params["k_proj"], hidden, linear(params["k_proj"], hidden), adapter_ids)
-    v = apply_lora(params["v_proj"], hidden, linear(params["v_proj"], hidden), adapter_ids)
-    if spec.qkv_bias:
-        q = q + params["q_proj"]["bias"]
-        k = k + params["k_proj"]["bias"]
-        v = v + params["v_proj"]["bias"]
+    if "qkv_proj" in params:
+        # fused_qkv: one column-parallel matmul, split after (LoRA serving is
+        # rejected with fused_qkv at config validation). The fused axis is
+        # rank-interleaved [q_i|k_i|v_i] per model-parallel rank (see
+        # builder._fuse_qkv) so this split is shard-local under GSPMD.
+        fused = linear(params["qkv_proj"], hidden)
+        if spec.qkv_bias:
+            fused = fused + params["qkv_proj"]["bias"]
+        g = spec.qkv_shards
+        q_sz = spec.num_heads * spec.head_dim
+        kv_sz = spec.num_kv_heads * spec.head_dim
+        pq, pkv = q_sz // g, kv_sz // g
+        grouped = fused.reshape(B, S, g, pq + 2 * pkv)
+        q = grouped[..., :pq].reshape(B, S, q_sz)
+        k = grouped[..., pq : pq + pkv].reshape(B, S, kv_sz)
+        v = grouped[..., pq + pkv :].reshape(B, S, kv_sz)
+    else:
+        q = apply_lora(params["q_proj"], hidden, linear(params["q_proj"], hidden), adapter_ids)
+        k = apply_lora(params["k_proj"], hidden, linear(params["k_proj"], hidden), adapter_ids)
+        v = apply_lora(params["v_proj"], hidden, linear(params["v_proj"], hidden), adapter_ids)
+        if spec.qkv_bias:
+            q = q + params["q_proj"]["bias"]
+            k = k + params["k_proj"]["bias"]
+            v = v + params["v_proj"]["bias"]
     q = q.reshape(B, S, spec.num_heads, spec.head_dim)
     k = k.reshape(B, S, spec.num_kv_heads, spec.head_dim)
     v = v.reshape(B, S, spec.num_kv_heads, spec.head_dim)

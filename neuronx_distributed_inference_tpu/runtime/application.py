@@ -375,6 +375,10 @@ class TpuModelForCausalLM:
                 pos = pos + take
                 remaining -= take
                 step += 1
+                if not tc.async_mode:
+                    # sync at every chunk boundary (debugging; reference
+                    # async_mode=False per-step dispatch semantics)
+                    jax.block_until_ready(tokens_c)
             gen = np.asarray(jax.device_get(jnp.concatenate(token_chunks, axis=1)))
             sequences = np.concatenate([input_ids, gen.astype(np.int64)], axis=1)
             logits = (
