@@ -171,9 +171,16 @@ UNIMPLEMENTED_FLAGS: Dict[str, Tuple[Any, str]] = {
     "num_medusa_heads": (0, "Medusa decoding (reference model_base.py:469-584)"),
     "token_tree_config": (None, "token-tree speculation (reference eagle/token_tree.py)"),
     "attn_block_tkg_kernel_enabled": (False, "fused block decode-attention kernel"),
-    "enable_eagle_speculation": (False, "EAGLE speculation runtime wiring"),
-    "is_eagle_target": (False, "EAGLE speculation runtime wiring"),
-    "is_eagle_draft": (False, "EAGLE speculation runtime wiring"),
+    "is_eagle_target": (
+        False,
+        "per-submodel role flags are internal to the reference's config "
+        "specialization; use runtime/fused_spec.TpuEagleSpecModelForCausalLM",
+    ),
+    "is_eagle_draft": (
+        False,
+        "per-submodel role flags are internal to the reference's config "
+        "specialization; use runtime/fused_spec.TpuEagleSpecModelForCausalLM",
+    ),
     "is_chunked_prefill": (False, "chunked prefill (tile scheduler + paged flash kernel)"),
     "is_prefix_caching": (False, "prefix caching (prior-KV prefill + 2-D buckets)"),
     "k_cache_transposed": (

@@ -80,8 +80,15 @@ def build_parser() -> argparse.ArgumentParser:
 
     # speculation
     run.add_argument("--draft-model-path", default=None)
+    run.add_argument("--draft-model-type", default=None,
+                     help="model_type of the draft (default: same as target; "
+                          "llama-eagle for EAGLE drafts)")
     run.add_argument("--speculation-length", type=int, default=0)
     run.add_argument("--enable-fused-speculation", action="store_true")
+    run.add_argument("--enable-eagle-speculation", action="store_true")
+    run.add_argument("--assisted-decoding", action="store_true",
+                     help="vanilla (unfused) draft-assisted decoding: draft "
+                          "and target compiled independently")
 
     # generation
     run.add_argument("--prompt", action="append", dest="prompts", default=None)
@@ -142,35 +149,75 @@ def run_inference(args) -> int:
     load_config = load_pretrained_config(args.model_path)
     config = config_cls(tpu_config, load_config=load_config)
 
-    fused_spec = args.enable_fused_speculation or (
+    if args.assisted_decoding and (
+        args.enable_fused_speculation or args.enable_eagle_speculation
+    ):
+        raise ValueError(
+            "--assisted-decoding is the unfused path; it conflicts with "
+            "--enable-fused-speculation/--enable-eagle-speculation"
+        )
+    if args.assisted_decoding and args.do_sample:
+        raise NotImplementedError(
+            "assisted decoding is greedy-only; sampled speculation runs "
+            "through --enable-fused-speculation (multinomial accept/reject)"
+        )
+    fused_spec = args.enable_fused_speculation or args.enable_eagle_speculation or (
         args.draft_model_path and args.speculation_length >= 2
+        and not args.assisted_decoding
     )
+    assisted = args.assisted_decoding
     print(f"[inference_demo] building {args.model_type} app "
-          f"(tp={args.tp_degree} ep={args.ep_degree} fused_spec={bool(fused_spec)})",
+          f"(tp={args.tp_degree} ep={args.ep_degree} fused_spec={bool(fused_spec)} "
+          f"eagle={args.enable_eagle_speculation} assisted={assisted})",
           file=sys.stderr)
     t0 = time.time()
+    draft_app = None
     if fused_spec:
         from neuronx_distributed_inference_tpu.config import FusedSpecConfig
         from neuronx_distributed_inference_tpu.runtime.fused_spec import (
+            TpuEagleSpecModelForCausalLM,
             TpuFusedSpecModelForCausalLM,
         )
 
         if not args.draft_model_path:
-            raise ValueError("--enable-fused-speculation requires --draft-model-path")
+            raise ValueError("fused/eagle speculation requires --draft-model-path")
         tpu_config.enable_fused_speculation = True
-        draft_config = config_cls(
+        tpu_config.enable_eagle_speculation = args.enable_eagle_speculation
+        draft_type = args.draft_model_type or (
+            "llama-eagle" if args.enable_eagle_speculation else args.model_type
+        )
+        draft_builder_cls = get_model_builder(draft_type)
+        draft_config_cls = getattr(draft_builder_cls, "config_cls", InferenceConfig)
+        draft_config = draft_config_cls(
             create_tpu_config(args), load_config=load_pretrained_config(args.draft_model_path)
         )
+        draft_config.model_type = draft_type
         config.fused_spec_config = FusedSpecConfig(
             draft_model_name=args.draft_model_path, draft_config=draft_config
         )
-        app = TpuFusedSpecModelForCausalLM(
-            args.model_path, config, draft_model_path=args.draft_model_path
+        app_cls = (
+            TpuEagleSpecModelForCausalLM
+            if args.enable_eagle_speculation
+            else TpuFusedSpecModelForCausalLM
         )
+        app = app_cls(args.model_path, config, draft_model_path=args.draft_model_path)
         app.load(random_weights=args.random_weights)
     else:
         app = TpuModelForCausalLM(args.model_path, config)
         app.load(random_weights=args.random_weights)
+        if assisted:
+            if not args.draft_model_path:
+                raise ValueError("--assisted-decoding requires --draft-model-path")
+            draft_type = args.draft_model_type or args.model_type
+            draft_builder_cls = get_model_builder(draft_type)
+            draft_config_cls = getattr(draft_builder_cls, "config_cls", InferenceConfig)
+            draft_config = draft_config_cls(
+                create_tpu_config(args),
+                load_config=load_pretrained_config(args.draft_model_path),
+            )
+            draft_config.model_type = draft_type
+            draft_app = TpuModelForCausalLM(args.draft_model_path, draft_config)
+            draft_app.load(random_weights=args.random_weights)
     print(f"[inference_demo] load: {time.time()-t0:.1f}s", file=sys.stderr)
     if not fused_spec:
         t0 = time.time()
@@ -195,11 +242,20 @@ def run_inference(args) -> int:
 
     eos_token_id = getattr(tok, "eos_token_id", None) if tok else None
     gen_kwargs = dict(max_new_tokens=args.max_new_tokens, eos_token_id=eos_token_id)
-    if not fused_spec and args.do_sample:
+    if args.do_sample:
         gen_kwargs.update(
             top_k=args.top_k, top_p=args.top_p, temperature=args.temperature
         )
-    out = app.generate(input_ids, attention_mask, **gen_kwargs)
+    if draft_app is not None:
+        from neuronx_distributed_inference_tpu.runtime.assisted import assisted_generate
+
+        out = assisted_generate(
+            app, draft_app, input_ids, attention_mask,
+            max_new_tokens=args.max_new_tokens, eos_token_id=eos_token_id,
+            speculation_length=max(args.speculation_length, 2),
+        )
+    else:
+        out = app.generate(input_ids, attention_mask, **gen_kwargs)
     for i, seq in enumerate(out.sequences):
         text = tok.decode(seq, skip_special_tokens=True) if tok else seq.tolist()
         print(f"--- output {i} ---\n{text}")

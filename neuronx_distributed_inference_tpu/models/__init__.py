@@ -11,3 +11,4 @@ from neuronx_distributed_inference_tpu.models.registry import (  # noqa: F401
 from neuronx_distributed_inference_tpu.models import llama  # noqa: F401
 from neuronx_distributed_inference_tpu.models import qwen  # noqa: F401
 from neuronx_distributed_inference_tpu.models import mixtral  # noqa: F401
+from neuronx_distributed_inference_tpu.models import eagle_draft  # noqa: F401

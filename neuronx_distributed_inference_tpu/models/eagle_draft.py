@@ -1,0 +1,70 @@
+"""EAGLE draft model builder (llama-family draft + fc fusion layer).
+
+Reference: the EAGLE draft is a (usually 1-layer) llama decoder whose input is
+``fc([embed(token), prev_hidden])`` (modeling_llama.py:260-308 fc module;
+model_base.py:1643-1650 draft fusion). HF EAGLE checkpoints carry the decoder
+weights under llama names plus ``fc.weight``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_inference_tpu.config import to_dtype
+from neuronx_distributed_inference_tpu.models.builder import DecoderModelBuilder
+from neuronx_distributed_inference_tpu.models.llama import LlamaInferenceConfig
+from neuronx_distributed_inference_tpu.models.registry import register_model
+
+
+@register_model("llama-eagle")
+class EagleLlamaDraftBuilder(DecoderModelBuilder):
+    """Llama draft + fc input fusion (+ optional draft input norm)."""
+
+    config_cls = LlamaInferenceConfig
+
+    @property
+    def _input_norm(self) -> bool:
+        return bool(self.config.tpu_config.enable_eagle_draft_input_norm)
+
+    def param_shapes(self) -> Dict:
+        shapes = super().param_shapes()
+        H = self.config.hidden_size
+        shapes["fc"] = {"weight": (2 * H, H)}
+        if self._input_norm:
+            shapes["input_norm"] = {"weight": (H,)}
+        return shapes
+
+    def param_pspecs(self) -> Dict:
+        specs = super().param_pspecs()
+        # small (2H, H) matrix: replicate (the reference keeps fc unsharded)
+        specs["fc"] = {"weight": P(None, None)}
+        if self._input_norm:
+            specs["input_norm"] = {"weight": P()}
+        return specs
+
+    def random_params(self, key=None, dtype=None) -> Dict:
+        params = super().random_params(key=key, dtype=dtype)
+        if self._input_norm:
+            params["input_norm"]["weight"] = jnp.ones_like(params["input_norm"]["weight"])
+        return params
+
+    def convert_hf_state_dict(self, sd, dtype=None) -> Dict:
+        dtype = dtype or to_dtype(self.config.tpu_config.dtype)
+        # EAGLE checkpoints sometimes omit the final norm (identity) — splice
+        # in ones so the shared conversion path works
+        sd = dict(sd)
+        H = self.config.hidden_size
+        sd.setdefault("model.norm.weight", np.ones(H, np.float32))
+        params = super().convert_hf_state_dict(sd, dtype)
+        fc_key = "fc.weight" if "fc.weight" in sd else "model.fc.weight"
+        params["fc"] = {"weight": jnp.asarray(np.asarray(sd[fc_key]).T, dtype)}
+        if self._input_norm:
+            w = sd.get("input_norm.weight")
+            params["input_norm"] = {
+                "weight": jnp.asarray(w, dtype) if w is not None else jnp.ones(H, dtype)
+            }
+        return params

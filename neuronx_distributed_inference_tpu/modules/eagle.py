@@ -41,7 +41,12 @@ from neuronx_distributed_inference_tpu.modules.kvcache import (
     slot_ids_from_seq_ids,
 )
 from neuronx_distributed_inference_tpu.modules.norm import rms_norm
-from neuronx_distributed_inference_tpu.modules.speculation import _row_mask
+from neuronx_distributed_inference_tpu.modules.speculation import (
+    _row_mask,
+    first_token,
+    propose_next,
+    verify_and_accept,
+)
 from neuronx_distributed_inference_tpu.ops.quant import linear
 
 
@@ -92,12 +97,15 @@ def eagle_context_encoding(
     target_cache: KVCache,
     hidden_buffer: jax.Array,
     inputs: StepInputs,
+    key=None,
     *,
     draft_spec: ModelSpec,
     target_spec: ModelSpec,
     draft_mlp_fn: Callable,
     target_mlp_fn: Callable,
     draft_input_norm: bool = False,
+    do_sample: bool = False,
+    max_topk: int = 256,
 ) -> EagleOutput:
     """Fused EAGLE prefill: target CTE (keeps all hiddens), draft CTE fed the
     1-shifted target hiddens (reference _eagle_context_encoding_forward,
@@ -114,7 +122,9 @@ def eagle_context_encoding(
         spec=draft_spec, phase=PHASE_CONTEXT_ENCODING, mlp_fn=draft_mlp_fn,
         input_norm=draft_input_norm,
     )
-    token = jnp.argmax(tlogits[:, -1:, :], axis=-1).astype(jnp.int32)
+    token = first_token(
+        tlogits[:, -1, :], inputs.sampling_params, key, do_sample, max_topk
+    )
     # stash the hidden that produced the first token, keyed by cache line
     last_hidden = gather_last_token(t_hidden, inputs.attention_mask)[:, 0, :]
     slots = slot_ids_from_seq_ids(inputs.seq_ids, hidden_buffer.shape[0] - 1)
@@ -136,6 +146,7 @@ def eagle_token_gen(
     target_cache: KVCache,
     hidden_buffer: jax.Array,
     inputs: StepInputs,
+    key=None,
     *,
     spec_len: int,
     draft_spec: ModelSpec,
@@ -143,21 +154,29 @@ def eagle_token_gen(
     draft_mlp_fn: Callable,
     target_mlp_fn: Callable,
     draft_input_norm: bool = False,
+    do_sample: bool = False,
+    max_topk: int = 256,
 ) -> EagleOutput:
     """Fused EAGLE decode step (reference _eagle_token_gen_forward,
-    model_base.py:2562): k-1 draft iterations chaining DRAFT hiddens, target
-    verify returning hiddens, contiguous-match acceptance, buffer update."""
+    model_base.py:2562): k-1 draft iterations chaining DRAFT hiddens plus a
+    final cache-fill iteration (reference final draft run :2708-2746), target
+    verify returning hiddens, acceptance (greedy contiguous-match or
+    multinomial accept/reject), buffer update."""
     k = spec_len
     bucket = inputs.attention_mask.shape[1]
     seq_ids = inputs.seq_ids
     sp = inputs.sampling_params
     slots = slot_ids_from_seq_ids(seq_ids, hidden_buffer.shape[0] - 1)
+    draft_keys = [None] * k
+    if do_sample:
+        key, *draft_keys = jax.random.split(key, k)
 
     cur = inputs.input_ids  # (B, 1)
     pos = inputs.position_ids
     prev_h = hidden_buffer[slots][:, None, :]  # (B, 1, H)
     candidates = [cur]
-    for i in range(k - 1):
+    draft_dists = []
+    for i in range(k):
         step_inputs = StepInputs(
             input_ids=cur,
             attention_mask=_row_mask(bucket, pos),
@@ -170,8 +189,14 @@ def eagle_token_gen(
             spec=draft_spec, phase=PHASE_TOKEN_GENERATION, mlp_fn=draft_mlp_fn,
             input_norm=draft_input_norm,
         )
+        if i == k - 1:
+            # cache-fill only: after a fully-accepted round, draft position
+            # p+k-1 must hold real KV for the next round's attention
+            break
         dlogits = lm_head(draft_params, d_hidden, draft_spec)[..., : draft_spec.vocab_size]
-        cur = jnp.argmax(dlogits[:, -1:, :], axis=-1).astype(jnp.int32)
+        cur, q = propose_next(dlogits[:, -1, :], sp, draft_keys[i], do_sample, max_topk)
+        if q is not None:
+            draft_dists.append(q)
         prev_h = d_hidden[:, -1:, :]  # chain the draft's own feature
         pos = pos + 1
         candidates.append(cur)
@@ -191,14 +216,10 @@ def eagle_token_gen(
         spec=target_spec, phase=PHASE_TOKEN_GENERATION, mlp_fn=target_mlp_fn,
         return_hidden=True,
     )  # logits/hiddens (B, k, ·)
-    greedy = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)
 
-    matches = (cand[:, 1:] == greedy[:, :-1]).astype(jnp.int32)
-    accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # (B,)
-    counts = accepted + 1
-
-    idx = jnp.arange(k, dtype=jnp.int32)[None, :]
-    tokens = jnp.where(idx < counts[:, None], greedy, 0)
+    tokens, counts = verify_and_accept(
+        cand, tlogits, draft_dists, sp, key, do_sample, max_topk
+    )
 
     # next step's draft input feature = target hidden that produced the bonus
     # token g_a (position index a = counts-1)

@@ -67,6 +67,45 @@ def greedy_sample(logits: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
+def _warped_window(
+    logits: jnp.ndarray,
+    sampling_params: jnp.ndarray,
+    max_topk: int,
+):
+    """Shared temperature/top-k/top-p warping: logits (B, V) ->
+    (masked window logits (B, k_width), top_idx (B, k_width)).
+
+    The SINGLE definition of the sampling distribution — :func:`sample` draws
+    from it, :func:`warped_probs` materializes it; speculative accept/reject
+    correctness requires the two to agree exactly
+    (reference sampling.py:249-332 multi-stage top-k + nucleus).
+    """
+    B, V = logits.shape
+    top_k = sampling_params[:, 0]
+    top_p = sampling_params[:, 1]
+    temperature = jnp.maximum(sampling_params[:, 2], 1e-6)
+
+    logits = logits.astype(jnp.float32) / temperature[:, None]
+    k_width = min(max_topk, V)
+    top_vals, top_idx = jax.lax.top_k(logits, k_width)  # sorted desc
+
+    # per-row dynamic top-k mask (top_k == -1 disables)
+    ranks = jnp.arange(k_width)[None, :]
+    k_eff = jnp.where(top_k <= 0, k_width, top_k)[:, None]
+    keep_k = ranks < k_eff
+
+    # top-p nucleus mask over the sorted window: keep the smallest prefix
+    # whose cumulative probability exceeds top_p; a token stays if cumsum up
+    # to *and including* it minus its own prob < top_p
+    probs = jax.nn.softmax(jnp.where(keep_k, top_vals, -jnp.inf), axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_p = (cum - probs) < top_p[:, None]
+
+    keep = keep_k & keep_p
+    keep = keep.at[:, 0].set(True)  # always keep the argmax
+    return jnp.where(keep, top_vals, -jnp.inf), top_idx
+
+
 def sample(
     logits: jnp.ndarray,
     sampling_params: jnp.ndarray,
@@ -80,35 +119,28 @@ def sample(
     """
     if not do_sample or key is None:
         return greedy_sample(logits)
-
-    B, V = logits.shape
-    top_k = sampling_params[:, 0]
-    top_p = sampling_params[:, 1]
-    temperature = jnp.maximum(sampling_params[:, 2], 1e-6)
-
-    logits = logits.astype(jnp.float32) / temperature[:, None]
-
-    k_width = min(max_topk, V)
-    top_vals, top_idx = jax.lax.top_k(logits, k_width)  # (B, k_width), sorted desc
-
-    # per-row dynamic top-k mask (top_k == -1 disables)
-    ranks = jnp.arange(k_width)[None, :]
-    k_eff = jnp.where(top_k <= 0, k_width, top_k)[:, None]
-    keep_k = ranks < k_eff
-
-    # top-p nucleus mask over the sorted window (reference sampling.py:249-310):
-    # keep the smallest prefix whose cumulative probability exceeds top_p;
-    # a token stays if cumsum up to *and including* it minus its own prob < top_p
-    probs = jax.nn.softmax(jnp.where(keep_k, top_vals, -jnp.inf), axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep_p = (cum - probs) < top_p[:, None]
-
-    keep = keep_k & keep_p
-    keep = keep.at[:, 0].set(True)  # always keep the argmax
-    masked = jnp.where(keep, top_vals, -jnp.inf)
-
+    masked, top_idx = _warped_window(logits, sampling_params, max_topk)
     choice = jax.random.categorical(key, masked, axis=-1)  # (B,) index into window
     return jnp.take_along_axis(top_idx, choice[:, None], axis=1)[:, 0].astype(jnp.int32)
+
+
+def warped_probs(
+    logits: jnp.ndarray,
+    sampling_params: jnp.ndarray,
+    max_topk: int = 256,
+) -> jnp.ndarray:
+    """Full-vocab probability distribution after temperature/top-k/top-p
+    warping — the exact distribution :func:`sample` draws from, materialized.
+
+    Speculative accept/reject needs q and p as distributions (reference
+    _speculative_token_selection, model_base.py:1727-1797). logits (B, V)
+    -> probs (B, V) fp32 (zero outside the kept window).
+    """
+    B, V = logits.shape
+    masked, top_idx = _warped_window(logits, sampling_params, max_topk)
+    window = jax.nn.softmax(masked, axis=-1)
+    full = jnp.zeros((B, V), jnp.float32)
+    return full.at[jnp.arange(B)[:, None], top_idx].set(window)
 
 
 def sample_tokens(

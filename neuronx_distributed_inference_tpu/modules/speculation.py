@@ -23,8 +23,11 @@ through to fill draft-cache position p+k-1 (the reference's final draft
 cache-update run, model_base.py:2708-2746).
 
 Greedy draft + greedy verify reproduces plain greedy decoding EXACTLY (the
-invariant the tests pin). Multinomial accept/reject sampling
-(reference _speculative_token_selection :1727) is the planned extension.
+invariant the tests pin). With sampling enabled the draft proposes from its
+warped distribution q and :func:`speculative_token_selection` runs the
+accept/reject rule (accept d with prob min(1, p(d)/q(d)); on rejection sample
+the residual max(p-q, 0)) whose output marginal is exactly the target
+distribution p (reference _speculative_token_selection, model_base.py:1727).
 """
 
 from __future__ import annotations
@@ -43,6 +46,7 @@ from neuronx_distributed_inference_tpu.models.base import (
     model_logits,
 )
 from neuronx_distributed_inference_tpu.modules.kvcache import KVCache
+from neuronx_distributed_inference_tpu.modules.sampling import sample, warped_probs
 
 
 @jax.tree_util.register_dataclass
@@ -59,36 +63,158 @@ def _row_mask(bucket: int, pos: jax.Array) -> jax.Array:
     return (jnp.arange(bucket)[None, :] <= pos).astype(jnp.int32)
 
 
+def propose_next(
+    dlogits_last: jax.Array,  # (B, V) draft logits at the last position
+    sampling_params: jax.Array,
+    key: Optional[jax.Array],
+    do_sample: bool,
+    max_topk: int,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """One draft proposal: -> (token (B, 1), q distribution (B, V) | None).
+
+    Shared by token-level and EAGLE drafts so the proposal distribution and
+    the accept/reject q stay definitionally identical.
+    """
+    if do_sample:
+        q = warped_probs(dlogits_last, sampling_params, max_topk)
+        cur = jax.random.categorical(
+            key, jnp.log(jnp.maximum(q, 1e-30)), axis=-1
+        ).astype(jnp.int32)[:, None]
+        return cur, q
+    return jnp.argmax(dlogits_last, axis=-1).astype(jnp.int32)[:, None], None
+
+
+def verify_and_accept(
+    cand: jax.Array,  # (B, k) candidates
+    tlogits: jax.Array,  # (B, k, V) target logits
+    draft_dists,  # list of k-1 (B, V) q distributions when sampling
+    sampling_params: jax.Array,
+    key: Optional[jax.Array],
+    do_sample: bool,
+    max_topk: int,
+) -> Tuple[jax.Array, jax.Array]:
+    """Acceptance: greedy contiguous-match or multinomial accept/reject.
+    -> (tokens (B, k) zero-padded, counts (B,)). Shared by fused and EAGLE."""
+    B, k = cand.shape
+    if do_sample:
+        p = warped_probs(
+            tlogits.reshape(B * k, -1), jnp.repeat(sampling_params, k, axis=0), max_topk
+        ).reshape(B, k, -1)
+        q = jnp.stack(draft_dists, axis=1)  # (B, k-1, V)
+        return speculative_token_selection(cand, q, p, key)
+    greedy = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (B, k) = g_0..g_{k-1}
+    # contiguous-match acceptance (reference _tkg_postprocessor :2844):
+    # draft token d_{i+1} = cand[:, i+1] must equal target g_i
+    matches = (cand[:, 1:] == greedy[:, :-1]).astype(jnp.int32)  # (B, k-1)
+    accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # (B,) in [0, k-1]
+    counts = accepted + 1  # accepted drafts + bonus token
+    idx = jnp.arange(k, dtype=jnp.int32)[None, :]
+    tokens = jnp.where(idx < counts[:, None], greedy, 0)
+    return tokens, counts
+
+
+def first_token(
+    tlogits_last: jax.Array,  # (B, V) target logits at the prompt's last position
+    sampling_params: jax.Array,
+    key: Optional[jax.Array],
+    do_sample: bool,
+    max_topk: int,
+) -> jax.Array:
+    """CTE first token: sampled from the warped target distribution (matching
+    plain decoding's CTE sampling, application.py _sample_key(0)) or greedy."""
+    if do_sample and key is not None:
+        return sample(tlogits_last, sampling_params, key, max_topk, True)[:, None]
+    return jnp.argmax(tlogits_last, axis=-1).astype(jnp.int32)[:, None]
+
+
+def speculative_token_selection(
+    cand: jax.Array,  # (B, k): cand[:, 0] = last accepted; cand[:, 1:] = draft proposals
+    draft_probs: jax.Array,  # (B, k-1, V): q_i, the dist cand[:, i+1] was drawn from
+    target_probs: jax.Array,  # (B, k, V): p_i, target dist after cand[:, i]
+    key: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
+    """Multinomial accept/reject (reference _speculative_token_selection,
+    model_base.py:1727-1797).
+
+    Accept draft token d_{i+1} with prob min(1, p_i(d)/q_i(d)). At the first
+    rejection, sample the residual distribution norm(max(p_i - q_i, 0)); after
+    a full accept, sample the bonus token from p_{k-1}. The emitted-token
+    marginal equals sampling from p directly (the spec-sampling theorem).
+
+    Returns (tokens (B, k) zero-padded, counts (B,) in [1, k]).
+    """
+    B, k = cand.shape
+    key_u, key_resid = jax.random.split(key)
+
+    d = cand[:, 1:]  # (B, k-1) proposals
+    p_d = jnp.take_along_axis(target_probs[:, :-1, :], d[:, :, None], axis=2)[:, :, 0]
+    q_d = jnp.take_along_axis(draft_probs, d[:, :, None], axis=2)[:, :, 0]
+    u = jax.random.uniform(key_u, (B, k - 1))
+    accept = (u * jnp.maximum(q_d, 1e-20) < p_d).astype(jnp.int32)  # (B, k-1)
+    acc = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)  # (B,) in [0, k-1]
+    counts = acc + 1
+
+    # final token: residual dist at the rejection index, or p_{k-1} on full accept
+    p_at = jnp.take_along_axis(target_probs, acc[:, None, None], axis=1)[:, 0]  # (B, V)
+    q_at = jnp.take_along_axis(
+        draft_probs, jnp.minimum(acc, k - 2)[:, None, None], axis=1
+    )[:, 0]
+    full_accept = (acc == k - 1)[:, None]
+    resid = jnp.where(full_accept, p_at, jnp.maximum(p_at - q_at, 0.0))
+    norm = jnp.sum(resid, axis=-1, keepdims=True)
+    # numerically-empty residual (p ~= q): fall back to p
+    resid = jnp.where(norm > 1e-20, resid / jnp.maximum(norm, 1e-20), p_at)
+    final_tok = jax.random.categorical(
+        key_resid, jnp.log(jnp.maximum(resid, 1e-30)), axis=-1
+    ).astype(jnp.int32)  # (B,)
+
+    idx = jnp.arange(k, dtype=jnp.int32)[None, :]
+    shifted = jnp.pad(d, ((0, 0), (0, 1)))  # accepted drafts at 0..acc-1
+    tokens = jnp.where(
+        idx < acc[:, None], shifted, jnp.where(idx == acc[:, None], final_tok[:, None], 0)
+    )
+    return tokens, counts
+
+
 def fused_spec_token_gen(
     draft_params: dict,
     target_params: dict,
     draft_cache: KVCache,
     target_cache: KVCache,
     inputs: StepInputs,
+    key: Optional[jax.Array] = None,
     *,
     spec_len: int,
     draft_spec: ModelSpec,
     target_spec: ModelSpec,
     draft_mlp_fn: Callable,
     target_mlp_fn: Callable,
+    do_sample: bool = False,
+    max_topk: int = 256,
 ) -> FusedSpecOutput:
     """One fused decode step producing up to ``spec_len`` tokens.
 
     inputs.input_ids: (B, 1) last accepted token; inputs.position_ids: (B, 1)
     its position p; inputs.attention_mask: (B, bucket) (width defines the
     compiled bucket; validity is recomputed in-graph from positions).
+
+    ``do_sample`` switches greedy contiguous-match acceptance for multinomial
+    accept/reject (:func:`speculative_token_selection`).
     """
     k = spec_len
     bucket = inputs.attention_mask.shape[1]
-    B = inputs.input_ids.shape[0]
     seq_ids = inputs.seq_ids
     sp = inputs.sampling_params
+    draft_keys = [None] * k
+    if do_sample:
+        key, *draft_keys = jax.random.split(key, k)
 
-    # ---- draft loop: k-1 greedy single-token steps + one cache-fill step
+    # ---- draft loop: k-1 single-token steps + one cache-fill step
     # (unrolled at trace time) --------------------------------------------
     cur = inputs.input_ids  # (B, 1)
     pos = inputs.position_ids  # (B, 1)
     candidates = [cur]
+    draft_dists = []  # q_i distributions when sampling
     for i in range(k):
         step_inputs = StepInputs(
             input_ids=cur,
@@ -110,7 +236,9 @@ def fused_spec_token_gen(
             # candidate (needed after a fully-accepted round; reference final
             # draft run, model_base.py:2708-2746)
             break
-        cur = jnp.argmax(dlogits[:, -1:, :], axis=-1).astype(jnp.int32)  # (B, 1)
+        cur, q = propose_next(dlogits[:, -1, :], sp, draft_keys[i], do_sample, max_topk)
+        if q is not None:
+            draft_dists.append(q)
         pos = pos + 1
         candidates.append(cur)
 
@@ -133,18 +261,10 @@ def fused_spec_token_gen(
         phase=PHASE_TOKEN_GENERATION,
         mlp_fn=target_mlp_fn,
     )  # (B, k, V): tlogits[:, i] predicts the token at cand_pos[:, i] + 1
-    greedy = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (B, k) = g_0..g_{k-1}
 
-    # ---- contiguous-match acceptance (reference _tkg_postprocessor :2844) -
-    # draft token d_{i+1} = cand[:, i+1] must equal target g_i
-    matches = (cand[:, 1:] == greedy[:, :-1]).astype(jnp.int32)  # (B, k-1)
-    accepted = jnp.sum(jnp.cumprod(matches, axis=1), axis=1)  # (B,) in [0, k-1]
-    counts = accepted + 1  # accepted drafts + bonus token
-
-    # output tokens are g_0..g_a then zero-padding
-    idx = jnp.arange(k, dtype=jnp.int32)[None, :]
-    tokens = jnp.where(idx < counts[:, None], greedy, 0)
-
+    tokens, counts = verify_and_accept(
+        cand, tlogits, draft_dists, sp, key, do_sample, max_topk
+    )
     return FusedSpecOutput(
         tokens=tokens, counts=counts, draft_cache=draft_cache, target_cache=target_cache
     )
@@ -156,11 +276,14 @@ def fused_spec_context_encoding(
     draft_cache: KVCache,
     target_cache: KVCache,
     inputs: StepInputs,
+    key: Optional[jax.Array] = None,
     *,
     draft_spec: ModelSpec,
     target_spec: ModelSpec,
     draft_mlp_fn: Callable,
     target_mlp_fn: Callable,
+    do_sample: bool = False,
+    max_topk: int = 256,
 ) -> FusedSpecOutput:
     """Fused prefill: target CTE (produces the first token) + draft CTE
     (populates the draft cache) in one graph
@@ -181,7 +304,9 @@ def fused_spec_context_encoding(
         phase=PHASE_CONTEXT_ENCODING,
         mlp_fn=draft_mlp_fn,
     )
-    token = jnp.argmax(tlogits[:, -1:, :], axis=-1).astype(jnp.int32)  # (B, 1)
+    token = first_token(
+        tlogits[:, -1, :], inputs.sampling_params, key, do_sample, max_topk
+    )  # (B, 1)
     B = token.shape[0]
     return FusedSpecOutput(
         tokens=token,

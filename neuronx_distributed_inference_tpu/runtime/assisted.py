@@ -1,0 +1,130 @@
+"""Vanilla (unfused) speculative decoding: two independent applications.
+
+Reference: assisted decoding through HuggingFaceGenerationAdapter with a
+separate draft application (utils/hf_adapter.py:427-607) — the draft and
+target are compiled independently (no fused graph), the host orchestrates
+propose -> verify -> accept.
+
+Greedy verification: the emitted sequence is byte-equal to the target's own
+greedy decoding (every emitted token is a target argmax), so a wrong draft
+only costs speed, never correctness.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from neuronx_distributed_inference_tpu.modules.autobucketing import get_target_bucket
+from neuronx_distributed_inference_tpu.modules.sampling import prepare_sampling_params
+from neuronx_distributed_inference_tpu.runtime.application import (
+    GenerationOutput,
+    TpuModelForCausalLM,
+)
+
+
+def assisted_generate(
+    target: TpuModelForCausalLM,
+    draft: TpuModelForCausalLM,
+    input_ids: np.ndarray,
+    attention_mask: Optional[np.ndarray] = None,
+    max_new_tokens: int = 32,
+    eos_token_id: Optional[int] = None,
+    speculation_length: Optional[int] = None,
+) -> GenerationOutput:
+    """Draft-assisted greedy generation (reference hf_adapter.py:427).
+
+    ``target`` and ``draft`` are independently loaded apps sharing a
+    tokenizer/vocab. Each round: the draft proposes k-1 greedy tokens with
+    k-1 single-token decodes, the target verifies all k candidates in ONE
+    multi-token pass (PHASE_TOKEN_GENERATION with n_active=k), and the
+    contiguous prefix matching the target's argmax is accepted plus one bonus
+    token. Cache discipline is write-then-attend on both sides, so rejected
+    candidates leave only masked-stale entries that later writes overwrite.
+    """
+    k = speculation_length or max(target.config.tpu_config.speculation_length, 2)
+    if k < 2:
+        raise ValueError("speculation_length must be >= 2")
+    tc = target.config.tpu_config
+    input_ids = np.asarray(input_ids)
+    B, S_in = input_ids.shape
+    if attention_mask is None:
+        attention_mask = np.ones_like(input_ids)
+    attention_mask = np.asarray(attention_mask)
+    seq_ids = np.arange(B, dtype=np.int32)
+    sp = prepare_sampling_params(B)
+
+    # --- prefill both apps on the prompt ---
+    ctx_lens = attention_mask.sum(axis=1).astype(np.int32)
+    position_ids = np.tile(np.arange(S_in, dtype=np.int32), (B, 1))
+    t_inputs, _ = target.context_encoding_model.prepare(
+        input_ids, attention_mask, position_ids, seq_ids, sp
+    )
+    t_out = target.context_encoding_model(target.params, target.kv_cache, t_inputs)
+    target.kv_cache = t_out.cache
+    d_inputs, _ = draft.context_encoding_model.prepare(
+        input_ids, attention_mask, position_ids, seq_ids, sp
+    )
+    d_out = draft.context_encoding_model(draft.params, draft.kv_cache, d_inputs)
+    draft.kv_cache = d_out.cache
+
+    first = np.asarray(jax.device_get(t_out.tokens))[:B, -1]
+    collected = [[int(first[b])] for b in range(B)]
+    done = np.zeros(B, bool)
+    if eos_token_id is not None:
+        done |= first == eos_token_id
+    pos = ctx_lens.copy()  # position of the token in `last`
+    last = first.astype(np.int32)
+
+    tkg = target.token_generation_model
+    while not done.all() and int(pos.max()) + k <= tc.seq_len and not all(
+        len(c) >= max_new_tokens for c in collected
+    ):
+        # --- draft proposes k-1 greedy tokens (k-1 single-token decodes) ---
+        bucket = get_target_bucket(
+            draft.token_generation_model.buckets, int(pos.max()) + k
+        )
+        d_tokens, _, d_cache = draft.token_generation_model.decode_chunk(
+            draft.params, draft.kv_cache, last[:, None], pos[:, None], seq_ids, sp,
+            None, num_steps=k - 1, bucket=bucket,
+        )
+        draft.kv_cache = d_cache
+        proposals = np.asarray(jax.device_get(d_tokens))[:B]  # (B, k-1)
+
+        # --- target verifies all k candidates in one pass ---
+        cand = np.concatenate([last[:, None], proposals], axis=1).astype(np.int32)
+        cand_pos = pos[:, None] + np.arange(k, dtype=np.int32)[None, :]
+        width = get_target_bucket(tkg.buckets, int(pos.max()) + k)
+        cache_mask = (np.arange(width)[None, :] <= cand_pos[:, -1:]).astype(np.int32)
+        v_inputs, _ = tkg.prepare(cand, cache_mask, cand_pos, seq_ids, sp)
+        v_out = tkg(target.params, target.kv_cache, v_inputs)
+        target.kv_cache = v_out.cache
+        greedy = np.asarray(jax.device_get(v_out.tokens))[:B]  # (B, k)
+
+        # --- contiguous-match acceptance ---
+        matches = (cand[:, 1:] == greedy[:, :-1]).astype(np.int64)
+        accepted = np.cumprod(matches, axis=1).sum(axis=1)  # (B,) in [0, k-1]
+        counts = accepted + 1
+        for b in range(B):
+            if done[b]:
+                continue
+            row = greedy[b, : counts[b]].tolist()
+            if eos_token_id is not None and eos_token_id in row:
+                row = row[: row.index(eos_token_id) + 1]
+                done[b] = True
+            collected[b].extend(row)
+            if len(collected[b]) >= max_new_tokens:
+                done[b] = True
+        last = greedy[np.arange(B), counts - 1].astype(np.int32)
+        pos = pos + counts.astype(np.int32)
+
+    n_new = min(max_new_tokens, max(len(c) for c in collected))
+    pad_tok = eos_token_id if eos_token_id is not None else 0
+    gen = np.full((B, n_new), pad_tok, np.int64)
+    for b in range(B):
+        row = collected[b][:n_new]
+        gen[b, : len(row)] = row
+    sequences = np.concatenate([input_ids, gen], axis=1)
+    return GenerationOutput(sequences=sequences, logits=None, num_generated=n_new)

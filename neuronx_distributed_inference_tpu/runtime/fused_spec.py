@@ -1,8 +1,19 @@
-"""Fused-speculation application: compile/load/generate for draft+target.
+"""Fused-speculation applications: draft+target compiled into one graph.
 
 Reference: the fused-spec sub-model path of NeuronBaseForCausalLM
-(model_base.py:3136, enable_fused_spec) + the host-side multi-token consumer
-in HuggingFaceGenerationAdapter (hf_adapter.py:468-607).
+(model_base.py:3136, enable_fused_spec), the EAGLE forwards
+(model_base.py:2082/:2562), and the host-side multi-token consumer in
+HuggingFaceGenerationAdapter (hf_adapter.py:468-607).
+
+Two applications share one host loop:
+- :class:`TpuFusedSpecModelForCausalLM` — token-level draft (a normal small
+  LM) + target (reference NeuronFusedSpecModel).
+- :class:`TpuEagleSpecModelForCausalLM` — EAGLE feature-level draft chained
+  with target hidden states (reference enable_eagle_speculation path).
+
+Both support greedy contiguous-match verification (byte-equal with plain
+greedy decoding) and multinomial accept/reject sampling
+(modules/speculation.speculative_token_selection).
 """
 
 from __future__ import annotations
@@ -13,15 +24,22 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from neuronx_distributed_inference_tpu.config import InferenceConfig, to_dtype
 from neuronx_distributed_inference_tpu.models.base import StepInputs
 from neuronx_distributed_inference_tpu.models.registry import get_model_builder
 from neuronx_distributed_inference_tpu.modules import autobucketing
 from neuronx_distributed_inference_tpu.modules.autobucketing import get_target_bucket
+from neuronx_distributed_inference_tpu.modules.eagle import (
+    eagle_context_encoding,
+    eagle_token_gen,
+    init_hidden_buffer,
+)
 from neuronx_distributed_inference_tpu.modules.kvcache import cache_spec, init_cache
-from neuronx_distributed_inference_tpu.modules.sampling import prepare_sampling_params
+from neuronx_distributed_inference_tpu.modules.sampling import (
+    prepare_sampling_params,
+    validate_sampling_params,
+)
 from neuronx_distributed_inference_tpu.modules.speculation import (
     fused_spec_context_encoding,
     fused_spec_token_gen,
@@ -32,8 +50,8 @@ from neuronx_distributed_inference_tpu.runtime.application import GenerationOutp
 from neuronx_distributed_inference_tpu.utils.hf_checkpoint import load_state_dict
 
 
-class TpuFusedSpecModelForCausalLM:
-    """Draft + target compiled together (reference NeuronFusedSpecModel)."""
+class _SpecAppBase:
+    """Shared compile/load/generate scaffolding for fused speculation."""
 
     def __init__(
         self,
@@ -55,6 +73,9 @@ class TpuFusedSpecModelForCausalLM:
         self.model_path = model_path
         self.draft_model_path = draft_model_path
         self.k = tc.speculation_length
+        ods = tc.on_device_sampling_config
+        self.do_sample = bool(ods and ods.do_sample)
+        self._rng_key = jax.random.PRNGKey(tc.seed)
 
         self.target_builder = get_model_builder(getattr(config, "model_type", "llama"))(config)
         self.draft_builder = get_model_builder(
@@ -67,24 +88,19 @@ class TpuFusedSpecModelForCausalLM:
         self.cte_buckets = autobucketing.generate_context_encoding_buckets(tc)
         self.tkg_buckets = autobucketing.generate_token_generation_buckets(tc)
 
-        common = dict(
+        self._common = dict(
             draft_spec=self.draft_spec,
             target_spec=self.target_spec,
             draft_mlp_fn=self.draft_builder.mlp_fn(),
             target_mlp_fn=self.target_builder.mlp_fn(),
         )
-        self._cte_fn = jax.jit(
-            partial(fused_spec_context_encoding, **common),
-            donate_argnums=(2, 3),
-        )
-        self._tkg_fn = jax.jit(
-            partial(fused_spec_token_gen, spec_len=self.k, **common),
-            donate_argnums=(2, 3),
-        )
+        self._make_fns()
         self.draft_params = None
         self.target_params = None
         self.draft_cache = None
         self.target_cache = None
+
+    # subclasses define _make_fns / _call_cte / _call_tkg
 
     def load(
         self,
@@ -132,7 +148,16 @@ class TpuFusedSpecModelForCausalLM:
             ),
             cspec, self.mesh,
         )
+        self._init_extra_state(kv_batch)
         return self
+
+    def _init_extra_state(self, kv_batch: int):
+        pass
+
+    def _step_key(self, step: int):
+        if not self.do_sample:
+            return None
+        return jax.random.fold_in(self._call_key, step)
 
     # ---- host loop -------------------------------------------------------
 
@@ -142,6 +167,9 @@ class TpuFusedSpecModelForCausalLM:
         attention_mask: Optional[np.ndarray] = None,
         max_new_tokens: int = 32,
         eos_token_id: Optional[int] = None,
+        top_k=None,
+        top_p=None,
+        temperature=None,
     ) -> GenerationOutput:
         tc = self.config.tpu_config
         input_ids = np.asarray(input_ids)
@@ -149,7 +177,9 @@ class TpuFusedSpecModelForCausalLM:
         if attention_mask is None:
             attention_mask = np.ones_like(input_ids)
         seq_ids = np.arange(B, dtype=np.int32)
-        sp = prepare_sampling_params(B)
+        sp = prepare_sampling_params(B, top_k, top_p, temperature)
+        validate_sampling_params(sp, tc.max_topk)
+        self._rng_key, self._call_key = jax.random.split(self._rng_key)
 
         # --- fused CTE ---
         bucket = get_target_bucket(self.cte_buckets, S_in)
@@ -164,11 +194,7 @@ class TpuFusedSpecModelForCausalLM:
             seq_ids=jnp.asarray(seq_ids),
             sampling_params=jnp.asarray(sp, jnp.float32),
         )
-        with jax.set_mesh(self.mesh):
-            out = self._cte_fn(
-                self.draft_params, self.target_params, self.draft_cache, self.target_cache, inputs
-            )
-        self.draft_cache, self.target_cache = out.draft_cache, out.target_cache
+        out = self._call_cte(inputs, self._step_key(0))
         first = np.asarray(jax.device_get(out.tokens))[:, 0]  # (B,)
 
         collected = [[int(first[b])] for b in range(B)]
@@ -179,6 +205,7 @@ class TpuFusedSpecModelForCausalLM:
         last = first.copy()
 
         done |= np.array([len(c) >= max_new_tokens for c in collected])
+        step = 1
         while not done.all() and int(pos.max()) + self.k <= tc.seq_len:
             width = int(pos.max()) + self.k
             bucket = get_target_bucket(self.tkg_buckets, width)
@@ -189,12 +216,7 @@ class TpuFusedSpecModelForCausalLM:
                 seq_ids=jnp.asarray(seq_ids),
                 sampling_params=jnp.asarray(sp, jnp.float32),
             )
-            with jax.set_mesh(self.mesh):
-                out = self._tkg_fn(
-                    self.draft_params, self.target_params, self.draft_cache,
-                    self.target_cache, inputs,
-                )
-            self.draft_cache, self.target_cache = out.draft_cache, out.target_cache
+            out = self._call_tkg(inputs, self._step_key(step))
             tokens = np.asarray(jax.device_get(out.tokens))
             counts = np.asarray(jax.device_get(out.counts))
             for b in range(B):
@@ -209,6 +231,7 @@ class TpuFusedSpecModelForCausalLM:
                     done[b] = True
             last = tokens[np.arange(B), counts - 1]
             pos = pos + counts
+            step += 1
 
         n_new = min(max_new_tokens, max(len(c) for c in collected))
         pad_tok = eos_token_id if eos_token_id is not None else 0
@@ -218,3 +241,112 @@ class TpuFusedSpecModelForCausalLM:
             gen[b, : len(row)] = row
         sequences = np.concatenate([input_ids, gen], axis=1)
         return GenerationOutput(sequences=sequences, logits=None, num_generated=n_new)
+
+
+class TpuFusedSpecModelForCausalLM(_SpecAppBase):
+    """Token-level draft + target compiled together (reference NeuronFusedSpecModel)."""
+
+    def _make_fns(self):
+        tc = self.config.tpu_config
+        self._cte_fn = jax.jit(
+            partial(
+                fused_spec_context_encoding,
+                do_sample=self.do_sample,
+                max_topk=tc.max_topk,
+                **self._common,
+            ),
+            donate_argnums=(2, 3),
+        )
+        self._tkg_fn = jax.jit(
+            partial(
+                fused_spec_token_gen,
+                spec_len=self.k,
+                do_sample=self.do_sample,
+                max_topk=tc.max_topk,
+                **self._common,
+            ),
+            donate_argnums=(2, 3),
+        )
+
+    def _call_cte(self, inputs, key):
+        with jax.set_mesh(self.mesh):
+            out = self._cte_fn(
+                self.draft_params, self.target_params, self.draft_cache,
+                self.target_cache, inputs, key,
+            )
+        self.draft_cache, self.target_cache = out.draft_cache, out.target_cache
+        return out
+
+    def _call_tkg(self, inputs, key):
+        with jax.set_mesh(self.mesh):
+            out = self._tkg_fn(
+                self.draft_params, self.target_params, self.draft_cache,
+                self.target_cache, inputs, key,
+            )
+        self.draft_cache, self.target_cache = out.draft_cache, out.target_cache
+        return out
+
+
+class TpuEagleSpecModelForCausalLM(_SpecAppBase):
+    """EAGLE: feature-level draft chained with target hidden states
+    (reference enable_eagle_speculation, model_base.py:2082/:2562).
+
+    The draft model_type should be ``llama-eagle``
+    (models/eagle_draft.EagleLlamaDraftBuilder: llama + fc fusion layer).
+    """
+
+    def __init__(self, model_path, config, draft_model_path=None, mesh=None):
+        tc = config.tpu_config
+        if not tc.enable_eagle_speculation:
+            raise ValueError("set tpu_config.enable_eagle_speculation=True")
+        super().__init__(model_path, config, draft_model_path, mesh)
+
+    def _make_fns(self):
+        tc = self.config.tpu_config
+        norm = bool(tc.enable_eagle_draft_input_norm)
+        self._cte_fn = jax.jit(
+            partial(
+                eagle_context_encoding,
+                draft_input_norm=norm,
+                do_sample=self.do_sample,
+                max_topk=tc.max_topk,
+                **self._common,
+            ),
+            donate_argnums=(2, 3, 4),
+        )
+        self._tkg_fn = jax.jit(
+            partial(
+                eagle_token_gen,
+                spec_len=self.k,
+                draft_input_norm=norm,
+                do_sample=self.do_sample,
+                max_topk=tc.max_topk,
+                **self._common,
+            ),
+            donate_argnums=(2, 3, 4),
+        )
+
+    def _init_extra_state(self, kv_batch: int):
+        self.hidden_buffer = init_hidden_buffer(
+            kv_batch, self.target_spec.hidden_size, to_dtype(self.config.tpu_config.dtype)
+        )
+
+    def _call_cte(self, inputs, key):
+        with jax.set_mesh(self.mesh):
+            out = self._cte_fn(
+                self.draft_params, self.target_params, self.draft_cache,
+                self.target_cache, self.hidden_buffer, inputs, key,
+            )
+        self.draft_cache, self.target_cache = out.draft_cache, out.target_cache
+        self.hidden_buffer = out.hidden_buffer
+        return out
+
+    def _call_tkg(self, inputs, key):
+        with jax.set_mesh(self.mesh):
+            out = self._tkg_fn(
+                self.draft_params, self.target_params, self.draft_cache,
+                self.target_cache, self.hidden_buffer, inputs, key,
+            )
+        self.draft_cache, self.target_cache = out.draft_cache, out.target_cache
+        self.hidden_buffer = out.hidden_buffer
+        return out

@@ -75,3 +75,22 @@ def test_cli_reload_from_artifact(tiny_checkpoint, tmp_path):
     cfg = InferenceConfig.load(compiled)
     assert cfg.tpu_config.batch_size == 2
     assert cfg.hidden_size == 64
+
+
+def test_cli_assisted_decoding(tiny_checkpoint, tmp_path):
+    """Vanilla assisted decoding through the CLI: draft == target checkpoint,
+    greedy parity guaranteed by construction."""
+    from neuronx_distributed_inference_tpu.inference_demo import main
+
+    rc = main(
+        [
+            "--model-type", "llama", "run",
+            "--model-path", tiny_checkpoint,
+            "--draft-model-path", tiny_checkpoint,
+            "--assisted-decoding",
+            "--speculation-length", "3",
+            "--batch-size", "1", "--seq-len", "64", "--dtype", "float32",
+            "--max-new-tokens", "6", "--skip-warmup",
+        ]
+    )
+    assert rc == 0
