@@ -181,8 +181,6 @@ UNIMPLEMENTED_FLAGS: Dict[str, Tuple[Any, str]] = {
         "per-submodel role flags are internal to the reference's config "
         "specialization; use runtime/fused_spec.TpuEagleSpecModelForCausalLM",
     ),
-    "is_chunked_prefill": (False, "chunked prefill (tile scheduler + paged flash kernel)"),
-    "is_prefix_caching": (False, "prefix caching (prior-KV prefill + 2-D buckets)"),
     "k_cache_transposed": (
         False,
         "XLA owns cache layouts on TPU; the transposed-K layout knob is a "
@@ -377,6 +375,11 @@ class TpuConfig:
             raise ValueError("cp_degree must divide tp_degree (cp splits the tp group)")
         if self.is_chunked_prefill and not self.is_block_kv_layout:
             raise ValueError("chunked prefill requires block KV layout")
+        if self.is_chunked_prefill and self.chunked_prefill_config is None:
+            self.chunked_prefill_config = ChunkedPrefillConfig()
+        if self.is_chunked_prefill and not self.is_continuous_batching:
+            raise ValueError("chunked prefill runs through the serving session: "
+                             "set is_continuous_batching=True")
         if self.is_prefix_caching and not self.is_block_kv_layout:
             raise ValueError("prefix caching requires block KV layout")
         if self.is_block_kv_layout and self.pa_num_blocks is None:

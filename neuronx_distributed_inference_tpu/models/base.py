@@ -143,7 +143,8 @@ def decoder_layer(
     phase: str,
     mlp_fn: Callable,
     key_valid: Optional[jax.Array] = None,
-    block_inputs: Optional[Tuple[jax.Array, jax.Array]] = None,
+    # (slot_mapping (B,S), block_table (B,MB), kv_limit (B,)) in block-KV mode
+    block_inputs: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     adapter_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decoder layer (reference NeuronLlamaDecoderLayer, modeling_llama.py:1188).
@@ -168,7 +169,7 @@ def decoder_layer(
             update_block_cache_at_layer,
         )
 
-        slot_mapping, block_table = block_inputs
+        slot_mapping, block_table, kv_limit = block_inputs
         k_cache, v_cache = update_block_cache_at_layer(
             k_cache, v_cache, k, v, layer_idx, slot_mapping
         )
@@ -192,8 +193,32 @@ def decoder_layer(
         if spec.cp_enabled:
             attn_out = cpx.shard_attn_out(attn_out)
     elif is_block:
-        k_r, v_r = read_block_cache_at_layer(k_cache, v_cache, layer_idx, block_table)
-        attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
+        from neuronx_distributed_inference_tpu.ops.paged_flash_attention import (
+            _use_paged_flash,
+            paged_flash_attention,
+        )
+
+        Sq = q.shape[1]
+        if (
+            sink is None
+            and not spec.sliding_window
+            and not spec.attention_chunk_size
+            and _use_paged_flash(aspec, Sq)
+        ):
+            # chunked/prefix prefill rides the paged flash kernel: blocks are
+            # DMA'd straight from the cache via the block table — no gather
+            # materialization (reference flash_pa_with_schedule.py:157)
+            k_l = jax.lax.dynamic_index_in_dim(k_cache, layer_idx, axis=0, keepdims=False)
+            v_l = jax.lax.dynamic_index_in_dim(v_cache, layer_idx, axis=0, keepdims=False)
+            attn_out = paged_flash_attention(
+                q, k_l, v_l, block_table, positions, kv_limit,
+                scale=aspec.softmax_scale,
+                n_rep=aspec.num_heads // aspec.num_kv_heads,
+                interpret=jax.default_backend() != "tpu",
+            )
+        else:
+            k_r, v_r = read_block_cache_at_layer(k_cache, v_cache, layer_idx, block_table)
+            attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
     else:
         B = q.shape[0]
         bucket = mask.shape[-1]
@@ -291,7 +316,8 @@ def run_decoder_layers(
         hidden = cpx.shard_seq(hidden)
         if spec.cp_enabled:
             mask = cpx.shard_prefill_mask(mask)
-    if inputs.slot_mapping is not None:
+    is_block = inputs.slot_mapping is not None or inputs.block_table is not None
+    if is_block:
         slot_ids = inputs.seq_ids  # block layout: writes go via slot_mapping
     else:
         slot_ids = slot_ids_from_seq_ids(inputs.seq_ids, cache.batch_size)
@@ -309,8 +335,21 @@ def run_decoder_layers(
         key_valid = inputs.attention_mask
 
     block_inputs = None
-    if inputs.slot_mapping is not None:
-        block_inputs = (inputs.slot_mapping, inputs.block_table)
+    if is_block:
+        slot_mapping = inputs.slot_mapping
+        if slot_mapping is None:
+            # in-graph slot-mapping generation from the block table (reference
+            # generate_tokengen_slot_mapping) — the host sends tables only
+            from neuronx_distributed_inference_tpu.modules.block_kvcache import (
+                slot_mapping_from_block_table,
+            )
+
+            slot_mapping = slot_mapping_from_block_table(
+                inputs.block_table, positions, cache.block_size
+            )
+        # valid cache length per row, for the paged flash kernel's bounds
+        kv_limit = jnp.sum(inputs.attention_mask.astype(jnp.int32), axis=-1)
+        block_inputs = (slot_mapping, inputs.block_table, kv_limit)
 
     num_layers = jax.tree.leaves(params["layers"])[0].shape[0]
 

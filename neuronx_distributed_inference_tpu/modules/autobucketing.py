@@ -66,3 +66,28 @@ def pad_length_to_bucket(length: int, buckets: List[int]) -> int:
 
 def round_up(n: int, multiple: int) -> int:
     return -(-n // multiple) * multiple
+
+
+def pow2_bucket(n: int, lo: int = 8) -> int:
+    """Smallest power of two >= n (at least lo)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def generate_chunk_q_buckets(config) -> List[int]:
+    """Query-length ladder for chunked/prefix prefill — the q dimension of
+    the 2-D (q_bucket, kv_bucket) programs (reference 2-D chunked-prefill
+    buckets, autobucketing.py:22-147)."""
+    cpc = config.chunked_prefill_config
+    if config.is_chunked_prefill and cpc is not None:
+        top = pow2_bucket(cpc.kernel_q_tile_size)
+    else:
+        top = pow2_bucket(config.max_context_length or config.seq_len)
+    out = []
+    b = 8
+    while b <= top:
+        out.append(b)
+        b *= 2
+    return out

@@ -20,8 +20,10 @@ slot mappings / block tables (the role vLLM plays for the reference).
 
 from __future__ import annotations
 
+import hashlib
+from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -99,6 +101,24 @@ def update_block_cache_at_layer(
     return flat_k.reshape(L, NB1, bs, H, D), flat_v.reshape(L, NB1, bs, H, D)
 
 
+def slot_mapping_from_block_table(
+    block_table: jax.Array,  # (B, MB)
+    positions: jax.Array,  # (B, S) logical positions
+    block_size: int,
+    valid: jax.Array = None,  # (B, S) bool; False -> garbage slot
+) -> jax.Array:
+    """IN-GRAPH slot-mapping generation for token-gen steps (reference
+    block_kv_cache_manager.generate_tokengen_slot_mapping): the host sends
+    only the block table; the write slot for position p is
+    ``block_table[p // bs] * bs + p % bs``. Invalid rows map to -1 (garbage)."""
+    idx = positions // block_size  # (B, S) block index per token
+    block_ids = jnp.take_along_axis(block_table, idx, axis=1)  # (B, S)
+    slots = block_ids * block_size + positions % block_size
+    if valid is not None:
+        slots = jnp.where(valid, slots, -1)
+    return slots.astype(jnp.int32)
+
+
 def read_block_cache_at_layer(
     k_cache: jax.Array,  # (L, NB+1, bs, H, D)
     v_cache: jax.Array,
@@ -162,3 +182,108 @@ class BlockAllocator:
         n = min(len(blocks), max_blocks)
         table[:n] = blocks[:n]
         return table
+
+
+@dataclass
+class PrefixCachingAllocator(BlockAllocator):
+    """Content-addressed block reuse (prefix caching).
+
+    Reference: is_prefix_caching serving on the block KV cache — prior KV for
+    a shared prompt prefix is reused instead of recomputed
+    (attention_base.py:893 perform_prefix_prefill consumes it). Here the
+    framework owns the content addressing (the reference delegates it to
+    vLLM): FULL blocks are keyed by a running sha1 over the token prefix, so
+    a block matches only when its content AND everything before it match.
+
+    Lifecycle: live blocks carry a refcount (one per attached sequence);
+    freeing a sequence moves refcount-0 registered blocks to an LRU evictable
+    pool — still matchable — and unregistered (partial-tail) blocks back to
+    the free list. Allocation evicts LRU blocks when the free list runs dry.
+    """
+
+    hash_of_block: Dict[int, bytes] = field(default_factory=dict)
+    block_by_hash: Dict[bytes, int] = field(default_factory=dict)
+    refcount: Dict[int, int] = field(default_factory=dict)
+    evictable: "OrderedDict[int, None]" = field(default_factory=OrderedDict)
+
+    # --- hashing ---------------------------------------------------------
+
+    def _chain_keys(self, tokens: np.ndarray) -> List[bytes]:
+        """One running-hash key per FULL block of ``tokens``."""
+        keys = []
+        h = hashlib.sha1()
+        bs = self.block_size
+        for i in range(len(tokens) // bs):
+            h.update(np.asarray(tokens[i * bs : (i + 1) * bs], np.int32).tobytes())
+            keys.append(h.digest())
+        return keys
+
+    # --- allocation with eviction ---------------------------------------
+
+    def alloc_seq(self, seq_id: int, num_tokens: int) -> List[int]:
+        blocks = self.seq_blocks.setdefault(seq_id, [])
+        needed = -(-num_tokens // self.block_size) - len(blocks)
+        while needed > len(self.free) and self.evictable:
+            victim, _ = self.evictable.popitem(last=False)  # LRU
+            key = self.hash_of_block.pop(victim, None)
+            if key is not None:
+                self.block_by_hash.pop(key, None)
+            self.refcount.pop(victim, None)
+            self.free.append(victim)
+        if needed > len(self.free):
+            raise RuntimeError(
+                f"out of KV blocks: need {needed}, free {len(self.free)}"
+            )
+        for _ in range(max(0, needed)):
+            blocks.append(self.free.pop(0))
+        return blocks
+
+    # --- prefix caching API ----------------------------------------------
+
+    def match_prefix(self, seq_id: int, tokens: np.ndarray) -> int:
+        """Attach the longest cached block-chain prefix of ``tokens`` to
+        ``seq_id``. Returns the number of cached TOKENS (multiple of
+        block_size, capped at len(tokens)-1 so at least one token is left to
+        produce next-token logits)."""
+        assert seq_id not in self.seq_blocks or not self.seq_blocks[seq_id]
+        matched: List[int] = []
+        for key in self._chain_keys(tokens):
+            b = self.block_by_hash.get(key)
+            if b is None:
+                break
+            matched.append(b)
+        # keep >= 1 token uncached (its forward produces the next token)
+        while matched and len(matched) * self.block_size >= len(tokens):
+            matched.pop()
+        for b in matched:
+            self.refcount[b] = self.refcount.get(b, 0) + 1
+            self.evictable.pop(b, None)
+        self.seq_blocks[seq_id] = list(matched)
+        return len(matched) * self.block_size
+
+    def commit_seq(self, seq_id: int, tokens: np.ndarray):
+        """Register this sequence's full prompt blocks for future matching
+        (idempotent; call once the prompt KV is fully written)."""
+        blocks = self.seq_blocks.get(seq_id, [])
+        for i, key in enumerate(self._chain_keys(tokens)):
+            if i >= len(blocks):
+                break
+            b = blocks[i]
+            if self.hash_of_block.get(b) == key:
+                continue  # already registered (e.g. matched prefix)
+            if key in self.block_by_hash:
+                continue  # identical content already cached under another block
+            if b in self.hash_of_block:
+                continue  # block already carries different content (shouldn't)
+            self.hash_of_block[b] = key
+            self.block_by_hash[key] = b
+            self.refcount[b] = self.refcount.get(b, 0) + 1
+
+    def free_seq(self, seq_id: int):
+        for b in self.seq_blocks.pop(seq_id, []):
+            if b in self.hash_of_block:
+                self.refcount[b] -= 1
+                if self.refcount[b] <= 0:
+                    self.evictable[b] = None  # matchable until evicted
+            else:
+                self.free.append(b)

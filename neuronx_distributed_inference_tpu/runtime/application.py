@@ -251,8 +251,15 @@ class TpuModelForCausalLM:
         return self
 
     def warmup(self):
+        tc = self.config.tpu_config
+        chunk_q = None
+        if tc.is_chunked_prefill or tc.is_prefix_caching:
+            chunk_q = autobucketing.generate_chunk_q_buckets(tc)
         for runner in self.runners:
-            self.kv_cache = runner.warmup(self.params, self.kv_cache, self._sample_key(0))
+            self.kv_cache = runner.warmup(
+                self.params, self.kv_cache, self._sample_key(0),
+                chunk_q_lens=chunk_q if runner is self.token_generation_model else None,
+            )
 
     def _sample_key(self, step: int):
         if not self.spec.do_sample:

@@ -227,8 +227,12 @@ class SubModelRunner:
 
     # ---- warmup ----------------------------------------------------------
 
-    def example_inputs(self, bucket: int) -> StepInputs:
-        """Reference: input_generator (model_wrapper.py:203-367)."""
+    def example_inputs(self, bucket: int, q_len: Optional[int] = None) -> StepInputs:
+        """Reference: input_generator (model_wrapper.py:203-367).
+
+        ``q_len`` > 1 builds a chunked/prefix-prefill example: multi-token
+        TKG inputs with BOTH slot_mapping and block_table, matching
+        ServingSession._prefill_chunks' call shape."""
         B = self.batch_size
         if self.phase == PHASE_CONTEXT_ENCODING:
             S = bucket
@@ -236,16 +240,19 @@ class SubModelRunner:
             mask = np.ones((B, S), np.int32)
             pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
         else:
-            S = self.n_active_tokens
+            S = q_len or self.n_active_tokens
             ids = np.zeros((B, S), np.int32)
             mask = np.ones((B, bucket), np.int32)
-            pos = np.zeros((B, S), np.int32)
+            pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
         kwargs = {}
         if self.block_kv:
             # warmup writes go to the garbage block; table reads block 0.
-            # Field presence must match real serving calls (CTE: slots only;
-            # TKG: slots + table) or the warmup program is never reused.
-            kwargs["slot_mapping"] = jnp.full((B, ids.shape[1]), -1, jnp.int32)
+            # Field presence must match real serving calls (CTE: slot mapping
+            # only; TKG decode: block table only, slot mapping generated
+            # in-graph; chunk/prefix prefill: both) or the warmup program is
+            # never reused.
+            if self.phase == PHASE_CONTEXT_ENCODING or q_len:
+                kwargs["slot_mapping"] = jnp.full((B, ids.shape[1]), -1, jnp.int32)
             if self.phase != PHASE_CONTEXT_ENCODING:
                 kwargs["block_table"] = jnp.zeros(
                     (B, max(1, bucket // self.block_size)), jnp.int32
@@ -259,12 +266,20 @@ class SubModelRunner:
             **kwargs,
         )
 
-    def warmup(self, params, cache: KVCache, rng=None) -> KVCache:
+    def warmup(self, params, cache: KVCache, rng=None, chunk_q_lens=None) -> KVCache:
         """Compile + execute every bucket once (reference warmup,
-        application_base.py:348-372)."""
+        application_base.py:348-372). ``chunk_q_lens`` additionally compiles
+        the 2-D chunk/prefix-prefill programs (q ladder x largest kv bucket;
+        smaller kv buckets compile lazily at first use)."""
         with jax.set_mesh(self.mesh):
             for bucket in self.buckets:
                 out = self._fn(params, cache, self.example_inputs(bucket), rng)
+                out.tokens.block_until_ready()
+                cache = out.cache
+            for q in chunk_q_lens or ():
+                out = self._fn(
+                    params, cache, self.example_inputs(self.buckets[-1], q_len=q), rng
+                )
                 out.tokens.block_until_ready()
                 cache = out.cache
         return cache

@@ -6,14 +6,29 @@ batch padding + sorting in ModelWrapper._forward_with_pad,
 model_wrapper.py:582-751; vLLM-style request lifecycle).
 
 A :class:`ServingSession` owns the KV cache slot table:
-- ``add_request`` assigns a free cache line (seq_id), runs context encoding
-  for just that request (batch padded to the compiled CTE batch; other rows
-  carry seq_id=-1 so their writes land in the garbage line), and queues the
-  request for decoding.
-- ``step`` advances ALL active requests by one token in a single TKG call
-  (rows ordered slot-aligned per the sorted-full-batch convention).
+- ``add_request`` assigns a free cache line (seq_id), prefills it (whole
+  prompt, or only the uncached suffix under prefix caching, or nothing yet
+  under chunked prefill), and queues the request for decoding.
+- ``step`` advances the session: one batched PREFILL-CHUNK pass for requests
+  with pending prompt tokens (chunked prefill), then one decode pass for all
+  decoding requests.
 - finished requests free their slot immediately — a new request can claim it
   on the next ``add_request`` (continuous batching).
+
+Prefix caching (reference perform_prefix_prefill, attention_base.py:893 +
+vLLM content addressing): cached prompt-prefix blocks are attached by content
+hash and only the suffix runs through the model — a multi-token
+PHASE_TOKEN_GENERATION pass whose per-token masks (masks.spec_token_gen_mask)
+give exactly "attend prior KV + causal among new tokens".
+
+Chunked prefill (reference modules/chunked_prefill/scheduler.py
+GridTileScheduler + flash_pa_with_schedule): long prompts are processed in
+fixed-size chunks through the SAME prior-KV pass, batching chunks of up to
+``max_num_seqs`` different requests per dispatch. Programs are keyed by the
+2-D (q_bucket, kv_bucket) shape — the TPU answer to the reference's 2-D
+chunked-prefill buckets (autobucketing.py:101). Decode runs as its own
+batched pass instead of being concatenated into the prefill tile schedule:
+two async dispatches with static shapes beat one megakernel under XLA.
 """
 
 from __future__ import annotations
@@ -23,6 +38,10 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from neuronx_distributed_inference_tpu.modules.autobucketing import (
+    get_target_bucket,
+    pow2_bucket,
+)
 from neuronx_distributed_inference_tpu.modules.sampling import prepare_sampling_params
 
 
@@ -34,9 +53,18 @@ class Request:
     eos_token_id: Optional[int] = None
     slot: int = -1
     pos: int = 0  # next write position
+    prefill_pos: int = 0  # prompt tokens already in the KV cache
     generated: List[int] = field(default_factory=list)
     finished: bool = False
     preempted: bool = False  # evicted mid-decode (KV pool exhausted)
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.input_ids.shape[0])
+
+    @property
+    def prefilling(self) -> bool:
+        return not self.finished and self.prefill_pos < self.prompt_len
 
     @property
     def last_token(self) -> int:
@@ -53,13 +81,20 @@ class ServingSession:
         self.slots: List[Optional[Request]] = [None] * self.num_slots
         self.requests: Dict[str, Request] = {}
         self.block_mode = tc.is_block_kv_layout
+        self.prefix_caching = tc.is_prefix_caching
+        self.chunked = tc.is_chunked_prefill
+        cpc = tc.chunked_prefill_config
+        self.chunk_size = cpc.kernel_q_tile_size if cpc else 128
+        self.max_prefill_seqs = cpc.max_num_seqs if cpc else 8
         self.allocator = None
         if self.block_mode:
             from neuronx_distributed_inference_tpu.modules.block_kvcache import (
                 BlockAllocator,
+                PrefixCachingAllocator,
             )
 
-            self.allocator = BlockAllocator(tc.pa_num_blocks, tc.pa_block_size)
+            cls = PrefixCachingAllocator if self.prefix_caching else BlockAllocator
+            self.allocator = cls(tc.pa_num_blocks, tc.pa_block_size)
 
     @property
     def free_slots(self) -> List[int]:
@@ -72,7 +107,7 @@ class ServingSession:
         max_new_tokens: int = 64,
         eos_token_id: Optional[int] = None,
     ) -> bool:
-        """Prefill one request into a free KV line. Returns False if full."""
+        """Admit one request into a free KV line. Returns False if full."""
         free = self.free_slots
         if not free:
             return False
@@ -84,18 +119,48 @@ class ServingSession:
             eos_token_id=eos_token_id,
             slot=slot,
         )
-        S = req.input_ids.shape[0]
+        if self.prefix_caching:
+            req.prefill_pos = self.allocator.match_prefix(slot, req.input_ids)
+            req.pos = req.prefill_pos
+        self.slots[slot] = req
+        self.requests[req_id] = req
+
+        if self.chunked:
+            # prompt runs in chunks inside step(); nothing dispatched yet
+            return True
+        if req.prefill_pos > 0:
+            # prefix hit: only the uncached suffix runs (prior-KV prefill)
+            ok = self._prefill_chunks([req], req.prompt_len - req.prefill_pos)
+            if not ok:
+                self._drop(req)
+                return False
+            return True
+        ok = self._full_prefill(req)
+        if not ok:
+            self._drop(req)
+        return ok
+
+    def _drop(self, req: Request):
+        if self.block_mode and req.slot >= 0:
+            self.allocator.free_seq(req.slot)
+        if req.slot >= 0:
+            self.slots[req.slot] = None
+        self.requests.pop(req.req_id, None)
+
+    def _full_prefill(self, req: Request) -> bool:
+        """Whole-prompt context encoding (flash-kernel eligible CTE path)."""
+        S = req.prompt_len
         ids = req.input_ids[None, :]
         mask = np.ones((1, S), np.int32)
         pos = np.arange(S, dtype=np.int32)[None, :]
-        seq_ids = np.array([slot], np.int32)
+        seq_ids = np.array([req.slot], np.int32)
         slot_mapping = None
         if self.block_mode:
             try:
-                self.allocator.alloc_seq(slot, S)
+                self.allocator.alloc_seq(req.slot, S)
             except RuntimeError:
                 return False  # out of KV blocks
-            slot_mapping = self.allocator.slot_mapping(slot, np.arange(S))[None, :]
+            slot_mapping = self.allocator.slot_mapping(req.slot, np.arange(S))[None, :]
         inputs, _ = self.app.context_encoding_model.prepare(
             ids, mask, pos, seq_ids, slot_mapping=slot_mapping
         )
@@ -104,14 +169,89 @@ class ServingSession:
         )
         self.app.kv_cache = out.cache
         first = int(np.asarray(out.tokens)[0, -1])
-        req.generated.append(first)
-        req.pos = S
-        if eos_token_id is not None and first == eos_token_id:
-            req.finished = True
-        self.slots[slot] = req
-        self.requests[req_id] = req
-        if req.finished or len(req.generated) >= req.max_new_tokens:
+        req.prefill_pos = S
+        self._finish_prefill(req, first)
+        return True
+
+    def _finish_prefill(self, req: Request, first_token: int):
+        req.pos = req.prompt_len
+        req.generated.append(first_token)
+        if self.prefix_caching:
+            self.allocator.commit_seq(req.slot, req.input_ids)
+        if (req.eos_token_id is not None and first_token == req.eos_token_id) or (
+            len(req.generated) >= req.max_new_tokens
+        ):
             self._finish(req)
+
+    def _prefill_chunks(
+        self, reqs: List[Request], chunk_size: int, preempt: bool = False
+    ) -> bool:
+        """One batched prior-KV prefill pass: each request advances by up to
+        ``chunk_size`` prompt tokens (2-D (q_bucket, kv_bucket) program).
+
+        A request that cannot get KV blocks is preempted when ``preempt``
+        (step()-driven chunked serving — never stalls the session); otherwise
+        the pass returns False and the caller drops the request
+        (admission-time prefill)."""
+        rows = []
+        for req in reqs:
+            n = min(chunk_size, req.prompt_len - req.prefill_pos)
+            if n <= 0:
+                continue
+            try:
+                self.allocator.alloc_seq(req.slot, req.prefill_pos + n)
+            except RuntimeError:
+                if not preempt:
+                    return False
+                req.preempted = True
+                self._finish(req)
+                continue
+            rows.append((req, n))
+        if not rows:
+            return True
+
+        B = self.num_slots
+        qb = pow2_bucket(max(n for _, n in rows))
+        bs = self.allocator.block_size
+        max_pos = max(r.prefill_pos + n for r, n in rows)
+        width = get_target_bucket(self.app.token_generation_model.buckets, max_pos)
+        mb = width // bs
+
+        ids = np.zeros((B, qb), np.int32)
+        positions = np.zeros((B, qb), np.int32)
+        mask = np.zeros((B, width), np.int32)
+        slot_mapping = np.full((B, qb), -1, np.int32)
+        block_table = np.zeros((B, mb), np.int32)
+        seq_ids = np.full((B,), -1, np.int32)
+        for req, n in rows:
+            s = req.slot
+            start = req.prefill_pos
+            ids[s, :n] = req.input_ids[start : start + n]
+            # padded tail positions continue so their (garbage) writes/reads
+            # stay in the masked region
+            positions[s] = start + np.arange(qb, dtype=np.int32)
+            mask[s, : start + n] = 1
+            slot_mapping[s, :n] = self.allocator.slot_mapping(
+                s, np.arange(start, start + n)
+            )
+            block_table[s] = self.allocator.block_table(s, mb)
+            seq_ids[s] = s
+
+        inputs, _ = self.app.token_generation_model.prepare(
+            ids, mask, positions, seq_ids, prepare_sampling_params(B),
+            slot_mapping=slot_mapping, block_table=block_table,
+        )
+        out = self.app.token_generation_model(
+            self.app.params, self.app.kv_cache, inputs, None
+        )
+        self.app.kv_cache = out.cache
+        tokens = np.asarray(out.tokens)
+
+        for req, n in rows:
+            req.prefill_pos += n
+            if req.prefill_pos >= req.prompt_len:
+                # the last prompt token's output IS the first generated token
+                self._finish_prefill(req, int(tokens[req.slot, n - 1]))
         return True
 
     def _finish(self, req: Request):
@@ -126,11 +266,34 @@ class ServingSession:
     def active(self) -> List[Request]:
         return [r for r in self.slots if r is not None]
 
+    @property
+    def decoding(self) -> List[Request]:
+        return [r for r in self.slots if r is not None and not r.prefilling]
+
+    @property
+    def prefilling(self) -> List[Request]:
+        return [r for r in self.slots if r is not None and r.prefilling]
+
     def step(self) -> Dict[str, int]:
-        """One decode step for every active request. Returns {req_id: token}."""
-        active = self.active
+        """Advance the session: one chunked-prefill pass (if pending) + one
+        decode step for every decoding request. Returns {req_id: token} for
+        tokens produced this step."""
+        results: Dict[str, int] = {}
+        prefill_finished: set = set()
+        if self.chunked and self.prefilling:
+            batch = self.prefilling[: self.max_prefill_seqs]
+            before = {r.req_id: len(r.generated) for r in batch}
+            self._prefill_chunks(batch, self.chunk_size, preempt=True)
+            for r in batch:
+                if len(r.generated) > before.get(r.req_id, 0):
+                    results[r.req_id] = r.generated[-1]
+                    prefill_finished.add(r.req_id)
+
+        # requests that finished prefill THIS step start decoding next step,
+        # so their prefill-completion token isn't overwritten in results
+        active = [r for r in self.decoding if r.req_id not in prefill_finished]
         if not active:
-            return {}
+            return results
         B = self.num_slots
         last = np.zeros((B, 1), np.int32)
         pos = np.zeros((B, 1), np.int32)
@@ -143,15 +306,10 @@ class ServingSession:
         block_table = None
         if self.block_mode:
             bs = self.allocator.block_size
-            from neuronx_distributed_inference_tpu.modules.autobucketing import (
-                get_target_bucket,
-            )
-
             width = get_target_bucket(
                 self.app.token_generation_model.buckets, int(pos.max()) + 1
             )
             mb = width // bs
-            slot_mapping = np.full((B, 1), -1, np.int32)
             block_table = np.zeros((B, mb), np.int32)
             for r in list(active):
                 try:
@@ -164,23 +322,24 @@ class ServingSession:
                     self._finish(r)
                     active.remove(r)
                     continue
-                slot_mapping[r.slot, 0] = self.allocator.slot_mapping(r.slot, [r.pos])[0]
                 block_table[r.slot] = self.allocator.block_table(r.slot, mb)
             if not active:
-                return {}
+                return results
+            # no host slot mapping: decode writes derive their slots IN-GRAPH
+            # from the block table (models/base.run_decoder_layers; reference
+            # generate_tokengen_slot_mapping)
         else:
             width = int(pos.max()) + 1
         mask = (np.arange(width)[None, :] <= pos).astype(np.int32)
         # inactive rows: mask garbage anyway
         inputs, _ = self.app.token_generation_model.prepare(
             last, mask, pos, seq_ids, prepare_sampling_params(B),
-            slot_mapping=slot_mapping, block_table=block_table,
+            block_table=block_table,
         )
         out = self.app.token_generation_model(self.app.params, self.app.kv_cache, inputs, None)
         self.app.kv_cache = out.cache
         tokens = np.asarray(out.tokens)[:, -1]
 
-        results = {}
         for r in active:
             tok = int(tokens[r.slot])
             r.generated.append(tok)
