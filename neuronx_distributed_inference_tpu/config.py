@@ -199,7 +199,6 @@ UNIMPLEMENTED_FLAGS: Dict[str, Tuple[Any, str]] = {
         "padding is a NKI detail with no TPU equivalent",
     ),
     "weights_to_skip_layout_optimization": (None, "XLA owns weight layouts on TPU"),
-    "attention_dp_degree": (1, "attention-DP decode over the dp mesh axis"),
 }
 
 # MoETpuConfig-only parity flags, same contract
@@ -371,8 +370,27 @@ class TpuConfig:
             raise ValueError("attention_dp_degree > 1 requires is_continuous_batching")
         if self.attention_dp_degree > 1 and self.max_batch_size % self.attention_dp_degree != 0:
             raise ValueError("batch size must divide evenly across attention DP ranks")
+        if self.attention_dp_degree > 1 and self.is_block_kv_layout:
+            raise NotImplementedError(
+                "attention-DP with the paged cache is not implemented; use "
+                "the contiguous cache (kv_cache_batch_size slots)"
+            )
+        if self.attention_dp_degree > 1 and self.enable_fused_speculation:
+            raise NotImplementedError(
+                "attention-DP with fused/EAGLE speculation is not implemented "
+                "(the speculation caches are not DP-sharded)"
+            )
+        if self.attention_dp_degree > 1 and (
+            self.kv_cache_batch_size or self.max_batch_size
+        ) % self.attention_dp_degree != 0:
+            raise ValueError("kv_cache_batch_size must divide across attention DP ranks")
         if self.cp_degree > 1 and self.tp_degree % self.cp_degree != 0:
             raise ValueError("cp_degree must divide tp_degree (cp splits the tp group)")
+        if self.tp_degree % (self.cp_degree * self.attention_dp_degree) != 0:
+            raise ValueError(
+                "cp_degree * attention_dp_degree must divide tp_degree "
+                "(both subdivide the TP group)"
+            )
         if self.is_chunked_prefill and not self.is_block_kv_layout:
             raise ValueError("chunked prefill requires block KV layout")
         if self.is_chunked_prefill and self.chunked_prefill_config is None:

@@ -40,6 +40,7 @@ from neuronx_distributed_inference_tpu.modules.attention import (
 )
 from neuronx_distributed_inference_tpu.modules.kvcache import (
     KVCache,
+    kv_batch_size,
     read_cache_at_layer,
     slot_ids_from_seq_ids,
     update_cache_at_layer,
@@ -74,6 +75,9 @@ class ModelSpec:
     # context/sequence parallelism (reference CP/SP, SURVEY §2.9)
     cp_enabled: bool = False
     sequence_parallel: bool = False
+    # attention-DP decode: batch-parallel attention over the dp mesh axis
+    # (reference attention_base.py:2308-2321)
+    attention_dp: int = 1
     # sampling
     on_device_sampling: bool = True
     do_sample: bool = False
@@ -222,8 +226,19 @@ def decoder_layer(
     else:
         B = q.shape[0]
         bucket = mask.shape[-1]
-        k_r, v_r = read_cache_at_layer(k_cache, v_cache, layer_idx, B, bucket)
+        if spec.attention_dp > 1:
+            # batch-parallel decode attention over dp: GSPMD all-to-alls
+            # heads<->batch around the attention (reference DP decode,
+            # attention_base.py:2308-2321)
+            from neuronx_distributed_inference_tpu.parallel import attention_dp as adp
+
+            q = adp.shard_decode_q(q)
+        k_r, v_r = read_cache_at_layer(
+            k_cache, v_cache, layer_idx, B, bucket, dp=spec.attention_dp
+        )
         attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
+        if spec.attention_dp > 1:
+            attn_out = adp.unshard_attn_out(attn_out)
 
     hidden = o_project(layer_params["self_attn"], attn_out, aspec, adapter_ids=adapter_ids)
     hidden = residual + hidden
@@ -320,7 +335,9 @@ def run_decoder_layers(
     if is_block:
         slot_ids = inputs.seq_ids  # block layout: writes go via slot_mapping
     else:
-        slot_ids = slot_ids_from_seq_ids(inputs.seq_ids, cache.batch_size)
+        slot_ids = slot_ids_from_seq_ids(
+            inputs.seq_ids, kv_batch_size(cache, spec.attention_dp), dp=spec.attention_dp
+        )
     positions = inputs.position_ids
     # plain-causal prefill exposes key validity so the flash kernel can run
     # (not under CP: pallas custom calls don't auto-partition — the CP path

@@ -93,6 +93,7 @@ class DecoderModelBuilder:
             attention_chunk_size=tc.attention_chunk_size,
             cp_enabled=tc.cp_degree > 1,
             sequence_parallel=tc.sequence_parallel_enabled,
+            attention_dp=tc.attention_dp_degree,
             on_device_sampling=ods is not None,
             do_sample=bool(ods and ods.do_sample),
             max_topk=tc.max_topk,

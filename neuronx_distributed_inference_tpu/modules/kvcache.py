@@ -43,12 +43,14 @@ class KVCache:
         return self.k.shape[0]
 
     @property
-    def batch_size(self) -> int:
-        return self.k.shape[1] - GARBAGE_LINES
-
-    @property
     def max_len(self) -> int:
         return self.k.shape[2]
+
+
+def kv_batch_size(cache: "KVCache", dp: int = 1) -> int:
+    """Real (non-garbage) cache lines: the dp layout carries one garbage line
+    per dp shard, the default layout one total."""
+    return cache.k.shape[1] - (dp if dp > 1 else GARBAGE_LINES)
 
 
 def init_cache(
@@ -58,38 +60,64 @@ def init_cache(
     num_kv_heads: int,
     head_dim: int,
     dtype=jnp.bfloat16,
+    dp: int = 1,
 ) -> KVCache:
-    shape = (num_layers, batch_size + GARBAGE_LINES, max_len, num_kv_heads, head_dim)
+    """``dp`` > 1 builds the attention-DP layout: one garbage line PER DP
+    SHARD, interleaved as [shard0: B/dp real + 1 garbage][shard1: ...] so the
+    batch dim shards evenly over ``dp`` and every row's garbage line is local
+    to its shard — the TPU answer to the reference's
+    DataParallelKVCacheManager (data_parallel_kv_cache_manager.py:8-40)."""
+    garbage = dp if dp > 1 else GARBAGE_LINES
+    shape = (num_layers, batch_size + garbage, max_len, num_kv_heads, head_dim)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
-def cache_spec(cp_enabled: bool = False):
+def cache_spec(cp_enabled: bool = False, dp_enabled: bool = False):
     """PartitionSpec for the cache — identical for the CTE and TKG programs so
     the cache never reshards between phases (SURVEY §7 hard-part 5).
 
     Default: KV heads sharded over the full model axes. With context
     parallelism the SEQUENCE dim shards over ``cp`` instead (heads over
     (ep, tp)): decode reductions over the key axis then become a
-    GSPMD-distributed softmax — flash decoding (reference flashdecode/)."""
+    GSPMD-distributed softmax — flash decoding (reference flashdecode/).
+    With attention-DP the BATCH dim shards over ``dp`` (decode attention is
+    batch-parallel; reference attention_base.py:2308-2321)."""
     from jax.sharding import PartitionSpec as P
 
-    from neuronx_distributed_inference_tpu.parallel.mesh import AXIS_CP, AXIS_EP, AXIS_TP, MODEL_AXES
+    from neuronx_distributed_inference_tpu.parallel.mesh import (
+        AXIS_CP,
+        AXIS_DP,
+        AXIS_EP,
+        AXIS_TP,
+        MODEL_AXES,
+    )
 
+    batch = AXIS_DP if dp_enabled else None
     if cp_enabled:
-        spec = P(None, None, AXIS_CP, (AXIS_EP, AXIS_TP), None)
+        spec = P(None, batch, AXIS_CP, (AXIS_EP, AXIS_TP), None)
     else:
-        spec = P(None, None, None, MODEL_AXES, None)
+        spec = P(None, batch, None, MODEL_AXES, None)
     return KVCache(k=spec, v=spec)
 
 
-def slot_ids_from_seq_ids(seq_ids: jax.Array, batch_size: int) -> jax.Array:
-    """Map invalid seq_ids (< 0 or >= B) to the garbage line (== B).
+def slot_ids_from_seq_ids(seq_ids: jax.Array, batch_size: int, dp: int = 1) -> jax.Array:
+    """Map seq_ids to cache lines; invalid ids (< 0 or >= B) go to a garbage
+    line (reference padding-zone writes, kv_cache_manager.py:356-417).
 
-    Reference: padding-zone writes for invalid seq_ids
-    (kv_cache_manager.py:356-417).
-    """
+    dp == 1: garbage is the single trailing line (== B). dp > 1: interleaved
+    attention-DP layout — seq s lives at ``(s // sr) * (sr+1) + s % sr`` with
+    ``sr = B // dp``, and an invalid row writes to ITS OWN shard's garbage
+    line so the scatter never crosses dp shards (the garbage-slot remap of
+    the reference DP KV manager)."""
     valid = (seq_ids >= 0) & (seq_ids < batch_size)
-    return jnp.where(valid, seq_ids, batch_size)
+    if dp <= 1:
+        return jnp.where(valid, seq_ids, batch_size)
+    sr = batch_size // dp
+    rows = jnp.arange(seq_ids.shape[0], dtype=seq_ids.dtype)
+    shard_of_row = jnp.minimum(rows // sr, dp - 1)
+    mapped = (seq_ids // sr) * (sr + 1) + seq_ids % sr
+    garbage = shard_of_row * (sr + 1) + sr
+    return jnp.where(valid, mapped, garbage)
 
 
 def update_cache_at_layer(
@@ -132,10 +160,24 @@ def read_cache_at_layer(
     layer_idx: jax.Array,
     batch_size: int,
     bucket_len: int,
+    dp: int = 1,
 ) -> Tuple[jax.Array, jax.Array]:
     """Read one layer's cache sliced to (batch, bucket) — no gather; batch
     row b owns cache line b (sorted-batch convention). Reference: get_cache
-    slices to bucket length (kv_cache_manager.py:331)."""
+    slices to bucket length (kv_cache_manager.py:331).
+
+    dp > 1: drop each shard's interleaved garbage line first (a shard-local
+    reshape/slice — the row dim splits exactly at dp shard boundaries)."""
+    if dp > 1:
+        sr = batch_size // dp
+        L, R, S = k_cache.shape[:3]
+        tail = k_cache.shape[3:]
+        k_cache = k_cache.reshape(L, dp, sr + 1, S, *tail)[:, :, :sr].reshape(
+            L, batch_size, S, *tail
+        )
+        v_cache = v_cache.reshape(L, dp, sr + 1, S, *tail)[:, :, :sr].reshape(
+            L, batch_size, S, *tail
+        )
     sizes = (1, batch_size, bucket_len) + k_cache.shape[3:]
     zeros = (0,) * (k_cache.ndim - 1)
     k = jax.lax.dynamic_slice(k_cache, (layer_idx,) + zeros, sizes)

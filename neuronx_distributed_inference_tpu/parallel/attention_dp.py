@@ -1,0 +1,37 @@
+"""Attention-DP decode sharding constraints.
+
+TPU-native re-design of the reference's data-parallel decode attention
+(reference: attention_base.py:2308-2321 attention-DP Q scatter / O gather over
+the TP-subdividing DP groups; attention_process_groups.py:126-163;
+modules/kvcache/data_parallel_kv_cache_manager.py:8-40).
+
+The mesh factors the model group as ``(dp, ep, cp, tp)``; weights stay
+sharded over all four axes (full tensor parallelism), while decode attention
+is constrained BATCH-parallel over ``dp``: GSPMD emits the all-to-all that
+trades head shards for batch shards before attention and back after — the
+hand-written scatter/gather of the reference. The KV cache's batch dim lives
+sharded over ``dp`` permanently (see kvcache.cache_spec / init_cache's
+interleaved garbage lines = the DataParallelKVCacheManager remap).
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_inference_tpu.parallel.mesh import AXIS_DP, MODEL_AXES
+from neuronx_distributed_inference_tpu.parallel.sharding import constrain as _constrain
+
+
+def shard_decode_q(q):
+    """(B, K, Hq, D): batch over dp, heads over the remaining model axes —
+    each dp group runs attention on its batch shard with heads sharded
+    tp/dp ways (reference DP decode Q scatter)."""
+    return _constrain(q, P(AXIS_DP, None, MODEL_AXES, None))
+
+
+def unshard_attn_out(out):
+    """(B, K, Hq, D) back to fully head-sharded for the O projection
+    (reference DP decode output gather)."""
+    from neuronx_distributed_inference_tpu.parallel.sharding import TENSOR
+
+    return _constrain(out, P(None, None, TENSOR, None))

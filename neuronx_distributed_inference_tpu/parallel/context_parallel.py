@@ -22,20 +22,12 @@ GSPMD emits the collectives:
 
 from __future__ import annotations
 
-import jax
 from jax.sharding import PartitionSpec as P
 
 from neuronx_distributed_inference_tpu.parallel.mesh import AXIS_CP, AXIS_EP, AXIS_TP
+from neuronx_distributed_inference_tpu.parallel.sharding import constrain as _constrain
 
 HEADS = (AXIS_EP, AXIS_TP)  # head sharding when cp is active (cp shards seq)
-
-
-def _constrain(x, spec):
-    try:
-        return jax.lax.with_sharding_constraint(x, spec)
-    except (ValueError, TypeError):
-        # no mesh context (single-device path) — constraint is advisory only
-        return x
 
 
 def shard_seq(hidden):

@@ -9,14 +9,19 @@ with hand-written TRN2 "8x8" physical-mesh tables. On TPU all of that collapses
 into ONE ``jax.sharding.Mesh`` with named axes; GSPMD emits the ICI/DCN
 collectives. Axis layout:
 
-    (dp, ep, cp, tp)   sizes: (dp_degree, ep_degree, cp_degree, tp_degree/cp_degree)
+    (dp, ep, cp, tp)
+    sizes: (attention_dp_degree, ep_degree, cp_degree,
+            tp_degree / (cp_degree * attention_dp_degree))
 
-- Weight tensor-parallel dims are sharded over the *combined* ``(ep, cp, tp)``
-  axes (= full tp_degree × ep_degree model group).
+- Weight tensor-parallel dims are sharded over ALL axes combined
+  (= full tp_degree × ep_degree model group; see sharding.TENSOR).
 - Context-parallel prefill shards sequence over ``cp`` while heads shard over
   ``tp`` — same devices, different view (reference attention_base.py:245-257).
+- Attention-DP decode shards the BATCH over ``dp`` while heads shard over the
+  remaining axes — both cp and dp subdivide the TP group, exactly like the
+  reference's CP/DP process groups reorganize the TP ranks
+  (attention_process_groups.py:80-163).
 - Expert-parallel shards the expert dim over ``ep``.
-- ``dp`` is whole-model data parallel (multi-slice / batch).
 
 ``mesh_utils.create_device_mesh`` picks an ICI-aware device ordering — the
 equivalent of the reference's hand-coded physical mesh tables
@@ -52,13 +57,17 @@ def build_mesh(
     """Build the global device mesh.
 
     ``tp_degree`` is the FULL tensor-parallel degree; internally the mesh
-    factors it as (cp, tp//cp) so context-parallel attention can address the
-    ``cp`` sub-axis (reference: CP groups split the TP group,
-    attention_process_groups.py:80-123).
+    factors it as (dp, cp, tp//(dp*cp)) so context-parallel attention can
+    address the ``cp`` sub-axis and attention-DP decode the ``dp`` sub-axis
+    (reference: CP/DP groups split the TP group,
+    attention_process_groups.py:80-163).
     """
-    if tp_degree % cp_degree != 0:
-        raise ValueError(f"cp_degree={cp_degree} must divide tp_degree={tp_degree}")
-    shape = (dp_degree, ep_degree, cp_degree, tp_degree // cp_degree)
+    if tp_degree % (cp_degree * dp_degree) != 0:
+        raise ValueError(
+            f"cp_degree*dp_degree={cp_degree * dp_degree} must divide "
+            f"tp_degree={tp_degree} (both split the TP group)"
+        )
+    shape = (dp_degree, ep_degree, cp_degree, tp_degree // (cp_degree * dp_degree))
     n = int(np.prod(shape))
     if devices is None:
         devices = jax.devices()
@@ -82,7 +91,7 @@ def mesh_from_config(tpu_config, devices=None) -> Mesh:
         tp_degree=tpu_config.tp_degree,
         cp_degree=tpu_config.cp_degree,
         ep_degree=tpu_config.ep_degree,
-        dp_degree=1,
+        dp_degree=tpu_config.attention_dp_degree,
         devices=devices,
     )
 

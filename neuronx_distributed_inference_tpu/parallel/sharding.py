@@ -25,10 +25,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from neuronx_distributed_inference_tpu.parallel.mesh import MODEL_AXES
+from neuronx_distributed_inference_tpu.parallel.mesh import ALL_AXES, MODEL_AXES
 
-# Logical axis names used in model param spec trees.
-TENSOR = MODEL_AXES  # shard over full model-parallel group (ep, cp, tp)
+# Logical axis names used in model param spec trees. Weight tensor-parallel
+# dims shard over EVERY mesh axis (dp included — attention-DP subdivides the
+# TP group, so the full model group is dp*ep*cp*tp; dp has size 1 unless
+# attention_dp_degree > 1).
+TENSOR = ALL_AXES
 EXPERT = "ep"
 
 
@@ -108,6 +111,16 @@ class GQASharding:
         out = np.zeros(shape[:-2] + (self.q_heads, head_dim, shape[-1]), w.dtype)
         out[..., self.slot_map, :, :] = w
         return out.reshape(shape[:-2] + (self.q_heads * head_dim, shape[-1]))
+
+
+def constrain(x, spec: P):
+    """with_sharding_constraint that degrades to a no-op outside a mesh
+    context (single-device paths). Shared by the CP/SP and attention-DP
+    constraint modules."""
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, TypeError):
+        return x
 
 
 def make_sharding_fn(mesh: Mesh):

@@ -191,8 +191,13 @@ class TpuModelForCausalLM:
             self.spec.attn.num_kv_heads,
             self.spec.attn.head_dim,
             dtype=dt,
+            dp=tc.attention_dp_degree,
         )
-        self.kv_cache = shard_pytree(cache, cache_spec(tc.cp_degree > 1), self.mesh)
+        self.kv_cache = shard_pytree(
+            cache,
+            cache_spec(tc.cp_degree > 1, tc.attention_dp_degree > 1),
+            self.mesh,
+        )
 
     def load_lora_adapters(self, adapters):
         """Attach multi-adapter LoRA weights (reference LoraModel.inject_adapter
