@@ -204,8 +204,6 @@ UNIMPLEMENTED_FLAGS: Dict[str, Tuple[Any, str]] = {
 # MoETpuConfig-only parity flags, same contract
 UNIMPLEMENTED_MOE_FLAGS: Dict[str, Tuple[Any, str]] = {
     "capacity_factor": (None, "capacity-factor (dropping) dispatch; MoE is dropless dense"),
-    "hidden_act_scaling_factor": (1.0, "GPT-OSS scaled-sigmoid GLU activation"),
-    "hidden_act_bias": (0.0, "GPT-OSS up-projection activation bias"),
     "fused_shared_experts": (False, "fused shared-expert path (DeepSeek)"),
     "moe_fused_kernel_enabled": (None, "fused MoE kernel"),
     "hybrid_sharding_config": (None, "hybrid expert sharding"),

@@ -12,3 +12,6 @@ from neuronx_distributed_inference_tpu.models import llama  # noqa: F401
 from neuronx_distributed_inference_tpu.models import qwen  # noqa: F401
 from neuronx_distributed_inference_tpu.models import mixtral  # noqa: F401
 from neuronx_distributed_inference_tpu.models import eagle_draft  # noqa: F401
+from neuronx_distributed_inference_tpu.models import deepseek  # noqa: F401
+from neuronx_distributed_inference_tpu.models import gpt_oss  # noqa: F401
+from neuronx_distributed_inference_tpu.models import dbrx  # noqa: F401

@@ -45,7 +45,7 @@ from neuronx_distributed_inference_tpu.modules.kvcache import (
     slot_ids_from_seq_ids,
     update_cache_at_layer,
 )
-from neuronx_distributed_inference_tpu.modules.norm import rms_norm
+from neuronx_distributed_inference_tpu.modules.norm import apply_norm, rms_norm
 from neuronx_distributed_inference_tpu.modules.rope import rope_cos_sin
 from neuronx_distributed_inference_tpu.modules.sampling import (
     mask_padded_logits,
@@ -55,6 +55,22 @@ from neuronx_distributed_inference_tpu.modules.sampling import (
 PHASE_CONTEXT_ENCODING = "context_encoding"
 PHASE_TOKEN_GENERATION = "token_generation"
 PHASE_SPECULATION = "speculation"
+
+
+@dataclass(frozen=True)
+class LayerGroupSpec:
+    """Static description of one contiguous run of structurally-identical
+    decoder layers. Heterogeneous stacks (GPT-OSS interleaved sliding/global
+    attention, DeepSeek dense-then-MoE) are a sequence of groups; each group
+    scans its own stacked params (reference: per-layer module init picks the
+    flavor per layer, e.g. modeling_gpt_oss.py sliding layers,
+    modeling_deepseek.py first_k_dense_replace)."""
+
+    num_layers: int
+    sliding_window: Optional[int] = None
+    attention_chunk_size: Optional[int] = None
+    # index into the mlp_fn / layer_fn lists the builder provides
+    fn_idx: int = 0
 
 
 @dataclass(frozen=True)
@@ -86,6 +102,11 @@ class ModelSpec:
     cast_logits_fp32: bool = True
     # rope
     attention_scaling: float = 1.0
+    # decoder norm flavor: "rmsnorm" (llama family) or "layernorm" (DBRX)
+    norm_type: str = "rmsnorm"
+    # heterogeneous layer stacks: None = one uniform group (spec-level
+    # sliding_window / attention_chunk_size apply)
+    layer_groups: Optional[Tuple[LayerGroupSpec, ...]] = None
 
 
 @jax.tree_util.register_dataclass
@@ -159,7 +180,9 @@ def decoder_layer(
     """
     aspec = spec.attn
     residual = hidden
-    hidden = rms_norm(hidden, layer_params["input_layernorm"]["weight"], spec.rms_eps)
+    hidden = apply_norm(
+        hidden, layer_params["input_layernorm"]["weight"], spec.rms_eps, spec.norm_type
+    )
     q, k, v = qkv_project(
         layer_params["self_attn"], hidden, cos, sin, aspec, adapter_ids=adapter_ids
     )
@@ -203,12 +226,21 @@ def decoder_layer(
         )
 
         Sq = q.shape[1]
-        if (
-            sink is None
-            and not spec.sliding_window
+        # the paged kernel implements the plain causal+prefix mask only: the
+        # MODEL must have no windowed/chunked attention anywhere, including
+        # inside layer groups (a group's mask never reaches the kernel)
+        plain_model = (
+            not spec.sliding_window
             and not spec.attention_chunk_size
-            and _use_paged_flash(aspec, Sq)
-        ):
+            and (
+                spec.layer_groups is None
+                or all(
+                    g.sliding_window is None and g.attention_chunk_size is None
+                    for g in spec.layer_groups
+                )
+            )
+        )
+        if sink is None and plain_model and _use_paged_flash(aspec, Sq):
             # chunked/prefix prefill rides the paged flash kernel: blocks are
             # DMA'd straight from the cache via the block table — no gather
             # materialization (reference flash_pa_with_schedule.py:157)
@@ -244,7 +276,10 @@ def decoder_layer(
     hidden = residual + hidden
 
     residual = hidden
-    hidden = rms_norm(hidden, layer_params["post_attention_layernorm"]["weight"], spec.rms_eps)
+    hidden = apply_norm(
+        hidden, layer_params["post_attention_layernorm"]["weight"], spec.rms_eps,
+        spec.norm_type,
+    )
     hidden = residual + mlp_fn(layer_params["mlp"], hidden, spec)
     if spec.cp_enabled and phase == PHASE_CONTEXT_ENCODING:
         from neuronx_distributed_inference_tpu.parallel import context_parallel as cpx
@@ -253,18 +288,23 @@ def decoder_layer(
     return hidden, k_cache, v_cache
 
 
-def build_mask(inputs: StepInputs, spec: ModelSpec, phase: str) -> jax.Array:
-    """Mask dispatch per attention flavor/phase (reference model_base.py:211-449)."""
+def build_mask(
+    inputs: StepInputs,
+    spec: ModelSpec,
+    phase: str,
+    window: Optional[int] = None,
+    chunk: Optional[int] = None,
+) -> jax.Array:
+    """Mask dispatch per attention flavor/phase (reference model_base.py:211-449).
+
+    ``window``/``chunk`` override the spec-level attention flavor (per-layer-
+    group masks for heterogeneous stacks)."""
     n_active = inputs.input_ids.shape[1]
     if phase == PHASE_CONTEXT_ENCODING:
-        if spec.attention_chunk_size:
-            return masks.chunked_mask(
-                inputs.attention_mask, inputs.position_ids, spec.attention_chunk_size
-            )
-        if spec.sliding_window:
-            return masks.windowed_mask(
-                inputs.attention_mask, inputs.position_ids, spec.sliding_window
-            )
+        if chunk:
+            return masks.chunked_mask(inputs.attention_mask, inputs.position_ids, chunk)
+        if window:
+            return masks.windowed_mask(inputs.attention_mask, inputs.position_ids, window)
         return masks.causal_mask(inputs.attention_mask)
     # token generation: base cache-validity mask, then attention-flavor bounds
     if n_active > 1:  # speculation: multi-token decode
@@ -273,14 +313,14 @@ def build_mask(inputs: StepInputs, spec: ModelSpec, phase: str) -> jax.Array:
         mask = masks.token_gen_mask(inputs.attention_mask, n_active)
     cols = jnp.arange(mask.shape[-1])[None, None, None, :]
     pos = inputs.position_ids[:, None, :, None]  # (B, 1, K, 1)
-    if spec.sliding_window:
+    if window:
         # decode attends only (pos - window, pos] (reference windowed TKG mask,
         # model_base.py:319-340)
-        mask = mask & (cols > pos - spec.sliding_window)
-    if spec.attention_chunk_size:
+        mask = mask & (cols > pos - window)
+    if chunk:
         # chunked attention: same-chunk positions only (reference
         # model_base.py:304-318 chunked TKG mask)
-        mask = mask & ((cols // spec.attention_chunk_size) == (pos // spec.attention_chunk_size))
+        mask = mask & ((cols // chunk) == (pos // chunk))
     return mask
 
 
@@ -314,23 +354,46 @@ def run_decoder_layers(
     spec: ModelSpec,
     phase: str,
     mlp_fn: Callable = gated_mlp,
+    layer_fn: Optional[Callable] = None,
 ) -> Tuple[jax.Array, KVCache]:
     """Layer stack + final norm over an already-embedded hidden state.
 
     Split out so variants that replace the embedding (EAGLE's fc-fused draft
-    input, reference model_base.py:1643-1650) reuse the whole decoder."""
+    input, reference model_base.py:1643-1650) reuse the whole decoder.
+
+    Heterogeneous stacks: when ``spec.layer_groups`` is set,
+    ``params["layers"]`` is a LIST of per-group stacked param dicts and
+    ``mlp_fn`` / ``layer_fn`` may be lists indexed by each group's
+    ``fn_idx``. Each group runs its own ``lax.scan`` with its own attention
+    flavor (sliding/chunked/global); the cache and hidden state thread
+    through in layer order.
+    """
     inv_freq = params["rope"]["inv_freq"]
     cos, sin = rope_cos_sin(inputs.position_ids, inv_freq, spec.attention_scaling)
 
-    mask = build_mask(inputs, spec, phase)
-    if (spec.cp_enabled or spec.sequence_parallel) and phase == PHASE_CONTEXT_ENCODING:
+    if spec.layer_groups is None:
+        groups = [params["layers"]]
+        group_specs = [
+            LayerGroupSpec(
+                num_layers=0,  # derived from params below
+                sliding_window=spec.sliding_window,
+                attention_chunk_size=spec.attention_chunk_size,
+            )
+        ]
+    else:
+        groups = params["layers"]
+        group_specs = list(spec.layer_groups)
+    mlp_fns = mlp_fn if isinstance(mlp_fn, (list, tuple)) else [mlp_fn]
+    layer_fns = layer_fn if isinstance(layer_fn, (list, tuple)) else [layer_fn]
+
+    sp_prefill = (spec.cp_enabled or spec.sequence_parallel) and phase == PHASE_CONTEXT_ENCODING
+    if sp_prefill:
         # SP: activations sharded along S over the cp axis (reference SP
         # reduce-scatter of embeddings, model_base.py:1524-1575)
         from neuronx_distributed_inference_tpu.parallel import context_parallel as cpx
 
         hidden = cpx.shard_seq(hidden)
-        if spec.cp_enabled:
-            mask = cpx.shard_prefill_mask(mask)
+
     is_block = inputs.slot_mapping is not None or inputs.block_table is not None
     if is_block:
         slot_ids = inputs.seq_ids  # block layout: writes go via slot_mapping
@@ -339,17 +402,6 @@ def run_decoder_layers(
             inputs.seq_ids, kv_batch_size(cache, spec.attention_dp), dp=spec.attention_dp
         )
     positions = inputs.position_ids
-    # plain-causal prefill exposes key validity so the flash kernel can run
-    # (not under CP: pallas custom calls don't auto-partition — the CP path
-    # uses the GSPMD-partitioned native attention)
-    key_valid = None
-    if (
-        phase == PHASE_CONTEXT_ENCODING
-        and not spec.sliding_window
-        and not spec.attention_chunk_size
-        and not spec.cp_enabled
-    ):
-        key_valid = inputs.attention_mask
 
     block_inputs = None
     if is_block:
@@ -368,28 +420,118 @@ def run_decoder_layers(
         kv_limit = jnp.sum(inputs.attention_mask.astype(jnp.int32), axis=-1)
         block_inputs = (slot_mapping, inputs.block_table, kv_limit)
 
-    num_layers = jax.tree.leaves(params["layers"])[0].shape[0]
+    def finalize_mask(mask):
+        if sp_prefill and spec.cp_enabled:
+            from neuronx_distributed_inference_tpu.parallel import context_parallel as cpx
 
-    def scan_body(carry, xs):
-        h, k_cache, v_cache = carry
-        layer_params, li = xs
-        h, k_cache, v_cache = decoder_layer(
-            layer_params, h, cos, sin, k_cache, v_cache, li, mask, slot_ids, positions,
-            spec, phase, mlp_fn, key_valid=key_valid, block_inputs=block_inputs,
-            adapter_ids=inputs.adapter_ids,
+            return cpx.shard_prefill_mask(mask)
+        return mask
+
+    def group_key_valid(window, chunk):
+        # plain-causal prefill exposes key validity so the flash kernel can
+        # run (not under CP: pallas custom calls don't auto-partition — the
+        # CP path uses the GSPMD-partitioned native attention)
+        if phase == PHASE_CONTEXT_ENCODING and not window and not chunk and not spec.cp_enabled:
+            return inputs.attention_mask
+        return None
+
+    k_cache, v_cache = cache.k, cache.v
+
+    # Alternating-flavor stacks (GPT-OSS sliding/global every other layer)
+    # would degenerate into one scan PER LAYER; when every group shares
+    # params structure and fn_idx and there are at most two attention
+    # flavors, restack into ONE scan that selects the flavor's mask per
+    # layer — depth-independent program size.
+    restacked = None
+    if spec.layer_groups is not None and len(groups) > 2:
+        flavors = [(g.sliding_window, g.attention_chunk_size) for g in group_specs]
+        uniq = list(dict.fromkeys(flavors))
+        if (
+            len({g.fn_idx for g in group_specs}) == 1
+            and len(uniq) <= 2
+            and all(
+                jax.tree.structure(g) == jax.tree.structure(groups[0])
+                for g in groups[1:]
+            )
+        ):
+            try:
+                restacked = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *groups)
+            except Exception:
+                restacked = None
+
+    if restacked is not None:
+        g_mlp = mlp_fns[group_specs[0].fn_idx if len(mlp_fns) > 1 else 0]
+        g_layer = layer_fns[group_specs[0].fn_idx if len(layer_fns) > 1 else 0] or decoder_layer
+        flavor_masks = [
+            finalize_mask(build_mask(inputs, spec, phase, window=w, chunk=c))
+            for (w, c) in uniq
+        ]
+        key_valid = group_key_valid(*uniq[0]) if len(uniq) == 1 else None
+        flavor_ids = []
+        for f, gp in zip(flavors, groups):
+            n = jax.tree.leaves(gp)[0].shape[0]
+            flavor_ids.extend([uniq.index(f)] * n)
+        flavor_arr = jnp.asarray(flavor_ids, jnp.int32)
+        total = len(flavor_ids)
+
+        def fused_body(carry, xs):
+            h, k_c, v_c = carry
+            layer_params, li, fl = xs
+            if len(flavor_masks) == 1:
+                mask = flavor_masks[0]
+            else:
+                mask = jnp.where(fl == 1, flavor_masks[1], flavor_masks[0])
+            h, k_c, v_c = g_layer(
+                layer_params, h, cos, sin, k_c, v_c, li, mask, slot_ids, positions,
+                spec, phase, g_mlp, key_valid=key_valid, block_inputs=block_inputs,
+                adapter_ids=inputs.adapter_ids,
+            )
+            return (h, k_c, v_c), None
+
+        (hidden, k_cache, v_cache), _ = jax.lax.scan(
+            fused_body,
+            (hidden, k_cache, v_cache),
+            (restacked, jnp.arange(total, dtype=jnp.int32), flavor_arr),
         )
-        return (h, k_cache, v_cache), None
+    else:
+        offset = 0
+        for group_params, gspec in zip(groups, group_specs):
+            window = gspec.sliding_window
+            chunk = gspec.attention_chunk_size
+            g_mlp = mlp_fns[gspec.fn_idx if len(mlp_fns) > 1 else 0]
+            g_layer = layer_fns[gspec.fn_idx if len(layer_fns) > 1 else 0] or decoder_layer
 
-    # the full cache rides the CARRY (updated in place per layer); only the
-    # layer params are scanned xs — no stacked-ys cache rebuild per step
-    (hidden, new_k, new_v), _ = jax.lax.scan(
-        scan_body,
-        (hidden, cache.k, cache.v),
-        (params["layers"], jnp.arange(num_layers, dtype=jnp.int32)),
-    )
-    new_cache = type(cache)(k=new_k, v=new_v)
+            mask = finalize_mask(build_mask(inputs, spec, phase, window=window, chunk=chunk))
+            key_valid = group_key_valid(window, chunk)
 
-    hidden = rms_norm(hidden, params["norm"]["weight"], spec.rms_eps)
+            num_layers = jax.tree.leaves(group_params)[0].shape[0]
+            if spec.layer_groups is not None and gspec.num_layers != num_layers:
+                raise ValueError(
+                    f"layer_groups mismatch: spec says {gspec.num_layers} layers, "
+                    f"params carry {num_layers}"
+                )
+
+            def scan_body(carry, xs, g_mlp=g_mlp, g_layer=g_layer, mask=mask, key_valid=key_valid):
+                h, k_c, v_c = carry
+                layer_params, li = xs
+                h, k_c, v_c = g_layer(
+                    layer_params, h, cos, sin, k_c, v_c, li, mask, slot_ids, positions,
+                    spec, phase, g_mlp, key_valid=key_valid, block_inputs=block_inputs,
+                    adapter_ids=inputs.adapter_ids,
+                )
+                return (h, k_c, v_c), None
+
+            # the full cache rides the CARRY (updated in place per layer); only
+            # the layer params are scanned xs — no stacked-ys cache rebuild
+            (hidden, k_cache, v_cache), _ = jax.lax.scan(
+                scan_body,
+                (hidden, k_cache, v_cache),
+                (group_params, offset + jnp.arange(num_layers, dtype=jnp.int32)),
+            )
+            offset += num_layers
+    new_cache = type(cache)(k=k_cache, v=v_cache)
+
+    hidden = apply_norm(hidden, params["norm"]["weight"], spec.rms_eps, spec.norm_type)
     return hidden, new_cache
 
 
@@ -401,6 +543,7 @@ def model_logits(
     spec: ModelSpec,
     phase: str,
     mlp_fn: Callable = gated_mlp,
+    layer_fn: Optional[Callable] = None,
     return_hidden: bool = False,
 ):
     """Backbone + lm head, no sampling: returns (logits (B, K, V), new cache)
@@ -411,7 +554,8 @@ def model_logits(
     """
     hidden = embed(params, inputs.input_ids)
     hidden, new_cache = run_decoder_layers(
-        params, hidden, cache, inputs, spec=spec, phase=phase, mlp_fn=mlp_fn
+        params, hidden, cache, inputs, spec=spec, phase=phase, mlp_fn=mlp_fn,
+        layer_fn=layer_fn,
     )
     full_hidden = hidden
 
@@ -438,6 +582,7 @@ def decode_steps(
     num_steps: int,
     bucket: int,
     mlp_fn: Callable = gated_mlp,
+    layer_fn: Optional[Callable] = None,
     adapter_ids: Optional[jax.Array] = None,
 ):
     """Run ``num_steps`` whole decode iterations in ONE compiled program.
@@ -463,7 +608,8 @@ def decode_steps(
             adapter_ids=adapter_ids,
         )
         logits, cache = model_logits(
-            params, cache, inputs, spec=spec, phase=PHASE_TOKEN_GENERATION, mlp_fn=mlp_fn
+            params, cache, inputs, spec=spec, phase=PHASE_TOKEN_GENERATION,
+            mlp_fn=mlp_fn, layer_fn=layer_fn,
         )
         if spec.on_device_sampling and spec.do_sample:
             tok = sample_tokens(logits, sampling_params, step_rng, spec.max_topk, True)
@@ -500,10 +646,11 @@ def forward(
     spec: ModelSpec,
     phase: str,
     mlp_fn: Callable = gated_mlp,
+    layer_fn: Optional[Callable] = None,
 ) -> StepOutput:
     """The traced step function (reference NeuronBaseModel.forward, model_base.py:732)."""
     logits, new_cache = model_logits(
-        params, cache, inputs, spec=spec, phase=phase, mlp_fn=mlp_fn
+        params, cache, inputs, spec=spec, phase=phase, mlp_fn=mlp_fn, layer_fn=layer_fn
     )
     if spec.on_device_sampling:
         tokens = sample_tokens(

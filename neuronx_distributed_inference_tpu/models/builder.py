@@ -43,6 +43,7 @@ class DecoderModelBuilder:
     qkv_bias = False
     o_bias = False
     qk_norm = False
+    norm_type = "rmsnorm"
 
     def __init__(self, config: InferenceConfig):
         self.config = config
@@ -100,6 +101,7 @@ class DecoderModelBuilder:
             output_logits=tc.output_logits,
             cast_logits_fp32=tc.cast_logits_fp32,
             attention_scaling=rope_attention_scaling(cfg),
+            norm_type=self.norm_type,
         )
 
     # ---- param pytree ----------------------------------------------------
@@ -378,3 +380,35 @@ class DecoderModelBuilder:
         from neuronx_distributed_inference_tpu.models.base import gated_mlp
 
         return gated_mlp
+
+    def layer_fn(self):
+        """Custom decoder-layer function(s), or None for the shared
+        decoder_layer (models/base.py). MLA-style attention overrides this."""
+        return None
+
+    def init_kv_cache(self, mesh):
+        """Allocate + shard this model's contiguous KV cache. Plugins with
+        non-standard cache streams (MLA latent cache) override."""
+        from neuronx_distributed_inference_tpu.modules.kvcache import (
+            cache_spec,
+            init_cache,
+        )
+        from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
+
+        tc = self.config.tpu_config
+        dt = to_dtype(tc.kv_cache_dtype or tc.dtype)
+        kv_batch = tc.kv_cache_batch_size or tc.max_batch_size
+        cache = init_cache(
+            self.config.num_hidden_layers,
+            kv_batch,
+            tc.seq_len,
+            self.gqa.kv_heads,
+            self.head_dim,
+            dtype=dt,
+            dp=tc.attention_dp_degree,
+        )
+        return shard_pytree(
+            cache,
+            cache_spec(tc.cp_degree > 1, tc.attention_dp_degree > 1),
+            mesh,
+        )

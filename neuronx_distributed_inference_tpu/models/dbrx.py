@@ -1,0 +1,152 @@
+"""DBRX model plugin: LayerNorm decoder + fused-Wqkv GQA + 16-expert MoE.
+
+TPU-native re-design of the reference DBRX model
+(reference: models/dbrx/modeling_dbrx.py — fused Wqkv with clip_qkv,
+LayerNorm (no bias) norms, DbrxExpertGLU w1/v1/w2 expert tensors, softmax
+router with p-norm renormalization).
+
+The fused Wqkv checkpoint splits into q/k/v at conversion (the runtime fused
+path is opt-in via fused_qkv for any model); clip_qkv rides
+AttnSpec.qkv_clip; norms dispatch through ModelSpec.norm_type.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import to_dtype
+from neuronx_distributed_inference_tpu.models.mixtral import (
+    MoEDecoderModelBuilder,
+    MoEInferenceConfig,
+)
+from neuronx_distributed_inference_tpu.models.registry import register_model
+
+
+class DbrxInferenceConfig(MoEInferenceConfig):
+    """Reference: DbrxInferenceConfig (modeling_dbrx.py). DBRX config nests
+    attn_config/ffn_config; flatten onto the canonical HF attribute names."""
+
+    def add_derived_config(self):
+        if hasattr(self, "d_model"):
+            self.hidden_size = self.d_model
+            self.num_attention_heads = self.n_heads
+            self.num_hidden_layers = self.n_layers
+            self.max_position_embeddings = getattr(self, "max_seq_len", 4096)
+        ac = getattr(self, "attn_config", None) or {}
+        if not isinstance(ac, dict):
+            ac = ac.to_dict() if hasattr(ac, "to_dict") else vars(ac)
+        fc = getattr(self, "ffn_config", None) or {}
+        if not isinstance(fc, dict):
+            fc = fc.to_dict() if hasattr(fc, "to_dict") else vars(fc)
+        if ac:
+            self.num_key_value_heads = ac.get("kv_n_heads", self.num_attention_heads)
+            self.rope_theta = ac.get("rope_theta", 10000.0)
+            self.clip_qkv = ac.get("clip_qkv")
+        if fc:
+            self.intermediate_size = fc.get("ffn_hidden_size")
+            self.num_experts = fc.get("moe_num_experts", 1)
+            self.num_experts_per_tok = fc.get("moe_top_k", 1)
+            act = fc.get("ffn_act_fn") or {}
+            self.hidden_act = act.get("name", "silu") if isinstance(act, dict) else "silu"
+            # p-norm exponent (reference DbrxRouter); None disables renorm
+            self.moe_normalize_expert_weights = fc.get("moe_normalize_expert_weights", 1)
+            self.norm_topk_prob = False
+        self.rms_norm_eps = 1e-5  # nn.LayerNorm default eps
+        self.tie_word_embeddings = False
+
+
+@register_model("dbrx")
+class DbrxModelBuilder(MoEDecoderModelBuilder):
+    """Reference: models/dbrx/modeling_dbrx.py NeuronDbrxForCausalLM."""
+
+    config_cls = DbrxInferenceConfig
+    norm_type = "layernorm"
+
+    def attn_spec(self):
+        spec = super().attn_spec()
+        clip = getattr(self.config, "clip_qkv", None)
+        return dataclasses.replace(spec, qkv_clip=float(clip) if clip else None)
+
+    def moe_spec(self):
+        spec = super().moe_spec()
+        p = getattr(self.config, "moe_normalize_expert_weights", 1)
+        return dataclasses.replace(
+            spec, norm_weights_p=float(p) if p is not None else None
+        )
+
+    def convert_hf_state_dict(self, sd: Dict[str, np.ndarray], dtype=None) -> Dict:
+        cfg = self.config
+        dtype = dtype or to_dtype(cfg.tpu_config.dtype)
+        L = cfg.num_hidden_layers
+        D = self.head_dim
+        g = self.gqa
+        Hq_orig, Hkv_orig = g.orig_q_heads, g.orig_kv_heads
+        E, I, H = self.num_experts, self.expert_intermediate, cfg.hidden_size
+
+        def get(name):
+            if name not in sd:
+                raise KeyError(f"missing HF weight {name}")
+            return np.asarray(sd[name])
+
+        def layer_params(i):
+            p = f"transformer.blocks.{i}."
+            wqkv = get(p + "norm_attn_norm.attn.Wqkv.weight")  # (q+k+v out, H)
+            q_sz, kv_sz = Hq_orig * D, Hkv_orig * D
+            wq = wqkv[:q_sz].T
+            wk = wqkv[q_sz : q_sz + kv_sz].T
+            wv = wqkv[q_sz + kv_sz :].T
+            # experts: w1/v1 (E*I, H) row-major per expert, used transposed;
+            # w2 (E*I, H) used directly (DbrxExpertGLU.forward)
+            fp = p + "ffn.experts.mlp."
+            w1 = get(fp + "w1").reshape(E, I, H).transpose(0, 2, 1)  # (E, H, I)
+            v1 = get(fp + "v1").reshape(E, I, H).transpose(0, 2, 1)
+            w2 = get(fp + "w2").reshape(E, I, H)  # (E, I, H)
+            return {
+                "input_layernorm": {"weight": get(p + "norm_attn_norm.norm_1.weight")},
+                "post_attention_layernorm": {
+                    "weight": get(p + "norm_attn_norm.norm_2.weight")
+                },
+                "self_attn": {
+                    "q_proj": {"weight": np.asarray(g.pad_q(wq, D))},
+                    "k_proj": {"weight": np.asarray(g.replicate_kv(wk, D))},
+                    "v_proj": {"weight": np.asarray(g.replicate_kv(wv, D))},
+                    "o_proj": {
+                        "weight": np.asarray(
+                            g.pad_o(get(p + "norm_attn_norm.attn.out_proj.weight").T, D)
+                        )
+                    },
+                },
+                "mlp": {
+                    "router": {"weight": get(p + "ffn.router.layer.weight").T},
+                    "experts": {
+                        "gate_proj": {"weight": w1},
+                        "up_proj": {"weight": v1},
+                        "down_proj": {"weight": w2},
+                    },
+                },
+            }
+
+        per = [layer_params(i) for i in range(L)]
+        layers = jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs), dtype), *per)
+
+        embed = get("transformer.wte.weight")
+        vpad = self.padded_vocab - embed.shape[0]
+        if vpad:
+            embed = np.pad(embed, ((0, vpad), (0, 0)))
+        lm = get("lm_head.weight").T if "lm_head.weight" in sd else embed.T
+        if vpad and lm.shape[1] != self.padded_vocab:
+            lm = np.pad(lm, ((0, 0), (0, vpad)))
+        from neuronx_distributed_inference_tpu.modules.rope import compute_inv_freq
+
+        return {
+            "embed_tokens": {"weight": jnp.asarray(embed, dtype)},
+            "rope": {"inv_freq": compute_inv_freq(cfg)},
+            "layers": layers,
+            "norm": {"weight": jnp.asarray(get("transformer.norm_f.weight"), dtype)},
+            "lm_head": {"weight": jnp.asarray(lm, dtype)},
+        }

@@ -1,0 +1,639 @@
+"""DeepSeek-V3 model plugin: MLA attention + sigmoid/group-limited MoE.
+
+TPU-native re-design of the reference DeepSeek-V3 model
+(reference: models/deepseek/modeling_deepseek.py:79-260 DeepseekV3Attention
+with weight-matrix absorption; rope_util.py yarn rope; MoEGate sigmoid
+scoring + e_score_correction_bias + group-limited top-k; shared experts;
+first_k_dense_replace dense layers).
+
+MLA here uses the same WEIGHT-ABSORPTION formulation the reference decodes
+with (modeling_deepseek.py:227-232 ``wkv_b`` absorb): the KV cache stores the
+compressed latent ``c`` (kv_lora_rank) in the K stream and the rope keys
+``k_pe`` (qk_rope_head_dim) in the V stream — per-token cache cost
+r_kv + d_rope instead of 2·H·D. Scores are
+``q_pe·k_pe + (q_nope·W_absorb_k)·c`` and outputs are
+``(probs·c)·W_absorb_v`` — all MXU einsums over static shapes.
+
+Tensor parallel: heads shard over the model axes; the latent cache is
+replicated (the standard MLA TP layout). Dense-first layers
+(first_k_dense_replace) run as a separate layer group (models/base.py
+LayerGroupSpec).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig, to_dtype
+from neuronx_distributed_inference_tpu.models.base import LayerGroupSpec, gated_mlp
+from neuronx_distributed_inference_tpu.models.builder import DecoderModelBuilder
+from neuronx_distributed_inference_tpu.models.registry import register_model
+from neuronx_distributed_inference_tpu.modules.kvcache import (
+    kv_batch_size,
+    read_cache_at_layer,
+    update_cache_at_layer,
+)
+from neuronx_distributed_inference_tpu.modules.moe import MoESpec, moe_layer
+from neuronx_distributed_inference_tpu.modules.norm import rms_norm
+from neuronx_distributed_inference_tpu.modules.rope import apply_rope, yarn_mscale
+from neuronx_distributed_inference_tpu.ops.quant import linear
+from neuronx_distributed_inference_tpu.parallel.sharding import TENSOR
+
+
+class DeepseekV3InferenceConfig(InferenceConfig):
+    """Reference: DeepseekV3InferenceConfig (modeling_deepseek.py)."""
+
+    _REQUIRED_ATTRS = (
+        "hidden_size",
+        "num_attention_heads",
+        "num_hidden_layers",
+        "vocab_size",
+        "kv_lora_rank",
+        "qk_nope_head_dim",
+        "qk_rope_head_dim",
+        "v_head_dim",
+    )
+
+    def add_derived_config(self):
+        # rope tables are built for the rope sub-dimension only
+        self.rope_dim = self.qk_rope_head_dim
+
+
+@dataclass(frozen=True)
+class MLASpec:
+    """Static MLA dims (reference modeling_deepseek.py:115-135)."""
+
+    num_heads: int  # per-model q heads (padded to degree)
+    q_lora_rank: Optional[int]
+    kv_lora_rank: int
+    qk_nope_head_dim: int
+    qk_rope_head_dim: int
+    v_head_dim: int
+    scale: float
+    rms_eps: float
+
+    @property
+    def q_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+
+def mla_decoder_layer(
+    layer_params: dict,
+    hidden: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    layer_idx: jax.Array,
+    mask: jax.Array,
+    slot_ids: jax.Array,
+    positions: jax.Array,
+    spec,
+    phase: str,
+    mlp_fn,
+    mla: MLASpec = None,
+    key_valid=None,
+    block_inputs=None,
+    adapter_ids=None,
+):
+    """One MLA decoder layer (reference DeepseekV3Attention.forward with
+    weight absorption, modeling_deepseek.py:205-260).
+
+    Cache streams: K stream holds the compressed latent ``c`` as a single
+    "head" of dim kv_lora_rank; V stream holds the shared rope key ``k_pe``
+    (one head of dim qk_rope_head_dim).
+    """
+    if block_inputs is not None:
+        raise NotImplementedError("MLA with the paged cache is not implemented")
+    sa = layer_params["self_attn"]
+    residual = hidden
+    hidden = rms_norm(hidden, layer_params["input_layernorm"]["weight"], spec.rms_eps)
+    B, S, _ = hidden.shape
+    H = mla.num_heads
+
+    # --- q path: low-rank (or direct) projection, split nope/rope ---------
+    if mla.q_lora_rank:
+        q = linear(sa["q_a_proj"], hidden)
+        q = rms_norm(q, sa["q_a_layernorm"]["weight"], mla.rms_eps)
+        q = linear(sa["q_b_proj"], q)
+    else:
+        q = linear(sa["q_proj"], hidden)
+    q = q.reshape(B, S, H, mla.q_head_dim)
+    q_nope = q[..., : mla.qk_nope_head_dim]
+    q_pe = apply_rope(q[..., mla.qk_nope_head_dim :], cos, sin)
+
+    # --- compressed kv + rope key ----------------------------------------
+    ckv = linear(sa["kv_a_proj"], hidden)  # (B, S, r_kv + d_rope)
+    c = rms_norm(ckv[..., : mla.kv_lora_rank], sa["kv_a_layernorm"]["weight"], mla.rms_eps)
+    k_pe = apply_rope(ckv[..., None, mla.kv_lora_rank :].reshape(
+        B, S, 1, mla.qk_rope_head_dim
+    ), cos, sin)
+
+    # q_nope absorbed into latent space: (B,S,H,d_nope)·(H,d_nope,r) -> (B,S,H,r)
+    q_c = jnp.einsum("bshd,hdr->bshr", q_nope, sa["k_absorb"]["weight"].astype(q.dtype))
+
+    # --- write-then-attend on the latent cache ----------------------------
+    k_cache, v_cache = update_cache_at_layer(
+        k_cache, v_cache, c[:, :, None, :], k_pe, layer_idx, slot_ids, positions
+    )
+    W = mask.shape[-1]
+    c_all, pe_all = read_cache_at_layer(k_cache, v_cache, layer_idx, B, W)
+    c_all = c_all[:, :, 0, :]  # (B, W, r)
+    pe_all = pe_all[:, :, 0, :]  # (B, W, d_rope)
+
+    scores = (
+        jnp.einsum("bshr,bwr->bhsw", q_c, c_all.astype(q.dtype),
+                   preferred_element_type=jnp.float32)
+        + jnp.einsum("bshd,bwd->bhsw", q_pe, pe_all.astype(q.dtype),
+                     preferred_element_type=jnp.float32)
+    ) * mla.scale
+    scores = jnp.where(mask, scores.astype(jnp.float32), jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+
+    latent = jnp.einsum(
+        "bhsw,bwr->bshr", probs.astype(c_all.dtype), c_all,
+        preferred_element_type=jnp.float32,
+    ).astype(hidden.dtype)
+    out = jnp.einsum("bshr,hrd->bshd", latent, sa["v_absorb"]["weight"].astype(hidden.dtype))
+
+    out = linear(sa["o_proj"], out.reshape(B, S, H * mla.v_head_dim))
+    hidden = residual + out
+
+    residual = hidden
+    hidden = rms_norm(hidden, layer_params["post_attention_layernorm"]["weight"], spec.rms_eps)
+    hidden = residual + mlp_fn(layer_params["mlp"], hidden, spec)
+    return hidden, k_cache, v_cache
+
+
+@register_model("deepseek_v3")
+class DeepseekV3ModelBuilder(DecoderModelBuilder):
+    """Reference: models/deepseek/modeling_deepseek.py NeuronDeepseekForCausalLM."""
+
+    config_cls = DeepseekV3InferenceConfig
+
+    def __init__(self, config):
+        super().__init__(config)
+        tc = config.tpu_config
+        for flag, why in (
+            (tc.is_block_kv_layout, "paged cache"),
+            (tc.cp_degree > 1, "context parallelism"),
+            (tc.attention_dp_degree > 1, "attention-DP"),
+            (tc.fused_qkv, "fused_qkv"),
+        ):
+            if flag:
+                raise NotImplementedError(f"DeepSeek-V3 MLA with {why} is not implemented")
+        cfg = config
+        # pad q heads to the model-parallel degree (MLA has no GQA groups)
+        self.q_heads = math.ceil(cfg.num_attention_heads / self.degree) * self.degree
+        self.first_dense = getattr(cfg, "first_k_dense_replace", 0)
+
+    @property
+    def num_experts(self) -> int:
+        return getattr(self.config, "n_routed_experts")
+
+    def mla_spec(self) -> MLASpec:
+        cfg = self.config
+        scale = (cfg.qk_nope_head_dim + cfg.qk_rope_head_dim) ** -0.5
+        scaling = getattr(cfg, "rope_scaling", None) or {}
+        if scaling.get("mscale_all_dim"):
+            m = yarn_mscale(scaling.get("factor", 1.0), scaling["mscale_all_dim"])
+            scale = scale * m * m
+        return MLASpec(
+            num_heads=self.q_heads,
+            q_lora_rank=getattr(cfg, "q_lora_rank", None),
+            kv_lora_rank=cfg.kv_lora_rank,
+            qk_nope_head_dim=cfg.qk_nope_head_dim,
+            qk_rope_head_dim=cfg.qk_rope_head_dim,
+            v_head_dim=cfg.v_head_dim,
+            scale=scale,
+            rms_eps=getattr(cfg, "rms_norm_eps", 1e-6),
+        )
+
+    def moe_spec(self) -> MoESpec:
+        cfg = self.config
+        tc = cfg.tpu_config
+        return MoESpec(
+            num_experts=self.num_experts,
+            top_k=getattr(cfg, "num_experts_per_tok", 8),
+            normalize_top_k_affinities=bool(getattr(cfg, "norm_topk_prob", True)),
+            router_dtype=getattr(tc, "router_dtype", "float32"),
+            act=getattr(cfg, "hidden_act", "silu"),
+            scoring_func=getattr(cfg, "scoring_func", "sigmoid"),
+            routed_scaling_factor=float(getattr(cfg, "routed_scaling_factor", 1.0)),
+            n_group=getattr(cfg, "n_group", 1),
+            topk_group=getattr(cfg, "topk_group", 1),
+        )
+
+    def model_spec(self):
+        cfg = self.config
+        spec = super().model_spec()
+        L = cfg.num_hidden_layers
+        groups = []
+        if self.first_dense:
+            groups.append(LayerGroupSpec(num_layers=self.first_dense, fn_idx=0))
+        if L - self.first_dense > 0:
+            groups.append(LayerGroupSpec(num_layers=L - self.first_dense, fn_idx=1))
+        return dataclasses.replace(spec, layer_groups=tuple(groups))
+
+    def mlp_fn(self):
+        mspec = self.moe_spec()
+        has_shared = bool(getattr(self.config, "n_shared_experts", 0))
+
+        def moe_mlp_fn(mlp_params, hidden, model_spec):
+            return moe_layer(
+                mlp_params, hidden, mspec,
+                shared_mlp_fn=(
+                    (lambda p, x: gated_mlp(p, x, model_spec)) if has_shared else None
+                ),
+            )
+
+        return [gated_mlp, moe_mlp_fn]
+
+    def layer_fn(self):
+        import functools
+
+        return functools.partial(mla_decoder_layer, mla=self.mla_spec())
+
+    # ---- cache: latent stream ------------------------------------------
+
+    def init_kv_cache(self, mesh):
+        from neuronx_distributed_inference_tpu.modules.kvcache import (
+            KVCache,
+            init_cache,
+        )
+        from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
+
+        cfg = self.config
+        tc = cfg.tpu_config
+        dt = to_dtype(tc.kv_cache_dtype or tc.dtype)
+        kv_batch = tc.kv_cache_batch_size or tc.max_batch_size
+        cache = init_cache(
+            cfg.num_hidden_layers, kv_batch, tc.seq_len,
+            1, cfg.kv_lora_rank,  # K stream: compressed latent
+            dtype=dt,
+            v_heads=1, v_head_dim=cfg.qk_rope_head_dim,  # V stream: rope keys
+        )
+        # single-"head" latent streams replicate over the model axes
+        spec = KVCache(k=P(), v=P())
+        return shard_pytree(cache, spec, mesh)
+
+    # ---- params ----------------------------------------------------------
+
+    def _group_sizes(self) -> Tuple[int, int]:
+        L = self.config.num_hidden_layers
+        return self.first_dense, L - self.first_dense
+
+    def _attn_shapes(self, L: int) -> Dict:
+        cfg = self.config
+        H = cfg.hidden_size
+        Hq = self.q_heads
+        dq = cfg.qk_nope_head_dim + cfg.qk_rope_head_dim
+        r_q = getattr(cfg, "q_lora_rank", None)
+        shapes = {
+            "kv_a_proj": {"weight": (L, H, cfg.kv_lora_rank + cfg.qk_rope_head_dim)},
+            "kv_a_layernorm": {"weight": (L, cfg.kv_lora_rank)},
+            "k_absorb": {"weight": (L, Hq, cfg.qk_nope_head_dim, cfg.kv_lora_rank)},
+            "v_absorb": {"weight": (L, Hq, cfg.kv_lora_rank, cfg.v_head_dim)},
+            "o_proj": {"weight": (L, Hq * cfg.v_head_dim, H)},
+        }
+        if r_q:
+            shapes["q_a_proj"] = {"weight": (L, H, r_q)}
+            shapes["q_a_layernorm"] = {"weight": (L, r_q)}
+            shapes["q_b_proj"] = {"weight": (L, r_q, Hq * dq)}
+        else:
+            shapes["q_proj"] = {"weight": (L, H, Hq * dq)}
+        return shapes
+
+    def _attn_pspecs(self) -> Dict:
+        r_q = getattr(self.config, "q_lora_rank", None)
+        specs = {
+            "kv_a_proj": {"weight": P()},
+            "kv_a_layernorm": {"weight": P()},
+            "k_absorb": {"weight": P(None, TENSOR, None, None)},
+            "v_absorb": {"weight": P(None, TENSOR, None, None)},
+            "o_proj": {"weight": P(None, TENSOR, None)},
+        }
+        if r_q:
+            specs["q_a_proj"] = {"weight": P()}
+            specs["q_a_layernorm"] = {"weight": P()}
+            specs["q_b_proj"] = {"weight": P(None, None, TENSOR)}
+        else:
+            specs["q_proj"] = {"weight": P(None, None, TENSOR)}
+        return specs
+
+    def _dense_mlp_shapes(self, L: int) -> Dict:
+        H, I = self.config.hidden_size, self.config.intermediate_size
+        return {
+            "gate_proj": {"weight": (L, H, I)},
+            "up_proj": {"weight": (L, H, I)},
+            "down_proj": {"weight": (L, I, H)},
+        }
+
+    def _moe_mlp_shapes(self, L: int) -> Dict:
+        cfg = self.config
+        H = cfg.hidden_size
+        E = self.num_experts
+        I = getattr(cfg, "moe_intermediate_size")
+        shapes = {
+            "router": {
+                "weight": (L, H, E),
+                "e_score_correction_bias": (L, E),
+            },
+            "experts": {
+                "gate_proj": {"weight": (L, E, H, I)},
+                "up_proj": {"weight": (L, E, H, I)},
+                "down_proj": {"weight": (L, E, I, H)},
+            },
+        }
+        n_shared = getattr(cfg, "n_shared_experts", 0)
+        if n_shared:
+            Is = I * n_shared
+            shapes["shared_experts"] = {
+                "gate_proj": {"weight": (L, H, Is)},
+                "up_proj": {"weight": (L, H, Is)},
+                "down_proj": {"weight": (L, Is, H)},
+            }
+        return shapes
+
+    def param_shapes(self) -> Dict:
+        cfg = self.config
+        H, V = cfg.hidden_size, self.padded_vocab
+        nd, nm = self._group_sizes()
+        groups = []
+        if nd:
+            groups.append(
+                {
+                    "input_layernorm": {"weight": (nd, H)},
+                    "post_attention_layernorm": {"weight": (nd, H)},
+                    "self_attn": self._attn_shapes(nd),
+                    "mlp": self._dense_mlp_shapes(nd),
+                }
+            )
+        if nm:
+            groups.append(
+                {
+                    "input_layernorm": {"weight": (nm, H)},
+                    "post_attention_layernorm": {"weight": (nm, H)},
+                    "self_attn": self._attn_shapes(nm),
+                    "mlp": self._moe_mlp_shapes(nm),
+                }
+            )
+        return {
+            "embed_tokens": {"weight": (V, H)},
+            "rope": {"inv_freq": (cfg.qk_rope_head_dim // 2,)},
+            "layers": groups,
+            "norm": {"weight": (H,)},
+            "lm_head": {"weight": (H, V)},
+        }
+
+    def param_pspecs(self) -> Dict:
+        tc = self.config.tpu_config
+        nd, _ = self._group_sizes()
+        ffn = TENSOR
+
+        def dense_specs():
+            return {
+                "gate_proj": {"weight": P(None, None, ffn)},
+                "up_proj": {"weight": P(None, None, ffn)},
+                "down_proj": {"weight": P(None, ffn, None)},
+            }
+
+        moe_specs = {
+            "router": {"weight": P(), "e_score_correction_bias": P()},
+            "experts": {
+                "gate_proj": {"weight": P(None, "ep", None, ("cp", "tp"))},
+                "up_proj": {"weight": P(None, "ep", None, ("cp", "tp"))},
+                "down_proj": {"weight": P(None, "ep", ("cp", "tp"), None)},
+            },
+        }
+        if getattr(self.config, "n_shared_experts", 0):
+            moe_specs["shared_experts"] = dense_specs()
+        groups = []
+        if nd:
+            groups.append(
+                {
+                    "input_layernorm": {"weight": P()},
+                    "post_attention_layernorm": {"weight": P()},
+                    "self_attn": self._attn_pspecs(),
+                    "mlp": dense_specs(),
+                }
+            )
+        if self.config.num_hidden_layers - nd > 0:
+            groups.append(
+                {
+                    "input_layernorm": {"weight": P()},
+                    "post_attention_layernorm": {"weight": P()},
+                    "self_attn": self._attn_pspecs(),
+                    "mlp": moe_specs,
+                }
+            )
+        return {
+            "embed_tokens": {"weight": P(TENSOR, None) if tc.vocab_parallel else P(None, TENSOR)},
+            "rope": {"inv_freq": P()},
+            "layers": groups,
+            "norm": {"weight": P()},
+            "lm_head": {"weight": P(None, TENSOR)},
+        }
+
+    def random_params(self, key=None, dtype=None) -> Dict:
+        dtype = dtype or to_dtype(self.config.tpu_config.dtype)
+        key = key if key is not None else jax.random.PRNGKey(self.config.tpu_config.seed)
+        shapes = self.param_shapes()
+        leaves, treedef = jax.tree.flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
+        keys = jax.random.split(key, len(leaves))
+        vals = [(0.05 * jax.random.normal(k, s)).astype(dtype) for k, s in zip(keys, leaves)]
+        params = jax.tree.unflatten(treedef, vals)
+        from neuronx_distributed_inference_tpu.modules.rope import compute_inv_freq
+
+        params["rope"]["inv_freq"] = compute_inv_freq(self.config)
+        params["norm"]["weight"] = jnp.ones_like(params["norm"]["weight"])
+        for g in params["layers"]:
+            for n in ("input_layernorm", "post_attention_layernorm"):
+                g[n]["weight"] = jnp.ones_like(g[n]["weight"])
+            g["self_attn"]["kv_a_layernorm"]["weight"] = jnp.ones_like(
+                g["self_attn"]["kv_a_layernorm"]["weight"]
+            )
+            if "q_a_layernorm" in g["self_attn"]:
+                g["self_attn"]["q_a_layernorm"]["weight"] = jnp.ones_like(
+                    g["self_attn"]["q_a_layernorm"]["weight"]
+                )
+            if "router" in g["mlp"]:
+                g["mlp"]["router"]["e_score_correction_bias"] = jnp.zeros_like(
+                    g["mlp"]["router"]["e_score_correction_bias"]
+                )
+        return params
+
+    def convert_hf_state_dict(self, sd: Dict[str, np.ndarray], dtype=None) -> Dict:
+        """HF DeepSeek-V3 checkpoint -> grouped param pytree.
+
+        kv_b_proj is split into the absorption tensors (reference wkv_b view,
+        modeling_deepseek.py:227-232). Padded q heads get zero rows.
+        """
+        cfg = self.config
+        dtype = dtype or to_dtype(cfg.tpu_config.dtype)
+        L = cfg.num_hidden_layers
+        nd, _ = self._group_sizes()
+        H = cfg.hidden_size
+        Hq_orig = cfg.num_attention_heads
+        Hq = self.q_heads
+        d_nope, d_rope, dv = cfg.qk_nope_head_dim, cfg.qk_rope_head_dim, cfg.v_head_dim
+        dq = d_nope + d_rope
+        r_kv = cfg.kv_lora_rank
+        r_q = getattr(cfg, "q_lora_rank", None)
+
+        def get(name):
+            if name not in sd:
+                raise KeyError(f"missing HF weight {name}")
+            return np.asarray(sd[name])
+
+        def lt(name):  # (out, in) -> (in, out)
+            return get(name).T
+
+        # interleaved rope weights (HF rope_interleave=True): permute each
+        # rope block's columns [r0,i0,r1,i1,...] -> [r...,i...] so the
+        # standard rotate-half rope applies (HF apply_rotary_pos_emb_interleave
+        # does the same permutation on activations)
+        perm = None
+        if getattr(cfg, "rope_interleave", False):
+            half = d_rope // 2
+            perm = np.empty(d_rope, np.int64)
+            perm[:half] = np.arange(half) * 2
+            perm[half:] = np.arange(half) * 2 + 1
+
+        def fix_q_rope(w):  # (in, Hq_orig*dq)
+            if perm is None:
+                return w
+            w = w.reshape(w.shape[0], Hq_orig, dq).copy()
+            w[..., d_nope:] = w[..., d_nope:][..., perm]
+            return w.reshape(w.shape[0], -1)
+
+        def fix_kv_rope(w):  # (in, r_kv + d_rope)
+            if perm is None:
+                return w
+            w = w.copy()
+            w[..., r_kv:] = w[..., r_kv:][..., perm]
+            return w
+
+        def pad_heads(w, per_head):
+            # (..., Hq_orig*per_head) -> (..., Hq*per_head) zero tail heads
+            if Hq == Hq_orig:
+                return w
+            pad = (Hq - Hq_orig) * per_head
+            return np.pad(w, [(0, 0)] * (w.ndim - 1) + [(0, pad)])
+
+        def attn_params(i):
+            p = f"model.layers.{i}.self_attn."
+            out = {
+                "kv_a_proj": {"weight": fix_kv_rope(lt(p + "kv_a_proj_with_mqa.weight"))},
+                "kv_a_layernorm": {"weight": get(p + "kv_a_layernorm.weight")},
+            }
+            if r_q:
+                out["q_a_proj"] = {"weight": lt(p + "q_a_proj.weight")}
+                out["q_a_layernorm"] = {"weight": get(p + "q_a_layernorm.weight")}
+                out["q_b_proj"] = {
+                    "weight": pad_heads(fix_q_rope(lt(p + "q_b_proj.weight")), dq)
+                }
+            else:
+                out["q_proj"] = {"weight": pad_heads(fix_q_rope(lt(p + "q_proj.weight")), dq)}
+            # kv_b (Hq_orig*(d_nope+dv), r_kv) -> absorb tensors
+            wkv = get(p + "kv_b_proj.weight").reshape(Hq_orig, d_nope + dv, r_kv)
+            k_ab = np.zeros((Hq, d_nope, r_kv), wkv.dtype)
+            v_ab = np.zeros((Hq, r_kv, dv), wkv.dtype)
+            k_ab[:Hq_orig] = wkv[:, :d_nope, :]
+            v_ab[:Hq_orig] = np.swapaxes(wkv[:, d_nope:, :], 1, 2)
+            out["k_absorb"] = {"weight": k_ab}
+            out["v_absorb"] = {"weight": v_ab}
+            o = lt(p + "o_proj.weight")  # (Hq_orig*dv, H)
+            o_pad = np.zeros((Hq * dv, o.shape[1]), o.dtype)
+            o_pad[: Hq_orig * dv] = o
+            out["o_proj"] = {"weight": o_pad}
+            return out
+
+        def mlp_dense(i):
+            p = f"model.layers.{i}.mlp."
+            return {
+                "gate_proj": {"weight": lt(p + "gate_proj.weight")},
+                "up_proj": {"weight": lt(p + "up_proj.weight")},
+                "down_proj": {"weight": lt(p + "down_proj.weight")},
+            }
+
+        def mlp_moe(i):
+            p = f"model.layers.{i}.mlp."
+            E = self.num_experts
+            out = {
+                "router": {
+                    "weight": lt(p + "gate.weight"),
+                    "e_score_correction_bias": get(p + "gate.e_score_correction_bias"),
+                },
+                "experts": {
+                    "gate_proj": {
+                        "weight": np.stack(
+                            [lt(p + f"experts.{e}.gate_proj.weight") for e in range(E)]
+                        )
+                    },
+                    "up_proj": {
+                        "weight": np.stack(
+                            [lt(p + f"experts.{e}.up_proj.weight") for e in range(E)]
+                        )
+                    },
+                    "down_proj": {
+                        "weight": np.stack(
+                            [lt(p + f"experts.{e}.down_proj.weight") for e in range(E)]
+                        )
+                    },
+                },
+            }
+            if getattr(cfg, "n_shared_experts", 0):
+                out["shared_experts"] = {
+                    "gate_proj": {"weight": lt(p + "shared_experts.gate_proj.weight")},
+                    "up_proj": {"weight": lt(p + "shared_experts.up_proj.weight")},
+                    "down_proj": {"weight": lt(p + "shared_experts.down_proj.weight")},
+                }
+            return out
+
+        def stack_group(layer_ids, mlp_fn_):
+            per = []
+            for i in layer_ids:
+                p = f"model.layers.{i}."
+                per.append(
+                    {
+                        "input_layernorm": {"weight": get(p + "input_layernorm.weight")},
+                        "post_attention_layernorm": {
+                            "weight": get(p + "post_attention_layernorm.weight")
+                        },
+                        "self_attn": attn_params(i),
+                        "mlp": mlp_fn_(i),
+                    }
+                )
+            return jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs), dtype), *per)
+
+        embed = get("model.embed_tokens.weight")
+        vpad = self.padded_vocab - embed.shape[0]
+        if vpad:
+            embed = np.pad(embed, ((0, vpad), (0, 0)))
+        lm = lt("lm_head.weight") if "lm_head.weight" in sd else embed.T
+        if vpad and lm.shape[1] != self.padded_vocab:
+            lm = np.pad(lm, ((0, 0), (0, vpad)))
+
+        from neuronx_distributed_inference_tpu.modules.rope import compute_inv_freq
+
+        groups = []
+        if nd:
+            groups.append(stack_group(range(nd), mlp_dense))
+        if L - nd > 0:
+            groups.append(stack_group(range(nd, L), mlp_moe))
+        return {
+            "embed_tokens": {"weight": jnp.asarray(embed, dtype)},
+            "rope": {"inv_freq": compute_inv_freq(cfg)},
+            "layers": groups,
+            "norm": {"weight": jnp.asarray(get("model.norm.weight"), dtype)},
+            "lm_head": {"weight": jnp.asarray(lm, dtype)},
+        }

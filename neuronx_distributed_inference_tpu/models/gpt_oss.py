@@ -1,0 +1,299 @@
+"""GPT-OSS model plugin: attention sinks + interleaved sliding/global layers
++ biased clamped-swiglu MoE.
+
+TPU-native re-design of the reference GPT-OSS model
+(reference: models/gpt_oss/modeling_gpt_oss.py — learned sinks in the softmax
+denominator, alternating sliding_attention/full_attention layer_types,
+GptOssTopKRouter softmax-after-top-k routing, clamped swiglu with
+alpha=1.702 / (up+1) bias, per-expert projection biases; per-layer cache
+sizing via gpt_oss_kv_cache_manager.py).
+
+Mapping here: layer_types become LayerGroupSpec runs (models/base.py), sinks
+ride the existing attention sink support (modules/attention.py:130), the
+expert math is MoESpec(act_scale=1.702, act_bias=1, swiglu_limit) with
+HF's interleaved gate_up_proj DE-INTERLEAVED at load so expert ffn sharding
+stays shard-local. The KV cache is full-length for all layers; bounding
+sliding layers to window-size ring buffers is the long-context follow-up.
+MXFP4 checkpoints load through the dequantized HF path (quantization task).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig, to_dtype
+from neuronx_distributed_inference_tpu.models.base import LayerGroupSpec
+from neuronx_distributed_inference_tpu.models.builder import DecoderModelBuilder
+from neuronx_distributed_inference_tpu.models.registry import register_model
+from neuronx_distributed_inference_tpu.modules.moe import MoESpec, moe_layer
+from neuronx_distributed_inference_tpu.parallel.sharding import TENSOR
+
+
+class GptOssInferenceConfig(InferenceConfig):
+    _REQUIRED_ATTRS = (
+        "hidden_size",
+        "num_attention_heads",
+        "num_hidden_layers",
+        "num_key_value_heads",
+        "vocab_size",
+        "num_local_experts",
+    )
+
+
+def _layer_runs(layer_types: List[str]) -> List[Tuple[int, int, str]]:
+    """Contiguous (start, end, type) runs of layer_types."""
+    runs = []
+    start = 0
+    for i in range(1, len(layer_types) + 1):
+        if i == len(layer_types) or layer_types[i] != layer_types[start]:
+            runs.append((start, i, layer_types[start]))
+            start = i
+    return runs
+
+
+@register_model("gpt_oss")
+class GptOssModelBuilder(DecoderModelBuilder):
+    """Reference: models/gpt_oss/modeling_gpt_oss.py NeuronGptOssForCausalLM."""
+
+    config_cls = GptOssInferenceConfig
+    qkv_bias = True
+    o_bias = True
+
+    def __init__(self, config):
+        super().__init__(config)
+        cfg = config
+        L = cfg.num_hidden_layers
+        self.layer_types = list(
+            getattr(cfg, "layer_types", None)
+            or ["sliding_attention" if i % 2 == 0 else "full_attention" for i in range(L)]
+        )
+        self.runs = _layer_runs(self.layer_types)
+
+    def attn_spec(self):
+        spec = super().attn_spec()
+        return dataclasses.replace(spec, has_sink=True)
+
+    def model_spec(self):
+        cfg = self.config
+        spec = super().model_spec()
+        sw = getattr(cfg, "sliding_window", None)
+        groups = tuple(
+            LayerGroupSpec(
+                num_layers=e - s,
+                sliding_window=sw if t == "sliding_attention" else None,
+            )
+            for s, e, t in self.runs
+        )
+        return dataclasses.replace(spec, layer_groups=groups, sliding_window=None)
+
+    def moe_spec(self) -> MoESpec:
+        cfg = self.config
+        tc = cfg.tpu_config
+        # model constants, set unconditionally exactly like the reference
+        # plugin does on its config (modeling_gpt_oss.py:681-682)
+        return MoESpec(
+            num_experts=cfg.num_local_experts,
+            top_k=getattr(cfg, "num_experts_per_tok", 4),
+            router_dtype=getattr(tc, "router_dtype", "float32"),
+            scoring_func="softmax_topk",
+            router_bias=True,
+            act_scale=1.702,
+            act_bias=1.0,
+            swiglu_limit=float(getattr(cfg, "swiglu_limit", 7.0) or 7.0),
+        )
+
+    def mlp_fn(self):
+        mspec = self.moe_spec()
+
+        def moe_mlp_fn(mlp_params, hidden, model_spec):
+            return moe_layer(mlp_params, hidden, mspec)
+
+        return moe_mlp_fn
+
+    # ---- params ----------------------------------------------------------
+
+    def _group_shapes(self, Lg: int) -> Dict:
+        cfg = self.config
+        H = cfg.hidden_size
+        D = self.head_dim
+        Hq, Hkv = self.gqa.q_heads, self.gqa.kv_heads
+        E = cfg.num_local_experts
+        I = cfg.intermediate_size
+        return {
+            "input_layernorm": {"weight": (Lg, H)},
+            "post_attention_layernorm": {"weight": (Lg, H)},
+            "self_attn": {
+                "q_proj": {"weight": (Lg, H, Hq * D), "bias": (Lg, Hq * D)},
+                "k_proj": {"weight": (Lg, H, Hkv * D), "bias": (Lg, Hkv * D)},
+                "v_proj": {"weight": (Lg, H, Hkv * D), "bias": (Lg, Hkv * D)},
+                "o_proj": {"weight": (Lg, Hq * D, H), "bias": (Lg, H)},
+                "sink": {"weight": (Lg, Hq)},
+            },
+            "mlp": {
+                "router": {"weight": (Lg, H, E), "bias": (Lg, E)},
+                "experts": {
+                    "gate_proj": {"weight": (Lg, E, H, I), "bias": (Lg, E, I)},
+                    "up_proj": {"weight": (Lg, E, H, I), "bias": (Lg, E, I)},
+                    "down_proj": {"weight": (Lg, E, I, H), "bias": (Lg, E, H)},
+                },
+            },
+        }
+
+    def _group_pspecs(self) -> Dict:
+        t = TENSOR
+        ffn = ("cp", "tp")
+        return {
+            "input_layernorm": {"weight": P()},
+            "post_attention_layernorm": {"weight": P()},
+            "self_attn": {
+                "q_proj": {"weight": P(None, None, t), "bias": P(None, t)},
+                "k_proj": {"weight": P(None, None, t), "bias": P(None, t)},
+                "v_proj": {"weight": P(None, None, t), "bias": P(None, t)},
+                "o_proj": {"weight": P(None, t, None), "bias": P()},
+                "sink": {"weight": P(None, t)},
+            },
+            "mlp": {
+                "router": {"weight": P(), "bias": P()},
+                "experts": {
+                    "gate_proj": {"weight": P(None, "ep", None, ffn), "bias": P(None, "ep", ffn)},
+                    "up_proj": {"weight": P(None, "ep", None, ffn), "bias": P(None, "ep", ffn)},
+                    "down_proj": {"weight": P(None, "ep", ffn, None), "bias": P(None, "ep", None)},
+                },
+            },
+        }
+
+    def param_shapes(self) -> Dict:
+        cfg = self.config
+        H, V = cfg.hidden_size, self.padded_vocab
+        return {
+            "embed_tokens": {"weight": (V, H)},
+            "rope": {"inv_freq": (self.head_dim // 2,)},
+            "layers": [self._group_shapes(e - s) for s, e, _ in self.runs],
+            "norm": {"weight": (H,)},
+            "lm_head": {"weight": (H, V)},
+        }
+
+    def param_pspecs(self) -> Dict:
+        tc = self.config.tpu_config
+        return {
+            "embed_tokens": {"weight": P(TENSOR, None) if tc.vocab_parallel else P(None, TENSOR)},
+            "rope": {"inv_freq": P()},
+            "layers": [self._group_pspecs() for _ in self.runs],
+            "norm": {"weight": P()},
+            "lm_head": {"weight": P(None, TENSOR)},
+        }
+
+    def random_params(self, key=None, dtype=None) -> Dict:
+        dtype = dtype or to_dtype(self.config.tpu_config.dtype)
+        key = key if key is not None else jax.random.PRNGKey(self.config.tpu_config.seed)
+        shapes = self.param_shapes()
+        leaves, treedef = jax.tree.flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
+        keys = jax.random.split(key, len(leaves))
+        vals = [(0.05 * jax.random.normal(k, s)).astype(dtype) for k, s in zip(keys, leaves)]
+        params = jax.tree.unflatten(treedef, vals)
+        from neuronx_distributed_inference_tpu.modules.rope import compute_inv_freq
+
+        params["rope"]["inv_freq"] = compute_inv_freq(self.config)
+        params["norm"]["weight"] = jnp.ones_like(params["norm"]["weight"])
+        for g in params["layers"]:
+            for n in ("input_layernorm", "post_attention_layernorm"):
+                g[n]["weight"] = jnp.ones_like(g[n]["weight"])
+        return params
+
+    def convert_hf_state_dict(self, sd: Dict[str, np.ndarray], dtype=None) -> Dict:
+        cfg = self.config
+        dtype = dtype or to_dtype(cfg.tpu_config.dtype)
+        D = self.head_dim
+        g = self.gqa
+
+        def get(name):
+            if name not in sd:
+                raise KeyError(f"missing HF weight {name}")
+            return np.asarray(sd[name])
+
+        def lt(name):
+            return get(name).T
+
+        def layer_params(i):
+            p = f"model.layers.{i}."
+            sink = np.asarray(g.pad_q(get(p + "self_attn.sinks")[None, :, None].repeat(D, -1)
+                                      .reshape(1, -1), D)).reshape(-1, D)[:, 0]
+            experts = p + "mlp.experts."
+            gate_up = get(experts + "gate_up_proj")  # (E, H, 2I) already (in,out)
+            gate_up_b = get(experts + "gate_up_proj_bias")  # (E, 2I)
+            return {
+                "input_layernorm": {"weight": get(p + "input_layernorm.weight")},
+                "post_attention_layernorm": {
+                    "weight": get(p + "post_attention_layernorm.weight")
+                },
+                "self_attn": {
+                    "q_proj": {
+                        "weight": g.pad_q(lt(p + "self_attn.q_proj.weight"), D),
+                        "bias": np.asarray(g.pad_q(get(p + "self_attn.q_proj.bias"), D)),
+                    },
+                    "k_proj": {
+                        "weight": g.replicate_kv(lt(p + "self_attn.k_proj.weight"), D),
+                        "bias": np.asarray(
+                            g.replicate_kv(get(p + "self_attn.k_proj.bias"), D)
+                        ),
+                    },
+                    "v_proj": {
+                        "weight": g.replicate_kv(lt(p + "self_attn.v_proj.weight"), D),
+                        "bias": np.asarray(
+                            g.replicate_kv(get(p + "self_attn.v_proj.bias"), D)
+                        ),
+                    },
+                    "o_proj": {
+                        "weight": g.pad_o(lt(p + "self_attn.o_proj.weight"), D),
+                        "bias": get(p + "self_attn.o_proj.bias"),
+                    },
+                    "sink": {"weight": sink},
+                },
+                "mlp": {
+                    "router": {
+                        "weight": lt(p + "mlp.router.weight"),
+                        "bias": get(p + "mlp.router.bias"),
+                    },
+                    "experts": {
+                        # de-interleave HF's [g0,u0,g1,u1,...] gate_up layout
+                        # so ffn sharding stays shard-local
+                        "gate_proj": {
+                            "weight": gate_up[..., 0::2], "bias": gate_up_b[..., 0::2]
+                        },
+                        "up_proj": {
+                            "weight": gate_up[..., 1::2], "bias": gate_up_b[..., 1::2]
+                        },
+                        "down_proj": {
+                            "weight": get(experts + "down_proj"),
+                            "bias": get(experts + "down_proj_bias"),
+                        },
+                    },
+                },
+            }
+
+        def stack_run(s, e):
+            per = [layer_params(i) for i in range(s, e)]
+            return jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs), dtype), *per)
+
+        embed = get("model.embed_tokens.weight")
+        vpad = self.padded_vocab - embed.shape[0]
+        if vpad:
+            embed = np.pad(embed, ((0, vpad), (0, 0)))
+        lm = lt("lm_head.weight") if "lm_head.weight" in sd else embed.T
+        if vpad and lm.shape[1] != self.padded_vocab:
+            lm = np.pad(lm, ((0, 0), (0, vpad)))
+        from neuronx_distributed_inference_tpu.modules.rope import compute_inv_freq
+
+        return {
+            "embed_tokens": {"weight": jnp.asarray(embed, dtype)},
+            "rope": {"inv_freq": compute_inv_freq(cfg)},
+            "layers": [stack_run(s, e) for s, e, _ in self.runs],
+            "norm": {"weight": jnp.asarray(get("model.norm.weight"), dtype)},
+            "lm_head": {"weight": jnp.asarray(lm, dtype)},
+        }

@@ -92,6 +92,10 @@ class MoEDecoderModelBuilder(DecoderModelBuilder):
             early_affinity_modulation=bool(
                 getattr(tc, "early_expert_affinity_modulation", False)
             ),
+            # MoETpuConfig activation knobs honored by every MoE model
+            # (reference MoENeuronConfig, config.py:679-680)
+            act_scale=float(getattr(tc, "hidden_act_scaling_factor", 1.0)),
+            act_bias=float(getattr(tc, "hidden_act_bias", 0.0)),
         )
 
     def param_shapes(self) -> Dict:

@@ -51,6 +51,8 @@ class AttnSpec:
     # model-parallel degree of the rank-interleaved fused-qkv layout
     # (builder._fuse_qkv); 1 when fused_qkv is off
     qkv_shards: int = 1
+    # clamp qkv projection outputs to [-clip, clip] (DBRX clip_qkv)
+    qkv_clip: Optional[float] = None
 
     @property
     def softmax_scale(self) -> float:
@@ -105,6 +107,10 @@ def qkv_project(
             q = q + params["q_proj"]["bias"]
             k = k + params["k_proj"]["bias"]
             v = v + params["v_proj"]["bias"]
+    if spec.qkv_clip is not None:
+        q = jnp.clip(q, -spec.qkv_clip, spec.qkv_clip)
+        k = jnp.clip(k, -spec.qkv_clip, spec.qkv_clip)
+        v = jnp.clip(v, -spec.qkv_clip, spec.qkv_clip)
     q = q.reshape(B, S, spec.num_heads, spec.head_dim)
     k = k.reshape(B, S, spec.num_kv_heads, spec.head_dim)
     v = v.reshape(B, S, spec.num_kv_heads, spec.head_dim)

@@ -61,15 +61,25 @@ def init_cache(
     head_dim: int,
     dtype=jnp.bfloat16,
     dp: int = 1,
+    v_heads: int = None,
+    v_head_dim: int = None,
 ) -> KVCache:
     """``dp`` > 1 builds the attention-DP layout: one garbage line PER DP
     SHARD, interleaved as [shard0: B/dp real + 1 garbage][shard1: ...] so the
     batch dim shards evenly over ``dp`` and every row's garbage line is local
     to its shard — the TPU answer to the reference's
-    DataParallelKVCacheManager (data_parallel_kv_cache_manager.py:8-40)."""
+    DataParallelKVCacheManager (data_parallel_kv_cache_manager.py:8-40).
+
+    ``v_heads``/``v_head_dim`` let the V stream differ from K (MLA caches the
+    compressed latent in K and the rope keys in V; reference
+    modeling_deepseek.py weight-absorption cache)."""
     garbage = dp if dp > 1 else GARBAGE_LINES
-    shape = (num_layers, batch_size + garbage, max_len, num_kv_heads, head_dim)
-    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+    rows = batch_size + garbage
+    k_shape = (num_layers, rows, max_len, num_kv_heads, head_dim)
+    v_shape = (
+        num_layers, rows, max_len, v_heads or num_kv_heads, v_head_dim or head_dim
+    )
+    return KVCache(k=jnp.zeros(k_shape, dtype), v=jnp.zeros(v_shape, dtype))
 
 
 def cache_spec(cp_enabled: bool = False, dp_enabled: bool = False):
@@ -171,15 +181,19 @@ def read_cache_at_layer(
     if dp > 1:
         sr = batch_size // dp
         L, R, S = k_cache.shape[:3]
-        tail = k_cache.shape[3:]
-        k_cache = k_cache.reshape(L, dp, sr + 1, S, *tail)[:, :, :sr].reshape(
-            L, batch_size, S, *tail
+        k_tail, v_tail = k_cache.shape[3:], v_cache.shape[3:]
+        k_cache = k_cache.reshape(L, dp, sr + 1, S, *k_tail)[:, :, :sr].reshape(
+            L, batch_size, S, *k_tail
         )
-        v_cache = v_cache.reshape(L, dp, sr + 1, S, *tail)[:, :, :sr].reshape(
-            L, batch_size, S, *tail
+        v_cache = v_cache.reshape(L, dp, sr + 1, S, *v_tail)[:, :, :sr].reshape(
+            L, batch_size, S, *v_tail
         )
-    sizes = (1, batch_size, bucket_len) + k_cache.shape[3:]
     zeros = (0,) * (k_cache.ndim - 1)
-    k = jax.lax.dynamic_slice(k_cache, (layer_idx,) + zeros, sizes)
-    v = jax.lax.dynamic_slice(v_cache, (layer_idx,) + zeros, sizes)
+    # k/v sized separately: MLA caches different streams in k vs v
+    k = jax.lax.dynamic_slice(
+        k_cache, (layer_idx,) + zeros, (1, batch_size, bucket_len) + k_cache.shape[3:]
+    )
+    v = jax.lax.dynamic_slice(
+        v_cache, (layer_idx,) + zeros, (1, batch_size, bucket_len) + v_cache.shape[3:]
+    )
     return k[0], v[0]

@@ -40,20 +40,74 @@ class MoESpec:
     # early_expert_affinity_modulation, config.py:665-713)
     early_affinity_modulation: bool = False
     router_bias: bool = False
+    # DeepSeek-V3 routing (reference modeling_deepseek.py MoEGate):
+    # sigmoid scoring with an aux-free correction bias and group-limited
+    # top-k over n_group expert groups
+    scoring_func: str = "softmax"  # or "sigmoid"
+    routed_scaling_factor: float = 1.0
+    n_group: int = 1
+    topk_group: int = 1
+    # GPT-OSS expert MLP variants (reference modeling_gpt_oss.py):
+    # act(x) = x * sigmoid(act_scale * x) clamped, up + act_bias
+    act_scale: float = 1.0
+    act_bias: float = 0.0
+    swiglu_limit: Optional[float] = None
+    # p-norm renormalization of the selected weights (DBRX
+    # moe_normalize_expert_weights); None = plain sum when
+    # normalize_top_k_affinities
+    norm_weights_p: Optional[float] = None
 
 
 def router_top_k(
     router_logits: jax.Array,  # (T, E) fp32
     spec: MoESpec,
+    correction_bias: Optional[jax.Array] = None,  # (E,) DeepSeek-V3 e_score_correction_bias
 ) -> jax.Array:
     """Full (T, E) affinity matrix, zero outside the top-k
-    (reference RouterTopK semantics)."""
-    probs = jax.nn.softmax(router_logits, axis=-1)
-    top_vals, top_idx = jax.lax.top_k(probs, spec.top_k)  # (T, k)
-    if spec.normalize_top_k_affinities:
-        top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
-    onehot = jax.nn.one_hot(top_idx, probs.shape[-1], dtype=probs.dtype)  # (T, k, E)
-    return jnp.einsum("tke,tk->te", onehot, top_vals)  # (T, E)
+    (reference RouterTopK semantics; sigmoid/group-limited variant =
+    DeepSeek-V3 MoEGate noaux_tc, modeling_deepseek.py)."""
+    T, E = router_logits.shape
+    if spec.scoring_func == "softmax_topk":
+        # GPT-OSS: top-k over raw LOGITS, softmax over the selected values
+        # (reference GptOssTopKRouter)
+        top_vals, top_idx = jax.lax.top_k(router_logits, spec.top_k)
+        weights = jax.nn.softmax(top_vals, axis=-1) * spec.routed_scaling_factor
+        onehot = jax.nn.one_hot(top_idx, E, dtype=router_logits.dtype)
+        return jnp.einsum("tke,tk->te", onehot, weights)
+    if spec.scoring_func == "sigmoid":
+        scores = jax.nn.sigmoid(router_logits)
+    else:
+        scores = jax.nn.softmax(router_logits, axis=-1)
+    choice = scores if correction_bias is None else scores + correction_bias[None, :]
+
+    if spec.n_group > 1:
+        # group-limited routing: keep the topk_group groups ranked by the sum
+        # of each group's top-2 choice scores, mask the rest
+        g = spec.n_group
+        grouped = choice.reshape(T, g, E // g)
+        top2 = jax.lax.top_k(grouped, min(2, E // g))[0].sum(axis=-1)  # (T, g)
+        _, keep_idx = jax.lax.top_k(top2, spec.topk_group)  # (T, topk_group)
+        group_mask = jnp.zeros((T, g), bool).at[
+            jnp.arange(T)[:, None], keep_idx
+        ].set(True)
+        choice = jnp.where(
+            jnp.repeat(group_mask, E // g, axis=1), choice, -jnp.inf
+        )
+
+    top_vals, top_idx = jax.lax.top_k(choice, spec.top_k)  # (T, k) ranked by choice
+    # combine weights use the UNCORRECTED scores of the selected experts
+    weights = jnp.take_along_axis(scores, top_idx, axis=1)
+    if spec.norm_weights_p is not None:
+        # DBRX p-norm renormalization (reference DbrxRouter
+        # moe_normalize_expert_weights)
+        p = spec.norm_weights_p
+        norm = jnp.sum(jnp.abs(weights) ** p, axis=-1, keepdims=True) ** (1.0 / p)
+        weights = weights / (norm + 1e-20)
+    elif spec.normalize_top_k_affinities:
+        weights = weights / (jnp.sum(weights, axis=-1, keepdims=True) + 1e-20)
+    weights = weights * spec.routed_scaling_factor
+    onehot = jax.nn.one_hot(top_idx, E, dtype=scores.dtype)  # (T, k, E)
+    return jnp.einsum("tke,tk->te", onehot, weights)  # (T, E)
 
 
 def expert_mlps_dense(
@@ -70,28 +124,40 @@ def expert_mlps_dense(
     """
     from neuronx_distributed_inference_tpu.models.base import act_fn as get_act
 
-    act = get_act(spec.act)
-
     def expert_mm(entry, x_in, eq):
-        """Expert batched matmul with optional dequant scale (E, out)."""
+        """Expert batched matmul with optional dequant scale + bias (E, out)."""
         w = entry["weight"]
         y = jnp.einsum(eq, x_in, w.astype(x_in.dtype))
         if "scale" in entry:
             y = y * entry["scale"].astype(y.dtype)[:, None, :]
+        if "bias" in entry:
+            y = y + entry["bias"].astype(y.dtype)[:, None, :]
         return y
+
+    def glu(gate, up):
+        if spec.act_scale != 1.0 or spec.act_bias != 0.0 or spec.swiglu_limit is not None:
+            # GPT-OSS swiglu: x·sigmoid(act_scale·x), clamped, up offset by
+            # act_bias (reference modeling_gpt_oss.py + mx_layout_transform
+            # hidden_act_scaling_factor=1.702, hidden_act_bias=1)
+            if spec.swiglu_limit is not None:
+                gate = jnp.clip(gate, max=spec.swiglu_limit)
+                up = jnp.clip(up, -spec.swiglu_limit, spec.swiglu_limit)
+            return gate * jax.nn.sigmoid(spec.act_scale * gate) * (up + spec.act_bias)
+        act = get_act(spec.act)
+        return act(gate) * up
 
     aff = affinities.astype(x.dtype)
     if spec.early_affinity_modulation:
         # scale expert inputs, combine unweighted (reference
         # early_expert_affinity_modulation)
         xe = jnp.einsum("te,th->eth", aff, x)
-        gate = act(expert_mm(params["gate_proj"], xe, "eth,ehi->eti"))
-        up = expert_mm(params["up_proj"], xe, "eth,ehi->eti")
-        y = expert_mm(params["down_proj"], gate * up, "eti,eih->eth")
+        g = expert_mm(params["gate_proj"], xe, "eth,ehi->eti")
+        u = expert_mm(params["up_proj"], xe, "eth,ehi->eti")
+        y = expert_mm(params["down_proj"], glu(g, u), "eti,eih->eth")
         return jnp.sum(y, axis=0)
-    gate = act(expert_mm(params["gate_proj"], x, "th,ehi->eti"))
-    up = expert_mm(params["up_proj"], x, "th,ehi->eti")
-    y = expert_mm(params["down_proj"], gate * up, "eti,eih->eth")  # (E, T, H)
+    g = expert_mm(params["gate_proj"], x, "th,ehi->eti")
+    u = expert_mm(params["up_proj"], x, "th,ehi->eti")
+    y = expert_mm(params["down_proj"], glu(g, u), "eti,eih->eth")  # (E, T, H)
     return jnp.einsum("te,eth->th", aff, y)
 
 
@@ -110,7 +176,12 @@ def moe_layer(
     router_logits = x.astype(rdt) @ params["router"]["weight"].astype(rdt)
     if spec.router_bias:
         router_logits = router_logits + params["router"]["bias"].astype(rdt)
-    affinities = router_top_k(router_logits.astype(jnp.float32), spec)  # (T, E) fp32
+    correction = params["router"].get("e_score_correction_bias")
+    if correction is not None:
+        correction = correction.astype(jnp.float32)
+    affinities = router_top_k(
+        router_logits.astype(jnp.float32), spec, correction_bias=correction
+    )  # (T, E) fp32
     out = expert_mlps_dense(params["experts"], x, affinities, spec)
     if shared_mlp_fn is not None:
         out = out + shared_mlp_fn(params["shared_experts"], x)

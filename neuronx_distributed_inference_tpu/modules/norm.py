@@ -25,3 +25,10 @@ def layer_norm(x, weight, bias=None, eps: float = 1e-5):
     if bias is not None:
         out = out + bias.astype(jnp.float32)
     return out.astype(x.dtype)
+
+
+def apply_norm(x, weight, eps: float, kind: str = "rmsnorm", bias=None):
+    """Norm dispatch: llama-family rmsnorm or DBRX-style LayerNorm."""
+    if kind == "layernorm":
+        return layer_norm(x, weight, bias=bias, eps=eps)
+    return rms_norm(x, weight, eps)

@@ -116,7 +116,11 @@ class TpuModelForCausalLM:
                 {autobucketing.round_up(b, tc.pa_block_size) for b in tkg_buckets}
             )
         mlp_fn = self.builder.mlp_fn()
-        block_kwargs = dict(block_kv=tc.is_block_kv_layout, block_size=tc.pa_block_size)
+        layer_fn = self.builder.layer_fn()
+        block_kwargs = dict(
+            block_kv=tc.is_block_kv_layout, block_size=tc.pa_block_size,
+            layer_fn=layer_fn,
+        )
         # per-sub-model specialized config (reference deep-copied configs,
         # model_base.py:3099-3222)
         self.context_encoding_model = SubModelRunner(
@@ -183,21 +187,7 @@ class TpuModelForCausalLM:
             )
             self.kv_cache = shard_pytree(cache, block_cache_spec(), self.mesh)
             return
-        kv_batch = tc.kv_cache_batch_size or tc.max_batch_size
-        cache = init_cache(
-            self.spec.num_layers,
-            kv_batch,
-            tc.seq_len,
-            self.spec.attn.num_kv_heads,
-            self.spec.attn.head_dim,
-            dtype=dt,
-            dp=tc.attention_dp_degree,
-        )
-        self.kv_cache = shard_pytree(
-            cache,
-            cache_spec(tc.cp_degree > 1, tc.attention_dp_degree > 1),
-            self.mesh,
-        )
+        self.kv_cache = self.builder.init_kv_cache(self.mesh)
 
     def load_lora_adapters(self, adapters):
         """Attach multi-adapter LoRA weights (reference LoraModel.inject_adapter

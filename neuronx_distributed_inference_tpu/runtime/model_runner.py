@@ -55,6 +55,7 @@ class SubModelRunner:
         n_active_tokens: int = 1,
         block_kv: bool = False,
         block_size: int = 16,
+        layer_fn=None,
     ):
         self.tag = tag
         self.phase = phase
@@ -66,13 +67,14 @@ class SubModelRunner:
         self.block_kv = block_kv
         self.block_size = block_size
         self.mlp_fn = mlp_fn
+        self.layer_fn = layer_fn
         self._decode_fns = {}  # (num_steps, bucket) -> jitted multi-step program
 
         # params/cache arrive as committed GSPMD-sharded arrays (device_put in
         # load()); jit follows their shardings, so no in_shardings needed —
         # and the param tree can change shape (e.g. quantization adds scale
         # leaves) without invalidating the runner
-        step = partial(forward, spec=spec, phase=phase, mlp_fn=mlp_fn)
+        step = partial(forward, spec=spec, phase=phase, mlp_fn=mlp_fn, layer_fn=layer_fn)
         self._fn = jax.jit(
             step,
             donate_argnums=(1,),  # cache in-place (reference KV aliasing)
@@ -207,6 +209,7 @@ class SubModelRunner:
                     num_steps=num_steps,
                     bucket=bucket,
                     mlp_fn=self.mlp_fn,
+                    layer_fn=self.layer_fn,
                 ),
                 donate_argnums=(1,),
             )
