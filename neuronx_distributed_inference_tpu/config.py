@@ -292,6 +292,10 @@ class TpuConfig:
     tp_degree: int = 1
     cp_degree: int = 1  # context parallel (prefill attention)
     attention_dp_degree: int = 1  # data parallel decode attention
+    # whole-model data parallel (leading ddp mesh axis; rides DCN multi-host:
+    # weights replicate, the batch shards). TPU-native extension — the
+    # reference runs whole-model DP as separate vLLM replicas.
+    data_parallel_degree: int = 1
     pp_degree: int = 1
     ep_degree: int = 1
     moe_tp_degree: Optional[int] = None
@@ -348,7 +352,7 @@ class TpuConfig:
     # world size identical to reference config.py:353-355
     @property
     def world_size(self) -> int:
-        return self.tp_degree * self.pp_degree * self.ep_degree
+        return self.tp_degree * self.pp_degree * self.ep_degree * self.data_parallel_degree
 
     @property
     def torch_dtype(self):  # name kept for API familiarity; returns jnp dtype
@@ -373,6 +377,21 @@ class TpuConfig:
                 "attention-DP with the paged cache is not implemented; use "
                 "the contiguous cache (kv_cache_batch_size slots)"
             )
+        if self.data_parallel_degree > 1:
+            shards = self.attention_dp_degree * self.data_parallel_degree
+            if (self.kv_cache_batch_size or self.max_batch_size) % shards != 0:
+                raise ValueError(
+                    "batch size must be divisible by attention_dp_degree * "
+                    "data_parallel_degree"
+                )
+            if self.enable_fused_speculation:
+                raise NotImplementedError(
+                    "whole-model DP with fused speculation is not implemented"
+                )
+            if self.is_block_kv_layout:
+                raise NotImplementedError(
+                    "whole-model DP with the paged cache is not implemented"
+                )
         if self.attention_dp_degree > 1 and self.enable_fused_speculation:
             raise NotImplementedError(
                 "attention-DP with fused/EAGLE speculation is not implemented "

@@ -94,6 +94,8 @@ class ModelSpec:
     # attention-DP decode: batch-parallel attention over the dp mesh axis
     # (reference attention_base.py:2308-2321)
     attention_dp: int = 1
+    # whole-model data parallel over the leading ddp axis (multi-host DCN)
+    data_parallel: int = 1
     # sampling
     on_device_sampling: bool = True
     do_sample: bool = False
@@ -258,18 +260,19 @@ def decoder_layer(
     else:
         B = q.shape[0]
         bucket = mask.shape[-1]
-        if spec.attention_dp > 1:
-            # batch-parallel decode attention over dp: GSPMD all-to-alls
-            # heads<->batch around the attention (reference DP decode,
-            # attention_base.py:2308-2321)
+        if spec.attention_dp > 1 or spec.data_parallel > 1:
+            # batch-parallel decode attention over (ddp, dp): GSPMD
+            # all-to-alls heads<->batch around the attention (reference DP
+            # decode, attention_base.py:2308-2321)
             from neuronx_distributed_inference_tpu.parallel import attention_dp as adp
 
             q = adp.shard_decode_q(q)
         k_r, v_r = read_cache_at_layer(
-            k_cache, v_cache, layer_idx, B, bucket, dp=spec.attention_dp
+            k_cache, v_cache, layer_idx, B, bucket,
+            dp=spec.attention_dp * spec.data_parallel,
         )
         attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
-        if spec.attention_dp > 1:
+        if spec.attention_dp > 1 or spec.data_parallel > 1:
             attn_out = adp.unshard_attn_out(attn_out)
 
     hidden = o_project(layer_params["self_attn"], attn_out, aspec, adapter_ids=adapter_ids)
@@ -371,6 +374,14 @@ def run_decoder_layers(
     inv_freq = params["rope"]["inv_freq"]
     cos, sin = rope_cos_sin(inputs.position_ids, inv_freq, spec.attention_scaling)
 
+    # three layouts for params["layers"]:
+    # - dict, no layer_groups: one uniform stacked scan (the common case)
+    # - dict + layer_groups: PRESTACKED heterogeneous flavors (GPT-OSS) —
+    #   structurally uniform layers stacked ONCE at load, per-layer flavor
+    #   selected in-scan (never concatenated inside the traced function)
+    # - list + layer_groups: per-group stacks with different structures
+    #   (DeepSeek dense-then-MoE) — one scan per group
+    prestacked = spec.layer_groups is not None and isinstance(params["layers"], dict)
     if spec.layer_groups is None:
         groups = [params["layers"]]
         group_specs = [
@@ -380,11 +391,22 @@ def run_decoder_layers(
                 attention_chunk_size=spec.attention_chunk_size,
             )
         ]
+    elif prestacked:
+        groups = [params["layers"]]
+        group_specs = list(spec.layer_groups)
     else:
         groups = params["layers"]
         group_specs = list(spec.layer_groups)
     mlp_fns = mlp_fn if isinstance(mlp_fn, (list, tuple)) else [mlp_fn]
     layer_fns = layer_fn if isinstance(layer_fn, (list, tuple)) else [layer_fn]
+
+    if spec.data_parallel > 1:
+        # whole-model DP: the batch shards over the leading ddp axis (weights
+        # replicate over it); one constraint here propagates everywhere
+        from neuronx_distributed_inference_tpu.parallel.sharding import constrain
+        from jax.sharding import PartitionSpec as _P
+
+        hidden = constrain(hidden, _P(("ddp",), None, None))
 
     sp_prefill = (spec.cp_enabled or spec.sequence_parallel) and phase == PHASE_CONTEXT_ENCODING
     if sp_prefill:
@@ -398,8 +420,9 @@ def run_decoder_layers(
     if is_block:
         slot_ids = inputs.seq_ids  # block layout: writes go via slot_mapping
     else:
+        shards = spec.attention_dp * spec.data_parallel
         slot_ids = slot_ids_from_seq_ids(
-            inputs.seq_ids, kv_batch_size(cache, spec.attention_dp), dp=spec.attention_dp
+            inputs.seq_ids, kv_batch_size(cache, shards), dp=shards
         )
     positions = inputs.position_ids
 
@@ -437,29 +460,20 @@ def run_decoder_layers(
 
     k_cache, v_cache = cache.k, cache.v
 
-    # Alternating-flavor stacks (GPT-OSS sliding/global every other layer)
-    # would degenerate into one scan PER LAYER; when every group shares
-    # params structure and fn_idx and there are at most two attention
-    # flavors, restack into ONE scan that selects the flavor's mask per
-    # layer — depth-independent program size.
-    restacked = None
-    if spec.layer_groups is not None and len(groups) > 2:
+    if prestacked:
+        # ONE scan over the load-time-stacked params; each layer selects its
+        # flavor's mask in-scan. Alternating stacks (GPT-OSS sliding/global)
+        # stay depth-independent in program size with no in-graph weight
+        # concatenation.
         flavors = [(g.sliding_window, g.attention_chunk_size) for g in group_specs]
         uniq = list(dict.fromkeys(flavors))
-        if (
-            len({g.fn_idx for g in group_specs}) == 1
-            and len(uniq) <= 2
-            and all(
-                jax.tree.structure(g) == jax.tree.structure(groups[0])
-                for g in groups[1:]
+        if len(uniq) > 2:
+            raise NotImplementedError(
+                "prestacked heterogeneous stacks support at most 2 attention "
+                "flavors; use per-group param lists instead"
             )
-        ):
-            try:
-                restacked = jax.tree.map(lambda *xs: jnp.concatenate(xs, axis=0), *groups)
-            except Exception:
-                restacked = None
-
-    if restacked is not None:
+        if len({g.fn_idx for g in group_specs}) != 1:
+            raise ValueError("prestacked layer groups must share fn_idx")
         g_mlp = mlp_fns[group_specs[0].fn_idx if len(mlp_fns) > 1 else 0]
         g_layer = layer_fns[group_specs[0].fn_idx if len(layer_fns) > 1 else 0] or decoder_layer
         flavor_masks = [
@@ -468,11 +482,15 @@ def run_decoder_layers(
         ]
         key_valid = group_key_valid(*uniq[0]) if len(uniq) == 1 else None
         flavor_ids = []
-        for f, gp in zip(flavors, groups):
-            n = jax.tree.leaves(gp)[0].shape[0]
-            flavor_ids.extend([uniq.index(f)] * n)
+        for f, g in zip(flavors, group_specs):
+            flavor_ids.extend([uniq.index(f)] * g.num_layers)
+        total = jax.tree.leaves(groups[0])[0].shape[0]
+        if total != len(flavor_ids):
+            raise ValueError(
+                f"layer_groups mismatch: spec says {len(flavor_ids)} layers, "
+                f"params carry {total}"
+            )
         flavor_arr = jnp.asarray(flavor_ids, jnp.int32)
-        total = len(flavor_ids)
 
         def fused_body(carry, xs):
             h, k_c, v_c = carry
@@ -491,7 +509,7 @@ def run_decoder_layers(
         (hidden, k_cache, v_cache), _ = jax.lax.scan(
             fused_body,
             (hidden, k_cache, v_cache),
-            (restacked, jnp.arange(total, dtype=jnp.int32), flavor_arr),
+            (groups[0], jnp.arange(total, dtype=jnp.int32), flavor_arr),
         )
     else:
         offset = 0

@@ -95,6 +95,7 @@ class DecoderModelBuilder:
             cp_enabled=tc.cp_degree > 1,
             sequence_parallel=tc.sequence_parallel_enabled,
             attention_dp=tc.attention_dp_degree,
+            data_parallel=tc.data_parallel_degree,
             on_device_sampling=ods is not None,
             do_sample=bool(ods and ods.do_sample),
             max_topk=tc.max_topk,
@@ -398,6 +399,7 @@ class DecoderModelBuilder:
         tc = self.config.tpu_config
         dt = to_dtype(tc.kv_cache_dtype or tc.dtype)
         kv_batch = tc.kv_cache_batch_size or tc.max_batch_size
+        batch_shards = tc.attention_dp_degree * tc.data_parallel_degree
         cache = init_cache(
             self.config.num_hidden_layers,
             kv_batch,
@@ -405,10 +407,10 @@ class DecoderModelBuilder:
             self.gqa.kv_heads,
             self.head_dim,
             dtype=dt,
-            dp=tc.attention_dp_degree,
+            dp=batch_shards,
         )
         return shard_pytree(
             cache,
-            cache_spec(tc.cp_degree > 1, tc.attention_dp_degree > 1),
+            cache_spec(tc.cp_degree > 1, batch_shards > 1),
             mesh,
         )

@@ -186,6 +186,7 @@ class DeepseekV3ModelBuilder(DecoderModelBuilder):
             (tc.is_block_kv_layout, "paged cache"),
             (tc.cp_degree > 1, "context parallelism"),
             (tc.attention_dp_degree > 1, "attention-DP"),
+            (tc.data_parallel_degree > 1, "whole-model DP"),
             (tc.fused_qkv, "fused_qkv"),
         ):
             if flag:

@@ -169,12 +169,16 @@ class GptOssModelBuilder(DecoderModelBuilder):
         }
 
     def param_shapes(self) -> Dict:
+        # ONE stacked param tree for all layers (layers are structurally
+        # uniform); spec.layer_groups carries the per-layer attention flavor
+        # and run_decoder_layers selects masks in-scan — no per-group stacks,
+        # no in-graph concatenation
         cfg = self.config
         H, V = cfg.hidden_size, self.padded_vocab
         return {
             "embed_tokens": {"weight": (V, H)},
             "rope": {"inv_freq": (self.head_dim // 2,)},
-            "layers": [self._group_shapes(e - s) for s, e, _ in self.runs],
+            "layers": self._group_shapes(cfg.num_hidden_layers),
             "norm": {"weight": (H,)},
             "lm_head": {"weight": (H, V)},
         }
@@ -184,7 +188,7 @@ class GptOssModelBuilder(DecoderModelBuilder):
         return {
             "embed_tokens": {"weight": P(TENSOR, None) if tc.vocab_parallel else P(None, TENSOR)},
             "rope": {"inv_freq": P()},
-            "layers": [self._group_pspecs() for _ in self.runs],
+            "layers": self._group_pspecs(),
             "norm": {"weight": P()},
             "lm_head": {"weight": P(None, TENSOR)},
         }
@@ -201,9 +205,8 @@ class GptOssModelBuilder(DecoderModelBuilder):
 
         params["rope"]["inv_freq"] = compute_inv_freq(self.config)
         params["norm"]["weight"] = jnp.ones_like(params["norm"]["weight"])
-        for g in params["layers"]:
-            for n in ("input_layernorm", "post_attention_layernorm"):
-                g[n]["weight"] = jnp.ones_like(g[n]["weight"])
+        for n in ("input_layernorm", "post_attention_layernorm"):
+            params["layers"][n]["weight"] = jnp.ones_like(params["layers"][n]["weight"])
         return params
 
     def convert_hf_state_dict(self, sd: Dict[str, np.ndarray], dtype=None) -> Dict:
@@ -277,9 +280,6 @@ class GptOssModelBuilder(DecoderModelBuilder):
                 },
             }
 
-        def stack_run(s, e):
-            per = [layer_params(i) for i in range(s, e)]
-            return jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs), dtype), *per)
 
         embed = get("model.embed_tokens.weight")
         vpad = self.padded_vocab - embed.shape[0]
@@ -293,7 +293,10 @@ class GptOssModelBuilder(DecoderModelBuilder):
         return {
             "embed_tokens": {"weight": jnp.asarray(embed, dtype)},
             "rope": {"inv_freq": compute_inv_freq(cfg)},
-            "layers": [stack_run(s, e) for s, e, _ in self.runs],
+            "layers": jax.tree.map(
+                lambda *xs: jnp.asarray(np.stack(xs), dtype),
+                *[layer_params(i) for i in range(cfg.num_hidden_layers)],
+            ),
             "norm": {"weight": jnp.asarray(get("model.norm.weight"), dtype)},
             "lm_head": {"weight": jnp.asarray(lm, dtype)},
         }

@@ -96,13 +96,16 @@ def cache_spec(cp_enabled: bool = False, dp_enabled: bool = False):
 
     from neuronx_distributed_inference_tpu.parallel.mesh import (
         AXIS_CP,
+        AXIS_DDP,
         AXIS_DP,
         AXIS_EP,
         AXIS_TP,
         MODEL_AXES,
     )
 
-    batch = AXIS_DP if dp_enabled else None
+    # the batch dim shards over whole-model DP and attention-DP jointly
+    # (sizes 1 when disabled -> replicated)
+    batch = (AXIS_DDP, AXIS_DP) if dp_enabled else None
     if cp_enabled:
         spec = P(None, batch, AXIS_CP, (AXIS_EP, AXIS_TP), None)
     else:

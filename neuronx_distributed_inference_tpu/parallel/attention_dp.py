@@ -18,7 +18,7 @@ from __future__ import annotations
 
 from jax.sharding import PartitionSpec as P
 
-from neuronx_distributed_inference_tpu.parallel.mesh import AXIS_DP, MODEL_AXES
+from neuronx_distributed_inference_tpu.parallel.mesh import AXIS_DDP, AXIS_DP, MODEL_AXES
 from neuronx_distributed_inference_tpu.parallel.sharding import constrain as _constrain
 
 
@@ -26,7 +26,7 @@ def shard_decode_q(q):
     """(B, K, Hq, D): batch over dp, heads over the remaining model axes —
     each dp group runs attention on its batch shard with heads sharded
     tp/dp ways (reference DP decode Q scatter)."""
-    return _constrain(q, P(AXIS_DP, None, MODEL_AXES, None))
+    return _constrain(q, P((AXIS_DDP, AXIS_DP), None, MODEL_AXES, None))
 
 
 def unshard_attn_out(out):

@@ -37,6 +37,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+AXIS_DDP = "ddp"  # whole-model data parallel (multi-slice, rides DCN)
 AXIS_DP = "dp"
 AXIS_EP = "ep"
 AXIS_CP = "cp"
@@ -45,6 +46,31 @@ AXIS_TP = "tp"
 #: Axes that together form the model-parallel group (weights sharded over all).
 MODEL_AXES = (AXIS_EP, AXIS_CP, AXIS_TP)
 ALL_AXES = (AXIS_DP, AXIS_EP, AXIS_CP, AXIS_TP)
+FULL_AXES = (AXIS_DDP,) + ALL_AXES
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids=None,
+):
+    """Join the multi-host runtime (reference: the torchrun env handshake of
+    scripts/nxdi_distributed_launcher.py:29-80).
+
+    On Cloud TPU pods ``jax.distributed.initialize()`` auto-discovers the
+    coordinator from the TPU metadata; elsewhere pass coordinator/worldsize
+    explicitly (or set JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID). After this, ``jax.devices()`` spans every host and the
+    SAME single-host model code runs SPMD over all of them — there is no
+    separate multi-node code path.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
 
 
 def build_mesh(
@@ -52,6 +78,7 @@ def build_mesh(
     cp_degree: int = 1,
     ep_degree: int = 1,
     dp_degree: int = 1,
+    ddp_degree: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
     """Build the global device mesh.
@@ -61,29 +88,48 @@ def build_mesh(
     address the ``cp`` sub-axis and attention-DP decode the ``dp`` sub-axis
     (reference: CP/DP groups split the TP group,
     attention_process_groups.py:80-163).
+
+    ``ddp_degree`` adds the leading whole-model data-parallel axis: weights
+    replicate over it and the batch shards over it. In a multi-host run the
+    device order puts ddp OUTERMOST so its collectives ride DCN while the
+    model axes stay on ICI (``mesh_utils.create_hybrid_device_mesh``).
     """
     if tp_degree % (cp_degree * dp_degree) != 0:
         raise ValueError(
             f"cp_degree*dp_degree={cp_degree * dp_degree} must divide "
             f"tp_degree={tp_degree} (both split the TP group)"
         )
-    shape = (dp_degree, ep_degree, cp_degree, tp_degree // (cp_degree * dp_degree))
+    shape = (
+        ddp_degree,
+        dp_degree,
+        ep_degree,
+        cp_degree,
+        tp_degree // (cp_degree * dp_degree),
+    )
     n = int(np.prod(shape))
     if devices is None:
         devices = jax.devices()
     if len(devices) < n:
         raise ValueError(f"mesh shape {shape} needs {n} devices, have {len(devices)}")
     devices = devices[:n]
+    if ddp_degree > 1 and jax.process_count() > 1:
+        try:
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                (1,) + shape[1:], (ddp_degree, 1, 1, 1, 1), devices=devices
+            )
+            return Mesh(dev_array, FULL_AXES)
+        except Exception:
+            pass
     try:
         dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
     except Exception:
         dev_array = np.asarray(devices).reshape(shape)
-    return Mesh(dev_array, ALL_AXES)
+    return Mesh(dev_array, FULL_AXES)
 
 
 def single_device_mesh(device=None) -> Mesh:
     dev = device if device is not None else jax.devices()[0]
-    return Mesh(np.asarray([dev]).reshape(1, 1, 1, 1), ALL_AXES)
+    return Mesh(np.asarray([dev]).reshape(1, 1, 1, 1, 1), FULL_AXES)
 
 
 def mesh_from_config(tpu_config, devices=None) -> Mesh:
@@ -92,6 +138,7 @@ def mesh_from_config(tpu_config, devices=None) -> Mesh:
         cp_degree=tpu_config.cp_degree,
         ep_degree=tpu_config.ep_degree,
         dp_degree=tpu_config.attention_dp_degree,
+        ddp_degree=getattr(tpu_config, "data_parallel_degree", 1),
         devices=devices,
     )
 

@@ -1,7 +1,10 @@
-"""HF config adapter.
+"""HF adapters: config loading + a GenerationMixin-style generate() wrapper.
 
-Reference: utils/hf_adapter.py:33-99 ``load_pretrained_config`` — copies HF
-``config.json`` attributes onto the InferenceConfig instance.
+Reference: utils/hf_adapter.py:33-99 ``load_pretrained_config`` (copies HF
+``config.json`` attributes onto the InferenceConfig instance) and
+:101-916 ``HuggingFaceGenerationAdapter`` — the transformers-compatible
+``generate()`` surface over a compiled application, including assisted
+(draft-model) decoding :427.
 """
 
 from __future__ import annotations
@@ -9,6 +12,8 @@ from __future__ import annotations
 import json
 import os
 from typing import Callable, Optional
+
+import numpy as np
 
 
 def load_pretrained_config(model_path: Optional[str] = None, hf_config: Optional[dict] = None) -> Callable:
@@ -33,3 +38,149 @@ def load_pretrained_config(model_path: Optional[str] = None, hf_config: Optional
             inference_config.num_key_value_heads = inference_config.num_attention_heads
 
     return load_config
+
+
+class HuggingFaceGenerationAdapter:
+    """transformers-style ``generate()`` over a compiled application
+    (reference HuggingFaceGenerationAdapter, hf_adapter.py:101-916).
+
+    Accepts torch or numpy inputs (tokenizer output either way), a
+    ``GenerationConfig``/kwargs sampling surface, LEFT- or right-padded
+    batches (rows are re-packed to the app's right-padded convention and the
+    returned sequences keep the caller's layout), and an optional
+    ``assistant_model`` draft application for assisted decoding
+    (hf_adapter.py:427 -> runtime/assisted.py).
+    """
+
+    def __init__(self, app, tokenizer=None):
+        self.app = app
+        self.tokenizer = tokenizer
+        self.generation_config = None  # set via kwargs or generate()
+
+    # --- helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _to_numpy(x):
+        if x is None:
+            return None, None
+        if isinstance(x, np.ndarray):
+            return x, "numpy"
+        if hasattr(x, "detach"):  # torch tensor without importing torch
+            return x.detach().cpu().numpy(), "torch"
+        return np.asarray(x), "numpy"
+
+    @staticmethod
+    def _from_numpy(x, kind):
+        if kind == "torch":
+            import torch
+
+            return torch.from_numpy(np.ascontiguousarray(x))
+        return x
+
+    def _resolve(self, generation_config, kwargs):
+        """GenerationConfig + kwargs -> flat dict (kwargs win, reference
+        generation-config precedence)."""
+        merged = {}
+        if generation_config is not None:
+            src = (
+                generation_config.to_dict()
+                if hasattr(generation_config, "to_dict")
+                else dict(generation_config)
+            )
+            merged.update({k: v for k, v in src.items() if v is not None})
+        merged.update({k: v for k, v in kwargs.items() if v is not None})
+        return merged
+
+    # --- generate --------------------------------------------------------
+
+    def generate(
+        self,
+        input_ids=None,
+        attention_mask=None,
+        generation_config=None,
+        assistant_model=None,
+        **kwargs,
+    ):
+        """HF-compatible greedy/sampled/assisted generation.
+
+        Returns sequences shaped (B, S_in + new) in the caller's array type,
+        with post-EOS positions filled with ``pad_token_id``.
+        """
+        if input_ids is None:
+            input_ids = kwargs.pop("inputs", None)
+        ids, kind = self._to_numpy(input_ids)
+        if ids is None:
+            raise ValueError("generate() needs input_ids")
+        mask, _ = self._to_numpy(attention_mask)
+        if mask is None:
+            mask = np.ones_like(ids)
+
+        g = self._resolve(generation_config, kwargs)
+        max_new = g.get("max_new_tokens")
+        if max_new is None and g.get("max_length"):
+            max_new = int(g["max_length"]) - ids.shape[1]
+        if max_new is None:
+            max_new = 32
+        eos = g.get("eos_token_id")
+        if isinstance(eos, (list, tuple)):
+            eos = eos[0] if eos else None
+        pad = g.get("pad_token_id")
+        if pad is None:
+            pad = eos if eos is not None else 0
+        do_sample = bool(g.get("do_sample", False))
+        sample_kwargs = {}
+        if do_sample:
+            sample_kwargs = dict(
+                top_k=g.get("top_k", 50),
+                top_p=g.get("top_p", 1.0),
+                temperature=g.get("temperature", 1.0),
+            )
+        if g.get("num_return_sequences", 1) != 1:
+            raise NotImplementedError("num_return_sequences > 1")
+        if g.get("num_beams", 1) != 1:
+            raise NotImplementedError("beam search (use sampling or greedy)")
+
+        # re-pack LEFT-padded rows (HF decoder-only convention) to the app's
+        # right-padded layout
+        B, S = ids.shape
+        left_padded = bool((mask[:, -1] == 1).all() and not (mask[:, 0] == 1).all())
+        if left_padded:
+            packed = np.zeros_like(ids)
+            packed_mask = np.zeros_like(mask)
+            for b in range(B):
+                valid = ids[b, mask[b].astype(bool)]
+                packed[b, : valid.shape[0]] = valid
+                packed_mask[b, : valid.shape[0]] = 1
+            run_ids, run_mask = packed, packed_mask
+        else:
+            run_ids, run_mask = ids, mask
+
+        if assistant_model is not None:
+            from neuronx_distributed_inference_tpu.runtime.assisted import (
+                assisted_generate,
+            )
+
+            if do_sample:
+                raise NotImplementedError(
+                    "assisted decoding is greedy-only; fused speculation "
+                    "supports multinomial sampling"
+                )
+            out = assisted_generate(
+                self.app, assistant_model, run_ids, run_mask,
+                max_new_tokens=max_new, eos_token_id=eos,
+            )
+        else:
+            out = self.app.generate(
+                run_ids, run_mask, max_new_tokens=max_new, eos_token_id=eos,
+                **sample_kwargs,
+            )
+
+        gen = out.sequences[:, run_ids.shape[1]:]
+        # post-EOS positions -> pad token (reference finalization)
+        if eos is not None:
+            done = np.cumsum(gen == eos, axis=1) > 0
+            after_eos = np.roll(done, 1, axis=1)
+            after_eos[:, 0] = False
+            gen = np.where(after_eos, pad, gen)
+        sequences = np.concatenate([ids, gen], axis=1)
+        return self._from_numpy(sequences, kind)

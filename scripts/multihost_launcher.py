@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Multi-host launcher: one process per host, SPMD over every chip.
+
+Reference: scripts/nxdi_distributed_launcher.py:29-80 — the reference wraps
+torchrun and re-execs per rank; on TPU the same job is
+``jax.distributed.initialize`` + running the SAME single-host entrypoint on
+every host. This launcher resolves the coordinator/world/rank triplet from
+flags or the environment and then hands off to inference_demo (or any
+``-m module``).
+
+Usage (run on EVERY host):
+
+    python scripts/multihost_launcher.py \
+        --coordinator-address host0:8476 --num-processes 2 --process-id $RANK \
+        -- -m neuronx_distributed_inference_tpu.inference_demo run \
+           --model-path ... --tp-degree 8 ...
+
+On Cloud TPU pod slices the triplet is auto-discovered; just run the same
+command on every worker with no coordinator flags.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--coordinator-address", default=os.environ.get("JAX_COORDINATOR_ADDRESS"))
+    p.add_argument(
+        "--num-processes",
+        type=int,
+        default=int(os.environ["JAX_NUM_PROCESSES"]) if "JAX_NUM_PROCESSES" in os.environ else None,
+    )
+    p.add_argument(
+        "--process-id",
+        type=int,
+        default=int(os.environ["JAX_PROCESS_ID"]) if "JAX_PROCESS_ID" in os.environ else None,
+    )
+    p.add_argument("rest", nargs=argparse.REMAINDER, help="-- -m module args...")
+    args = p.parse_args(argv)
+
+    from neuronx_distributed_inference_tpu.parallel.mesh import initialize_multihost
+
+    initialize_multihost(
+        coordinator_address=args.coordinator_address,
+        num_processes=args.num_processes,
+        process_id=args.process_id,
+    )
+
+    rest = args.rest
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    if not rest:
+        import jax
+
+        print(
+            f"[multihost] process {jax.process_index()}/{jax.process_count()} "
+            f"sees {jax.device_count()} global devices"
+        )
+        return 0
+    if rest[0] == "-m":
+        sys.argv = [rest[1]] + rest[2:]
+        runpy.run_module(rest[1], run_name="__main__")
+        return 0
+    sys.argv = rest
+    runpy.run_path(rest[0], run_name="__main__")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,131 @@
+"""Multi-host scaffolding (VERDICT r1 next #8): the ddp (whole-model DP)
+mesh axis + the jax.distributed initialize path.
+
+The ddp parity test runs on the in-process 8-device virtual mesh; the
+2-process test does a REAL jax.distributed.initialize handshake over
+localhost subprocesses (the CPU stand-in for a 2-slice DCN topology).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_random_hf_state_dict, make_tiny_config
+
+from neuronx_distributed_inference_tpu.runtime.application import TpuModelForCausalLM
+
+PROMPTS = np.array([[5, 17, 92, 41, 33, 88, 2, 11], [64, 3, 27, 9, 14, 0, 0, 0]])
+MASK = np.array([[1, 1, 1, 1, 1, 1, 1, 1], [1, 1, 1, 1, 1, 0, 0, 0]])
+
+
+def test_ddp_logit_parity():
+    """data_parallel_degree=2 x tp=2 must match tp=1 exactly: weights
+    replicate over ddp, batch + KV cache shard over it."""
+    ref_cfg = make_tiny_config(tpu=dict(output_logits=True))
+    sd = make_random_hf_state_dict(ref_cfg)
+    ref = TpuModelForCausalLM(None, ref_cfg).load(state_dict=sd)
+    ref_out = ref.generate(PROMPTS, MASK, max_new_tokens=8)
+
+    cfg = make_tiny_config(
+        tpu=dict(output_logits=True, tp_degree=2, data_parallel_degree=2)
+    )
+    app = TpuModelForCausalLM(None, cfg).load(state_dict=sd)
+    assert app.mesh.shape["ddp"] == 2
+    out = app.generate(PROMPTS, MASK, max_new_tokens=8)
+    np.testing.assert_array_equal(out.sequences, ref_out.sequences)
+    np.testing.assert_allclose(out.logits, ref_out.logits, atol=1e-4, rtol=1e-4)
+
+
+def test_ddp_with_attention_dp():
+    """ddp=2 x dp=2 x tp=4 on 8 virtual devices: both batch axes jointly
+    shard the cache (interleaved garbage per shard)."""
+    ref_cfg = make_tiny_config(tpu=dict(batch_size=4))
+    sd = make_random_hf_state_dict(ref_cfg)
+    ref = TpuModelForCausalLM(None, ref_cfg).load(state_dict=sd)
+    prompts = np.tile(PROMPTS, (2, 1))
+    mask = np.tile(MASK, (2, 1))
+    ref_out = ref.generate(prompts, mask, max_new_tokens=6)
+
+    cfg = make_tiny_config(
+        tpu=dict(
+            batch_size=4, tp_degree=4, attention_dp_degree=2,
+            data_parallel_degree=2, is_continuous_batching=True,
+        )
+    )
+    app = TpuModelForCausalLM(None, cfg).load(state_dict=sd)
+    out = app.generate(prompts, mask, max_new_tokens=6)
+    np.testing.assert_array_equal(out.sequences, ref_out.sequences)
+
+
+_WORKER = textwrap.dedent(
+    """
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+    import numpy as np
+
+    port, pid = sys.argv[1], int(sys.argv[2])
+    from neuronx_distributed_inference_tpu.parallel.mesh import (
+        build_mesh,
+        initialize_multihost,
+    )
+
+    initialize_multihost(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 4, jax.device_count()
+
+    mesh = build_mesh(tp_degree=2, ddp_degree=2)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # a ddp-sharded batch reduced across the "DCN" axis: every process must
+    # agree on the global sum
+    x = jax.device_put(
+        np.arange(8.0).reshape(4, 2),
+        NamedSharding(mesh, P(("ddp",), None)),
+    )
+
+    @jax.jit
+    def f(a):
+        return jax.lax.with_sharding_constraint(a, NamedSharding(mesh, P(None, None))).sum()
+
+    total = float(f(x))
+    assert total == 28.0, total
+    print(f"proc {pid} ok", flush=True)
+    """
+)
+
+
+def test_two_process_distributed_cpu(tmp_path):
+    """Real jax.distributed.initialize across 2 localhost processes, global
+    mesh with ddp spanning them (reference multi-node launcher handshake,
+    nxdi_distributed_launcher.py:29-80)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(port), str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env, text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=150)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out}"
+        assert f"proc {i} ok" in out
