@@ -101,6 +101,18 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--divergence-difference-tol", type=float, default=0.001)
     run.add_argument("--num-runs", type=int, default=5)
     run.add_argument("--skip-warmup", action="store_true")
+
+    # observability (reference inference_demo.py:329-334 + profiling)
+    run.add_argument("--input-capture-save-dir", default=None,
+                     help="directory for input snapshots / divergence capture")
+    run.add_argument("--capture-indices", nargs="+", default=None,
+                     help="dispatch indices to snapshot, or 'auto' to capture "
+                          "only when the accuracy check diverges")
+    run.add_argument("--profile-dir", default=None,
+                     help="capture a jax.profiler device trace of generation "
+                          "into this directory (view with tensorboard/XProf)")
+    run.add_argument("--debug-io", action="store_true",
+                     help="log every dispatch's input shapes and output tokens")
     return p
 
 
@@ -246,16 +258,42 @@ def run_inference(args) -> int:
         gen_kwargs.update(
             top_k=args.top_k, top_p=args.top_p, temperature=args.temperature
         )
-    if draft_app is not None:
-        from neuronx_distributed_inference_tpu.runtime.assisted import assisted_generate
+    if args.debug_io:
+        from neuronx_distributed_inference_tpu.utils.snapshot import enable_debug_logging
 
-        out = assisted_generate(
-            app, draft_app, input_ids, attention_mask,
-            max_new_tokens=args.max_new_tokens, eos_token_id=eos_token_id,
-            speculation_length=max(args.speculation_length, 2),
+        enable_debug_logging()
+    capture_hook = None
+    if args.input_capture_save_dir and args.capture_indices and args.capture_indices != ["auto"]:
+        from neuronx_distributed_inference_tpu.utils.snapshot import install_input_capture
+
+        capture_hook = install_input_capture(
+            app, args.input_capture_save_dir,
+            capture_indices=[int(i) for i in args.capture_indices],
         )
+
+    import contextlib
+
+    if args.profile_dir:
+        from neuronx_distributed_inference_tpu.utils.profiling import profile_capture
+
+        profile_ctx = profile_capture(args.profile_dir)
     else:
-        out = app.generate(input_ids, attention_mask, **gen_kwargs)
+        profile_ctx = contextlib.nullcontext()
+
+    with profile_ctx:
+        if draft_app is not None:
+            from neuronx_distributed_inference_tpu.runtime.assisted import assisted_generate
+
+            out = assisted_generate(
+                app, draft_app, input_ids, attention_mask,
+                max_new_tokens=args.max_new_tokens, eos_token_id=eos_token_id,
+                speculation_length=max(args.speculation_length, 2),
+            )
+        else:
+            out = app.generate(input_ids, attention_mask, **gen_kwargs)
+    if capture_hook is not None:
+        print(f"[inference_demo] captured {len(capture_hook.saved)} input snapshots",
+              file=sys.stderr)
     for i, seq in enumerate(out.sequences):
         text = tok.decode(seq, skip_special_tokens=True) if tok else seq.tolist()
         print(f"--- output {i} ---\n{text}")
@@ -266,10 +304,18 @@ def run_inference(args) -> int:
         import transformers
 
         hf = transformers.AutoModelForCausalLM.from_pretrained(args.model_path).eval().float()
+        capture_dir = None
+        if args.input_capture_save_dir and (
+            args.capture_indices == ["auto"] or not args.capture_indices
+        ):
+            # capture-on-divergence (reference --capture-indices auto,
+            # inference_demo.py:600-614)
+            capture_dir = args.input_capture_save_dir
         report = check_accuracy(
             app, input_ids, attention_mask, hf,
             max_new_tokens=args.max_new_tokens,
             divergence_tol=args.divergence_difference_tol,
+            capture_dir=capture_dir,
         )
         print(f"[accuracy] passed={report.passed} {report.message}")
         if not report.passed:

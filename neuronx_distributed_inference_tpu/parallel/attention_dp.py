@@ -31,7 +31,10 @@ def shard_decode_q(q):
 
 def unshard_attn_out(out):
     """(B, K, Hq, D) back to fully head-sharded for the O projection
-    (reference DP decode output gather)."""
+    (reference DP decode output gather). The batch STAYS sharded over ddp —
+    whole-model DP never combines batches inside a layer, so nothing crosses
+    DCN here (only the dp<->head all-to-all rides ICI)."""
+    from neuronx_distributed_inference_tpu.parallel.mesh import AXIS_DDP
     from neuronx_distributed_inference_tpu.parallel.sharding import TENSOR
 
-    return _constrain(out, P(None, None, TENSOR, None))
+    return _constrain(out, P(AXIS_DDP, None, TENSOR, None))

@@ -118,8 +118,16 @@ def build_mesh(
                 (1,) + shape[1:], (ddp_degree, 1, 1, 1, 1), devices=devices
             )
             return Mesh(dev_array, FULL_AXES)
-        except Exception:
-            pass
+        except Exception as e:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "hybrid ICI/DCN mesh construction failed (%s); falling back "
+                "to a flat device mesh — the ddp axis may land on ICI and "
+                "tensor-parallel collectives on DCN, which is SLOW. Check "
+                "that ddp_degree matches the slice count.",
+                e,
+            )
     try:
         dev_array = mesh_utils.create_device_mesh(shape, devices=devices)
     except Exception:

@@ -392,13 +392,15 @@ class TpuModelForCausalLM:
                 sequences=sequences, logits=logits, num_generated=gen.shape[1]
             )
 
+        eos_arr = np.atleast_1d(np.asarray(eos_token_id)).astype(np.int64)
+        eos_fill = int(eos_arr[0])
         tokens = np.asarray(jax.device_get(out.tokens))[:B]  # (B, 1)
         logits_acc: List[np.ndarray] = []
         if self.spec.output_logits:
             logits_acc.append(np.asarray(jax.device_get(out.logits))[:B])
         generated = [tokens[:, -1]]
         done = np.zeros(B, bool)
-        done |= generated[-1] == eos_token_id
+        done |= np.isin(generated[-1], eos_arr)
         last = generated[-1][:, None].astype(np.int32)
         pos_limit = min(tc.seq_len, self.token_generation_model.buckets[-1])
         while remaining > 0 and not done.all():
@@ -432,8 +434,8 @@ class TpuModelForCausalLM:
                 logits_acc.append(np.asarray(jax.device_get(logits_c))[:B, :take])
             for j in range(take):
                 step_tokens = tokens_c[:, j]
-                step_tokens = np.where(done, eos_token_id, step_tokens)
-                done |= step_tokens == eos_token_id
+                step_tokens = np.where(done, eos_fill, step_tokens)
+                done |= np.isin(step_tokens, eos_arr)
                 generated.append(step_tokens)
             last = tokens_c[:, take - 1 : take].astype(np.int32)
             pos = pos + take

@@ -73,8 +73,11 @@ def assisted_generate(
     first = np.asarray(jax.device_get(t_out.tokens))[:B, -1]
     collected = [[int(first[b])] for b in range(B)]
     done = np.zeros(B, bool)
-    if eos_token_id is not None:
-        done |= first == eos_token_id
+    eos_arr = (
+        np.atleast_1d(np.asarray(eos_token_id)) if eos_token_id is not None else None
+    )
+    if eos_arr is not None:
+        done |= np.isin(first, eos_arr)
     pos = ctx_lens.copy()  # position of the token in `last`
     last = first.astype(np.int32)
 
@@ -111,9 +114,11 @@ def assisted_generate(
             if done[b]:
                 continue
             row = greedy[b, : counts[b]].tolist()
-            if eos_token_id is not None and eos_token_id in row:
-                row = row[: row.index(eos_token_id) + 1]
-                done[b] = True
+            if eos_arr is not None:
+                hits = [i for i, t in enumerate(row) if t in eos_arr]
+                if hits:
+                    row = row[: hits[0] + 1]
+                    done[b] = True
             collected[b].extend(row)
             if len(collected[b]) >= max_new_tokens:
                 done[b] = True

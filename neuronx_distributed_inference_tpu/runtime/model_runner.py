@@ -35,6 +35,7 @@ from neuronx_distributed_inference_tpu.models.base import (
 from neuronx_distributed_inference_tpu.modules.autobucketing import get_target_bucket
 from neuronx_distributed_inference_tpu.modules.kvcache import KVCache, cache_spec
 from neuronx_distributed_inference_tpu.modules.sampling import prepare_sampling_params
+from neuronx_distributed_inference_tpu.utils.snapshot import debug_log_step
 
 TAG_CONTEXT_ENCODING = "context_encoding_model"
 TAG_TOKEN_GENERATION = "token_generation_model"
@@ -165,7 +166,9 @@ class SubModelRunner:
         Runs under the mesh context so in-graph sharding constraints
         (CP/SP hints) resolve against the right axes."""
         with jax.set_mesh(self.mesh):
-            return self._fn(params, cache, inputs, rng)
+            out = self._fn(params, cache, inputs, rng)
+        debug_log_step(self.tag, inputs, out)
+        return out
 
     def decode_chunk(
         self,

@@ -148,9 +148,15 @@ def check_accuracy(
     hf_model,
     max_new_tokens: int = 32,
     divergence_tol: float = DEFAULT_DIVERGENCE_TOL,
+    capture_dir: Optional[str] = None,
 ) -> AccuracyReport:
     """End-to-end accuracy gate: greedy token match + logit match vs an HF
-    golden (reference inference_demo accuracy-check flow, :458-614)."""
+    golden (reference inference_demo accuracy-check flow, :458-614).
+
+    ``capture_dir``: on divergence, re-run generation with input capture
+    installed and save every dispatch's inputs plus the actual/golden logits
+    and divergence index — a self-contained offline repro (reference
+    auto-capture, inference_demo.py:600-614 + utils/snapshot.py)."""
     out = app.generate(input_ids, attention_mask, max_new_tokens=max_new_tokens)
     golden_seq, golden_logits = get_generate_outputs_hf(
         hf_model, input_ids, attention_mask, out.num_generated
@@ -162,5 +168,42 @@ def check_accuracy(
             out.logits, golden_logits, divergence_tol, raise_on_fail=False
         )
         if not logit_report.passed:
-            return logit_report
+            report = logit_report
+    if not report.passed and capture_dir:
+        _capture_divergence(
+            app, input_ids, attention_mask, max_new_tokens, out, golden_seq,
+            golden_logits, report, capture_dir,
+        )
     return report
+
+
+def _capture_divergence(
+    app, input_ids, attention_mask, max_new_tokens, out, golden_seq,
+    golden_logits, report, capture_dir,
+):
+    import os
+
+    from neuronx_distributed_inference_tpu.utils.snapshot import (
+        install_input_capture,
+        uninstall_input_capture,
+    )
+
+    os.makedirs(capture_dir, exist_ok=True)
+    hook = install_input_capture(app, capture_dir)
+    try:
+        app.generate(input_ids, attention_mask, max_new_tokens=max_new_tokens)
+    finally:
+        uninstall_input_capture(app)
+    np.savez(
+        os.path.join(capture_dir, "divergence.npz"),
+        input_ids=np.asarray(input_ids),
+        attention_mask=np.asarray(attention_mask),
+        actual_sequences=np.asarray(out.sequences),
+        golden_sequences=np.asarray(golden_seq),
+        actual_logits=np.asarray(out.logits) if out.logits is not None else np.zeros(0),
+        golden_logits=np.asarray(golden_logits),
+        divergence_index=np.int64(
+            -1 if report.first_divergence_index is None else report.first_divergence_index
+        ),
+    )
+    report.message += f" [captured {len(hook.saved)} dispatch inputs -> {capture_dir}]"

@@ -55,7 +55,9 @@ class HuggingFaceGenerationAdapter:
     def __init__(self, app, tokenizer=None):
         self.app = app
         self.tokenizer = tokenizer
-        self.generation_config = None  # set via kwargs or generate()
+        # HF-idiomatic default config: honored as the lowest-precedence layer
+        # in _resolve (adapter.generation_config = GenerationConfig(...))
+        self.generation_config = None
 
     # --- helpers ---------------------------------------------------------
 
@@ -81,6 +83,8 @@ class HuggingFaceGenerationAdapter:
         """GenerationConfig + kwargs -> flat dict (kwargs win, reference
         generation-config precedence)."""
         merged = {}
+        if self.generation_config is not None and generation_config is None:
+            generation_config = self.generation_config
         if generation_config is not None:
             src = (
                 generation_config.to_dict()
@@ -119,14 +123,25 @@ class HuggingFaceGenerationAdapter:
         max_new = g.get("max_new_tokens")
         if max_new is None and g.get("max_length"):
             max_new = int(g["max_length"]) - ids.shape[1]
+            if max_new <= 0:
+                # mirrors transformers' hard error instead of silently
+                # returning the prompt (GenerationConfig defaults max_length=20)
+                raise ValueError(
+                    f"max_length ({g['max_length']}) <= prompt length "
+                    f"({ids.shape[1]}); set max_new_tokens or a larger max_length"
+                )
         if max_new is None:
             max_new = 32
         eos = g.get("eos_token_id")
-        if isinstance(eos, (list, tuple)):
-            eos = eos[0] if eos else None
+        if isinstance(eos, (list, tuple)) and not eos:
+            eos = None
         pad = g.get("pad_token_id")
         if pad is None:
-            pad = eos if eos is not None else 0
+            pad = (
+                (eos[0] if isinstance(eos, (list, tuple)) else eos)
+                if eos is not None
+                else 0
+            )
         do_sample = bool(g.get("do_sample", False))
         sample_kwargs = {}
         if do_sample:
@@ -176,9 +191,11 @@ class HuggingFaceGenerationAdapter:
             )
 
         gen = out.sequences[:, run_ids.shape[1]:]
-        # post-EOS positions -> pad token (reference finalization)
+        # post-EOS positions -> pad token (reference finalization); eos may be
+        # a LIST (llama-3 eos + eot) — any member terminates
         if eos is not None:
-            done = np.cumsum(gen == eos, axis=1) > 0
+            eos_arr = np.atleast_1d(np.asarray(eos))
+            done = np.cumsum(np.isin(gen, eos_arr), axis=1) > 0
             after_eos = np.roll(done, 1, axis=1)
             after_eos[:, 0] = False
             gen = np.where(after_eos, pad, gen)

@@ -94,3 +94,26 @@ def test_cli_assisted_decoding(tiny_checkpoint, tmp_path):
         ]
     )
     assert rc == 0
+
+
+def test_cli_input_capture_and_profile(tiny_checkpoint, tmp_path):
+    """--input-capture-save-dir with explicit indices + --profile-dir."""
+    import glob
+
+    from neuronx_distributed_inference_tpu.inference_demo import main
+
+    cap = str(tmp_path / "caps")
+    prof = str(tmp_path / "prof")
+    rc = main(
+        [
+            "--model-type", "llama", "run",
+            "--model-path", tiny_checkpoint,
+            "--batch-size", "1", "--seq-len", "64", "--dtype", "float32",
+            "--max-new-tokens", "4", "--skip-warmup",
+            "--input-capture-save-dir", cap, "--capture-indices", "0", "1",
+            "--profile-dir", prof,
+        ]
+    )
+    assert rc == 0
+    assert len(glob.glob(os.path.join(cap, "*.npz"))) == 2
+    assert glob.glob(os.path.join(prof, "**", "*.xplane.pb"), recursive=True)

@@ -122,3 +122,37 @@ def test_unsupported_modes_raise(adapter):
         ad.generate(input_ids=PROMPT, attention_mask=MASK, num_beams=4)
     with pytest.raises(NotImplementedError):
         ad.generate(input_ids=PROMPT, attention_mask=MASK, num_return_sequences=2)
+
+
+def test_eos_token_id_list(adapter):
+    """llama-3-style multi-EOS lists terminate on ANY member (r2 review)."""
+    ad, app = adapter
+    plain = app.generate(PROMPT, MASK, max_new_tokens=8).sequences
+    second_eos = int(plain[0, 8 + 2])  # 3rd generated token of row 0
+    out = ad.generate(
+        input_ids=PROMPT, attention_mask=MASK, max_new_tokens=8,
+        eos_token_id=[123456, second_eos], pad_token_id=99,
+    )
+    row = np.asarray(out[0, 8:])
+    hit = np.where(row == second_eos)[0]
+    assert hit.size and hit[0] <= 2
+    assert (row[hit[0] + 1 :] == 99).all()
+
+
+def test_max_length_too_short_raises(adapter):
+    ad, _ = adapter
+    with pytest.raises(ValueError, match="max_length"):
+        ad.generate(
+            input_ids=PROMPT, attention_mask=MASK,
+            generation_config=transformers.GenerationConfig(max_length=4),
+        )
+
+
+def test_adapter_generation_config_attribute(adapter):
+    ad, _ = adapter
+    ad.generation_config = transformers.GenerationConfig(max_new_tokens=3)
+    try:
+        out = ad.generate(input_ids=PROMPT, attention_mask=MASK)
+        assert out.shape == (2, 8 + 3)
+    finally:
+        ad.generation_config = None

@@ -1,0 +1,140 @@
+"""Observability (VERDICT r1 next #10): input snapshots, divergence
+auto-capture with offline replay, profiler capture, debug IO logging."""
+
+import glob
+import logging
+import os
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_random_hf_state_dict, make_tiny_config
+
+from neuronx_distributed_inference_tpu.runtime.application import TpuModelForCausalLM
+from neuronx_distributed_inference_tpu.utils.snapshot import (
+    enable_debug_logging,
+    install_input_capture,
+    load_inputs_snapshot,
+    replay_snapshot,
+    save_inputs_snapshot,
+    uninstall_input_capture,
+)
+
+PROMPT = np.array([[5, 17, 92, 41, 33, 88, 2, 11], [64, 3, 27, 9, 14, 0, 0, 0]])
+MASK = np.array([[1, 1, 1, 1, 1, 1, 1, 1], [1, 1, 1, 1, 1, 0, 0, 0]])
+
+
+@pytest.fixture(scope="module")
+def app():
+    cfg = make_tiny_config()
+    a = TpuModelForCausalLM(None, cfg)
+    a.load(state_dict=make_random_hf_state_dict(cfg))
+    return a
+
+
+def test_snapshot_round_trip(tmp_path, app):
+    inputs, _ = app.context_encoding_model.prepare(
+        PROMPT, MASK, np.tile(np.arange(8, dtype=np.int32), (2, 1)),
+        np.arange(2, dtype=np.int32),
+    )
+    path = str(tmp_path / "snap.npz")
+    save_inputs_snapshot(inputs, path, step=3, tag="context_encoding_model")
+    loaded, meta = load_inputs_snapshot(path)
+    assert meta["step"] == 3 and meta["tag"] == "context_encoding_model"
+    np.testing.assert_array_equal(np.asarray(loaded.input_ids), np.asarray(inputs.input_ids))
+    assert loaded.slot_mapping is None  # absent fields stay absent
+
+
+def test_capture_and_replay(tmp_path, app):
+    """Captured dispatches replay offline to the same tokens (the snapshot is
+    a self-contained repro; reference re-feeding captured inputs)."""
+    hook = install_input_capture(app, str(tmp_path / "caps"))
+    try:
+        out = app.generate(PROMPT, MASK, max_new_tokens=6)
+    finally:
+        uninstall_input_capture(app)
+    assert hook.saved, "no dispatches captured"
+    # replay the CTE snapshot: first token must match the original run
+    cte = [p for p in hook.saved if "context_encoding" in p][0]
+    replayed = replay_snapshot(app, cte)
+    first = np.asarray(replayed.tokens)[:2, -1]
+    np.testing.assert_array_equal(first, out.sequences[:, 8])
+    # replay a decode-chunk snapshot end-to-end (runs without error and
+    # produces the chunk's tokens)
+    chunks = [p for p in hook.saved if p.endswith(".chunk.npz")]
+    assert chunks, "decode chunks not captured"
+    tokens, _, _ = replay_snapshot(app, chunks[0])
+    assert np.asarray(tokens).shape[0] >= 2
+
+
+def test_capture_indices_filter(tmp_path, app):
+    hook = install_input_capture(app, str(tmp_path / "caps2"), capture_indices=[0])
+    try:
+        app.generate(PROMPT, MASK, max_new_tokens=6)
+    finally:
+        uninstall_input_capture(app)
+    assert len(hook.saved) == 1 and "00000_" in hook.saved[0]
+
+
+def test_divergence_auto_capture(tmp_path):
+    """A failing logit check captures every dispatch plus the divergence
+    artifacts (reference inference_demo.py:600-614 auto-capture)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from neuronx_distributed_inference_tpu.utils.accuracy import check_accuracy
+
+    cfg = make_tiny_config(tpu=dict(output_logits=True))
+    sd = make_random_hf_state_dict(cfg)
+    app = TpuModelForCausalLM(None, cfg)
+    app.load(state_dict=sd)
+
+    hf_config = transformers.LlamaConfig(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_hidden_layers,
+        num_attention_heads=cfg.num_attention_heads,
+        num_key_value_heads=cfg.num_key_value_heads,
+        rms_norm_eps=cfg.rms_norm_eps, rope_theta=cfg.rope_theta,
+        max_position_embeddings=256, tie_word_embeddings=False,
+        eos_token_id=None, bos_token_id=None,
+    )
+    torch.manual_seed(99)  # DIFFERENT weights -> guaranteed divergence
+    hf = transformers.LlamaForCausalLM(hf_config).eval().float()
+
+    cap = str(tmp_path / "divergence")
+    report = check_accuracy(
+        app, PROMPT, MASK, hf, max_new_tokens=4, capture_dir=cap
+    )
+    assert not report.passed
+    assert os.path.exists(os.path.join(cap, "divergence.npz"))
+    with np.load(os.path.join(cap, "divergence.npz")) as z:
+        assert z["divergence_index"] >= 0 or z["actual_sequences"].size
+    assert glob.glob(os.path.join(cap, "*_context_encoding_model.npz"))
+    assert "captured" in report.message
+
+
+def test_debug_logging_smoke(app, caplog):
+    enable_debug_logging()
+    try:
+        with caplog.at_level(logging.DEBUG, logger="nxdi_tpu.debug"):
+            app.generate(PROMPT, MASK, max_new_tokens=2)
+        assert any("context_encoding" in r.message for r in caplog.records)
+    finally:
+        logging.getLogger("nxdi_tpu.debug").setLevel(logging.WARNING)
+
+
+def test_profiler_capture(tmp_path, app):
+    """jax.profiler trace capture + xplane summary (reference
+    utils/profiling.py:33-66)."""
+    from neuronx_distributed_inference_tpu.utils.profiling import profile_fn
+
+    summary = profile_fn(
+        lambda: app.generate(PROMPT, MASK, max_new_tokens=2).sequences,
+        str(tmp_path / "prof"), n_warmup=1, n_profile=1,
+    )
+    assert "ops" in summary
+    # the trace directory must exist with an xplane artifact
+    assert glob.glob(str(tmp_path / "prof" / "**" / "*.xplane.pb"), recursive=True) or (
+        "trace_dir" in summary or summary["ops"]
+    )
