@@ -106,6 +106,9 @@ class ModelSpec:
     attention_scaling: float = 1.0
     # decoder norm flavor: "rmsnorm" (llama family) or "layernorm" (DBRX)
     norm_type: str = "rmsnorm"
+    # ring-buffer KV cache bounded to the sliding window (cache holds W slots;
+    # reference kv_cache_manager.py:194-198 bounds the cache to window size)
+    bounded_window: Optional[int] = None
     # heterogeneous layer stacks: None = one uniform group (spec-level
     # sliding_window / attention_chunk_size apply)
     layer_groups: Optional[Tuple[LayerGroupSpec, ...]] = None
@@ -192,6 +195,16 @@ def decoder_layer(
     # write-then-attend: scatter new KV into this layer's cache first
     # (reference updates via kv_mgr.update_cache per layer, model_base.py:1449)
     is_block = block_inputs is not None
+    bounded = spec.bounded_window is not None and not is_block
+    if bounded and phase != PHASE_CONTEXT_ENCODING:
+        # ring cache: read the PRIOR window state BEFORE this chunk's writes
+        # land (prior/active decomposition — reference compute_for_token_gen's
+        # prior/active split, attention_base.py:1909; in-chunk writes may
+        # overwrite slots earlier in-chunk queries still need)
+        W = spec.bounded_window
+        k_prior, v_prior = read_cache_at_layer(
+            k_cache, v_cache, layer_idx, q.shape[0], W
+        )
     if is_block:
         from neuronx_distributed_inference_tpu.modules.block_kvcache import (
             read_block_cache_at_layer,
@@ -203,8 +216,16 @@ def decoder_layer(
             k_cache, v_cache, k, v, layer_idx, slot_mapping
         )
     else:
+        if bounded:
+            # slot = position mod W; sentinel (negative) positions map out of
+            # range and are DROPPED (padded prompt tails must not wrap into
+            # live ring slots)
+            W = spec.bounded_window
+            write_positions = jnp.where(positions >= 0, positions % W, W)
+        else:
+            write_positions = positions
         k_cache, v_cache = update_cache_at_layer(
-            k_cache, v_cache, k, v, layer_idx, slot_ids, positions
+            k_cache, v_cache, k, v, layer_idx, slot_ids, write_positions
         )
 
     sink = layer_params["self_attn"].get("sink", {}).get("weight") if aspec.has_sink else None
@@ -257,6 +278,30 @@ def decoder_layer(
         else:
             k_r, v_r = read_block_cache_at_layer(k_cache, v_cache, layer_idx, block_table)
             attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
+    elif bounded:
+        # ring decode/prefill-chunk attention: softmax over [prior ring slots
+        # | in-flight chunk] with masks derived from absolute positions
+        # (reference windowed TKG mask over a bounded cache,
+        # model_base.py:319-340 + kv_cache_manager.py:194-198)
+        W = spec.bounded_window
+        B, S = q.shape[0], q.shape[1]
+        p = positions  # (B, S) absolute (sentinel-negative for padded)
+        head = p[:, :1] - 1  # (B, 1) last pre-chunk position
+        slots = jnp.arange(W, dtype=p.dtype)[None, :]
+        # position stored in ring slot s before this chunk wrote anything
+        slot_pos = head - ((head - slots) % W)  # (B, W)
+        qp = p[:, None, :, None]  # (B, 1, S, 1)
+        prior_ok = (
+            (slot_pos[:, None, None, :] >= 0)
+            & (slot_pos[:, None, None, :] > qp - W)
+            & (qp >= 0)
+        )  # (B, 1, S, W)
+        kp = p[:, None, None, :]  # in-flight token positions (B, 1, 1, S)
+        active_ok = (kp >= 0) & (kp <= qp) & (kp > qp - W)
+        ring_mask = jnp.concatenate([prior_ok, active_ok], axis=-1)
+        keys = jnp.concatenate([k_prior.astype(k.dtype), k], axis=1)
+        vals = jnp.concatenate([v_prior.astype(v.dtype), v], axis=1)
+        attn_out = attention_decode(q, keys, vals, ring_mask, aspec, sink=sink)
     else:
         B = q.shape[0]
         bucket = mask.shape[-1]
@@ -602,6 +647,7 @@ def decode_steps(
     mlp_fn: Callable = gated_mlp,
     layer_fn: Optional[Callable] = None,
     adapter_ids: Optional[jax.Array] = None,
+    unroll: int = 1,
 ):
     """Run ``num_steps`` whole decode iterations in ONE compiled program.
 
@@ -644,11 +690,11 @@ def decode_steps(
         step_rngs = None
         (cache, last, pos), (tokens, logits) = jax.lax.scan(
             lambda c, _: body(c, None), (cache, last_tokens, positions), None,
-            length=num_steps,
+            length=num_steps, unroll=unroll,
         )
     else:
         (cache, last, pos), (tokens, logits) = jax.lax.scan(
-            body, (cache, last_tokens, positions), step_rngs
+            body, (cache, last_tokens, positions), step_rngs, unroll=unroll
         )
     tokens = jnp.swapaxes(tokens, 0, 1)  # (B, num_steps)
     out_logits = jnp.swapaxes(logits, 0, 1) if spec.output_logits else None

@@ -23,6 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+import dataclasses
+
 from neuronx_distributed_inference_tpu.config import InferenceConfig, to_dtype
 from neuronx_distributed_inference_tpu.models.base import ModelSpec
 from neuronx_distributed_inference_tpu.modules.attention import AttnSpec
@@ -81,7 +83,7 @@ class DecoderModelBuilder:
         cfg = self.config
         tc = cfg.tpu_config
         ods = tc.on_device_sampling_config
-        return ModelSpec(
+        spec = ModelSpec(
             num_layers=cfg.num_hidden_layers,
             hidden_size=cfg.hidden_size,
             vocab_size=cfg.vocab_size,
@@ -104,6 +106,25 @@ class DecoderModelBuilder:
             attention_scaling=rope_attention_scaling(cfg),
             norm_type=self.norm_type,
         )
+        return self._finalize_bounded(spec)
+
+    def _finalize_bounded(self, spec: ModelSpec) -> ModelSpec:
+        """Bound the KV cache to the sliding window (ring buffer) when the
+        layout supports it (reference kv_cache_manager.py:194-198). Feature
+        combinations that assume position==slot keep the full-length cache."""
+        tc = self.config.tpu_config
+        if (
+            spec.sliding_window
+            and spec.layer_groups is None
+            and spec.sliding_window < tc.seq_len
+            and not tc.is_block_kv_layout
+            and tc.cp_degree == 1
+            and tc.attention_dp_degree == 1
+            and tc.data_parallel_degree == 1
+            and not tc.enable_fused_speculation
+        ):
+            return dataclasses.replace(spec, bounded_window=spec.sliding_window)
+        return spec
 
     # ---- param pytree ----------------------------------------------------
 
@@ -400,10 +421,12 @@ class DecoderModelBuilder:
         dt = to_dtype(tc.kv_cache_dtype or tc.dtype)
         kv_batch = tc.kv_cache_batch_size or tc.max_batch_size
         batch_shards = tc.attention_dp_degree * tc.data_parallel_degree
+        # ring-bounded caches hold only W slots (see _finalize_bounded)
+        cache_len = self.model_spec().bounded_window or tc.seq_len
         cache = init_cache(
             self.config.num_hidden_layers,
             kv_batch,
-            tc.seq_len,
+            cache_len,
             self.gqa.kv_heads,
             self.head_dim,
             dtype=dt,

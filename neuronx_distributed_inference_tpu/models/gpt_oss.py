@@ -90,7 +90,9 @@ class GptOssModelBuilder(DecoderModelBuilder):
             )
             for s, e, t in self.runs
         )
-        return dataclasses.replace(spec, layer_groups=groups, sliding_window=None)
+        return dataclasses.replace(
+            spec, layer_groups=groups, sliding_window=None, bounded_window=None
+        )
 
     def moe_spec(self) -> MoESpec:
         cfg = self.config

@@ -43,4 +43,4 @@ class MistralModelBuilder(DecoderModelBuilder):
         sw = getattr(self.config, "sliding_window", None)
         if sw and spec.sliding_window is None:
             spec = dataclasses.replace(spec, sliding_window=sw)
-        return spec
+        return self._finalize_bounded(spec)

@@ -110,6 +110,9 @@ class TpuModelForCausalLM:
 
         cte_buckets = autobucketing.generate_context_encoding_buckets(tc)
         tkg_buckets = autobucketing.generate_token_generation_buckets(tc)
+        if self.spec.bounded_window:
+            # ring cache: exactly one decode shape (the W-slot window)
+            tkg_buckets = [self.spec.bounded_window]
         if tc.is_block_kv_layout:
             # block-table gathers need bucket % block_size == 0
             tkg_buckets = sorted(
@@ -267,6 +270,91 @@ class TpuModelForCausalLM:
         from construction (reference deterministic flag, sampling.py)."""
         self._rng_key, self._call_key = jax.random.split(self._rng_key)
 
+    def _windowed_prefill(self, input_ids, attention_mask, seq_ids, sampling_params, adapter_ids):
+        """Prefill a prompt LONGER than one context program in windows
+        (reference windowed context encoding, model_base.py:957-1010).
+
+        Chunk 0 runs through the CTE program; every later chunk is a
+        multi-token PHASE_TOKEN_GENERATION pass attending the populated cache
+        (the same prior-KV pattern chunked prefill uses on the paged cache).
+        Activation memory stays bounded by the chunk size instead of S².
+        Returns (first_tokens (B,1) device array, first_logits (B,1,V)|None).
+        """
+        tc = self.config.tpu_config
+        B, S_in = input_ids.shape
+        W = self.spec.bounded_window
+        C = self.context_encoding_model.buckets[-1]
+        if W:
+            C = min(C, W)  # ring slots must stay distinct within one chunk
+        ctx_lens = attention_mask.sum(axis=1).astype(np.int64)
+        first_tok = np.zeros((B,), np.int64)
+        first_logits = (
+            np.zeros((B, 1, self.spec.vocab_size), np.float32)
+            if self.spec.output_logits
+            else None
+        )
+
+        # --- chunk 0: CTE ---
+        n0 = min(C, S_in)
+        pos0 = np.tile(np.arange(n0, dtype=np.int32), (B, 1))
+        inputs, _ = self.context_encoding_model.prepare(
+            input_ids[:, :n0], attention_mask[:, :n0], pos0, seq_ids,
+            sampling_params, adapter_ids=adapter_ids,
+        )
+        out = self.context_encoding_model(
+            self.params, self.kv_cache, inputs, self._sample_key(1_000_000)
+        )
+        self.kv_cache = out.cache
+        rows = ctx_lens <= n0
+        if rows.any():
+            t0 = np.asarray(jax.device_get(out.tokens))[:B]
+            first_tok[rows] = t0[rows, -1]
+            if first_logits is not None:
+                l0 = np.asarray(jax.device_get(out.logits))[:B]
+                first_logits[rows, 0] = l0[rows, -1]
+
+        # --- later chunks: multi-token prior-KV passes ---
+        sentinel = -10 * (W or tc.seq_len) - 16
+        start = n0
+        step = 1
+        while start < S_in:
+            end = min(start + C, S_in)
+            n = end - start
+            ids = input_ids[:, start:end]
+            pos = np.tile(np.arange(start, end, dtype=np.int32), (B, 1))
+            valid = pos < ctx_lens[:, None]
+            if W:
+                # drop padded-row writes instead of wrapping onto live slots
+                pos = np.where(valid, pos, sentinel)
+            width = W or autobucketing.get_target_bucket(
+                self.token_generation_model.buckets, end
+            )
+            # full-width carrier: per-token causal bounds make junk columns
+            # unreachable for valid queries; junk slots are overwritten
+            # (write-then-attend) before any query can see them
+            mask = np.ones((B, width), np.int32)
+            inputs, _ = self.token_generation_model.prepare(
+                ids, mask, pos, seq_ids, sampling_params, adapter_ids=adapter_ids
+            )
+            # prefill chunks draw from their own key domain so decode chunks
+            # (step 1, 2, ...) never reuse a prefill key
+            out = self.token_generation_model(
+                self.params, self.kv_cache, inputs, self._sample_key(1_000_000 + step)
+            )
+            self.kv_cache = out.cache
+            rows = (ctx_lens > start) & (ctx_lens <= end)
+            if rows.any():
+                toks = np.asarray(jax.device_get(out.tokens))[:B]
+                idx = np.clip(ctx_lens - 1 - start, 0, n - 1)
+                first_tok[rows] = toks[rows, idx[rows]]
+                if first_logits is not None:
+                    lg = np.asarray(jax.device_get(out.logits))[:B]
+                    first_logits[rows, 0] = lg[rows, idx[rows]]
+            start = end
+            step += 1
+        fl = jnp.asarray(first_logits) if first_logits is not None else None
+        return jnp.asarray(first_tok[:, None], jnp.int32), fl
+
     # ---- generation loop -------------------------------------------------
 
     def generate(
@@ -302,11 +390,22 @@ class TpuModelForCausalLM:
         sampling_params = prepare_sampling_params(B, top_k, top_p, temperature)
         validate_sampling_params(sampling_params, tc.max_topk)
 
-        if S_in > tc.max_context_length:
+        windowed = S_in > tc.max_context_length or (
+            self.spec.bounded_window and S_in > self.spec.bounded_window
+        )
+        if S_in > tc.seq_len:
             raise ValueError(
-                f"prompt length {S_in} exceeds max_context_length "
-                f"{tc.max_context_length} (reference: bucket overflow, "
-                f"autobucketing get_target_bucket)"
+                f"prompt length {S_in} exceeds seq_len {tc.seq_len}"
+            )
+        if (
+            windowed
+            and not self.spec.bounded_window
+            and S_in > self.token_generation_model.buckets[-1]
+        ):
+            raise ValueError(
+                f"prompt length {S_in} exceeds the largest token-generation "
+                f"bucket ({self.token_generation_model.buckets[-1]}) needed "
+                f"for windowed prefill; raise token_generation_buckets/seq_len"
             )
         max_total = min(tc.seq_len, S_in + max_new_tokens)
         n_new = max_total - S_in
@@ -315,15 +414,27 @@ class TpuModelForCausalLM:
 
         adapter_ids = self.resolve_adapter_ids(lora_adapter_names)
         ctx_lens = attention_mask.sum(axis=1).astype(np.int32)
-        # CTE: positions are slot indices [0, S) — padded slots write into the
-        # masked tail (reference fill_prefix semantics, kvcache/utils.py)
-        position_ids = np.tile(np.arange(S_in, dtype=np.int32), (B, 1))
-        inputs, _ = self.context_encoding_model.prepare(
-            input_ids, attention_mask, position_ids, seq_ids, sampling_params,
-            adapter_ids=adapter_ids,
-        )
-        out = self.context_encoding_model(self.params, self.kv_cache, inputs, self._sample_key(0))
-        self.kv_cache = out.cache
+        if windowed:
+            # long-prompt prefill in windows (reference windowed context
+            # encoding, model_base.py:957-1010): chunk 0 through the CTE
+            # program, later chunks as multi-token prior-KV passes
+            first_tokens, first_logits = self._windowed_prefill(
+                input_ids, attention_mask, seq_ids, sampling_params, adapter_ids
+            )
+        else:
+            # CTE: positions are slot indices [0, S) — padded slots write into
+            # the masked tail (reference fill_prefix semantics, kvcache/utils.py)
+            position_ids = np.tile(np.arange(S_in, dtype=np.int32), (B, 1))
+            inputs, _ = self.context_encoding_model.prepare(
+                input_ids, attention_mask, position_ids, seq_ids, sampling_params,
+                adapter_ids=adapter_ids,
+            )
+            out = self.context_encoding_model(
+                self.params, self.kv_cache, inputs, self._sample_key(0)
+            )
+            self.kv_cache = out.cache
+            first_tokens = out.tokens[:B]  # device (B, 1)
+            first_logits = out.logits[:B] if self.spec.output_logits else None
         pos = ctx_lens.copy()  # next write position per row
         remaining = n_new - 1
         step = 1
@@ -338,12 +449,16 @@ class TpuModelForCausalLM:
         if eos_token_id is None:
             # chunks are sliced to the true batch B on device: the CTE and
             # TKG runners may be compiled at different batch sizes
-            token_chunks = [out.tokens[:B]]  # device (B, 1)
-            logit_chunks = [out.logits[:B]] if self.spec.output_logits else []
-            last = out.tokens[:B, -1:].astype(jnp.int32)
+            token_chunks = [first_tokens]  # device (B, 1)
+            logit_chunks = [first_logits] if self.spec.output_logits else []
+            last = first_tokens[:, -1:].astype(jnp.int32)
             # positions must stay inside the largest compiled TKG bucket as
             # well as the cache window — pow2 rounding must not push past it
-            pos_limit = min(tc.seq_len, self.token_generation_model.buckets[-1])
+            # (a ring cache bounds slots, not positions)
+            if self.spec.bounded_window:
+                pos_limit = tc.seq_len
+            else:
+                pos_limit = min(tc.seq_len, self.token_generation_model.buckets[-1])
             while remaining > 0:
                 headroom = pos_limit - int(pos.max())
                 if headroom < 1:
@@ -354,7 +469,7 @@ class TpuModelForCausalLM:
                     )
                 chunk = _pick_chunk(remaining, False, headroom)
                 take = min(chunk, remaining)
-                bucket = autobucketing.get_target_bucket(
+                bucket = self.spec.bounded_window or autobucketing.get_target_bucket(
                     self.token_generation_model.buckets, int(pos.max()) + chunk
                 )
                 tokens_c, logits_c, cache = self.token_generation_model.decode_chunk(
@@ -394,15 +509,18 @@ class TpuModelForCausalLM:
 
         eos_arr = np.atleast_1d(np.asarray(eos_token_id)).astype(np.int64)
         eos_fill = int(eos_arr[0])
-        tokens = np.asarray(jax.device_get(out.tokens))[:B]  # (B, 1)
+        tokens = np.asarray(jax.device_get(first_tokens))  # (B, 1)
         logits_acc: List[np.ndarray] = []
         if self.spec.output_logits:
-            logits_acc.append(np.asarray(jax.device_get(out.logits))[:B])
+            logits_acc.append(np.asarray(jax.device_get(first_logits)))
         generated = [tokens[:, -1]]
         done = np.zeros(B, bool)
         done |= np.isin(generated[-1], eos_arr)
         last = generated[-1][:, None].astype(np.int32)
-        pos_limit = min(tc.seq_len, self.token_generation_model.buckets[-1])
+        if self.spec.bounded_window:
+            pos_limit = tc.seq_len
+        else:
+            pos_limit = min(tc.seq_len, self.token_generation_model.buckets[-1])
         while remaining > 0 and not done.all():
             headroom = pos_limit - int(pos.max())
             if headroom < 1:
@@ -413,7 +531,7 @@ class TpuModelForCausalLM:
                 )
             chunk = _pick_chunk(remaining, True, headroom)
             take = min(chunk, remaining)
-            bucket = autobucketing.get_target_bucket(
+            bucket = self.spec.bounded_window or autobucketing.get_target_bucket(
                 self.token_generation_model.buckets, int(pos.max()) + chunk
             )
             tokens_c, logits_c, cache = self.token_generation_model.decode_chunk(

@@ -47,6 +47,12 @@ def assisted_generate(
     k = speculation_length or max(target.config.tpu_config.speculation_length, 2)
     if k < 2:
         raise ValueError("speculation_length must be >= 2")
+    if target.spec.bounded_window or draft.spec.bounded_window:
+        raise NotImplementedError(
+            "assisted decoding over a ring-bounded sliding-window cache is "
+            "not implemented (rejected speculative writes would corrupt ring "
+            "slots); disable the window bound or use plain decoding"
+        )
     tc = target.config.tpu_config
     input_ids = np.asarray(input_ids)
     B, S_in = input_ids.shape

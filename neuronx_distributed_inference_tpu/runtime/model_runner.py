@@ -19,6 +19,7 @@ token_generation, ...; reference model_wrapper.py:32-37). Responsibilities:
 
 from __future__ import annotations
 
+import os
 from functools import partial
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -120,21 +121,32 @@ class SubModelRunner:
     ) -> Tuple[StepInputs, int]:
         """Pad to (compiled batch, bucket) and build StepInputs."""
         B, S = input_ids.shape
+        bounded = self.spec.bounded_window
         if self.phase == PHASE_CONTEXT_ENCODING:
             bucket = get_target_bucket(self.buckets, S)
             pad_s = bucket - S
             if pad_s:
                 input_ids = np.pad(input_ids, ((0, 0), (0, pad_s)))
                 attention_mask = np.pad(attention_mask, ((0, 0), (0, pad_s)))
-                # pad positions continue the sequence so padded K/V lands in
-                # the masked tail, not on real slots
-                tail = position_ids[:, -1:] + 1 + np.arange(pad_s)[None, :]
+                if bounded:
+                    # ring cache: sentinel positions make padded writes DROP
+                    # instead of wrapping onto live ring slots
+                    tail = np.full((position_ids.shape[0], pad_s), -10 * bounded - 16)
+                else:
+                    # pad positions continue the sequence so padded K/V lands
+                    # in the masked tail, not on real slots
+                    tail = position_ids[:, -1:] + 1 + np.arange(pad_s)[None, :]
                 position_ids = np.concatenate([position_ids, tail], axis=1)
                 if slot_mapping is not None:
                     # padded tokens write to the garbage block
                     slot_mapping = np.pad(
                         slot_mapping, ((0, 0), (0, pad_s)), constant_values=-1
                     )
+        elif bounded:
+            # ring cache: the mask is derived in-graph from positions; the
+            # attention_mask is only the (B, W) width carrier
+            bucket = bounded
+            attention_mask = np.ones((B, bounded), np.int32)
         else:
             # TKG: bucket over cache length = attention_mask width
             bucket = get_target_bucket(self.buckets, attention_mask.shape[1])
@@ -213,6 +225,7 @@ class SubModelRunner:
                     bucket=bucket,
                     mlp_fn=self.mlp_fn,
                     layer_fn=self.layer_fn,
+                    unroll=int(os.environ.get("NXDI_TPU_DECODE_UNROLL", "1")),
                 ),
                 donate_argnums=(1,),
             )

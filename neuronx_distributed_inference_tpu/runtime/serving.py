@@ -150,6 +150,13 @@ class ServingSession:
     def _full_prefill(self, req: Request) -> bool:
         """Whole-prompt context encoding (flash-kernel eligible CTE path)."""
         S = req.prompt_len
+        W = self.app.spec.bounded_window
+        if W and S > W:
+            raise NotImplementedError(
+                f"serving a prompt of {S} tokens over a ring-bounded cache "
+                f"(W={W}) needs chunked prefill; generate() handles this via "
+                f"windowed prefill — serving support is a follow-up"
+            )
         ids = req.input_ids[None, :]
         mask = np.ones((1, S), np.int32)
         pos = np.arange(S, dtype=np.int32)[None, :]

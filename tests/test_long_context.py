@@ -1,0 +1,144 @@
+"""Long-context support (VERDICT r1 missing #11 / §5):
+
+- windowed context encoding: prompts longer than one CTE program prefill in
+  chunks (reference model_base.py:957-1010), matching one-shot prefill
+  token-for-token;
+- ring-buffer sliding-window KV cache: cache bounded to W slots (reference
+  kv_cache_manager.py:194-198), HF Mistral parity with prompts and decodes
+  far beyond the window;
+- >1k-token sequence coverage.
+"""
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_random_hf_state_dict, make_tiny_config
+
+from neuronx_distributed_inference_tpu.runtime.application import TpuModelForCausalLM
+
+
+def _prompt(n, seed=3):
+    rng = np.random.RandomState(seed)
+    return rng.randint(2, 120, size=(1, n))
+
+
+def test_windowed_prefill_matches_one_shot():
+    """max_context_length=64 forces windowed prefill for a 150-token prompt;
+    tokens must equal the one-shot CTE app's."""
+    long_ids = _prompt(150)
+    mask = np.ones_like(long_ids)
+    sd = None
+    outs = {}
+    for mc in (256, 64):
+        cfg = make_tiny_config(
+            max_position_embeddings=512,
+            tpu=dict(batch_size=1, seq_len=256, max_context_length=mc,
+                     output_logits=True),
+        )
+        if sd is None:
+            sd = make_random_hf_state_dict(cfg)
+        app = TpuModelForCausalLM(None, cfg).load(state_dict=sd)
+        outs[mc] = app.generate(long_ids, mask, max_new_tokens=12)
+    np.testing.assert_array_equal(outs[64].sequences, outs[256].sequences)
+    np.testing.assert_allclose(outs[64].logits, outs[256].logits, atol=1e-4, rtol=1e-4)
+
+
+def test_windowed_prefill_padded_batch():
+    """Windowed prefill with rows whose lengths fall in different chunks."""
+    ids = np.zeros((2, 150), np.int64)
+    ids[0] = _prompt(150)[0]
+    ids[1, :40] = _prompt(40, seed=5)[0]
+    mask = np.zeros_like(ids)
+    mask[0] = 1
+    mask[1, :40] = 1
+    sd = None
+    outs = {}
+    for mc in (256, 64):
+        cfg = make_tiny_config(
+            max_position_embeddings=512,
+            tpu=dict(batch_size=2, seq_len=256, max_context_length=mc),
+        )
+        if sd is None:
+            sd = make_random_hf_state_dict(cfg)
+        app = TpuModelForCausalLM(None, cfg).load(state_dict=sd)
+        outs[mc] = app.generate(ids, mask, max_new_tokens=10)
+    np.testing.assert_array_equal(outs[64].sequences, outs[256].sequences)
+
+
+def test_ring_cache_is_bounded_and_matches_hf():
+    """Sliding-window model: the cache holds only W slots, yet a prompt 4x
+    the window and a long decode match HF Mistral exactly."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.llama import LlamaInferenceConfig
+
+    window = 8
+    hf_config = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        sliding_window=window, rms_norm_eps=1e-5, max_position_embeddings=256,
+        tie_word_embeddings=False, attn_implementation="eager",
+        eos_token_id=None, bos_token_id=None,
+    )
+    torch.manual_seed(0)
+    hf = transformers.MistralForCausalLM(hf_config).eval().float()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+
+    attrs = dict(
+        model_type="mistral", hidden_size=64, intermediate_size=128,
+        num_attention_heads=4, num_key_value_heads=2, num_hidden_layers=2,
+        vocab_size=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        sliding_window=window, hidden_act="silu", tie_word_embeddings=False,
+    )
+
+    def load_cfg(c):
+        for k, v in attrs.items():
+            setattr(c, k, v)
+
+    tc = TpuConfig(batch_size=1, seq_len=128, max_context_length=64, dtype="float32")
+    cfg = LlamaInferenceConfig(tc, load_config=load_cfg)
+    app = TpuModelForCausalLM(None, cfg)
+    app.load(state_dict=sd)
+    # the cache really is a ring of W slots, not seq_len
+    assert app.spec.bounded_window == window
+    assert app.kv_cache.k.shape[2] == window
+
+    ids = _prompt(33, seed=9)  # 4x the window, crosses several wraps
+    n_new = 30  # decode wraps the ring repeatedly
+    out = app.generate(ids, np.ones_like(ids), max_new_tokens=n_new)
+    hf_out = hf.generate(
+        input_ids=torch.tensor(ids), max_new_tokens=n_new, do_sample=False,
+        pad_token_id=0,
+    )
+    np.testing.assert_array_equal(out.sequences, hf_out.numpy())
+
+
+def test_long_sequence_1k():
+    """seq_len > 1k exercised end to end (VERDICT: 'seq_len exercised only
+    to 1024')."""
+    ids = _prompt(1100, seed=11)
+    mask = np.ones_like(ids)
+    cfg = make_tiny_config(
+        max_position_embeddings=2048,
+        tpu=dict(batch_size=1, seq_len=1536, max_context_length=512),
+    )
+    app = TpuModelForCausalLM(None, cfg).load(
+        state_dict=make_random_hf_state_dict(cfg)
+    )
+    out = app.generate(ids, mask, max_new_tokens=16)
+    assert out.sequences.shape == (1, 1100 + 16)
+    assert out.num_generated == 16
+
+
+def test_bounded_cache_memory_savings():
+    """The whole point: a 4k-seq sliding-window model allocates W slots."""
+    cfg = make_tiny_config(
+        sliding_window=16, max_position_embeddings=8192,
+        tpu=dict(batch_size=1, seq_len=4096, max_context_length=128),
+    )
+    cfg.model_type = "mistral"
+    app = TpuModelForCausalLM(None, cfg)
+    app.load(state_dict=make_random_hf_state_dict(cfg))
+    assert app.kv_cache.k.shape[2] == 16  # not 4096
