@@ -311,6 +311,13 @@ class TpuConfig:
     quantization_type: str = "per_channel_symmetric"  # or per_tensor_symmetric, blockwise
     quantization_dtype: str = "int8"
     modules_to_not_convert: Optional[List[str]] = None
+    # pre-quantized checkpoint dir: loaded when present, written after the
+    # first quantize-at-load (reference quantized_checkpoints_path,
+    # application_base.py:636-797)
+    quantized_checkpoints_path: Optional[str] = None
+    # input-axis block size for quantization_type="blockwise" (reference
+    # blockwise_matmul_block_size, config.py:665-713)
+    blockwise_matmul_block_size: int = 128
 
     # --- LoRA ------------------------------------------------------------
     lora_config: Optional[LoraServingConfig] = None
@@ -513,7 +520,6 @@ class MoETpuConfig(TpuConfig):
     router_dtype: str = "float32"
     moe_fused_kernel_enabled: Optional[bool] = None
     hybrid_sharding_config: Optional[dict] = None
-    blockwise_matmul_block_size: int = 128
 
     def validate(self):
         super().validate()

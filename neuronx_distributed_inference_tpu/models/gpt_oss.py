@@ -216,6 +216,15 @@ class GptOssModelBuilder(DecoderModelBuilder):
         dtype = dtype or to_dtype(cfg.tpu_config.dtype)
         D = self.head_dim
         g = self.gqa
+        if any(k.endswith("_blocks") for k in sd):
+            # MXFP4-packed expert weights (HF gpt-oss checkpoints): dequantize
+            # to the compute dtype at load (reference mx_layout_transform.py
+            # re-lays-out for NKI kernels instead)
+            from neuronx_distributed_inference_tpu.ops.mxfp4 import (
+                dequantize_packed_state_dict,
+            )
+
+            sd = dequantize_packed_state_dict(sd)
 
         def get(name):
             if name not in sd:

@@ -29,6 +29,11 @@ import jax.numpy as jnp
 
 GARBAGE_LINES = 1  # padding-zone lines for invalid seq_id writes
 
+#: position sentinel for padded tokens: far enough below zero that every
+#: attention-window test fails and the cache scatter drops the write
+#: (update_cache_at_layer uses mode="drop")
+PAD_POSITION_SENTINEL = -(1 << 30)
+
 
 @jax.tree_util.register_dataclass
 @dataclass

@@ -125,11 +125,27 @@ def expert_mlps_dense(
     from neuronx_distributed_inference_tpu.models.base import act_fn as get_act
 
     def expert_mm(entry, x_in, eq):
-        """Expert batched matmul with optional dequant scale + bias (E, out)."""
+        """Expert batched matmul with optional dequant scale + bias (E, out).
+
+        Blockwise scales (scale.ndim == weight.ndim; reference
+        blockwise_matmul_block_size) apply per input block before the sum —
+        the exact dequantized matmul, MXU-shaped."""
         w = entry["weight"]
-        y = jnp.einsum(eq, x_in, w.astype(x_in.dtype))
-        if "scale" in entry:
-            y = y * entry["scale"].astype(y.dtype)[:, None, :]
+        s = entry.get("scale")
+        if s is not None and s.ndim == w.ndim:
+            G = s.shape[-2]
+            bs = w.shape[-2] // G
+            wb = w.reshape(w.shape[0], G, bs, w.shape[-1]).astype(x_in.dtype)
+            xb = x_in.reshape(*x_in.shape[:-1], G, bs)
+            if x_in.ndim == 2:  # (T, in)
+                y = jnp.einsum("tgb,egbo->egto", xb, wb)
+            else:  # (E, T, in)
+                y = jnp.einsum("etgb,egbo->egto", xb, wb)
+            y = jnp.einsum("egto,ego->eto", y, s.astype(x_in.dtype))
+        else:
+            y = jnp.einsum(eq, x_in, w.astype(x_in.dtype))
+            if s is not None:
+                y = y * s.astype(y.dtype)[:, None, :]
         if "bias" in entry:
             y = y + entry["bias"].astype(y.dtype)[:, None, :]
         return y

