@@ -129,6 +129,10 @@ class StepInputs:
     slot_mapping: Optional[jax.Array] = None  # (B, S) block-KV flat slots
     block_table: Optional[jax.Array] = None  # (B, MB) block-KV block ids
     adapter_ids: Optional[jax.Array] = None  # (B,) LoRA adapter per request
+    # precomputed input embeddings (multimodal prefill: text embeds with
+    # image features merged at placeholder positions; reference ImageToText
+    # inputs_embeds path) — input_ids still carries shapes/placeholders
+    inputs_embeds: Optional[jax.Array] = None  # (B, S, H)
 
 
 @jax.tree_util.register_dataclass
@@ -615,7 +619,10 @@ def model_logits(
     The composable core — fused speculation chains several of these in one
     graph (reference NeuronFusedSpecModel, model_base.py:1656).
     """
-    hidden = embed(params, inputs.input_ids)
+    if inputs.inputs_embeds is not None:
+        hidden = inputs.inputs_embeds
+    else:
+        hidden = embed(params, inputs.input_ids)
     hidden, new_cache = run_decoder_layers(
         params, hidden, cache, inputs, spec=spec, phase=phase, mlp_fn=mlp_fn,
         layer_fn=layer_fn,

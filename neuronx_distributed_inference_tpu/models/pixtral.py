@@ -1,0 +1,181 @@
+"""Pixtral vision encoder (the vision tower of Pixtral/Mistral multimodal).
+
+TPU-native re-design of the reference Pixtral vision support
+(reference: models/pixtral/ vision tower used by the ImageToText
+application). Architecture (HF PixtralVisionModel): patch conv -> RMS ln_pre
+-> N transformer layers (RMS attention_norm, MHA with 2-D rope, RMS ffn_norm,
+SwiGLU) -> patch features. Attention is full (bidirectional) within an image;
+images ride the BATCH dim here (uniform sizes) instead of the reference's
+concatenated-sequence + block-diagonal-mask layout — same math, XLA-friendly
+static shapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.modules.norm import rms_norm
+from neuronx_distributed_inference_tpu.ops.quant import linear
+
+
+@dataclass(frozen=True)
+class PixtralVisionSpec:
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    head_dim: int
+    patch_size: int
+    image_size: int
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+
+
+def pixtral_rope_table(spec: PixtralVisionSpec) -> jnp.ndarray:
+    """(max_side^2, head_dim) 2-D rope angles — even frequency slots carry the
+    row coordinate, odd slots the column (HF PixtralRotaryEmbedding)."""
+    dim = spec.head_dim
+    side = spec.image_size // spec.patch_size
+    freqs = 1.0 / (spec.rope_theta ** (np.arange(0, dim, 2, dtype=np.float64) / dim))
+    h = np.arange(side)
+    w = np.arange(side)
+    freqs_h = np.outer(h, freqs[0::2])
+    freqs_w = np.outer(w, freqs[1::2])
+    table = np.concatenate(
+        [
+            np.repeat(freqs_h[:, None, :], side, axis=1),
+            np.repeat(freqs_w[None, :, :], side, axis=0),
+        ],
+        axis=-1,
+    ).reshape(side * side, dim // 2)
+    return jnp.asarray(np.concatenate([table, table], axis=-1), jnp.float32)
+
+
+def _rotate_half(x):
+    d2 = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., d2:], x[..., :d2]], axis=-1)
+
+
+def pixtral_vision_encoder(
+    params: Dict,
+    pixel_values: jax.Array,  # (N, C, H, W), one image per batch row
+    spec: PixtralVisionSpec,
+) -> jax.Array:
+    """-> (N, patches, hidden) patch features."""
+    N, C, H, W = pixel_values.shape
+    ps = spec.patch_size
+    h, w = H // ps, W // ps
+    side_max = spec.image_size // ps
+    if h > side_max or w > side_max:
+        raise ValueError(
+            f"image grid {h}x{w} patches exceeds the rope table "
+            f"({side_max}x{side_max} from vision_config.image_size="
+            f"{spec.image_size}); resize the image or raise image_size"
+        )
+    # patch "conv" = patch extraction + matmul (identical to stride-ps conv)
+    x = pixel_values.reshape(N, C, h, ps, w, ps)
+    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(N, h * w, C * ps * ps)
+    kernel = params["patch_conv"]["weight"]  # (hidden, C, ps, ps)
+    x = x @ kernel.reshape(spec.hidden_size, -1).T.astype(x.dtype)
+    x = rms_norm(x, params["ln_pre"]["weight"], spec.rms_eps)
+
+    # 2-D rope angles for this grid (row-major patch order)
+    side = spec.image_size // spec.patch_size
+    pos = (jnp.arange(h)[:, None] * side + jnp.arange(w)[None, :]).reshape(-1)
+    angles = params["rope"]["table"][pos]  # (P, head_dim)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+
+    def attention(lp, hidden):
+        P = hidden.shape[1]
+        q = linear(lp["q_proj"], hidden).reshape(N, P, spec.num_heads, spec.head_dim)
+        k = linear(lp["k_proj"], hidden).reshape(N, P, spec.num_heads, spec.head_dim)
+        v = linear(lp["v_proj"], hidden).reshape(N, P, spec.num_heads, spec.head_dim)
+        c = cos[None, :, None, :].astype(jnp.float32)
+        s = sin[None, :, None, :].astype(jnp.float32)
+        q = (q.astype(jnp.float32) * c + _rotate_half(q.astype(jnp.float32)) * s).astype(hidden.dtype)
+        k = (k.astype(jnp.float32) * c + _rotate_half(k.astype(jnp.float32)) * s).astype(hidden.dtype)
+        scores = jnp.einsum("nphd,nqhd->nhpq", q, k, preferred_element_type=jnp.float32)
+        probs = jax.nn.softmax(scores * spec.head_dim**-0.5, axis=-1).astype(v.dtype)
+        out = jnp.einsum("nhpq,nqhd->nphd", probs, v)
+        return linear(lp["o_proj"], out.reshape(N, P, -1))
+
+    def mlp(lp, hidden):
+        return linear(
+            lp["down_proj"], jax.nn.silu(linear(lp["gate_proj"], hidden)) * linear(lp["up_proj"], hidden)
+        )
+
+    def layer(carry, lp):
+        hidden = carry
+        hidden = hidden + attention(
+            lp["attention"], rms_norm(hidden, lp["attention_norm"]["weight"], spec.rms_eps)
+        )
+        hidden = hidden + mlp(
+            lp["feed_forward"], rms_norm(hidden, lp["ffn_norm"]["weight"], spec.rms_eps)
+        )
+        return hidden, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    return x
+
+
+def pixtral_vision_spec(vision_config) -> PixtralVisionSpec:
+    g = (
+        vision_config.get
+        if isinstance(vision_config, dict)
+        else lambda k, d=None: getattr(vision_config, k, d)
+    )
+    hidden = g("hidden_size", 1024)
+    heads = g("num_attention_heads", 16)
+    return PixtralVisionSpec(
+        hidden_size=hidden,
+        num_layers=g("num_hidden_layers", 24),
+        num_heads=heads,
+        head_dim=g("head_dim", None) or hidden // heads,
+        patch_size=g("patch_size", 16),
+        image_size=g("image_size", 1024),
+        rope_theta=g("rope_theta", 10000.0),
+        rms_eps=1e-5,
+    )
+
+
+def convert_pixtral_vision_state_dict(sd: Dict, spec: PixtralVisionSpec, prefix: str, dtype):
+    """HF PixtralVisionModel weights -> param pytree (layers stacked)."""
+
+    def get(name):
+        return np.asarray(sd[prefix + name])
+
+    def lt(name):
+        return get(name).T
+
+    L = spec.num_layers
+    layers = []
+    for i in range(L):
+        p = f"transformer.layers.{i}."
+        layers.append(
+            {
+                "attention_norm": {"weight": get(p + "attention_norm.weight")},
+                "ffn_norm": {"weight": get(p + "ffn_norm.weight")},
+                "attention": {
+                    "q_proj": {"weight": lt(p + "attention.q_proj.weight")},
+                    "k_proj": {"weight": lt(p + "attention.k_proj.weight")},
+                    "v_proj": {"weight": lt(p + "attention.v_proj.weight")},
+                    "o_proj": {"weight": lt(p + "attention.o_proj.weight")},
+                },
+                "feed_forward": {
+                    "gate_proj": {"weight": lt(p + "feed_forward.gate_proj.weight")},
+                    "up_proj": {"weight": lt(p + "feed_forward.up_proj.weight")},
+                    "down_proj": {"weight": lt(p + "feed_forward.down_proj.weight")},
+                },
+            }
+        )
+    stacked = jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs), dtype), *layers)
+    return {
+        "patch_conv": {"weight": jnp.asarray(get("patch_conv.weight"), dtype)},
+        "ln_pre": {"weight": jnp.asarray(get("ln_pre.weight"), dtype)},
+        "layers": stacked,
+        "rope": {"table": pixtral_rope_table(spec)},
+    }

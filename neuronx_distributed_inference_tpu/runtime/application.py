@@ -173,6 +173,7 @@ class TpuModelForCausalLM:
             tc.quantized
             and not random_weights
             and state_dict is None
+            and model_path is None
             and has_quantized_checkpoint(tc.quantized_checkpoints_path, tc)
         )
         if use_ckpt:
@@ -420,10 +421,14 @@ class TpuModelForCausalLM:
         temperature=None,
         seq_ids: Optional[np.ndarray] = None,
         lora_adapter_names=None,
+        inputs_embeds=None,
     ) -> GenerationOutput:
         """Host generation loop (reference hf_adapter _sample, hf_adapter.py:129).
 
         input_ids: (B, S) RIGHT-padded; attention_mask: (B, S) 1=valid.
+        ``inputs_embeds`` (B, S, H) replaces the prompt's token embeddings at
+        prefill (multimodal merge; reference inputs_embeds path) — decode
+        continues from sampled token ids as usual.
         """
         tc = self.config.tpu_config
         if tc.is_block_kv_layout:
@@ -467,6 +472,11 @@ class TpuModelForCausalLM:
         adapter_ids = self.resolve_adapter_ids(lora_adapter_names)
         ctx_lens = attention_mask.sum(axis=1).astype(np.int32)
         if windowed:
+            if inputs_embeds is not None:
+                raise NotImplementedError(
+                    "inputs_embeds with windowed prefill is not implemented; "
+                    "raise max_context_length to cover the multimodal prompt"
+                )
             # long-prompt prefill in windows (reference windowed context
             # encoding, model_base.py:957-1010): chunk 0 through the CTE
             # program, later chunks as multi-token prior-KV passes
@@ -479,7 +489,7 @@ class TpuModelForCausalLM:
             position_ids = np.tile(np.arange(S_in, dtype=np.int32), (B, 1))
             inputs, _ = self.context_encoding_model.prepare(
                 input_ids, attention_mask, position_ids, seq_ids, sampling_params,
-                adapter_ids=adapter_ids,
+                adapter_ids=adapter_ids, inputs_embeds=inputs_embeds,
             )
             out = self.context_encoding_model(
                 self.params, self.kv_cache, inputs, self._sample_key(0)

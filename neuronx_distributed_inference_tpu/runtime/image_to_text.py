@@ -1,0 +1,233 @@
+"""Image-to-text application: vision tower + projector + causal-LM decoder.
+
+TPU-native re-design of the reference multimodal application base
+(reference: ImageToText application family — Pixtral/Mllama/Llama4 share the
+pattern: encode images, project into the text embedding space, splice the
+features at image-placeholder token positions, then run the ordinary
+causal-LM prefill/decode; SURVEY §2.2 ImageToText base).
+
+Currently wired vision tower: Pixtral (models/pixtral.py). The decoder is the
+unmodified TpuModelForCausalLM — multimodality enters ONLY through
+``inputs_embeds`` at prefill, exactly like the reference's inputs_embeds
+path, so every decoder feature (buckets, sampling, speculation-free decode,
+observability) applies unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig, TpuConfig, to_dtype
+from neuronx_distributed_inference_tpu.models.pixtral import (
+    convert_pixtral_vision_state_dict,
+    pixtral_vision_encoder,
+    pixtral_vision_spec,
+)
+from neuronx_distributed_inference_tpu.models.registry import get_model_builder
+from neuronx_distributed_inference_tpu.runtime.application import (
+    GenerationOutput,
+    TpuModelForCausalLM,
+)
+
+
+class TpuImageToTextModel:
+    """Llava-architecture multimodal app (vision tower = Pixtral).
+
+    ``config`` carries HF multimodal attrs: ``text_config`` / ``vision_config``
+    dicts, ``image_token_index``, projector act. The text decoder is a full
+    TpuModelForCausalLM built from text_config.
+    """
+
+    def __init__(self, model_path: Optional[str], config: InferenceConfig, mesh=None):
+        self.config = config
+        self.model_path = model_path
+        tc = config.tpu_config
+        vision_cfg = getattr(config, "vision_config", None)
+        text_cfg = getattr(config, "text_config", None)
+        if vision_cfg is None or text_cfg is None:
+            raise ValueError("multimodal config needs vision_config and text_config")
+        self.vision_spec = pixtral_vision_spec(vision_cfg)
+        self.image_token = getattr(config, "image_token_index", None)
+        if self.image_token is None:
+            raise ValueError("config.image_token_index required")
+        self.projector_act = getattr(config, "projector_hidden_act", "gelu")
+
+        tg = text_cfg.get if isinstance(text_cfg, dict) else lambda k, d=None: getattr(text_cfg, k, d)
+        text_type = tg("model_type", "llama")
+
+        def load_text(cfg_obj):
+            cfg_obj.model_type = text_type
+            items = text_cfg.items() if isinstance(text_cfg, dict) else vars(text_cfg).items()
+            for k, v in items:
+                setattr(cfg_obj, k, v)
+
+        builder_cls = get_model_builder(text_type)
+        config_cls = getattr(builder_cls, "config_cls", InferenceConfig)
+        text_conf = config_cls(TpuConfig.from_dict(tc.to_dict()), load_config=load_text)
+        self.text = TpuModelForCausalLM(model_path, text_conf, mesh=mesh)
+        self.vision_params = None
+        self.projector = None
+        self._encode_fn = jax.jit(
+            partial(pixtral_vision_encoder, spec=self.vision_spec)
+        )
+        from neuronx_distributed_inference_tpu.models.base import embed
+
+        self._embed_fn = jax.jit(embed)
+
+    # ---- load ------------------------------------------------------------
+
+    def load(self, model_path=None, state_dict=None, random_weights: bool = False):
+        dt = to_dtype(self.config.tpu_config.dtype)
+        if state_dict is None and not random_weights:
+            from neuronx_distributed_inference_tpu.utils.hf_checkpoint import (
+                load_state_dict,
+            )
+
+            state_dict = load_state_dict(model_path or self.model_path)
+        if random_weights:
+            self.text.load(random_weights=True)
+            self.vision_params = self._random_vision_params(dt)
+            H_t = self.text.spec.hidden_size
+            H_v = self.vision_spec.hidden_size
+            key = jax.random.PRNGKey(7)
+            k1, k2 = jax.random.split(key)
+            self.projector = {
+                "linear_1": {
+                    "weight": (0.05 * jax.random.normal(k1, (H_v, H_t))).astype(dt),
+                    "bias": jnp.zeros((H_t,), dt),
+                },
+                "linear_2": {
+                    "weight": (0.05 * jax.random.normal(k2, (H_t, H_t))).astype(dt),
+                    "bias": jnp.zeros((H_t,), dt),
+                },
+            }
+            return self
+        # HF llava layout: model.vision_tower.* / model.multi_modal_projector.*
+        # / model.language_model.* / lm_head.weight
+        text_sd = {}
+        for k, v in state_dict.items():
+            if k.startswith("model.language_model."):
+                text_sd["model." + k[len("model.language_model."):]] = v
+            elif k == "lm_head.weight":
+                text_sd[k] = v
+        self.text.load(state_dict=text_sd)
+        self.vision_params = convert_pixtral_vision_state_dict(
+            state_dict, self.vision_spec, "model.vision_tower.", dt
+        )
+        proj = "model.multi_modal_projector."
+        self.projector = {
+            "linear_1": {
+                "weight": jnp.asarray(np.asarray(state_dict[proj + "linear_1.weight"]).T, dt),
+                "bias": jnp.asarray(state_dict[proj + "linear_1.bias"], dt),
+            },
+            "linear_2": {
+                "weight": jnp.asarray(np.asarray(state_dict[proj + "linear_2.weight"]).T, dt),
+                "bias": jnp.asarray(state_dict[proj + "linear_2.bias"], dt),
+            },
+        }
+        return self
+
+    def _random_vision_params(self, dt):
+        from neuronx_distributed_inference_tpu.models.pixtral import pixtral_rope_table
+
+        s = self.vision_spec
+        key = jax.random.PRNGKey(11)
+        ks = jax.random.split(key, 8)
+
+        def w(k, *shape):
+            return (0.05 * jax.random.normal(k, shape)).astype(dt)
+
+        L, H, I = s.num_layers, s.hidden_size, s.hidden_size * 2
+        return {
+            "patch_conv": {"weight": w(ks[0], H, 3, s.patch_size, s.patch_size)},
+            "ln_pre": {"weight": jnp.ones((H,), dt)},
+            "layers": {
+                "attention_norm": {"weight": jnp.ones((L, H), dt)},
+                "ffn_norm": {"weight": jnp.ones((L, H), dt)},
+                "attention": {
+                    "q_proj": {"weight": w(ks[1], L, H, H)},
+                    "k_proj": {"weight": w(ks[2], L, H, H)},
+                    "v_proj": {"weight": w(ks[3], L, H, H)},
+                    "o_proj": {"weight": w(ks[4], L, H, H)},
+                },
+                "feed_forward": {
+                    "gate_proj": {"weight": w(ks[5], L, H, I)},
+                    "up_proj": {"weight": w(ks[6], L, H, I)},
+                    "down_proj": {"weight": w(ks[7], L, I, H)},
+                },
+            },
+            "rope": {"table": pixtral_rope_table(s)},
+        }
+
+    # ---- multimodal prefill ---------------------------------------------
+
+    def warmup(self):
+        """Compile the text programs AND the inputs_embeds CTE variant (a
+        different StepInputs pytree = a different program; the first image
+        request must not pay a serve-time compile)."""
+        self.text.warmup()
+        cte = self.text.context_encoding_model
+        H = self.text.spec.hidden_size
+        dt = to_dtype(self.config.tpu_config.dtype)
+        B = cte.batch_size
+        for bucket in cte.buckets:
+            ids = np.zeros((B, bucket), np.int64)
+            mask = np.ones((B, bucket), np.int64)
+            pos = np.tile(np.arange(bucket, dtype=np.int32), (B, 1))
+            inputs, _ = cte.prepare(
+                ids, mask, pos, np.arange(B, dtype=np.int32),
+                inputs_embeds=np.zeros((B, bucket, H), jnp.dtype(dt)),
+            )
+            out = cte(self.text.params, self.text.kv_cache, inputs, None)
+            jax.block_until_ready(out.tokens)
+            self.text.kv_cache = out.cache
+        return self
+
+    def encode_images(self, pixel_values: np.ndarray) -> jax.Array:
+        """(N, C, H, W) -> (N, patches, H_text) projected image features
+        (vision tower + llava projector)."""
+        from neuronx_distributed_inference_tpu.models.base import act_fn
+
+        feats = self._encode_fn(self.vision_params, jnp.asarray(pixel_values))
+        act = act_fn(self.projector_act)
+        x = feats @ self.projector["linear_1"]["weight"] + self.projector["linear_1"]["bias"]
+        x = act(x)
+        return x @ self.projector["linear_2"]["weight"] + self.projector["linear_2"]["bias"]
+
+    def merge_embeddings(self, input_ids: np.ndarray, image_features) -> jax.Array:
+        """Text embeddings with image features spliced at the placeholder
+        positions, in raster order (reference inputs_embeds merge)."""
+        ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
+        embeds = self._embed_fn(self.text.params, ids)  # (B, S, H)
+        flat_feats = jnp.reshape(image_features, (-1, image_features.shape[-1]))
+        mask = np.asarray(input_ids) == self.image_token
+        n_placeholder = int(mask.sum())
+        if n_placeholder != flat_feats.shape[0]:
+            raise ValueError(
+                f"image tokens ({n_placeholder}) != image features "
+                f"({flat_feats.shape[0]}); check image_token_index / image sizes"
+            )
+        b_idx, s_idx = np.nonzero(mask)
+        return embeds.at[jnp.asarray(b_idx), jnp.asarray(s_idx)].set(
+            flat_feats.astype(embeds.dtype)
+        )
+
+    def generate(
+        self,
+        input_ids: np.ndarray,
+        attention_mask: Optional[np.ndarray] = None,
+        pixel_values: Optional[np.ndarray] = None,
+        **kwargs,
+    ) -> GenerationOutput:
+        if pixel_values is None:
+            return self.text.generate(input_ids, attention_mask, **kwargs)
+        feats = self.encode_images(pixel_values)
+        embeds = self.merge_embeddings(input_ids, feats)
+        return self.text.generate(
+            input_ids, attention_mask, inputs_embeds=embeds, **kwargs
+        )

@@ -118,6 +118,7 @@ class SubModelRunner:
         slot_mapping: Optional[np.ndarray] = None,
         block_table: Optional[np.ndarray] = None,
         adapter_ids: Optional[np.ndarray] = None,
+        inputs_embeds: Optional[np.ndarray] = None,
     ) -> Tuple[StepInputs, int]:
         """Pad to (compiled batch, bucket) and build StepInputs."""
         B, S = input_ids.shape
@@ -128,6 +129,10 @@ class SubModelRunner:
             if pad_s:
                 input_ids = np.pad(input_ids, ((0, 0), (0, pad_s)))
                 attention_mask = np.pad(attention_mask, ((0, 0), (0, pad_s)))
+                if inputs_embeds is not None:
+                    inputs_embeds = np.pad(
+                        np.asarray(inputs_embeds), ((0, 0), (0, pad_s), (0, 0))
+                    )
                 if bounded:
                     # ring cache: sentinel positions make padded writes DROP
                     # instead of wrapping onto live ring slots
@@ -173,6 +178,10 @@ class SubModelRunner:
             arrs["block_table"] = block_table.astype(np.int32)
         if adapter_ids is not None:
             arrs["adapter_ids"] = adapter_ids.astype(np.int32)
+        if inputs_embeds is not None:
+            # keep the caller's dtype (the merged-embedding table's compute
+            # dtype) — forcing fp32 would silently run bf16 prefill in fp32
+            arrs["inputs_embeds"] = np.asarray(inputs_embeds)
         arrs = self._pad_batch(arrs, self.batch_size)
         return StepInputs(**{k: jnp.asarray(v) for k, v in arrs.items()}), B
 
