@@ -167,8 +167,6 @@ def _field_names(cls) -> set:
 # ---------------------------------------------------------------------------
 
 UNIMPLEMENTED_FLAGS: Dict[str, Tuple[Any, str]] = {
-    "medusa_speculation_length": (0, "Medusa decoding (reference model_base.py:469-584)"),
-    "num_medusa_heads": (0, "Medusa decoding (reference model_base.py:469-584)"),
     "token_tree_config": (None, "token-tree speculation (reference eagle/token_tree.py)"),
     "attn_block_tkg_kernel_enabled": (False, "fused block decode-attention kernel"),
     "is_eagle_target": (

@@ -15,3 +15,4 @@ from neuronx_distributed_inference_tpu.models import eagle_draft  # noqa: F401
 from neuronx_distributed_inference_tpu.models import deepseek  # noqa: F401
 from neuronx_distributed_inference_tpu.models import gpt_oss  # noqa: F401
 from neuronx_distributed_inference_tpu.models import dbrx  # noqa: F401
+from neuronx_distributed_inference_tpu.models import llama4  # noqa: F401

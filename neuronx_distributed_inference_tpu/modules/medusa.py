@@ -1,0 +1,140 @@
+"""Medusa speculation: extra prediction heads on the target's own hiddens.
+
+TPU-native re-design of the reference Medusa path
+(reference: models/model_base.py:469-584 — medusa heads = ResBlock(SiLU
+residual linear) + per-head lm head; accepted via the same contiguous-match
+postprocessor as fused speculation).
+
+Structure mirrors EAGLE's step (modules/eagle.py) minus the draft network:
+candidates come from the heads applied to the rolling hidden of the last
+emitted token, verification is ONE multi-token pass of the target, and the
+bonus hidden refreshes the buffer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_inference_tpu.models.base import (
+    PHASE_CONTEXT_ENCODING,
+    ModelSpec,
+    StepInputs,
+    gather_last_token,
+    model_logits,
+)
+from neuronx_distributed_inference_tpu.modules.kvcache import (
+    KVCache,
+    slot_ids_from_seq_ids,
+)
+from neuronx_distributed_inference_tpu.modules.speculation import (
+    first_token,
+    verify_and_accept,
+)
+from neuronx_distributed_inference_tpu.ops.quant import linear
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class MedusaOutput:
+    tokens: jax.Array  # (B, K)
+    counts: jax.Array  # (B,)
+    cache: KVCache
+    hidden_buffer: jax.Array  # (B_kv+G, H)
+
+
+def medusa_head_logits(head_params, hidden: jax.Array) -> jax.Array:
+    """One medusa head: ResBlock (SiLU linear + residual) -> lm head
+    (reference medusa head structure, model_base.py:469-584)."""
+    h = hidden + jax.nn.silu(
+        linear(head_params["res"], hidden) + head_params["res"]["bias"]
+    )
+    return h @ head_params["lm_head"]["weight"]
+
+
+def medusa_context_encoding(
+    params: dict,
+    cache: KVCache,
+    hidden_buffer: jax.Array,
+    inputs: StepInputs,
+    key=None,
+    *,
+    spec: ModelSpec,
+    mlp_fn: Callable,
+    do_sample: bool = False,
+    max_topk: int = 256,
+) -> MedusaOutput:
+    """Prefill + stash the last hidden for the heads (reference medusa CTE)."""
+    logits, cache, hidden = model_logits(
+        params, cache, inputs, spec=spec, phase=PHASE_CONTEXT_ENCODING,
+        mlp_fn=mlp_fn, return_hidden=True,
+    )
+    token = first_token(logits[:, -1, :], inputs.sampling_params, key, do_sample, max_topk)
+    last_hidden = gather_last_token(hidden, inputs.attention_mask)[:, 0, :]
+    slots = slot_ids_from_seq_ids(inputs.seq_ids, hidden_buffer.shape[0] - 1)
+    hidden_buffer = hidden_buffer.at[slots].set(last_hidden.astype(hidden_buffer.dtype))
+    B = token.shape[0]
+    return MedusaOutput(
+        tokens=token, counts=jnp.ones((B,), jnp.int32), cache=cache,
+        hidden_buffer=hidden_buffer,
+    )
+
+
+def medusa_token_gen(
+    params: dict,
+    cache: KVCache,
+    hidden_buffer: jax.Array,
+    inputs: StepInputs,
+    key=None,
+    *,
+    spec_len: int,
+    spec: ModelSpec,
+    mlp_fn: Callable,
+) -> MedusaOutput:
+    """One medusa decode step: head predictions -> one verify pass ->
+    contiguous-match accept (reference medusa speculation,
+    model_base.py:469-584 + _tkg_postprocessor).
+
+    Greedy verification makes the output byte-equal to plain greedy decoding
+    whatever the heads propose.
+    """
+    k = spec_len
+    bucket = inputs.attention_mask.shape[1]
+    seq_ids = inputs.seq_ids
+    sp = inputs.sampling_params
+    slots = slot_ids_from_seq_ids(seq_ids, hidden_buffer.shape[0] - 1)
+
+    prev_h = hidden_buffer[slots]  # (B, H) hidden that produced cand[0]
+    # candidates: the last emitted token + head i's offset-(i+1) prediction
+    cands = [inputs.input_ids]
+    head_params = params["medusa_heads"]
+    for i in range(k - 1):
+        hp = jax.tree.map(lambda a, i=i: a[i], head_params)
+        hl = medusa_head_logits(hp, prev_h)[..., : spec.vocab_size]
+        cands.append(jnp.argmax(hl, axis=-1).astype(jnp.int32)[:, None])
+    cand = jnp.concatenate(cands, axis=1)  # (B, k)
+    cand_pos = inputs.position_ids + jnp.arange(k, dtype=jnp.int32)[None, :]
+
+    verify_inputs = StepInputs(
+        input_ids=cand,
+        attention_mask=(jnp.arange(bucket)[None, :] <= cand_pos[:, -1:]).astype(jnp.int32),
+        position_ids=cand_pos,
+        seq_ids=seq_ids,
+        sampling_params=sp,
+    )
+    tlogits, cache, t_hidden = model_logits(
+        params, cache, verify_inputs, spec=spec, phase="token_generation",
+        mlp_fn=mlp_fn, return_hidden=True,
+    )
+    tokens, counts = verify_and_accept(cand, tlogits, [], sp, key, False, 256)
+
+    bonus_hidden = jnp.take_along_axis(
+        t_hidden, (counts - 1)[:, None, None], axis=1
+    )[:, 0, :]
+    hidden_buffer = hidden_buffer.at[slots].set(bonus_hidden.astype(hidden_buffer.dtype))
+    return MedusaOutput(
+        tokens=tokens, counts=counts, cache=cache, hidden_buffer=hidden_buffer
+    )

@@ -67,11 +67,17 @@ def router_top_k(
     (reference RouterTopK semantics; sigmoid/group-limited variant =
     DeepSeek-V3 MoEGate noaux_tc, modeling_deepseek.py)."""
     T, E = router_logits.shape
-    if spec.scoring_func == "softmax_topk":
-        # GPT-OSS: top-k over raw LOGITS, softmax over the selected values
-        # (reference GptOssTopKRouter)
+    if spec.scoring_func in ("softmax_topk", "sigmoid_topk"):
+        # top-k over raw LOGITS, then weight the selected values:
+        # softmax_topk = GPT-OSS (reference GptOssTopKRouter),
+        # sigmoid_topk = Llama4, no renormalization (reference Llama4Router)
         top_vals, top_idx = jax.lax.top_k(router_logits, spec.top_k)
-        weights = jax.nn.softmax(top_vals, axis=-1) * spec.routed_scaling_factor
+        weigh = (
+            jax.nn.sigmoid
+            if spec.scoring_func == "sigmoid_topk"
+            else lambda v: jax.nn.softmax(v, axis=-1)
+        )
+        weights = weigh(top_vals) * spec.routed_scaling_factor
         onehot = jax.nn.one_hot(top_idx, E, dtype=router_logits.dtype)
         return jnp.einsum("tke,tk->te", onehot, weights)
     if spec.scoring_func == "sigmoid":

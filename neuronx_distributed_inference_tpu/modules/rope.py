@@ -156,3 +156,20 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarra
     out1 = xf1 * cos - xf2 * sin
     out2 = xf2 * cos + xf1 * sin
     return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+def apply_rope_interleaved(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """Complex-pair rotary convention: adjacent pairs (x[2i], x[2i+1]) rotate
+    by angle i (Llama4 apply_rotary_emb / torch.view_as_complex; reference
+    models/llama4/modeling_llama4_text.py rope path).
+
+    x: (B, S, H, D); cos/sin: (B, S, D/2).
+    """
+    x0 = x[..., 0::2].astype(jnp.float32)
+    x1 = x[..., 1::2].astype(jnp.float32)
+    c = cos[:, :, None, :]
+    s = sin[:, :, None, :]
+    out0 = x0 * c - x1 * s
+    out1 = x0 * s + x1 * c
+    out = jnp.stack([out0, out1], axis=-1).reshape(x.shape)
+    return out.astype(x.dtype)

@@ -1,0 +1,181 @@
+"""Medusa application: target model + prediction heads, one compiled step.
+
+Reference: the medusa path of NeuronBaseForCausalLM (model_base.py:469-584,
+medusa_speculation_length / num_medusa_heads config) — here a dedicated
+application class over the shared speculation host loop.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig, to_dtype
+from neuronx_distributed_inference_tpu.models.registry import get_model_builder
+from neuronx_distributed_inference_tpu.modules import autobucketing
+from neuronx_distributed_inference_tpu.modules.eagle import init_hidden_buffer
+from neuronx_distributed_inference_tpu.modules.kvcache import cache_spec, init_cache
+from neuronx_distributed_inference_tpu.modules.medusa import (
+    medusa_context_encoding,
+    medusa_token_gen,
+)
+from neuronx_distributed_inference_tpu.parallel.mesh import mesh_from_config
+from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
+from neuronx_distributed_inference_tpu.runtime.fused_spec import _SpecAppBase
+from neuronx_distributed_inference_tpu.utils.hf_checkpoint import load_state_dict
+
+
+class TpuMedusaModelForCausalLM(_SpecAppBase):
+    """Medusa speculation (reference medusa config path, model_base.py:469)."""
+
+    def __init__(self, model_path: Optional[str], config: InferenceConfig, mesh=None):
+        tc = config.tpu_config
+        self.k = tc.medusa_speculation_length
+        self.num_heads = tc.num_medusa_heads
+        if self.k < 2:
+            raise ValueError("medusa_speculation_length must be >= 2")
+        if self.num_heads < self.k - 1:
+            raise ValueError(
+                f"medusa needs num_medusa_heads >= speculation_length-1 "
+                f"({self.num_heads} < {self.k - 1})"
+            )
+        self.config = config
+        self.model_path = model_path
+        ods = tc.on_device_sampling_config
+        if ods and ods.do_sample:
+            raise NotImplementedError(
+                "medusa verification is greedy-only; for sampled speculation "
+                "use fused speculation's multinomial accept/reject"
+            )
+        self.do_sample = False
+        self._rng_key = jax.random.PRNGKey(tc.seed)
+
+        self.builder = get_model_builder(getattr(config, "model_type", "llama"))(config)
+        if self.builder.layer_fn() is not None:
+            raise NotImplementedError(
+                "medusa over models with custom decoder layers (MLA, Llama4) "
+                "is not implemented"
+            )
+        self.spec = self.builder.model_spec()
+        self.mesh = mesh if mesh is not None else mesh_from_config(tc)
+        self.cte_buckets = autobucketing.generate_context_encoding_buckets(tc)
+        self.tkg_buckets = autobucketing.generate_token_generation_buckets(tc)
+
+        mlp_fn = self.builder.mlp_fn()
+        self._cte_fn = jax.jit(
+            partial(
+                medusa_context_encoding, spec=self.spec, mlp_fn=mlp_fn,
+                do_sample=self.do_sample, max_topk=tc.max_topk,
+            ),
+            donate_argnums=(1, 2),
+        )
+        self._tkg_fn = jax.jit(
+            partial(medusa_token_gen, spec_len=self.k, spec=self.spec, mlp_fn=mlp_fn),
+            donate_argnums=(1, 2),
+        )
+        self.params = None
+        self.kv_cache = None
+        self.hidden_buffer = None
+
+    # ---- load ------------------------------------------------------------
+
+    def load(
+        self,
+        state_dict=None,
+        medusa_head_state_dict=None,
+        random_weights: bool = False,
+    ):
+        from jax.sharding import PartitionSpec as P
+
+        from neuronx_distributed_inference_tpu.parallel.sharding import TENSOR
+
+        tc = self.config.tpu_config
+        dt = to_dtype(tc.dtype)
+        H = self.spec.hidden_size
+        V = self.spec.padded_vocab_size
+        n = self.num_heads
+        if random_weights:
+            params = self.builder.random_params()
+            key = jax.random.PRNGKey(tc.seed + 3)
+            k1, k2 = jax.random.split(key)
+            heads = {
+                "res": {
+                    "weight": (0.05 * jax.random.normal(k1, (n, H, H))).astype(dt),
+                    "bias": jnp.zeros((n, H), dt),
+                },
+                "lm_head": {
+                    "weight": (0.05 * jax.random.normal(k2, (n, H, V))).astype(dt),
+                },
+            }
+        else:
+            sd = state_dict if state_dict is not None else load_state_dict(self.model_path)
+            params = self.builder.convert_hf_state_dict(sd)
+            msd = medusa_head_state_dict or sd
+            heads = self._convert_medusa_heads(msd, dt)
+        params["medusa_heads"] = heads
+        pspecs = self.builder.param_pspecs()
+        pspecs["medusa_heads"] = {
+            "res": {"weight": P(), "bias": P()},
+            "lm_head": {"weight": P(None, None, TENSOR)},
+        }
+        self.params = shard_pytree(params, pspecs, self.mesh)
+        kv_batch = tc.kv_cache_batch_size or tc.max_batch_size
+        self.kv_cache = shard_pytree(
+            init_cache(
+                self.spec.num_layers, kv_batch, tc.seq_len,
+                self.spec.attn.num_kv_heads, self.spec.attn.head_dim,
+                to_dtype(tc.kv_cache_dtype or tc.dtype),
+            ),
+            cache_spec(tc.cp_degree > 1), self.mesh,
+        )
+        self.hidden_buffer = init_hidden_buffer(kv_batch, H, dt)
+        return self
+
+    def _convert_medusa_heads(self, sd, dt):
+        """Medusa checkpoint heads: ``{i}.0.linear.weight/bias`` (ResBlock) +
+        ``{i}.1.weight`` (head lm head), with or without a ``medusa_head.``
+        prefix."""
+        H, V = self.spec.hidden_size, self.spec.padded_vocab_size
+
+        def get(i, suffix):
+            for prefix in ("", "medusa_head.", "medusa_heads."):
+                k = f"{prefix}{i}.{suffix}"
+                if k in sd:
+                    return np.asarray(sd[k])
+            raise KeyError(f"missing medusa head weight {i}.{suffix}")
+
+        res_w, res_b, lm = [], [], []
+        for i in range(self.num_heads):
+            res_w.append(get(i, "0.linear.weight").T)
+            res_b.append(get(i, "0.linear.bias"))
+            w = get(i, "1.weight").T  # (H, V_orig)
+            if w.shape[1] < V:
+                w = np.pad(w, ((0, 0), (0, V - w.shape[1])))
+            lm.append(w)
+        return {
+            "res": {
+                "weight": jnp.asarray(np.stack(res_w), dt),
+                "bias": jnp.asarray(np.stack(res_b), dt),
+            },
+            "lm_head": {"weight": jnp.asarray(np.stack(lm), dt)},
+        }
+
+    # ---- step calls (shared host loop in _SpecAppBase.generate) ----------
+
+    def _call_cte(self, inputs, key):
+        with jax.set_mesh(self.mesh):
+            out = self._cte_fn(self.params, self.kv_cache, self.hidden_buffer, inputs, key)
+        self.kv_cache = out.cache
+        self.hidden_buffer = out.hidden_buffer
+        return out
+
+    def _call_tkg(self, inputs, key):
+        with jax.set_mesh(self.mesh):
+            out = self._tkg_fn(self.params, self.kv_cache, self.hidden_buffer, inputs, key)
+        self.kv_cache = out.cache
+        self.hidden_buffer = out.hidden_buffer
+        return out

@@ -203,3 +203,41 @@ def test_dbrx_hf_parity():
     out = app.generate(PROMPT, MASK, max_new_tokens=6)
     np.testing.assert_array_equal(out.sequences[:, 8:], ref_seq)
     np.testing.assert_allclose(out.logits, ref_logits, atol=2e-3, rtol=2e-3)
+
+
+# ---------------------------------------------------------------------------
+# Llama4 (text)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("interleave_step", [2, 1])
+def test_llama4_text_hf_parity(interleave_step):
+    """Chunked/NoPE attention interleave + sigmoid-top-k MoE with shared
+    experts vs HF Llama4ForCausalLM (both the Maverick-style dense/moe
+    interleave and the Scout-style all-moe layout)."""
+    from transformers import Llama4ForCausalLM, Llama4TextConfig
+
+    from neuronx_distributed_inference_tpu.models.llama4 import (
+        Llama4TextInferenceConfig,
+    )
+
+    hf_cfg = Llama4TextConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        intermediate_size_mlp=256, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        num_local_experts=2, num_experts_per_tok=1,
+        interleave_moe_layer_step=interleave_step, attention_chunk_size=4,
+        max_position_embeddings=256, rope_theta=10000.0, rope_scaling=None,
+        attn_implementation="eager", eos_token_id=None, bos_token_id=None,
+        pad_token_id=0, tie_word_embeddings=False,
+        attention_bias=False, use_qk_norm=True, attn_temperature_tuning=True,
+        floor_scale=8, attn_scale=0.1,
+    )
+    torch.manual_seed(0)
+    hf = Llama4ForCausalLM(hf_cfg).eval().float()
+    ref_seq, ref_logits = _hf_reference(hf, 6)
+
+    app = _app_from_hf(hf, "llama4_text", Llama4TextInferenceConfig)
+    out = app.generate(PROMPT, MASK, max_new_tokens=6)
+    np.testing.assert_array_equal(out.sequences[:, 8:], ref_seq)
+    np.testing.assert_allclose(out.logits, ref_logits, atol=2e-3, rtol=2e-3)

@@ -191,3 +191,80 @@ def test_assisted_greedy_parity(draft_seed):
         target, draft, PROMPTS, MASK, max_new_tokens=12, speculation_length=4
     )
     np.testing.assert_array_equal(out.sequences[:, : ref.shape[1]], ref)
+
+
+# ---------------------------------------------------------------------------
+# Medusa
+# ---------------------------------------------------------------------------
+
+
+def test_medusa_greedy_parity():
+    """Medusa verification is target-greedy-exact: output equals plain greedy
+    decoding whatever the (random) heads propose (reference medusa path,
+    model_base.py:469-584)."""
+    from neuronx_distributed_inference_tpu.runtime.medusa import (
+        TpuMedusaModelForCausalLM,
+    )
+
+    target_cfg = make_tiny_config()
+    target_sd = make_random_hf_state_dict(target_cfg, seed=0)
+
+    plain = TpuModelForCausalLM(None, target_cfg)
+    plain.load(state_dict=target_sd)
+    ref = plain.generate(PROMPTS, MASK, max_new_tokens=12).sequences
+
+    cfg = make_tiny_config(
+        tpu=dict(medusa_speculation_length=4, num_medusa_heads=3)
+    )
+    app = TpuMedusaModelForCausalLM(None, cfg)
+    app.load(random_weights=True)
+    # swap in the reference target weights (heads stay random)
+    from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
+
+    params = app.builder.convert_hf_state_dict(target_sd)
+    params["medusa_heads"] = jax.device_get(app.params["medusa_heads"])
+    pspecs = app.builder.param_pspecs()
+    from jax.sharding import PartitionSpec as P
+    from neuronx_distributed_inference_tpu.parallel.sharding import TENSOR
+
+    pspecs["medusa_heads"] = {
+        "res": {"weight": P(), "bias": P()},
+        "lm_head": {"weight": P(None, None, TENSOR)},
+    }
+    app.params = shard_pytree(params, pspecs, app.mesh)
+    out = app.generate(PROMPTS, MASK, max_new_tokens=12)
+    np.testing.assert_array_equal(out.sequences[:, : ref.shape[1]], ref)
+
+
+def test_medusa_head_count_validation():
+    from neuronx_distributed_inference_tpu.runtime.medusa import (
+        TpuMedusaModelForCausalLM,
+    )
+
+    cfg = make_tiny_config(tpu=dict(medusa_speculation_length=5, num_medusa_heads=2))
+    with pytest.raises(ValueError, match="num_medusa_heads"):
+        TpuMedusaModelForCausalLM(None, cfg)
+
+
+def test_medusa_checkpoint_head_conversion():
+    """Classic medusa checkpoint layout loads (``{i}.0.linear.*``/``{i}.1``)."""
+    from neuronx_distributed_inference_tpu.runtime.medusa import (
+        TpuMedusaModelForCausalLM,
+    )
+
+    cfg = make_tiny_config(tpu=dict(medusa_speculation_length=3, num_medusa_heads=2))
+    sd = make_random_hf_state_dict(cfg)
+    rng = np.random.RandomState(0)
+    H, V = cfg.hidden_size, cfg.vocab_size
+    heads = {}
+    for i in range(2):
+        heads[f"medusa_head.{i}.0.linear.weight"] = rng.randn(H, H).astype(np.float32)
+        heads[f"medusa_head.{i}.0.linear.bias"] = rng.randn(H).astype(np.float32)
+        heads[f"medusa_head.{i}.1.weight"] = rng.randn(V, H).astype(np.float32)
+    app = TpuMedusaModelForCausalLM(None, cfg)
+    app.load(state_dict=sd, medusa_head_state_dict=heads)
+    out = app.generate(PROMPTS, MASK, max_new_tokens=6)
+    plain = TpuModelForCausalLM(None, make_tiny_config())
+    plain.load(state_dict=sd)
+    ref = plain.generate(PROMPTS, MASK, max_new_tokens=6).sequences
+    np.testing.assert_array_equal(out.sequences[:, : ref.shape[1]], ref)
