@@ -188,6 +188,7 @@ class DeepseekV3ModelBuilder(DecoderModelBuilder):
             (tc.attention_dp_degree > 1, "attention-DP"),
             (tc.data_parallel_degree > 1, "whole-model DP"),
             (tc.fused_qkv, "fused_qkv"),
+            (tc.lora_config is not None, "LoRA serving"),
         ):
             if flag:
                 raise NotImplementedError(f"DeepSeek-V3 MLA with {why} is not implemented")

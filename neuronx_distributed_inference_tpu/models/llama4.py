@@ -63,6 +63,15 @@ class Llama4TextInferenceConfig(InferenceConfig):
         "vocab_size",
     )
 
+    def add_derived_config(self):
+        # Llama4ForConditionalGeneration config.json nests every decoder
+        # hyperparam inside text_config; flatten BEFORE required-attr
+        # validation runs (the nested values are the decoder's hyperparams)
+        text_cfg = getattr(self, "text_config", None)
+        if isinstance(text_cfg, dict):
+            for k, v in text_cfg.items():
+                setattr(self, k, v)
+
 
 def llama4_decoder_layer(
     layer_params: dict,
@@ -154,11 +163,10 @@ class Llama4TextModelBuilder(DecoderModelBuilder):
     config_cls = Llama4TextInferenceConfig
 
     def __init__(self, config):
-        # Llama4ForConditionalGeneration nests the text config; flatten it
-        # onto the InferenceConfig (the nested text values win — they ARE the
-        # decoder's hyperparams)
+        # conditional-gen configs flatten in Llama4TextInferenceConfig.
+        # add_derived_config; handle raw InferenceConfig instances too
         text_cfg = getattr(config, "text_config", None)
-        if isinstance(text_cfg, dict):
+        if isinstance(text_cfg, dict) and not hasattr(config, "hidden_size"):
             for k, v in text_cfg.items():
                 setattr(config, k, v)
         super().__init__(config)
@@ -168,8 +176,10 @@ class Llama4TextModelBuilder(DecoderModelBuilder):
             (tc.is_block_kv_layout, "paged cache"),
             (tc.cp_degree > 1, "context parallelism"),
             (tc.attention_dp_degree > 1, "attention-DP"),
+            (tc.data_parallel_degree > 1, "whole-model DP"),
             (tc.fused_qkv, "fused_qkv"),
             (tc.enable_fused_speculation, "fused speculation"),
+            (tc.lora_config is not None, "LoRA serving"),
         ):
             if flag:
                 raise NotImplementedError(f"Llama4 with {why} is not implemented")

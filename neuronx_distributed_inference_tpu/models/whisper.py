@@ -1,0 +1,263 @@
+"""Whisper encoder-decoder (speech-to-text) model.
+
+TPU-native re-design of the reference Whisper support
+(reference: models/whisper/modeling_whisper.py:432-530 — encoder conv
+frontend + transformer, decoder with cached self-attention and
+cross-attention over the encoder states).
+
+Architecture (HF Whisper): pre-LN LayerNorm(+bias) transformer, GELU MLPs,
+learned positional embeddings, conv1d x2 (stride 1 then 2) mel frontend;
+decoder k_proj carries no bias. The decoder's self-attention uses the same
+donated stacked KV cache as the causal-LM core; cross-attention K/V are
+recomputed from the encoder states per step (small T_enc; precomputing them
+once per request is the planned optimization, mirroring the reference's
+static cross-KV cache).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.modules.kvcache import (
+    KVCache,
+    read_cache_at_layer,
+    update_cache_at_layer,
+)
+from neuronx_distributed_inference_tpu.modules.norm import layer_norm
+
+
+@dataclass(frozen=True)
+class WhisperSpec:
+    d_model: int
+    encoder_layers: int
+    decoder_layers: int
+    num_heads: int
+    num_mel_bins: int
+    vocab_size: int
+    max_source_positions: int
+    max_target_positions: int
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.num_heads
+
+
+def _ln(p, x):
+    return layer_norm(x, p["weight"], bias=p["bias"], eps=1e-5)
+
+
+def _proj(p, x):
+    y = x @ p["weight"]
+    if "bias" in p:
+        y = y + p["bias"]
+    return y
+
+
+def _mha(q, k, v, spec: WhisperSpec, mask=None):
+    """q (B,Sq,H*D) k/v (B,Sk,H*D) -> (B,Sq,H*D); mask (B,1,Sq,Sk) bool."""
+    B, Sq, _ = q.shape
+    Sk = k.shape[1]
+    H, D = spec.num_heads, spec.head_dim
+    q = q.reshape(B, Sq, H, D)
+    k = k.reshape(B, Sk, H, D)
+    v = v.reshape(B, Sk, H, D)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * (D**-0.5)
+    if mask is not None:
+        scores = jnp.where(mask, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return out.reshape(B, Sq, H * D)
+
+
+def _attn_block(p, hidden, kv_hidden, spec, mask=None):
+    """One (self or cross) attention: q/k/v/out projections around _mha.
+    Whisper k_proj has no bias (HF convention)."""
+    q = _proj(p["q_proj"], hidden)
+    k = kv_hidden @ p["k_proj"]["weight"]  # no bias
+    v = _proj(p["v_proj"], kv_hidden)
+    return _proj(p["out_proj"], _mha(q, k, v, spec, mask))
+
+
+def whisper_encoder(params: Dict, input_features: jax.Array, spec: WhisperSpec) -> jax.Array:
+    """(B, num_mel_bins, T) log-mel features -> (B, T//2, d_model)."""
+    x = jnp.swapaxes(input_features, 1, 2)  # (B, T, mel)
+    # conv1: kernel 3, stride 1, pad 1; conv2: kernel 3, stride 2, pad 1
+    def conv1d(p, x, stride):
+        w = p["weight"]  # (out, in, 3)
+        y = jax.lax.conv_general_dilated(
+            x, jnp.swapaxes(w, 0, 2),  # (3, in, out)
+            window_strides=(stride,), padding=((1, 1),),
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
+        return y + p["bias"]
+
+    x = jax.nn.gelu(conv1d(params["conv1"], x, 1), approximate=False)
+    x = jax.nn.gelu(conv1d(params["conv2"], x, 2), approximate=False)
+    T = x.shape[1]
+    x = x + params["embed_positions"]["weight"][:T][None]
+
+    def layer(h, lp):
+        h = h + _attn_block(lp["self_attn"], _ln(lp["self_attn_layer_norm"], h),
+                            _ln(lp["self_attn_layer_norm"], h), spec)
+        z = _ln(lp["final_layer_norm"], h)
+        z = jax.nn.gelu(_proj(lp["fc1"], z), approximate=False)
+        h = h + _proj(lp["fc2"], z)
+        return h, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    return _ln(params["layer_norm"], x)
+
+
+def whisper_decoder_step(
+    params: Dict,
+    cache: KVCache,
+    tokens: jax.Array,  # (B, S) int32
+    positions: jax.Array,  # (B, S) int32
+    cache_mask: jax.Array,  # (B, W) int32 valid cache region incl. this step
+    encoder_hidden: jax.Array,  # (B, T_enc, H)
+    spec: WhisperSpec,
+) -> Tuple[jax.Array, KVCache]:
+    """One decoder pass over S tokens (prefill of forced ids or decode):
+    cached causal self-attention + cross-attention (reference whisper decoder,
+    modeling_whisper.py:432-530). Returns (logits (B, S, V), cache)."""
+    B, S = tokens.shape
+    W = cache_mask.shape[1]
+    h = params["embed_tokens"]["weight"][tokens]
+    h = h + params["embed_positions"]["weight"][positions]
+
+    cols = jnp.arange(W)[None, None, None, :]
+    self_mask = (cols <= positions[:, None, :, None]) & cache_mask.astype(bool)[:, None, None, :]
+    slot_ids = jnp.arange(B, dtype=jnp.int32)
+
+    def layer(carry, xs):
+        h, k_c, v_c = carry
+        lp, li = xs
+        # cached causal self-attention (write-then-attend)
+        z = _ln(lp["self_attn_layer_norm"], h)
+        sa = lp["self_attn"]
+        q = _proj(sa["q_proj"], z)
+        k = z @ sa["k_proj"]["weight"]
+        v = _proj(sa["v_proj"], z)
+        H, D = spec.num_heads, spec.head_dim
+        k_c, v_c = update_cache_at_layer(
+            k_c, v_c, k.reshape(B, S, H, D), v.reshape(B, S, H, D), li, slot_ids, positions
+        )
+        k_r, v_r = read_cache_at_layer(k_c, v_c, li, B, W)
+        attn = _mha(q, k_r.reshape(B, W, H * D), v_r.reshape(B, W, H * D), spec, self_mask)
+        h = h + _proj(sa["out_proj"], attn)
+        # cross-attention over the encoder states (full)
+        z = _ln(lp["encoder_attn_layer_norm"], h)
+        h = h + _attn_block(lp["encoder_attn"], z, encoder_hidden, spec)
+        # mlp
+        z = _ln(lp["final_layer_norm"], h)
+        z = jax.nn.gelu(_proj(lp["fc1"], z), approximate=False)
+        h = h + _proj(lp["fc2"], z)
+        return (h, k_c, v_c), None
+
+    n_layers = jax.tree.leaves(params["layers"])[0].shape[0]
+    (h, new_k, new_v), _ = jax.lax.scan(
+        layer, (h, cache.k, cache.v),
+        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
+    )
+    h = _ln(params["layer_norm"], h)
+    logits = h @ params["embed_tokens"]["weight"].T  # tied proj_out
+    return logits.astype(jnp.float32), KVCache(k=new_k, v=new_v)
+
+
+def whisper_spec(cfg) -> WhisperSpec:
+    g = cfg.get if isinstance(cfg, dict) else lambda k, d=None: getattr(cfg, k, d)
+    return WhisperSpec(
+        d_model=g("d_model"),
+        encoder_layers=g("encoder_layers"),
+        decoder_layers=g("decoder_layers"),
+        num_heads=g("decoder_attention_heads"),
+        num_mel_bins=g("num_mel_bins"),
+        vocab_size=g("vocab_size"),
+        max_source_positions=g("max_source_positions"),
+        max_target_positions=g("max_target_positions"),
+    )
+
+
+def convert_whisper_state_dict(sd: Dict, spec: WhisperSpec, dtype) -> Dict:
+    """HF WhisperForConditionalGeneration weights -> param pytrees."""
+
+    def get(name):
+        return np.asarray(sd[name])
+
+    def lin(prefix, bias=True):
+        out = {"weight": jnp.asarray(get(prefix + ".weight").T, dtype)}
+        if bias:
+            out["bias"] = jnp.asarray(get(prefix + ".bias"), dtype)
+        return out
+
+    def ln(prefix):
+        return {
+            "weight": jnp.asarray(get(prefix + ".weight"), dtype),
+            "bias": jnp.asarray(get(prefix + ".bias"), dtype),
+        }
+
+    def attn(prefix):
+        return {
+            "q_proj": lin(prefix + ".q_proj"),
+            "k_proj": lin(prefix + ".k_proj", bias=False),
+            "v_proj": lin(prefix + ".v_proj"),
+            "out_proj": lin(prefix + ".out_proj"),
+        }
+
+    def enc_layer(i):
+        p = f"model.encoder.layers.{i}"
+        return {
+            "self_attn": attn(p + ".self_attn"),
+            "self_attn_layer_norm": ln(p + ".self_attn_layer_norm"),
+            "fc1": lin(p + ".fc1"),
+            "fc2": lin(p + ".fc2"),
+            "final_layer_norm": ln(p + ".final_layer_norm"),
+        }
+
+    def dec_layer(i):
+        p = f"model.decoder.layers.{i}"
+        return {
+            "self_attn": attn(p + ".self_attn"),
+            "self_attn_layer_norm": ln(p + ".self_attn_layer_norm"),
+            "encoder_attn": attn(p + ".encoder_attn"),
+            "encoder_attn_layer_norm": ln(p + ".encoder_attn_layer_norm"),
+            "fc1": lin(p + ".fc1"),
+            "fc2": lin(p + ".fc2"),
+            "final_layer_norm": ln(p + ".final_layer_norm"),
+        }
+
+    def stack(layers):
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+
+    encoder = {
+        "conv1": {
+            "weight": jnp.asarray(get("model.encoder.conv1.weight"), dtype),
+            "bias": jnp.asarray(get("model.encoder.conv1.bias"), dtype),
+        },
+        "conv2": {
+            "weight": jnp.asarray(get("model.encoder.conv2.weight"), dtype),
+            "bias": jnp.asarray(get("model.encoder.conv2.bias"), dtype),
+        },
+        "embed_positions": {
+            "weight": jnp.asarray(get("model.encoder.embed_positions.weight"), dtype)
+        },
+        "layers": stack([enc_layer(i) for i in range(spec.encoder_layers)]),
+        "layer_norm": ln("model.encoder.layer_norm"),
+    }
+    decoder = {
+        "embed_tokens": {
+            "weight": jnp.asarray(get("model.decoder.embed_tokens.weight"), dtype)
+        },
+        "embed_positions": {
+            "weight": jnp.asarray(get("model.decoder.embed_positions.weight"), dtype)
+        },
+        "layers": stack([dec_layer(i) for i in range(spec.decoder_layers)]),
+        "layer_norm": ln("model.decoder.layer_norm"),
+    }
+    return {"encoder": encoder, "decoder": decoder}

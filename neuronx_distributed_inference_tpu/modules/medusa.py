@@ -31,6 +31,7 @@ from neuronx_distributed_inference_tpu.modules.kvcache import (
     slot_ids_from_seq_ids,
 )
 from neuronx_distributed_inference_tpu.modules.speculation import (
+    _row_mask,
     first_token,
     verify_and_accept,
 )
@@ -120,7 +121,7 @@ def medusa_token_gen(
 
     verify_inputs = StepInputs(
         input_ids=cand,
-        attention_mask=(jnp.arange(bucket)[None, :] <= cand_pos[:, -1:]).astype(jnp.int32),
+        attention_mask=_row_mask(bucket, cand_pos[:, -1:]),
         position_ids=cand_pos,
         seq_ids=seq_ids,
         sampling_params=sp,

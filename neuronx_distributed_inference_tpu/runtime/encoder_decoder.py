@@ -1,0 +1,116 @@
+"""Encoder-decoder application (Whisper speech-to-text).
+
+Reference: the encoder application family (SURVEY §2.2 Encoder application;
+models/whisper/modeling_whisper.py:432-530). One jitted encode program, one
+jitted multi-token decoder program per (S, cache-width) shape; the decoder's
+self-attention KV cache is donated exactly like the causal-LM runtime.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig, to_dtype
+from neuronx_distributed_inference_tpu.models.whisper import (
+    convert_whisper_state_dict,
+    whisper_decoder_step,
+    whisper_encoder,
+    whisper_spec,
+)
+from neuronx_distributed_inference_tpu.modules.kvcache import init_cache
+from neuronx_distributed_inference_tpu.runtime.application import GenerationOutput
+from neuronx_distributed_inference_tpu.utils.hf_checkpoint import load_state_dict
+
+
+class TpuWhisperModel:
+    """Whisper speech-to-text (reference NeuronWhisper* application)."""
+
+    def __init__(self, model_path: Optional[str], config: InferenceConfig):
+        self.config = config
+        self.model_path = model_path
+        self.spec = whisper_spec(config)
+        self.decoder_start = getattr(config, "decoder_start_token_id", 1)
+        tc = config.tpu_config
+        self.batch = tc.batch_size
+        self.max_len = min(tc.seq_len, self.spec.max_target_positions)
+        self._encode_fn = jax.jit(partial(whisper_encoder, spec=self.spec))
+        self._decode_fn = jax.jit(
+            partial(whisper_decoder_step, spec=self.spec), donate_argnums=(1,)
+        )
+        self.params = None
+        self.kv_cache = None
+
+    def load(self, model_path=None, state_dict=None):
+        if state_dict is None:
+            state_dict = load_state_dict(model_path or self.model_path)
+        dt = to_dtype(self.config.tpu_config.dtype)
+        self.params = convert_whisper_state_dict(state_dict, self.spec, dt)
+        return self
+
+    def _fresh_cache(self):
+        return init_cache(
+            self.spec.decoder_layers, self.batch, self.max_len,
+            self.spec.num_heads, self.spec.head_dim,
+            to_dtype(self.config.tpu_config.dtype),
+        )
+
+    def encode(self, input_features: np.ndarray) -> jax.Array:
+        """(B, num_mel_bins, T) log-mel -> (B, T//2, d_model)."""
+        return self._encode_fn(self.params["encoder"], jnp.asarray(input_features))
+
+    def generate(
+        self,
+        input_features: np.ndarray,
+        decoder_input_ids: Optional[np.ndarray] = None,
+        max_new_tokens: int = 64,
+        eos_token_id: Optional[int] = None,
+    ) -> GenerationOutput:
+        """Greedy transcription: encode once, prefill the forced decoder ids,
+        then single-token decode to EOS/max."""
+        enc = self.encode(input_features)
+        B = enc.shape[0]
+        if decoder_input_ids is None:
+            decoder_input_ids = np.full((B, 1), self.decoder_start, np.int64)
+        decoder_input_ids = np.asarray(decoder_input_ids)
+        S0 = decoder_input_ids.shape[1]
+        cache = self._fresh_cache()
+        W = self.max_len
+
+        # prefill the forced ids in one pass
+        pos = np.tile(np.arange(S0, dtype=np.int32), (B, 1))
+        cache_mask = (np.arange(W)[None, :] < S0).astype(np.int32)
+        cache_mask = np.tile(cache_mask, (B, 1))
+        logits, cache = self._decode_fn(
+            self.params["decoder"], cache, jnp.asarray(decoder_input_ids, jnp.int32),
+            jnp.asarray(pos), jnp.asarray(cache_mask), enc,
+        )
+        tokens = [np.asarray(jnp.argmax(logits[:, -1], axis=-1))]
+        eos_arr = (
+            np.atleast_1d(np.asarray(eos_token_id)) if eos_token_id is not None else None
+        )
+        done = np.zeros(B, bool)
+        if eos_arr is not None:
+            done |= np.isin(tokens[0], eos_arr)
+        p = S0
+        while len(tokens) < max_new_tokens and p < self.max_len and not done.all():
+            step_pos = np.full((B, 1), p, np.int32)
+            cache_mask = np.tile((np.arange(W)[None, :] <= p).astype(np.int32), (B, 1))
+            logits, cache = self._decode_fn(
+                self.params["decoder"], cache,
+                jnp.asarray(tokens[-1][:, None], jnp.int32),
+                jnp.asarray(step_pos), jnp.asarray(cache_mask), enc,
+            )
+            nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+            if eos_arr is not None:
+                nxt = np.where(done, eos_arr[0], nxt)
+                done |= np.isin(nxt, eos_arr)
+            tokens.append(nxt)
+            p += 1
+        gen = np.stack(tokens, axis=1).astype(np.int64)
+        sequences = np.concatenate([decoder_input_ids, gen], axis=1)
+        return GenerationOutput(sequences=sequences, num_generated=gen.shape[1])

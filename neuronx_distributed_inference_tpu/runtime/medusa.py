@@ -43,6 +43,16 @@ class TpuMedusaModelForCausalLM(_SpecAppBase):
                 f"medusa needs num_medusa_heads >= speculation_length-1 "
                 f"({self.num_heads} < {self.k - 1})"
             )
+        if tc.attention_dp_degree > 1 or tc.data_parallel_degree > 1:
+            raise NotImplementedError(
+                "medusa with attention-DP / whole-model DP is not implemented "
+                "(the medusa cache is not DP-sharded)"
+            )
+        if tc.is_block_kv_layout or tc.cp_degree > 1:
+            raise NotImplementedError(
+                "medusa with the paged cache or context parallelism is not "
+                "implemented"
+            )
         self.config = config
         self.model_path = model_path
         ods = tc.on_device_sampling_config
@@ -122,6 +132,12 @@ class TpuMedusaModelForCausalLM(_SpecAppBase):
             "res": {"weight": P(), "bias": P()},
             "lm_head": {"weight": P(None, None, TENSOR)},
         }
+        if tc.quantized:
+            from neuronx_distributed_inference_tpu.ops.quant import (
+                prepare_quantized_params,
+            )
+
+            params, pspecs = prepare_quantized_params(params, pspecs, tc)
         self.params = shard_pytree(params, pspecs, self.mesh)
         kv_batch = tc.kv_cache_batch_size or tc.max_batch_size
         self.kv_cache = shard_pytree(

@@ -268,3 +268,18 @@ def test_medusa_checkpoint_head_conversion():
     plain.load(state_dict=sd)
     ref = plain.generate(PROMPTS, MASK, max_new_tokens=6).sequences
     np.testing.assert_array_equal(out.sequences[:, : ref.shape[1]], ref)
+
+
+def test_medusa_unsupported_combos_raise():
+    from neuronx_distributed_inference_tpu.runtime.medusa import (
+        TpuMedusaModelForCausalLM,
+    )
+
+    cfg = make_tiny_config(
+        tpu=dict(
+            medusa_speculation_length=3, num_medusa_heads=2,
+            tp_degree=4, attention_dp_degree=2, is_continuous_batching=True,
+        )
+    )
+    with pytest.raises(NotImplementedError, match="attention-DP"):
+        TpuMedusaModelForCausalLM(None, cfg)

@@ -1,0 +1,105 @@
+"""Whisper encoder-decoder (VERDICT §2.2 Encoder application / §2.11
+Whisper): HF parity for the encoder and for greedy transcription."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig, TpuConfig
+from neuronx_distributed_inference_tpu.runtime.encoder_decoder import TpuWhisperModel
+
+
+def _tiny_hf_whisper():
+    from transformers import WhisperConfig, WhisperForConditionalGeneration
+
+    cfg = WhisperConfig(
+        vocab_size=128, d_model=64, encoder_layers=2, decoder_layers=2,
+        encoder_attention_heads=4, decoder_attention_heads=4,
+        encoder_ffn_dim=128, decoder_ffn_dim=128, num_mel_bins=16,
+        max_source_positions=32, max_target_positions=64,
+        decoder_start_token_id=1, eos_token_id=None, pad_token_id=0,
+        bos_token_id=None, suppress_tokens=[], begin_suppress_tokens=[],
+        forced_decoder_ids=None, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    m = WhisperForConditionalGeneration(cfg).eval().float()
+    m.generation_config.forced_decoder_ids = None
+    m.generation_config.suppress_tokens = []
+    m.generation_config.begin_suppress_tokens = []
+    return m
+
+
+def _tpu_whisper(hf):
+    sd = {k: v.float().numpy() for k, v in hf.state_dict().items()}
+
+    def load_config(cfg):
+        for k, v in hf.config.to_dict().items():
+            setattr(cfg, k, v)
+        # satisfy the generic required attrs surface
+        cfg.hidden_size = hf.config.d_model
+        cfg.num_attention_heads = hf.config.decoder_attention_heads
+        cfg.num_hidden_layers = hf.config.decoder_layers
+        cfg.num_key_value_heads = hf.config.decoder_attention_heads
+        cfg.intermediate_size = hf.config.decoder_ffn_dim
+
+    cfg = InferenceConfig(
+        TpuConfig(batch_size=2, seq_len=64, dtype="float32"), load_config=load_config
+    )
+    app = TpuWhisperModel(None, cfg)
+    app.load(state_dict=sd)
+    return app
+
+
+def test_whisper_encoder_hf_parity():
+    hf = _tiny_hf_whisper()
+    app = _tpu_whisper(hf)
+    rng = np.random.RandomState(0)
+    feats = rng.randn(2, 16, 64).astype(np.float32)  # (B, mel, T)
+    with torch.no_grad():
+        ref = hf.model.encoder(torch.tensor(feats)).last_hidden_state.numpy()
+    got = np.asarray(app.encode(feats))
+    np.testing.assert_allclose(got, ref, atol=5e-4, rtol=5e-4)
+
+
+def test_whisper_greedy_transcription_hf_parity():
+    hf = _tiny_hf_whisper()
+    app = _tpu_whisper(hf)
+    rng = np.random.RandomState(1)
+    feats = rng.randn(2, 16, 64).astype(np.float32)
+    n_new = 10
+    with torch.no_grad():
+        # HF whisper generate returns GENERATED tokens only (the start/forced
+        # prefix is stripped); compare against our generated suffix
+        ref = hf.generate(
+            input_features=torch.tensor(feats), max_new_tokens=n_new,
+            do_sample=False, num_beams=1,
+        ).numpy()
+    out = app.generate(feats, max_new_tokens=n_new)
+    np.testing.assert_array_equal(out.sequences[:, 1 : 1 + ref.shape[1]], ref)
+
+
+def test_whisper_forced_decoder_ids_and_eos():
+    hf = _tiny_hf_whisper()
+    app = _tpu_whisper(hf)
+    rng = np.random.RandomState(2)
+    feats = rng.randn(1, 16, 64).astype(np.float32)
+    forced = np.array([[1, 7, 3]])
+    with torch.no_grad():
+        ref = hf.generate(
+            input_features=torch.tensor(feats),
+            decoder_input_ids=torch.tensor(forced),
+            max_new_tokens=8, do_sample=False, num_beams=1,
+        ).numpy()
+    out = app.generate(feats, decoder_input_ids=forced, max_new_tokens=8)
+    # HF strips the forced prefix from its output
+    np.testing.assert_array_equal(
+        out.sequences[:, forced.shape[1] : forced.shape[1] + ref.shape[1]], ref
+    )
+    # eos termination: use the 3rd generated token as EOS, later positions fill
+    eos = int(ref[0, 2])
+    out2 = app.generate(feats, decoder_input_ids=forced, max_new_tokens=8, eos_token_id=eos)
+    row = out2.sequences[0, forced.shape[1]:]
+    hit = np.where(row == eos)[0]
+    assert hit.size and (row[hit[0]:] == eos).all()
