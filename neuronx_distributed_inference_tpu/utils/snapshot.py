@@ -222,3 +222,50 @@ def debug_log_step(tag: str, inputs, output=None):
     )
     if output is not None and getattr(output, "tokens", None) is not None:
         logger.debug("%s -> tokens %s", tag, np.asarray(output.tokens)[:, :8].tolist())
+
+
+# ---------------------------------------------------------------------------
+# KV cache reconstruction (reference utils/kv_cache_reconstruct_utils.py)
+# ---------------------------------------------------------------------------
+
+
+def reconstruct_kv_cache(app, token_history, attention_mask=None):
+    """Rebuild the app's KV cache from a token history — e.g. to resume a
+    preempted/restored request without the original cache (reference
+    kv_cache_reconstruct_utils.py: replay prompt+generated tokens through
+    context encoding).
+
+    ``token_history``: (B, S) everything decoded so far (prompt + generated),
+    RIGHT-PACKED per row (each row's valid tokens contiguous from position 0 —
+    generated tokens directly follow the prompt, as serving histories are).
+    Returns the per-row next write position. The app's cache is replaced.
+
+    Runs through the app's own windowed-prefill path, so histories longer
+    than one CTE program (or the ring window) reconstruct the same way
+    generate() would prefill them.
+    """
+    from neuronx_distributed_inference_tpu.modules.sampling import (
+        prepare_sampling_params,
+    )
+
+    tc = app.config.tpu_config
+    if tc.is_block_kv_layout:
+        raise NotImplementedError(
+            "block-KV reconstruction replays through ServingSession "
+            "re-admission (add_request with the history as the prompt)"
+        )
+    token_history = np.asarray(token_history)
+    if attention_mask is None:
+        attention_mask = np.ones_like(token_history)
+    attention_mask = np.asarray(attention_mask)
+    B, S = token_history.shape
+    if S > tc.seq_len:
+        raise ValueError(f"history length {S} exceeds seq_len {tc.seq_len}")
+    app.init_kv_cache()  # fresh lines
+    # _windowed_prefill degenerates to a single CTE pass when the history
+    # fits one program — one shared prefill path, one set of guards
+    app._windowed_prefill(
+        token_history, attention_mask, np.arange(B, dtype=np.int32),
+        prepare_sampling_params(B), None,
+    )
+    return attention_mask.sum(axis=1).astype(np.int64)
