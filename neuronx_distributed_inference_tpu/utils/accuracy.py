@@ -69,14 +69,19 @@ def check_logit_match(
     divergence_tol: float = DEFAULT_DIVERGENCE_TOL,
     tol_map: Optional[Dict[int, float]] = None,
     raise_on_fail: bool = True,
+    mode: str = "absolute",
 ) -> AccuracyReport:
     """Logit matching with per-position tolerance and divergence-index
     reporting (reference check_accuracy_logits, accuracy.py:474-683).
 
     actual/golden: (B, N, V) logits for the N generated positions.
     ``tol_map`` maps position -> tolerance override (reference per-index tol
-    maps, accuracy.py:474-500).
+    maps, accuracy.py:474-500). ``mode="absolute"`` is the reference's gate
+    (max |a - g| per position vs divergence_difference_tol);
+    ``mode="relative"`` normalizes by the golden's magnitude.
     """
+    if mode not in ("absolute", "relative"):
+        raise ValueError(f"mode must be 'absolute' or 'relative', got {mode!r}")
     actual = np.asarray(actual_logits, np.float32)
     golden = np.asarray(golden_logits, np.float32)
     n = min(actual.shape[1], golden.shape[1])
@@ -84,9 +89,9 @@ def check_logit_match(
     divergence = None
     for i in range(n):
         tol = tol_map.get(i, divergence_tol) if tol_map else divergence_tol
-        # relative-to-range error like the reference's logit check
-        scale = max(float(np.max(np.abs(golden[:, i]))), 1.0)
-        err = float(np.max(np.abs(actual[:, i] - golden[:, i]))) / scale
+        err = float(np.max(np.abs(actual[:, i] - golden[:, i])))
+        if mode == "relative":
+            err = err / max(float(np.max(np.abs(golden[:, i]))), 1.0)
         errors.append(err)
         if err > tol and divergence is None:
             divergence = i
@@ -98,7 +103,7 @@ def check_logit_match(
         max_error_per_position=errors,
         message=(
             f"logit divergence at generated position {divergence}: "
-            f"rel-err {errors[divergence]:.5f} > tol"
+            f"{mode} err {errors[divergence]:.5f} > tol"
         ),
     )
     if raise_on_fail:

@@ -64,9 +64,13 @@ class SubmodelTimer:
         timer = self
 
         def timed(params, cache, inputs, rng=None):
+            import jax
+
             t0 = time.perf_counter()
             out = timer._orig(params, cache, inputs, rng)
-            out.tokens.block_until_ready()
+            # device_get, not block_until_ready: relayed backends (axon) only
+            # truly synchronize on a fetch (PERF.md)
+            jax.device_get(out.tokens)
             timer.latencies.append(time.perf_counter() - t0)
             return out
 
@@ -95,12 +99,15 @@ class DecodeChunkTimer:
 
         def timed(params, cache, last, pos, seq_ids, sampling_params, rng,
                   num_steps, bucket, adapter_ids=None):
+            import jax
+
             t0 = time.perf_counter()
             tokens, logits, new_cache = timer._orig(
                 params, cache, last, pos, seq_ids, sampling_params, rng,
                 num_steps=num_steps, bucket=bucket, adapter_ids=adapter_ids,
             )
-            tokens.block_until_ready()
+            # see SubmodelTimer: a fetch is the only true sync over a relay
+            jax.device_get(tokens)
             dt = time.perf_counter() - t0
             timer.per_token_latencies.extend([dt / num_steps] * num_steps)
             return tokens, logits, new_cache

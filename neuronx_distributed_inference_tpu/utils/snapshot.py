@@ -229,7 +229,7 @@ def debug_log_step(tag: str, inputs, output=None):
 # ---------------------------------------------------------------------------
 
 
-def reconstruct_kv_cache(app, token_history, attention_mask=None):
+def reconstruct_kv_cache(app, token_history, attention_mask=None, lora_adapter_names=None):
     """Rebuild the app's KV cache from a token history — e.g. to resume a
     preempted/restored request without the original cache (reference
     kv_cache_reconstruct_utils.py: replay prompt+generated tokens through
@@ -261,11 +261,23 @@ def reconstruct_kv_cache(app, token_history, attention_mask=None):
     B, S = token_history.shape
     if S > tc.seq_len:
         raise ValueError(f"history length {S} exceeds seq_len {tc.seq_len}")
+    if (
+        S > tc.max_context_length
+        and not app.spec.bounded_window
+        and S > app.token_generation_model.buckets[-1]
+    ):
+        # mirror generate()'s pre-check BEFORE wiping the live cache
+        raise ValueError(
+            f"history length {S} exceeds the largest token-generation bucket "
+            f"({app.token_generation_model.buckets[-1]}) needed for windowed "
+            f"prefill; raise token_generation_buckets/seq_len"
+        )
+    adapter_ids = app.resolve_adapter_ids(lora_adapter_names)
     app.init_kv_cache()  # fresh lines
     # _windowed_prefill degenerates to a single CTE pass when the history
     # fits one program — one shared prefill path, one set of guards
     app._windowed_prefill(
         token_history, attention_mask, np.arange(B, dtype=np.int32),
-        prepare_sampling_params(B), None,
+        prepare_sampling_params(B), adapter_ids,
     )
     return attention_mask.sum(axis=1).astype(np.int64)

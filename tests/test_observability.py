@@ -161,9 +161,29 @@ def test_kv_cache_reconstruct(app):
         hist_mask[b, : row.size] = 1
     pos = reconstruct_kv_cache(app, history, hist_mask)
     np.testing.assert_array_equal(pos, hist_mask.sum(1))
-    # continuing over the reconstructed history must reproduce the suffix
-    cont = app.generate(history, hist_mask, max_new_tokens=6).sequences
-    np.testing.assert_array_equal(cont[:, width:], full[:, 8 + n_keep :])
+    # decode DIRECTLY off the reconstructed cache (no re-prefill): the next
+    # tokens must reproduce the unbroken run's suffix
+    from neuronx_distributed_inference_tpu.modules.autobucketing import (
+        get_target_bucket,
+    )
+    from neuronx_distributed_inference_tpu.modules.sampling import (
+        prepare_sampling_params,
+    )
+
+    # re-feed the last history token at ITS position (pos-1): the write is
+    # idempotent and the chunk emits the successors off the rebuilt cache
+    last = history[np.arange(2), pos - 1].astype(np.int32)
+    bucket = get_target_bucket(
+        app.token_generation_model.buckets, int(pos.max()) + 6
+    )
+    tokens, _, cache = app.token_generation_model.decode_chunk(
+        app.params, app.kv_cache, last[:, None],
+        (pos[:, None] - 1).astype(np.int32),
+        np.arange(2, dtype=np.int32), prepare_sampling_params(2), None,
+        num_steps=6, bucket=bucket,
+    )
+    app.kv_cache = cache
+    np.testing.assert_array_equal(np.asarray(tokens)[:, :6], full[:, 8 + n_keep : 8 + n_keep + 6])
 
 
 def test_kv_cache_reconstruct_long_history():
@@ -183,5 +203,20 @@ def test_kv_cache_reconstruct_long_history():
     history = full[:, :105]
     pos = reconstruct_kv_cache(a, history)
     assert pos[0] == 105
-    cont = a.generate(history, np.ones_like(history), max_new_tokens=5).sequences
-    np.testing.assert_array_equal(cont[:, 105:], full[:, 105:])
+    from neuronx_distributed_inference_tpu.modules.autobucketing import (
+        get_target_bucket,
+    )
+    from neuronx_distributed_inference_tpu.modules.sampling import (
+        prepare_sampling_params,
+    )
+
+    last = history[:, -1].astype(np.int32)
+    bucket = get_target_bucket(a.token_generation_model.buckets, 110)
+    tokens, _, cache = a.token_generation_model.decode_chunk(
+        a.params, a.kv_cache, last[:, None],
+        (pos[:, None] - 1).astype(np.int32),
+        np.arange(1, dtype=np.int32), prepare_sampling_params(1), None,
+        num_steps=5, bucket=bucket,
+    )
+    a.kv_cache = cache
+    np.testing.assert_array_equal(np.asarray(tokens)[:, :5], full[:, 105:110])
