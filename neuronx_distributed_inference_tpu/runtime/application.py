@@ -393,6 +393,27 @@ class TpuModelForCausalLM:
         fl = jnp.asarray(first_logits) if first_logits is not None else None
         return jnp.asarray(first_tok[:, None], jnp.int32), fl
 
+    def validate_prefill_length(self, S: int):
+        """Shared pre-checks for any prompt/history prefill (generate and
+        utils.snapshot.reconstruct_kv_cache use the same rule)."""
+        tc = self.config.tpu_config
+        if S > tc.seq_len:
+            raise ValueError(f"prompt length {S} exceeds seq_len {tc.seq_len}")
+        windowed = S > tc.max_context_length or (
+            self.spec.bounded_window and S > self.spec.bounded_window
+        )
+        if (
+            windowed
+            and not self.spec.bounded_window
+            and S > self.token_generation_model.buckets[-1]
+        ):
+            raise ValueError(
+                f"prompt length {S} exceeds the largest token-generation "
+                f"bucket ({self.token_generation_model.buckets[-1]}) needed "
+                f"for windowed prefill; raise token_generation_buckets/seq_len"
+            )
+        return windowed
+
     def _pos_limit(self) -> int:
         """Largest writable position: a ring cache bounds SLOTS, not
         positions; otherwise the largest compiled TKG bucket bounds it."""
@@ -447,23 +468,7 @@ class TpuModelForCausalLM:
         sampling_params = prepare_sampling_params(B, top_k, top_p, temperature)
         validate_sampling_params(sampling_params, tc.max_topk)
 
-        windowed = S_in > tc.max_context_length or (
-            self.spec.bounded_window and S_in > self.spec.bounded_window
-        )
-        if S_in > tc.seq_len:
-            raise ValueError(
-                f"prompt length {S_in} exceeds seq_len {tc.seq_len}"
-            )
-        if (
-            windowed
-            and not self.spec.bounded_window
-            and S_in > self.token_generation_model.buckets[-1]
-        ):
-            raise ValueError(
-                f"prompt length {S_in} exceeds the largest token-generation "
-                f"bucket ({self.token_generation_model.buckets[-1]}) needed "
-                f"for windowed prefill; raise token_generation_buckets/seq_len"
-            )
+        windowed = self.validate_prefill_length(S_in)
         max_total = min(tc.seq_len, S_in + max_new_tokens)
         n_new = max_total - S_in
         if n_new <= 0:

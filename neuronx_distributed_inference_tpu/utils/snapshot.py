@@ -259,19 +259,8 @@ def reconstruct_kv_cache(app, token_history, attention_mask=None, lora_adapter_n
         attention_mask = np.ones_like(token_history)
     attention_mask = np.asarray(attention_mask)
     B, S = token_history.shape
-    if S > tc.seq_len:
-        raise ValueError(f"history length {S} exceeds seq_len {tc.seq_len}")
-    if (
-        S > tc.max_context_length
-        and not app.spec.bounded_window
-        and S > app.token_generation_model.buckets[-1]
-    ):
-        # mirror generate()'s pre-check BEFORE wiping the live cache
-        raise ValueError(
-            f"history length {S} exceeds the largest token-generation bucket "
-            f"({app.token_generation_model.buckets[-1]}) needed for windowed "
-            f"prefill; raise token_generation_buckets/seq_len"
-        )
+    # generate()'s own pre-checks, run BEFORE wiping the live cache
+    app.validate_prefill_length(S)
     adapter_ids = app.resolve_adapter_ids(lora_adapter_names)
     app.init_kv_cache()  # fresh lines
     # _windowed_prefill degenerates to a single CTE pass when the history

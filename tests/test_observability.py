@@ -140,6 +140,30 @@ def test_profiler_capture(tmp_path, app):
     )
 
 
+def _decode_from_cache(a, history, pos, n_steps):
+    """Decode directly off a (reconstructed) cache: re-feed the last history
+    token at ITS position (idempotent write) and emit the successors."""
+    from neuronx_distributed_inference_tpu.modules.autobucketing import (
+        get_target_bucket,
+    )
+    from neuronx_distributed_inference_tpu.modules.sampling import (
+        prepare_sampling_params,
+    )
+
+    B = history.shape[0]
+    last = history[np.arange(B), pos - 1].astype(np.int32)
+    bucket = get_target_bucket(
+        a.token_generation_model.buckets, int(pos.max()) + n_steps
+    )
+    tokens, _, cache = a.token_generation_model.decode_chunk(
+        a.params, a.kv_cache, last[:, None], (pos[:, None] - 1).astype(np.int32),
+        np.arange(B, dtype=np.int32), prepare_sampling_params(B), None,
+        num_steps=n_steps, bucket=bucket,
+    )
+    a.kv_cache = cache
+    return np.asarray(tokens)[:, :n_steps]
+
+
 def test_kv_cache_reconstruct(app):
     """A reconstructed cache continues generation exactly where an unbroken
     run would (reference kv_cache_reconstruct_utils.py)."""
@@ -163,27 +187,8 @@ def test_kv_cache_reconstruct(app):
     np.testing.assert_array_equal(pos, hist_mask.sum(1))
     # decode DIRECTLY off the reconstructed cache (no re-prefill): the next
     # tokens must reproduce the unbroken run's suffix
-    from neuronx_distributed_inference_tpu.modules.autobucketing import (
-        get_target_bucket,
-    )
-    from neuronx_distributed_inference_tpu.modules.sampling import (
-        prepare_sampling_params,
-    )
-
-    # re-feed the last history token at ITS position (pos-1): the write is
-    # idempotent and the chunk emits the successors off the rebuilt cache
-    last = history[np.arange(2), pos - 1].astype(np.int32)
-    bucket = get_target_bucket(
-        app.token_generation_model.buckets, int(pos.max()) + 6
-    )
-    tokens, _, cache = app.token_generation_model.decode_chunk(
-        app.params, app.kv_cache, last[:, None],
-        (pos[:, None] - 1).astype(np.int32),
-        np.arange(2, dtype=np.int32), prepare_sampling_params(2), None,
-        num_steps=6, bucket=bucket,
-    )
-    app.kv_cache = cache
-    np.testing.assert_array_equal(np.asarray(tokens)[:, :6], full[:, 8 + n_keep : 8 + n_keep + 6])
+    tokens = _decode_from_cache(app, history, pos, 6)
+    np.testing.assert_array_equal(tokens, full[:, 8 + n_keep : 8 + n_keep + 6])
 
 
 def test_kv_cache_reconstruct_long_history():
@@ -203,20 +208,5 @@ def test_kv_cache_reconstruct_long_history():
     history = full[:, :105]
     pos = reconstruct_kv_cache(a, history)
     assert pos[0] == 105
-    from neuronx_distributed_inference_tpu.modules.autobucketing import (
-        get_target_bucket,
-    )
-    from neuronx_distributed_inference_tpu.modules.sampling import (
-        prepare_sampling_params,
-    )
-
-    last = history[:, -1].astype(np.int32)
-    bucket = get_target_bucket(a.token_generation_model.buckets, 110)
-    tokens, _, cache = a.token_generation_model.decode_chunk(
-        a.params, a.kv_cache, last[:, None],
-        (pos[:, None] - 1).astype(np.int32),
-        np.arange(1, dtype=np.int32), prepare_sampling_params(1), None,
-        num_steps=5, bucket=bucket,
-    )
-    a.kv_cache = cache
-    np.testing.assert_array_equal(np.asarray(tokens)[:, :5], full[:, 105:110])
+    tokens = _decode_from_cache(a, history, pos, 5)
+    np.testing.assert_array_equal(tokens, full[:, 105:110])
