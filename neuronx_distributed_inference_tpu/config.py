@@ -85,7 +85,7 @@ class OnDeviceSamplingConfig:
 
     @classmethod
     def from_dict(cls, d):
-        return cls(**{k: v for k, v in d.items() if k in _field_names(cls)})
+        return cls(**_strict_kwargs(cls, dict(d)))
 
 
 @dataclass
@@ -127,7 +127,7 @@ class ChunkedPrefillConfig:
 
     @classmethod
     def from_dict(cls, d):
-        return cls(**{k: v for k, v in d.items() if k in _field_names(cls)})
+        return cls(**_strict_kwargs(cls, dict(d)))
 
 
 @dataclass
@@ -151,11 +151,27 @@ class LoraServingConfig:
         d = dict(d)
         if "target_modules" in d:
             d["target_modules"] = tuple(d["target_modules"])
-        return cls(**{k: v for k, v in d.items() if k in _field_names(cls)})
+        return cls(**_strict_kwargs(cls, d))
 
 
 def _field_names(cls) -> set:
     return {f.name for f in dataclasses.fields(cls)}
+
+
+def _strict_kwargs(cls, d: dict) -> dict:
+    """Reject unknown keys when deserializing a config.
+
+    A typo'd feature flag in a saved ``tpu_config.json`` must fail loudly, not
+    round-trip to silently-off (the same contract the in-memory
+    ``UNIMPLEMENTED_FLAGS`` audit enforces for live configs).
+    """
+    unknown = sorted(set(d) - _field_names(cls))
+    if unknown:
+        raise ValueError(
+            f"Unknown {cls.__name__} key(s) in serialized config: {unknown}. "
+            "Refusing to silently drop them — fix or remove these keys."
+        )
+    return d
 
 
 # ---------------------------------------------------------------------------
@@ -168,7 +184,6 @@ def _field_names(cls) -> set:
 
 UNIMPLEMENTED_FLAGS: Dict[str, Tuple[Any, str]] = {
     "token_tree_config": (None, "token-tree speculation (reference eagle/token_tree.py)"),
-    "attn_block_tkg_kernel_enabled": (False, "fused block decode-attention kernel"),
     "is_eagle_target": (
         False,
         "per-submodel role flags are internal to the reference's config "
@@ -271,7 +286,12 @@ class TpuConfig:
     flash_decoding_enabled: bool = False
     num_cores_per_group: int = 1
     attn_kernel_enabled: Optional[bool] = None  # None = auto (pallas flash attn on TPU)
-    attn_block_tkg_kernel_enabled: bool = False
+    # decode (TKG) attention kernel, contiguous + paged (ops/decode_attention.py):
+    # None = auto on TPU, True = force, False = native gather path.
+    # NOTE: artifacts saved before this feature landed serialized the then-
+    # inert default `false`, which now pins the native path — re-save the
+    # artifact (or edit tpu_config.json to null) to restore auto.
+    attn_block_tkg_kernel_enabled: Optional[bool] = None
     k_cache_transposed: bool = False
     qk_norm: bool = False
 
@@ -499,8 +519,7 @@ class TpuConfig:
             d["chunked_prefill_config"] = ChunkedPrefillConfig.from_dict(d["chunked_prefill_config"])
         if d.get("lora_config"):
             d["lora_config"] = LoraServingConfig.from_dict(d["lora_config"])
-        known = _field_names(cls)
-        return cls(**{k: v for k, v in d.items() if k in known})
+        return cls(**_strict_kwargs(cls, d))
 
 
 @dataclass

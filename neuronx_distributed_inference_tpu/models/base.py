@@ -109,6 +109,10 @@ class ModelSpec:
     # ring-buffer KV cache bounded to the sliding window (cache holds W slots;
     # reference kv_cache_manager.py:194-198 bounds the cache to window size)
     bounded_window: Optional[int] = None
+    # interleaved per-layer cache sizing (GPT-OSS): sliding layers ring-bound
+    # to this window while global layers keep full-length lines; the cache is
+    # an InterleavedKVCache (reference gpt_oss_kv_cache_manager.py)
+    ring_window: Optional[int] = None
     # heterogeneous layer stacks: None = one uniform group (spec-level
     # sliding_window / attention_chunk_size apply)
     layer_groups: Optional[Tuple[LayerGroupSpec, ...]] = None
@@ -162,6 +166,44 @@ def gated_mlp(params: dict, hidden: jax.Array, spec: ModelSpec) -> jax.Array:
     return linear(params["down_proj"], gate * up)
 
 
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    k_prior: jax.Array,
+    v_prior: jax.Array,
+    positions: jax.Array,
+    W: int,
+    aspec: AttnSpec,
+    sink: Optional[jax.Array],
+) -> jax.Array:
+    """Ring decode/prefill-chunk attention: softmax over [prior ring slots |
+    in-flight chunk] with masks derived from absolute positions (reference
+    windowed TKG mask over a bounded cache, model_base.py:319-340 +
+    kv_cache_manager.py:194-198).
+
+    ``k_prior``/``v_prior`` hold the W ring slots read BEFORE this chunk's
+    writes landed; ``positions`` are absolute (sentinel-negative for padded).
+    """
+    p = positions  # (B, S)
+    head = p[:, :1] - 1  # (B, 1) last pre-chunk position
+    slots = jnp.arange(W, dtype=p.dtype)[None, :]
+    # position stored in ring slot s before this chunk wrote anything
+    slot_pos = head - ((head - slots) % W)  # (B, W)
+    qp = p[:, None, :, None]  # (B, 1, S, 1)
+    prior_ok = (
+        (slot_pos[:, None, None, :] >= 0)
+        & (slot_pos[:, None, None, :] > qp - W)
+        & (qp >= 0)
+    )  # (B, 1, S, W)
+    kp = p[:, None, None, :]  # in-flight token positions (B, 1, 1, S)
+    active_ok = (kp >= 0) & (kp <= qp) & (kp > qp - W)
+    ring_mask = jnp.concatenate([prior_ok, active_ok], axis=-1)
+    keys = jnp.concatenate([k_prior.astype(k.dtype), k], axis=1)
+    vals = jnp.concatenate([v_prior.astype(v.dtype), v], axis=1)
+    return attention_decode(q, keys, vals, ring_mask, aspec, sink=sink)
+
+
 def decoder_layer(
     layer_params: dict,
     hidden: jax.Array,
@@ -199,7 +241,12 @@ def decoder_layer(
     # write-then-attend: scatter new KV into this layer's cache first
     # (reference updates via kv_mgr.update_cache per layer, model_base.py:1449)
     is_block = block_inputs is not None
-    bounded = spec.bounded_window is not None and not is_block
+    # interleaved per-layer cache: k_cache/v_cache arrive as (full, ring)
+    # stacks and layer_idx as (full_idx, ring_idx, is_sliding) — exactly one
+    # of the two scatters below lands; the other drops on its out-of-range
+    # layer-index sentinel (scatter mode="drop")
+    interleaved = isinstance(k_cache, tuple)
+    bounded = spec.bounded_window is not None and not is_block and not interleaved
     if bounded and phase != PHASE_CONTEXT_ENCODING:
         # ring cache: read the PRIOR window state BEFORE this chunk's writes
         # land (prior/active decomposition — reference compute_for_token_gen's
@@ -209,7 +256,27 @@ def decoder_layer(
         k_prior, v_prior = read_cache_at_layer(
             k_cache, v_cache, layer_idx, q.shape[0], W
         )
-    if is_block:
+    if interleaved:
+        k_full, k_ring = k_cache
+        v_full, v_ring = v_cache
+        full_i, ring_i, is_sliding = layer_idx
+        W = spec.ring_window
+        if phase != PHASE_CONTEXT_ENCODING:
+            # prior ring window read BEFORE writes (same hazard as `bounded`);
+            # for global layers ring_i clamps to a real slice whose values are
+            # never used (the lax.cond below takes the full-cache branch)
+            k_prior, v_prior = read_cache_at_layer(
+                k_ring, v_ring, ring_i, q.shape[0], W
+            )
+        ring_pos = jnp.where(positions >= 0, positions % W, W)
+        k_full, v_full = update_cache_at_layer(
+            k_full, v_full, k, v, full_i, slot_ids, positions
+        )
+        k_ring, v_ring = update_cache_at_layer(
+            k_ring, v_ring, k, v, ring_i, slot_ids, ring_pos
+        )
+        k_cache, v_cache = (k_full, k_ring), (v_full, v_ring)
+    elif is_block:
         from neuronx_distributed_inference_tpu.modules.block_kvcache import (
             read_block_cache_at_layer,
             update_block_cache_at_layer,
@@ -280,49 +347,95 @@ def decoder_layer(
                 interpret=jax.default_backend() != "tpu",
             )
         else:
-            k_r, v_r = read_block_cache_at_layer(k_cache, v_cache, layer_idx, block_table)
-            attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
+            from neuronx_distributed_inference_tpu.ops.decode_attention import (
+                paged_tkg_decode_attention,
+                use_tkg_kernel,
+            )
+
+            bs = k_cache.shape[2]
+            width_ok = mask.shape[-1] == block_table.shape[1] * bs
+            if (
+                width_ok
+                and k_cache.shape == v_cache.shape
+                and use_tkg_kernel(aspec, Sq, mask.shape[-1])
+            ):
+                # decode/speculation off the paged cache: blocks DMA'd via the
+                # block table — no gather materialization (reference block TKG
+                # mega kernel, attention_base.py:1609)
+                attn_out = paged_tkg_decode_attention(
+                    q, k_cache, v_cache, layer_idx, block_table, mask, sink,
+                    scale=aspec.softmax_scale,
+                    n_kv=aspec.num_kv_heads,
+                    interpret=jax.default_backend() != "tpu",
+                )
+            else:
+                k_r, v_r = read_block_cache_at_layer(
+                    k_cache, v_cache, layer_idx, block_table
+                )
+                attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
     elif bounded:
-        # ring decode/prefill-chunk attention: softmax over [prior ring slots
-        # | in-flight chunk] with masks derived from absolute positions
-        # (reference windowed TKG mask over a bounded cache,
-        # model_base.py:319-340 + kv_cache_manager.py:194-198)
-        W = spec.bounded_window
-        B, S = q.shape[0], q.shape[1]
-        p = positions  # (B, S) absolute (sentinel-negative for padded)
-        head = p[:, :1] - 1  # (B, 1) last pre-chunk position
-        slots = jnp.arange(W, dtype=p.dtype)[None, :]
-        # position stored in ring slot s before this chunk wrote anything
-        slot_pos = head - ((head - slots) % W)  # (B, W)
-        qp = p[:, None, :, None]  # (B, 1, S, 1)
-        prior_ok = (
-            (slot_pos[:, None, None, :] >= 0)
-            & (slot_pos[:, None, None, :] > qp - W)
-            & (qp >= 0)
-        )  # (B, 1, S, W)
-        kp = p[:, None, None, :]  # in-flight token positions (B, 1, 1, S)
-        active_ok = (kp >= 0) & (kp <= qp) & (kp > qp - W)
-        ring_mask = jnp.concatenate([prior_ok, active_ok], axis=-1)
-        keys = jnp.concatenate([k_prior.astype(k.dtype), k], axis=1)
-        vals = jnp.concatenate([v_prior.astype(v.dtype), v], axis=1)
-        attn_out = attention_decode(q, keys, vals, ring_mask, aspec, sink=sink)
+        attn_out = ring_attention(
+            q, k, v, k_prior, v_prior, positions, spec.bounded_window, aspec, sink
+        )
+    elif interleaved:
+        # decode: sliding layers attend [prior ring | chunk]; global layers
+        # attend their full-length cache line. lax.cond executes only the
+        # taken branch, so sliding layers never pay the full-cache read
+        B = q.shape[0]
+        bucket = mask.shape[-1]
+
+        def _global_attend(_):
+            k_r, v_r = read_cache_at_layer(k_full, v_full, full_i, B, bucket)
+            return attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
+
+        def _ring_attend(_):
+            return ring_attention(
+                q, k, v, k_prior, v_prior, positions, spec.ring_window, aspec, sink
+            )
+
+        attn_out = jax.lax.cond(is_sliding == 1, _ring_attend, _global_attend, None)
     else:
         B = q.shape[0]
         bucket = mask.shape[-1]
-        if spec.attention_dp > 1 or spec.data_parallel > 1:
-            # batch-parallel decode attention over (ddp, dp): GSPMD
-            # all-to-alls heads<->batch around the attention (reference DP
-            # decode, attention_base.py:2308-2321)
-            from neuronx_distributed_inference_tpu.parallel import attention_dp as adp
-
-            q = adp.shard_decode_q(q)
-        k_r, v_r = read_cache_at_layer(
-            k_cache, v_cache, layer_idx, B, bucket,
-            dp=spec.attention_dp * spec.data_parallel,
+        from neuronx_distributed_inference_tpu.ops.decode_attention import (
+            tkg_decode_attention,
+            use_tkg_kernel,
         )
-        attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
-        if spec.attention_dp > 1 or spec.data_parallel > 1:
-            attn_out = adp.unshard_attn_out(attn_out)
+
+        plain_parallel = (
+            spec.attention_dp == 1 and spec.data_parallel == 1 and not spec.cp_enabled
+        )
+        if (
+            plain_parallel
+            and k_cache.shape == v_cache.shape
+            and use_tkg_kernel(aspec, q.shape[1], bucket)
+        ):
+            # decode/speculation attention straight off the stacked cache —
+            # no bucket-slice copy, no repeat_kv broadcast (reference TKG
+            # kernel, attention_base.py:1467)
+            attn_out = tkg_decode_attention(
+                q, k_cache, v_cache, layer_idx, mask, sink,
+                scale=aspec.softmax_scale,
+                n_kv=aspec.num_kv_heads,
+                interpret=jax.default_backend() != "tpu",
+            )
+        else:
+            if spec.attention_dp > 1 or spec.data_parallel > 1:
+                # batch-parallel decode attention over (ddp, dp): GSPMD
+                # all-to-alls heads<->batch around the attention (reference DP
+                # decode, attention_base.py:2308-2321)
+                from neuronx_distributed_inference_tpu.parallel import (
+                    attention_dp as adp,
+                )
+
+                q = adp.shard_decode_q(q)
+            k_r, v_r = read_cache_at_layer(
+                k_cache, v_cache, layer_idx, B, bucket,
+                dp=spec.attention_dp * spec.data_parallel,
+            )
+            attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
+            if spec.attention_dp > 1 or spec.data_parallel > 1:
+                attn_out = adp.unshard_attn_out(attn_out)
 
     hidden = o_project(layer_params["self_attn"], attn_out, aspec, adapter_ids=adapter_ids)
     hidden = residual + hidden
@@ -463,7 +576,7 @@ def run_decoder_layers(
         # reduce-scatter of embeddings, model_base.py:1524-1575)
         from neuronx_distributed_inference_tpu.parallel import context_parallel as cpx
 
-        hidden = cpx.shard_seq(hidden)
+        hidden = cpx.shard_seq_from_embed(hidden)
 
     is_block = inputs.slot_mapping is not None or inputs.block_table is not None
     if is_block:
@@ -507,7 +620,17 @@ def run_decoder_layers(
             return inputs.attention_mask
         return None
 
-    k_cache, v_cache = cache.k, cache.v
+    interleaved = spec.ring_window is not None
+    if interleaved:
+        if not prestacked:
+            raise ValueError(
+                "ring_window (interleaved per-layer cache sizing) requires a "
+                "prestacked layer stack"
+            )
+        k_cache = (cache.k_full, cache.k_ring)
+        v_cache = (cache.v_full, cache.v_ring)
+    else:
+        k_cache, v_cache = cache.k, cache.v
 
     if prestacked:
         # ONE scan over the load-time-stacked params; each layer selects its
@@ -541,25 +664,75 @@ def run_decoder_layers(
             )
         flavor_arr = jnp.asarray(flavor_ids, jnp.int32)
 
-        def fused_body(carry, xs):
-            h, k_c, v_c = carry
-            layer_params, li, fl = xs
-            if len(flavor_masks) == 1:
-                mask = flavor_masks[0]
-            else:
-                mask = jnp.where(fl == 1, flavor_masks[1], flavor_masks[0])
-            h, k_c, v_c = g_layer(
-                layer_params, h, cos, sin, k_c, v_c, li, mask, slot_ids, positions,
-                spec, phase, g_mlp, key_valid=key_valid, block_inputs=block_inputs,
-                adapter_ids=inputs.adapter_ids,
-            )
-            return (h, k_c, v_c), None
+        if interleaved:
+            # per-layer indices into the two stacks; a layer's index into the
+            # OTHER flavor's stack is the out-of-range sentinel, which drops
+            # that stack's scatter (kvcache.update_cache_at_layer mode="drop")
+            if len(uniq) != 2 or (None, None) not in uniq or any(c for (_, c) in uniq):
+                raise ValueError(
+                    "ring_window needs exactly one sliding and one global "
+                    "flavor (no chunked-attention flavors)"
+                )
+            slide, full_idx, ring_idx = [], [], []
+            for g in group_specs:
+                s = 1 if g.sliding_window is not None else 0
+                slide.extend([s] * g.num_layers)
+            nf = nr = 0
+            for s in slide:
+                full_idx.append(-1 if s else nf)
+                ring_idx.append(nr if s else -1)
+                nf += 0 if s else 1
+                nr += 1 if s else 0
+            full_arr = jnp.asarray([x if x >= 0 else nf for x in full_idx], jnp.int32)
+            ring_arr = jnp.asarray([x if x >= 0 else nr for x in ring_idx], jnp.int32)
+            slide_arr = jnp.asarray(slide, jnp.int32)
+            global_mask = flavor_masks[uniq.index((None, None))]
+            sliding_mask = flavor_masks[1 - uniq.index((None, None))]
 
-        (hidden, k_cache, v_cache), _ = jax.lax.scan(
-            fused_body,
-            (hidden, k_cache, v_cache),
-            (groups[0], jnp.arange(total, dtype=jnp.int32), flavor_arr),
-        )
+            def fused_body(carry, xs):
+                h, k_c, v_c = carry
+                layer_params, full_i, ring_i, sl = xs
+                if phase == PHASE_CONTEXT_ENCODING:
+                    # prefill attends the in-flight chunk only: per-flavor mask
+                    mask = jnp.where(sl == 1, sliding_mask, global_mask)
+                else:
+                    # decode: global layers use this mask; sliding layers build
+                    # their ring mask from positions inside decoder_layer
+                    mask = global_mask
+                h, k_c, v_c = g_layer(
+                    layer_params, h, cos, sin, k_c, v_c, (full_i, ring_i, sl),
+                    mask, slot_ids, positions, spec, phase, g_mlp,
+                    key_valid=key_valid, block_inputs=block_inputs,
+                    adapter_ids=inputs.adapter_ids,
+                )
+                return (h, k_c, v_c), None
+
+            (hidden, k_cache, v_cache), _ = jax.lax.scan(
+                fused_body,
+                (hidden, k_cache, v_cache),
+                (groups[0], full_arr, ring_arr, slide_arr),
+            )
+        else:
+
+            def fused_body(carry, xs):
+                h, k_c, v_c = carry
+                layer_params, li, fl = xs
+                if len(flavor_masks) == 1:
+                    mask = flavor_masks[0]
+                else:
+                    mask = jnp.where(fl == 1, flavor_masks[1], flavor_masks[0])
+                h, k_c, v_c = g_layer(
+                    layer_params, h, cos, sin, k_c, v_c, li, mask, slot_ids, positions,
+                    spec, phase, g_mlp, key_valid=key_valid, block_inputs=block_inputs,
+                    adapter_ids=inputs.adapter_ids,
+                )
+                return (h, k_c, v_c), None
+
+            (hidden, k_cache, v_cache), _ = jax.lax.scan(
+                fused_body,
+                (hidden, k_cache, v_cache),
+                (groups[0], jnp.arange(total, dtype=jnp.int32), flavor_arr),
+            )
     else:
         offset = 0
         for group_params, gspec in zip(groups, group_specs):
@@ -596,7 +769,12 @@ def run_decoder_layers(
                 (group_params, offset + jnp.arange(num_layers, dtype=jnp.int32)),
             )
             offset += num_layers
-    new_cache = type(cache)(k=k_cache, v=v_cache)
+    if interleaved:
+        new_cache = type(cache)(
+            k_full=k_cache[0], v_full=v_cache[0], k_ring=k_cache[1], v_ring=v_cache[1]
+        )
+    else:
+        new_cache = type(cache)(k=k_cache, v=v_cache)
 
     hidden = apply_norm(hidden, params["norm"]["weight"], spec.rms_eps, spec.norm_type)
     return hidden, new_cache

@@ -39,6 +39,19 @@ def pad_vocab(vocab_size: int, degree: int) -> int:
     return math.ceil(vocab_size / degree) * degree
 
 
+def ring_layout_ok(tc) -> bool:
+    """Layout gate for ring-bounded (slot = position mod W) KV caches —
+    uniform (bounded_window) or interleaved per-layer (ring_window). Feature
+    combinations that assume position == slot must keep full-length caches."""
+    return (
+        not tc.is_block_kv_layout
+        and tc.cp_degree == 1
+        and tc.attention_dp_degree == 1
+        and tc.data_parallel_degree == 1
+        and not tc.enable_fused_speculation
+    )
+
+
 class DecoderModelBuilder:
     """Base builder for llama-family decoder-only models."""
 
@@ -76,6 +89,7 @@ class DecoderModelBuilder:
             has_sink=bool(getattr(self.config, "attention_sink", False)),
             rms_norm_eps=getattr(self.config, "rms_norm_eps", 1e-6),
             use_flash_kernel=tc.attn_kernel_enabled,
+            use_tkg_kernel=tc.attn_block_tkg_kernel_enabled,
             qkv_shards=self.degree if tc.fused_qkv else 1,
         )
 
@@ -117,11 +131,7 @@ class DecoderModelBuilder:
             spec.sliding_window
             and spec.layer_groups is None
             and spec.sliding_window < tc.seq_len
-            and not tc.is_block_kv_layout
-            and tc.cp_degree == 1
-            and tc.attention_dp_degree == 1
-            and tc.data_parallel_degree == 1
-            and not tc.enable_fused_speculation
+            and ring_layout_ok(tc)
         ):
             return dataclasses.replace(spec, bounded_window=spec.sliding_window)
         return spec

@@ -81,6 +81,7 @@ class GptOssModelBuilder(DecoderModelBuilder):
 
     def model_spec(self):
         cfg = self.config
+        tc = cfg.tpu_config
         spec = super().model_spec()
         sw = getattr(cfg, "sliding_window", None)
         groups = tuple(
@@ -90,9 +91,50 @@ class GptOssModelBuilder(DecoderModelBuilder):
             )
             for s, e, t in self.runs
         )
-        return dataclasses.replace(
-            spec, layer_groups=groups, sliding_window=None, bounded_window=None
+        # per-layer cache sizing: sliding layers ring-bound to W slots while
+        # global layers keep full lines (reference gpt_oss_kv_cache_manager.py,
+        # kv_cache_manager.py:145-151). Same layout gates as _finalize_bounded:
+        # combinations that assume position==slot keep full-length caches.
+        from neuronx_distributed_inference_tpu.models.builder import ring_layout_ok
+
+        kinds = {t for _, _, t in self.runs}
+        ring = (
+            sw
+            and sw < tc.seq_len
+            and kinds == {"sliding_attention", "full_attention"}
+            and ring_layout_ok(tc)
         )
+        return dataclasses.replace(
+            spec,
+            layer_groups=groups,
+            sliding_window=None,
+            bounded_window=None,
+            ring_window=sw if ring else None,
+        )
+
+    def init_kv_cache(self, mesh):
+        spec = self.model_spec()
+        if spec.ring_window is None:
+            return super().init_kv_cache(mesh)
+        from neuronx_distributed_inference_tpu.modules.kvcache import (
+            init_interleaved_cache,
+            interleaved_cache_spec,
+        )
+        from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
+
+        tc = self.config.tpu_config
+        num_sliding = sum(t == "sliding_attention" for t in self.layer_types)
+        cache = init_interleaved_cache(
+            len(self.layer_types) - num_sliding,
+            num_sliding,
+            tc.kv_cache_batch_size or tc.max_batch_size,
+            tc.seq_len,
+            spec.ring_window,
+            self.gqa.kv_heads,
+            self.head_dim,
+            dtype=to_dtype(tc.kv_cache_dtype or tc.dtype),
+        )
+        return shard_pytree(cache, interleaved_cache_spec(), mesh)
 
     def moe_spec(self) -> MoESpec:
         cfg = self.config

@@ -48,6 +48,9 @@ class AttnSpec:
     has_sink: bool = False
     rms_norm_eps: float = 1e-6
     use_flash_kernel: Optional[bool] = None  # None = auto by platform
+    # decode (TKG) attention kernel (config attn_block_tkg_kernel_enabled):
+    # None = auto on TPU, True = force, False = native path
+    use_tkg_kernel: Optional[bool] = None
     # model-parallel degree of the rank-interleaved fused-qkv layout
     # (builder._fuse_qkv); 1 when fused_qkv is off
     qkv_shards: int = 1

@@ -87,6 +87,78 @@ def init_cache(
     return KVCache(k=jnp.zeros(k_shape, dtype), v=jnp.zeros(v_shape, dtype))
 
 
+@jax.tree_util.register_dataclass
+@dataclass
+class InterleavedKVCache:
+    """Per-layer-sized cache for interleaved sliding/global stacks (GPT-OSS).
+
+    Global-attention layers keep full-length lines; sliding layers are
+    ring-bound to W slots — total HBM equals the sum of per-layer sizes
+    (reference per-layer sizing, modules/kvcache/gpt_oss_kv_cache_manager.py,
+    kv_cache_manager.py:145-151).
+
+    k_full/v_full: (L_global, B+G, S_max, H, D)
+    k_ring/v_ring: (L_sliding, B+G, W, H, D)
+    """
+
+    k_full: jax.Array
+    v_full: jax.Array
+    k_ring: jax.Array
+    v_ring: jax.Array
+
+    # shape probes (batch rows, max positions) read the full stack; code that
+    # needs the ring stack addresses it explicitly
+    @property
+    def k(self) -> jax.Array:
+        return self.k_full
+
+    @property
+    def v(self) -> jax.Array:
+        return self.v_full
+
+    @property
+    def num_layers(self) -> int:
+        return self.k_full.shape[0] + self.k_ring.shape[0]
+
+    @property
+    def max_len(self) -> int:
+        return self.k_full.shape[2]
+
+    @property
+    def window(self) -> int:
+        return self.k_ring.shape[2]
+
+
+def init_interleaved_cache(
+    num_global_layers: int,
+    num_sliding_layers: int,
+    batch_size: int,
+    max_len: int,
+    window: int,
+    num_kv_heads: int,
+    head_dim: int,
+    dtype=jnp.bfloat16,
+) -> InterleavedKVCache:
+    rows = batch_size + GARBAGE_LINES
+    return InterleavedKVCache(
+        k_full=jnp.zeros((num_global_layers, rows, max_len, num_kv_heads, head_dim), dtype),
+        v_full=jnp.zeros((num_global_layers, rows, max_len, num_kv_heads, head_dim), dtype),
+        k_ring=jnp.zeros((num_sliding_layers, rows, window, num_kv_heads, head_dim), dtype),
+        v_ring=jnp.zeros((num_sliding_layers, rows, window, num_kv_heads, head_dim), dtype),
+    )
+
+
+def interleaved_cache_spec():
+    """Head-sharded PartitionSpecs for both stacks (the interleaved layout is
+    gated to cp=1/dp=1, so only the head dim shards)."""
+    from jax.sharding import PartitionSpec as P
+
+    from neuronx_distributed_inference_tpu.parallel.mesh import MODEL_AXES
+
+    spec = P(None, None, None, MODEL_AXES, None)
+    return InterleavedKVCache(k_full=spec, v_full=spec, k_ring=spec, v_ring=spec)
+
+
 def cache_spec(cp_enabled: bool = False, dp_enabled: bool = False):
     """PartitionSpec for the cache — identical for the CTE and TKG programs so
     the cache never reshards between phases (SURVEY §7 hard-part 5).

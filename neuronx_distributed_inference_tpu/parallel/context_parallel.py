@@ -36,6 +36,22 @@ def shard_seq(hidden):
     return _constrain(hidden, P(None, AXIS_CP, None))
 
 
+def shard_seq_from_embed(hidden):
+    """Guided reshard for the embedding-gather output.
+
+    The gather inherits the table's H-sharding over the FULL model group
+    (``[1,1,dp·ep·cp·tp]``); GSPMD cannot reach the prefill layout
+    (S over cp, H over tp) in one step and falls back to involuntary full
+    rematerialization (replicate-then-slice). Hop 1 moves the cp factor from
+    H to S — a single all-to-all over the cp ring; hop 2 (:func:`shard_seq`)
+    pins S and releases H to propagation.
+    """
+    from neuronx_distributed_inference_tpu.parallel.mesh import AXIS_DP
+
+    hidden = _constrain(hidden, P(None, AXIS_CP, (AXIS_DP, AXIS_EP, AXIS_TP)))
+    return shard_seq(hidden)
+
+
 def shard_q(q):
     """(B, S, Hq, D): Q keeps its sequence stripe, heads over (ep, tp)."""
     return _constrain(q, P(None, AXIS_CP, HEADS, None))

@@ -320,8 +320,9 @@ class TpuModelForCausalLM:
         B, S_in = input_ids.shape
         W = self.spec.bounded_window
         C = self.context_encoding_model.buckets[-1]
-        if W:
-            C = min(C, W)  # ring slots must stay distinct within one chunk
+        ring_w = W or self.spec.ring_window
+        if ring_w:
+            C = min(C, ring_w)  # ring slots must stay distinct within one chunk
         ctx_lens = attention_mask.sum(axis=1).astype(np.int64)
         first_tok = np.zeros((B,), np.int64)
         first_logits = (
@@ -401,6 +402,10 @@ class TpuModelForCausalLM:
             raise ValueError(f"prompt length {S} exceeds seq_len {tc.seq_len}")
         windowed = S > tc.max_context_length or (
             self.spec.bounded_window and S > self.spec.bounded_window
+        ) or (
+            # interleaved ring cache: prompts longer than the window must
+            # prefill in ≤W chunks so in-chunk ring slots stay distinct
+            self.spec.ring_window and S > self.spec.ring_window
         )
         if (
             windowed

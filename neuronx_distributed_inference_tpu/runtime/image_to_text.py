@@ -68,7 +68,7 @@ class TpuImageToTextModel:
 
         builder_cls = get_model_builder(text_type)
         config_cls = getattr(builder_cls, "config_cls", InferenceConfig)
-        text_conf = config_cls(TpuConfig.from_dict(tc.to_dict()), load_config=load_text)
+        text_conf = config_cls(type(tc).from_dict(tc.to_dict()), load_config=load_text)
         self.text = TpuModelForCausalLM(model_path, text_conf, mesh=mesh)
         self.vision_params = None
         self.projector = None

@@ -133,9 +133,10 @@ class SubModelRunner:
                     inputs_embeds = np.pad(
                         np.asarray(inputs_embeds), ((0, 0), (0, pad_s), (0, 0))
                     )
-                if bounded:
-                    # ring cache: sentinel positions make padded writes DROP
-                    # instead of wrapping onto live ring slots
+                if bounded or self.spec.ring_window:
+                    # ring cache (uniform or interleaved per-layer): sentinel
+                    # positions make padded writes DROP instead of wrapping
+                    # (mod W) onto live ring slots
                     from neuronx_distributed_inference_tpu.modules.kvcache import (
                         PAD_POSITION_SENTINEL,
                     )

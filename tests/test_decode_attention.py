@@ -1,0 +1,205 @@
+"""Kernel-parity tests for the Pallas TKG decode-attention kernels
+(VERDICT r2 next #1) — oracle is the native masked-softmax decode path, at
+q=1 (decode) and q=4 (speculation), with GQA, sinks, and paged block tables.
+Runs in interpret mode on CPU (same pattern as tests/test_chunked_prefill.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_inference_tpu.modules.attention import (
+    AttnSpec,
+    attention_decode,
+)
+from neuronx_distributed_inference_tpu.ops.decode_attention import (
+    paged_tkg_decode_attention,
+    tkg_decode_attention,
+    use_tkg_kernel,
+)
+
+L, R, S_MAX = 3, 5, 256
+HQ, HKV, D = 8, 2, 64
+
+
+def _spec(**kw):
+    return AttnSpec(num_heads=HQ, num_kv_heads=HKV, head_dim=D, **kw)
+
+
+def _rand(rng, *shape):
+    return jnp.asarray(rng.randn(*shape).astype(np.float32) * 0.3)
+
+
+def _decode_mask(rng, B, K, S, valid_len):
+    """Standard decode mask: cols <= per-token position, per row."""
+    pos = np.stack(
+        [np.arange(valid_len[b] - K, valid_len[b]) for b in range(B)]
+    )  # (B, K)
+    cols = np.arange(S)[None, None, :]
+    return jnp.asarray(cols <= pos[:, :, None])[:, None], pos
+
+
+@pytest.mark.parametrize("K", [1, 4])
+@pytest.mark.parametrize("sink", [False, True])
+def test_tkg_contiguous_parity(K, sink):
+    rng = np.random.RandomState(0 if K == 1 else 1)
+    B, bucket = 2, 128
+    layer = 1
+    q = _rand(rng, B, K, HQ, D)
+    k_cache = _rand(rng, L, R, S_MAX, HKV, D)
+    v_cache = _rand(rng, L, R, S_MAX, HKV, D)
+    valid = [100, 37]
+    mask, _ = _decode_mask(rng, B, K, bucket, valid)
+    sink_w = _rand(rng, HQ) if sink else None
+
+    spec = _spec(has_sink=sink)
+    k_r = k_cache[layer, :B, :bucket]
+    v_r = v_cache[layer, :B, :bucket]
+    ref = attention_decode(q, k_r, v_r, mask, spec, sink=sink_w)
+
+    out = tkg_decode_attention(
+        q, k_cache, v_cache, jnp.int32(layer), mask, sink_w,
+        scale=spec.softmax_scale, n_kv=HKV, bs=64, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_tkg_contiguous_windowed_mask():
+    """Window-flavored decode masks work unchanged (mask-driven kernel)."""
+    rng = np.random.RandomState(2)
+    B, K, bucket, W = 2, 1, 128, 16
+    q = _rand(rng, B, K, HQ, D)
+    k_cache = _rand(rng, L, R, S_MAX, HKV, D)
+    v_cache = _rand(rng, L, R, S_MAX, HKV, D)
+    mask, pos = _decode_mask(rng, B, K, bucket, [90, 50])
+    cols = jnp.arange(bucket)[None, None, None, :]
+    mask = mask & (cols > jnp.asarray(pos)[:, None, :, None] - W)
+
+    spec = _spec()
+    ref = attention_decode(
+        q, k_cache[0, :B, :bucket], v_cache[0, :B, :bucket], mask, spec
+    )
+    out = tkg_decode_attention(
+        q, k_cache, v_cache, jnp.int32(0), mask, None,
+        scale=spec.softmax_scale, n_kv=HKV, bs=64, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("K", [1, 4])
+def test_tkg_paged_parity(K):
+    from neuronx_distributed_inference_tpu.modules.block_kvcache import (
+        read_block_cache_at_layer,
+    )
+
+    rng = np.random.RandomState(3 + K)
+    B, NB, bs, MB = 2, 12, 16, 8
+    layer = 2
+    q = _rand(rng, B, K, HQ, D)
+    k_cache = _rand(rng, L, NB + 1, bs, HKV, D)
+    v_cache = _rand(rng, L, NB + 1, bs, HKV, D)
+    # distinct non-garbage blocks per row; unused tail -> 0 (garbage)
+    bt = np.zeros((B, MB), np.int32)
+    bt[0, :6] = rng.permutation(np.arange(1, NB + 1))[:6]
+    bt[1, :3] = rng.permutation(np.arange(1, NB + 1))[:3]
+    block_table = jnp.asarray(bt)
+    valid = [6 * bs - 5, 3 * bs - 9]
+    mask, _ = _decode_mask(rng, B, K, MB * bs, valid)
+
+    spec = _spec()
+    k_r, v_r = read_block_cache_at_layer(
+        k_cache, v_cache, jnp.int32(layer), block_table
+    )
+    ref = attention_decode(q, k_r, v_r, mask, spec)
+
+    out = paged_tkg_decode_attention(
+        q, k_cache, v_cache, jnp.int32(layer), block_table, mask, None,
+        scale=spec.softmax_scale, n_kv=HKV, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_use_tkg_kernel_gates():
+    spec = _spec(use_tkg_kernel=True)
+    assert use_tkg_kernel(spec, 1, 512)
+    assert use_tkg_kernel(spec, 1, 128)
+    assert not use_tkg_kernel(spec, 32, 512)  # q too long
+    assert not use_tkg_kernel(spec, 1, 96)  # non-tileable width
+    off = _spec(use_tkg_kernel=False)
+    assert not use_tkg_kernel(off, 1, 512)
+    auto = _spec()
+    # auto mode requires a real TPU backend
+    assert use_tkg_kernel(auto, 1, 512) == (jax.default_backend() == "tpu")
+
+
+def test_tkg_kernel_e2e_token_match():
+    """generate() with the forced TKG kernel (interpret mode on CPU) matches
+    the native decode path bit-for-bit on tokens and logits."""
+    import sys, os
+    sys.path.insert(0, os.path.dirname(__file__))
+    from conftest import make_tiny_config, make_random_hf_state_dict
+
+    from neuronx_distributed_inference_tpu.runtime.application import (
+        TpuModelForCausalLM,
+    )
+
+    prompts = np.array([[5, 17, 92, 41, 33, 88, 2, 11], [64, 3, 27, 9, 0, 0, 0, 0]])
+    mask = np.array([[1, 1, 1, 1, 1, 1, 1, 1], [1, 1, 1, 1, 0, 0, 0, 0]])
+
+    outs = {}
+    for flag in (False, True):
+        cfg = make_tiny_config(
+            tpu=dict(
+                seq_len=128,
+                token_generation_buckets=[128],
+                output_logits=True,
+                attn_block_tkg_kernel_enabled=flag,
+            )
+        )
+        sd = make_random_hf_state_dict(cfg)
+        app = TpuModelForCausalLM(None, cfg)
+        app.load(state_dict=sd)
+        outs[flag] = app.generate(prompts, mask, max_new_tokens=6)
+    np.testing.assert_array_equal(outs[True].sequences, outs[False].sequences)
+    np.testing.assert_allclose(
+        outs[True].logits, outs[False].logits, atol=2e-5, rtol=2e-5
+    )
+
+
+def test_tkg_kernel_serving_paged_decode():
+    """ServingSession block-KV decode with the forced paged TKG kernel matches
+    the native gather path token-for-token (the serving path the kernel was
+    built for — VERDICT r2 next #1)."""
+    import sys, os
+    sys.path.insert(0, os.path.dirname(__file__))
+    from conftest import make_tiny_config, make_random_hf_state_dict
+
+    from neuronx_distributed_inference_tpu.runtime.application import (
+        TpuModelForCausalLM,
+    )
+    from neuronx_distributed_inference_tpu.runtime.serving import ServingSession
+
+    results = {}
+    for flag in (False, True):
+        cfg = make_tiny_config(
+            tpu=dict(
+                seq_len=128,
+                token_generation_buckets=[128],
+                is_continuous_batching=True,
+                is_block_kv_layout=True,
+                pa_block_size=16,
+                pa_num_blocks=64,
+                batch_size=2,
+                ctx_batch_size=1,
+                attn_block_tkg_kernel_enabled=flag,
+            )
+        )
+        sd = make_random_hf_state_dict(cfg)
+        app = TpuModelForCausalLM(None, cfg)
+        app.load(state_dict=sd)
+        sess = ServingSession(app)
+        assert sess.add_request("r1", [5, 17, 92, 41], max_new_tokens=5)
+        assert sess.add_request("r2", [64, 3, 27, 9, 14, 33], max_new_tokens=5)
+        results[flag] = sess.run_to_completion()
+    assert results[True] == results[False]

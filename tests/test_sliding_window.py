@@ -67,3 +67,54 @@ def test_mistral_sliding_window_token_match():
         input_ids=torch.tensor(ids), max_new_tokens=n_new, do_sample=False, pad_token_id=0
     )
     np.testing.assert_array_equal(out.sequences, hf_out.numpy())
+
+
+def test_gpt_oss_interleaved_per_layer_cache_sizing():
+    """Interleaved sliding/global stacks size the cache PER LAYER: sliding
+    layers hold W ring slots, global layers full-length lines (VERDICT r2
+    weak #6; reference gpt_oss_kv_cache_manager.py, kv_cache_manager.py:145-151).
+    Long prompt (> W) exercises ring windowed prefill; oracle is HF."""
+    from transformers import GptOssConfig, GptOssForCausalLM
+
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.gpt_oss import GptOssInferenceConfig
+    from neuronx_distributed_inference_tpu.modules.kvcache import InterleavedKVCache
+    from neuronx_distributed_inference_tpu.runtime.application import TpuModelForCausalLM
+
+    W = 4
+    hf_cfg = GptOssConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=64,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, num_local_experts=4, num_experts_per_tok=2,
+        sliding_window=W, max_position_embeddings=256,
+        rope_scaling=None, attn_implementation="eager",
+        eos_token_id=None, pad_token_id=0, tie_word_embeddings=False,
+    )
+    torch.manual_seed(3)
+    hf = GptOssForCausalLM(hf_cfg).eval().float()
+    sd = {k: v.float().numpy() for k, v in hf.state_dict().items()}
+
+    def load_config(cfg):
+        cfg.model_type = "gpt_oss"
+        for k, v in hf_cfg.to_dict().items():
+            setattr(cfg, k, v)
+
+    tc = TpuConfig(batch_size=1, seq_len=64, dtype="float32")
+    cfg = GptOssInferenceConfig(tc, load_config=load_config)
+    app = TpuModelForCausalLM(None, cfg)
+    app.load(state_dict=sd)
+
+    # per-layer sizing: 2 sliding layers at W slots, 2 global at seq_len
+    cache = app.kv_cache
+    assert isinstance(cache, InterleavedKVCache)
+    assert cache.k_ring.shape[0] == 2 and cache.k_ring.shape[2] == W
+    assert cache.k_full.shape[0] == 2 and cache.k_full.shape[2] == 64
+
+    # prompt longer than W -> ring windowed prefill; decode crosses the ring
+    # boundary repeatedly
+    ids = np.array([[5, 17, 92, 41, 33, 88, 2, 11, 64, 3]])
+    out = app.generate(ids, np.ones_like(ids), max_new_tokens=10)
+    hf_out = hf.generate(
+        input_ids=torch.tensor(ids), max_new_tokens=10, do_sample=False, pad_token_id=0
+    )
+    np.testing.assert_array_equal(out.sequences, hf_out.numpy())
