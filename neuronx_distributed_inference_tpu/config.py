@@ -183,7 +183,6 @@ def _strict_kwargs(cls, d: dict) -> dict:
 # ---------------------------------------------------------------------------
 
 UNIMPLEMENTED_FLAGS: Dict[str, Tuple[Any, str]] = {
-    "token_tree_config": (None, "token-tree speculation (reference eagle/token_tree.py)"),
     "is_eagle_target": (
         False,
         "per-submodel role flags are internal to the reference's config "
@@ -216,7 +215,6 @@ UNIMPLEMENTED_FLAGS: Dict[str, Tuple[Any, str]] = {
 
 # MoETpuConfig-only parity flags, same contract
 UNIMPLEMENTED_MOE_FLAGS: Dict[str, Tuple[Any, str]] = {
-    "capacity_factor": (None, "capacity-factor (dropping) dispatch; MoE is dropless dense"),
     "fused_shared_experts": (False, "fused shared-expert path (DeepSeek)"),
     "moe_fused_kernel_enabled": (None, "fused MoE kernel"),
     "hybrid_sharding_config": (None, "hybrid expert sharding"),
@@ -300,6 +298,9 @@ class TpuConfig:
     enable_fused_speculation: bool = False
     enable_eagle_speculation: bool = False
     enable_eagle_draft_input_norm: bool = False
+    # EAGLE3: multi-layer target hidden capture + fused 2H-qkv draft layer
+    # (reference is_eagle3, model_base.py:1444-1479)
+    is_eagle3: bool = False
     is_eagle_target: bool = False
     is_eagle_draft: bool = False
     medusa_speculation_length: int = 0
@@ -448,6 +449,19 @@ class TpuConfig:
             )
         if self.enable_eagle_speculation and not self.enable_fused_speculation:
             raise ValueError("EAGLE speculation requires fused speculation")
+        if self.is_eagle3 and not self.enable_eagle_speculation:
+            raise ValueError("is_eagle3 requires enable_eagle_speculation")
+        if self.token_tree_config is not None:
+            if not self.enable_eagle_speculation:
+                raise ValueError(
+                    "token_tree_config requires enable_eagle_speculation "
+                    "(trees expand the EAGLE draft; reference eagle/token_tree.py)"
+                )
+            ods = self.on_device_sampling_config
+            if ods is not None and ods.do_sample:
+                raise NotImplementedError(
+                    "token-tree speculation is greedy-only; disable do_sample"
+                )
         if self.medusa_speculation_length and self.num_medusa_heads <= 0:
             raise ValueError("medusa requires num_medusa_heads > 0")
         if self.padding_side not in ("right", "left"):
@@ -545,6 +559,19 @@ class MoETpuConfig(TpuConfig):
                 "non-GLU expert MLPs are not implemented (experts are "
                 "gate/up/down GLU, modules/moe.py)"
             )
+        if self.capacity_factor is not None:
+            # loud-fail contract: combinations the capacity path cannot honor
+            # must not silently fall back to dense-dropless (modules/moe.py)
+            if self.ep_degree > 1:
+                raise NotImplementedError(
+                    "capacity_factor with expert parallelism is not "
+                    "implemented (the dispatch is token-sorted on one shard)"
+                )
+            if self.quantized and self.quantization_type == "blockwise":
+                raise NotImplementedError(
+                    "capacity_factor with blockwise-quantized experts is not "
+                    "implemented"
+                )
         self._check_unimplemented(UNIMPLEMENTED_MOE_FLAGS)
 
 

@@ -137,6 +137,14 @@ class StepInputs:
     # image features merged at placeholder positions; reference ImageToText
     # inputs_embeds path) — input_ids still carries shapes/placeholders
     inputs_embeds: Optional[jax.Array] = None  # (B, S, H)
+    # token-tree speculation (reference eagle/token_tree.py): cache WRITE
+    # slots diverge from RoPE positions (tree nodes occupy distinct slots at
+    # the same depth). When set, rope uses these and position_ids carries the
+    # write slots (reference rotary_position_ids, modeling_llama.py:1196).
+    rope_position_ids: Optional[jax.Array] = None  # (B, S)
+    # fully-custom attention mask (B, 1, S, bucket) — tree ancestry masks
+    # bypass the standard causal/window mask dispatch
+    mask_override: Optional[jax.Array] = None
 
 
 @jax.tree_util.register_dataclass
@@ -202,6 +210,61 @@ def ring_attention(
     keys = jnp.concatenate([k_prior.astype(k.dtype), k], axis=1)
     vals = jnp.concatenate([v_prior.astype(v.dtype), v], axis=1)
     return attention_decode(q, keys, vals, ring_mask, aspec, sink=sink)
+
+
+def contiguous_decode_attend(
+    q: jax.Array,
+    k_cache: jax.Array,
+    v_cache: jax.Array,
+    layer_idx: jax.Array,
+    mask: jax.Array,
+    spec: ModelSpec,
+    aspec: AttnSpec,
+    sink: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Token-gen attention over one layer of the stacked contiguous cache:
+    TKG Pallas kernel when eligible, else bucket-slice + native softmax
+    (with attention-DP batch sharding when active). Shared by decoder_layer
+    and the EAGLE3 draft layer."""
+    from neuronx_distributed_inference_tpu.ops.decode_attention import (
+        tkg_decode_attention,
+        use_tkg_kernel,
+    )
+
+    B = q.shape[0]
+    bucket = mask.shape[-1]
+    plain_parallel = (
+        spec.attention_dp == 1 and spec.data_parallel == 1 and not spec.cp_enabled
+    )
+    if (
+        plain_parallel
+        and k_cache.shape == v_cache.shape
+        and use_tkg_kernel(aspec, q.shape[1], bucket)
+    ):
+        # decode/speculation attention straight off the stacked cache —
+        # no bucket-slice copy, no repeat_kv broadcast (reference TKG
+        # kernel, attention_base.py:1467)
+        return tkg_decode_attention(
+            q, k_cache, v_cache, layer_idx, mask, sink,
+            scale=aspec.softmax_scale,
+            n_kv=aspec.num_kv_heads,
+            interpret=jax.default_backend() != "tpu",
+        )
+    if spec.attention_dp > 1 or spec.data_parallel > 1:
+        # batch-parallel decode attention over (ddp, dp): GSPMD all-to-alls
+        # heads<->batch around the attention (reference DP decode,
+        # attention_base.py:2308-2321)
+        from neuronx_distributed_inference_tpu.parallel import attention_dp as adp
+
+        q = adp.shard_decode_q(q)
+    k_r, v_r = read_cache_at_layer(
+        k_cache, v_cache, layer_idx, B, bucket,
+        dp=spec.attention_dp * spec.data_parallel,
+    )
+    attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
+    if spec.attention_dp > 1 or spec.data_parallel > 1:
+        attn_out = adp.unshard_attn_out(attn_out)
+    return attn_out
 
 
 def decoder_layer(
@@ -395,47 +458,9 @@ def decoder_layer(
 
         attn_out = jax.lax.cond(is_sliding == 1, _ring_attend, _global_attend, None)
     else:
-        B = q.shape[0]
-        bucket = mask.shape[-1]
-        from neuronx_distributed_inference_tpu.ops.decode_attention import (
-            tkg_decode_attention,
-            use_tkg_kernel,
+        attn_out = contiguous_decode_attend(
+            q, k_cache, v_cache, layer_idx, mask, spec, aspec, sink
         )
-
-        plain_parallel = (
-            spec.attention_dp == 1 and spec.data_parallel == 1 and not spec.cp_enabled
-        )
-        if (
-            plain_parallel
-            and k_cache.shape == v_cache.shape
-            and use_tkg_kernel(aspec, q.shape[1], bucket)
-        ):
-            # decode/speculation attention straight off the stacked cache —
-            # no bucket-slice copy, no repeat_kv broadcast (reference TKG
-            # kernel, attention_base.py:1467)
-            attn_out = tkg_decode_attention(
-                q, k_cache, v_cache, layer_idx, mask, sink,
-                scale=aspec.softmax_scale,
-                n_kv=aspec.num_kv_heads,
-                interpret=jax.default_backend() != "tpu",
-            )
-        else:
-            if spec.attention_dp > 1 or spec.data_parallel > 1:
-                # batch-parallel decode attention over (ddp, dp): GSPMD
-                # all-to-alls heads<->batch around the attention (reference DP
-                # decode, attention_base.py:2308-2321)
-                from neuronx_distributed_inference_tpu.parallel import (
-                    attention_dp as adp,
-                )
-
-                q = adp.shard_decode_q(q)
-            k_r, v_r = read_cache_at_layer(
-                k_cache, v_cache, layer_idx, B, bucket,
-                dp=spec.attention_dp * spec.data_parallel,
-            )
-            attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
-            if spec.attention_dp > 1 or spec.data_parallel > 1:
-                attn_out = adp.unshard_attn_out(attn_out)
 
     hidden = o_project(layer_params["self_attn"], attn_out, aspec, adapter_ids=adapter_ids)
     hidden = residual + hidden
@@ -464,6 +489,8 @@ def build_mask(
 
     ``window``/``chunk`` override the spec-level attention flavor (per-layer-
     group masks for heterogeneous stacks)."""
+    if inputs.mask_override is not None:
+        return inputs.mask_override
     n_active = inputs.input_ids.shape[1]
     if phase == PHASE_CONTEXT_ENCODING:
         if chunk:
@@ -520,7 +547,8 @@ def run_decoder_layers(
     phase: str,
     mlp_fn: Callable = gated_mlp,
     layer_fn: Optional[Callable] = None,
-) -> Tuple[jax.Array, KVCache]:
+    capture_layers: Optional[Tuple[int, ...]] = None,
+):
     """Layer stack + final norm over an already-embedded hidden state.
 
     Split out so variants that replace the embedding (EAGLE's fc-fused draft
@@ -532,9 +560,18 @@ def run_decoder_layers(
     ``fn_idx``. Each group runs its own ``lax.scan`` with its own attention
     flavor (sliding/chunked/global); the cache and hidden state thread
     through in layer order.
+
+    ``capture_layers``: EAGLE3 multi-layer hidden capture (reference
+    model_base.py:1444-1447) — returns a third value, the (B, S, C*H) concat
+    of the named layers' outputs, accumulated in-scan (uniform stacks only).
     """
     inv_freq = params["rope"]["inv_freq"]
-    cos, sin = rope_cos_sin(inputs.position_ids, inv_freq, spec.attention_scaling)
+    rope_pos = (
+        inputs.rope_position_ids
+        if inputs.rope_position_ids is not None
+        else inputs.position_ids
+    )
+    cos, sin = rope_cos_sin(rope_pos, inv_freq, spec.attention_scaling)
 
     # three layouts for params["layers"]:
     # - dict, no layer_groups: one uniform stacked scan (the common case)
@@ -633,6 +670,10 @@ def run_decoder_layers(
         k_cache, v_cache = cache.k, cache.v
 
     if prestacked:
+        if capture_layers is not None:
+            raise NotImplementedError(
+                "capture_layers requires a uniform (single-group) stack"
+            )
         # ONE scan over the load-time-stacked params; each layer selects its
         # flavor's mask in-scan. Alternating stacks (GPT-OSS sliding/global)
         # stay depth-independent in program size with no in-graph weight
@@ -734,6 +775,17 @@ def run_decoder_layers(
                 (groups[0], jnp.arange(total, dtype=jnp.int32), flavor_arr),
             )
     else:
+        captured = None
+        if capture_layers is not None:
+            # EAGLE3 multi-layer hidden capture rides the scan carry: one
+            # (B, S, H) accumulator per tap, where-selected at its layer index
+            # (reference model_base.py:1444-1447)
+            if len(groups) != 1:
+                raise NotImplementedError(
+                    "capture_layers requires a uniform (single-group) stack"
+                )
+            captured = jnp.zeros((len(capture_layers),) + hidden.shape, hidden.dtype)
+            cap_idx = jnp.asarray(capture_layers, jnp.int32)
         offset = 0
         for group_params, gspec in zip(groups, group_specs):
             window = gspec.sliding_window
@@ -752,20 +804,23 @@ def run_decoder_layers(
                 )
 
             def scan_body(carry, xs, g_mlp=g_mlp, g_layer=g_layer, mask=mask, key_valid=key_valid):
-                h, k_c, v_c = carry
+                h, k_c, v_c, cap = carry
                 layer_params, li = xs
                 h, k_c, v_c = g_layer(
                     layer_params, h, cos, sin, k_c, v_c, li, mask, slot_ids, positions,
                     spec, phase, g_mlp, key_valid=key_valid, block_inputs=block_inputs,
                     adapter_ids=inputs.adapter_ids,
                 )
-                return (h, k_c, v_c), None
+                if cap is not None:
+                    hit = (cap_idx == li)[:, None, None, None]
+                    cap = jnp.where(hit, h[None].astype(cap.dtype), cap)
+                return (h, k_c, v_c, cap), None
 
             # the full cache rides the CARRY (updated in place per layer); only
             # the layer params are scanned xs — no stacked-ys cache rebuild
-            (hidden, k_cache, v_cache), _ = jax.lax.scan(
+            (hidden, k_cache, v_cache, captured), _ = jax.lax.scan(
                 scan_body,
-                (hidden, k_cache, v_cache),
+                (hidden, k_cache, v_cache, captured),
                 (group_params, offset + jnp.arange(num_layers, dtype=jnp.int32)),
             )
             offset += num_layers
@@ -777,6 +832,11 @@ def run_decoder_layers(
         new_cache = type(cache)(k=k_cache, v=v_cache)
 
     hidden = apply_norm(hidden, params["norm"]["weight"], spec.rms_eps, spec.norm_type)
+    if capture_layers is not None:
+        # (C, B, S, H) -> (B, S, C*H) concat in tap order
+        C = captured.shape[0]
+        cat = jnp.concatenate([captured[i] for i in range(C)], axis=-1)
+        return hidden, new_cache, cat
     return hidden, new_cache
 
 
@@ -790,9 +850,14 @@ def model_logits(
     mlp_fn: Callable = gated_mlp,
     layer_fn: Optional[Callable] = None,
     return_hidden: bool = False,
+    capture_layers: Optional[Tuple[int, ...]] = None,
 ):
     """Backbone + lm head, no sampling: returns (logits (B, K, V), new cache)
     [, full-sequence hidden states when ``return_hidden``].
+
+    ``capture_layers``: EAGLE3 — with ``return_hidden``, the returned hidden
+    is the (B, S, C*H) multi-layer capture concat instead of the final hidden
+    (the reference's full_hidden_states, model_base.py:1470-1476).
 
     The composable core — fused speculation chains several of these in one
     graph (reference NeuronFusedSpecModel, model_base.py:1656).
@@ -801,11 +866,17 @@ def model_logits(
         hidden = inputs.inputs_embeds
     else:
         hidden = embed(params, inputs.input_ids)
-    hidden, new_cache = run_decoder_layers(
-        params, hidden, cache, inputs, spec=spec, phase=phase, mlp_fn=mlp_fn,
-        layer_fn=layer_fn,
-    )
-    full_hidden = hidden
+    if capture_layers is not None:
+        hidden, new_cache, full_hidden = run_decoder_layers(
+            params, hidden, cache, inputs, spec=spec, phase=phase, mlp_fn=mlp_fn,
+            layer_fn=layer_fn, capture_layers=capture_layers,
+        )
+    else:
+        hidden, new_cache = run_decoder_layers(
+            params, hidden, cache, inputs, spec=spec, phase=phase, mlp_fn=mlp_fn,
+            layer_fn=layer_fn,
+        )
+        full_hidden = hidden
 
     if phase == PHASE_CONTEXT_ENCODING:
         hidden = gather_last_token(hidden, inputs.attention_mask)

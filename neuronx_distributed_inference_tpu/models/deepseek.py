@@ -232,6 +232,8 @@ class DeepseekV3ModelBuilder(DecoderModelBuilder):
             routed_scaling_factor=float(getattr(cfg, "routed_scaling_factor", 1.0)),
             n_group=getattr(cfg, "n_group", 1),
             topk_group=getattr(cfg, "topk_group", 1),
+            capacity_factor=getattr(tc, "capacity_factor", None),
+            ep_degree=tc.ep_degree,
         )
 
     def model_spec(self):

@@ -68,3 +68,109 @@ class EagleLlamaDraftBuilder(DecoderModelBuilder):
                 "weight": jnp.asarray(w, dtype) if w is not None else jnp.ones(H, dtype)
             }
         return params
+
+
+@register_model("llama-eagle3")
+class Eagle3LlamaDraftBuilder(DecoderModelBuilder):
+    """EAGLE3 draft: ONE fused llama layer whose qkv consumes the
+    [embed | feature] 2H concat, with split input norms and a 3H->H fc for
+    the target's multi-layer hidden capture (reference
+    modeling_llama.py:1149-1239 eagle3 branches, :1397 fc sizing;
+    attention_base.py:343,398 2H qkv_hidden_size). Consumed by
+    modules/eagle.eagle3_draft_hidden."""
+
+    config_cls = LlamaInferenceConfig
+
+    def __init__(self, config):
+        super().__init__(config)
+        if config.num_hidden_layers != 1:
+            raise ValueError(
+                "EAGLE3 drafts are a single fused decoder layer "
+                "(reference modeling_llama.py eagle3 branch)"
+            )
+
+    @property
+    def draft_vocab(self):
+        """Reduced lm-head vocab of HF eagle3 checkpoints (draft_vocab_size
+        + d2t draft->target offset table); None = full target vocab."""
+        return getattr(self.config, "draft_vocab_size", None)
+
+    def model_spec(self):
+        spec = super().model_spec()
+        if self.draft_vocab:
+            # the draft lm head scores the REDUCED vocab; the embed table
+            # stays target-vocab (draft inputs are target token ids)
+            import dataclasses
+
+            spec = dataclasses.replace(
+                spec,
+                vocab_size=self.draft_vocab,
+                padded_vocab_size=self._padded_draft_vocab(),
+            )
+        return spec
+
+    def _padded_draft_vocab(self) -> int:
+        import math
+
+        return math.ceil(self.draft_vocab / self.degree) * self.degree
+
+    def param_shapes(self) -> Dict:
+        shapes = super().param_shapes()
+        cfg = self.config
+        H = cfg.hidden_size
+        D = self.head_dim
+        Hq, Hkv = self.gqa.q_heads, self.gqa.kv_heads
+        sa = shapes["layers"]["self_attn"]
+        # qkv over the 2H [embed | feature] concat
+        sa["q_proj"]["weight"] = (1, 2 * H, Hq * D)
+        sa["k_proj"]["weight"] = (1, 2 * H, Hkv * D)
+        sa["v_proj"]["weight"] = (1, 2 * H, Hkv * D)
+        shapes["layers"]["hidden_norm"] = {"weight": (1, H)}
+        shapes["fc"] = {"weight": (3 * H, H)}
+        if self.draft_vocab:
+            shapes["lm_head"] = {"weight": (H, self._padded_draft_vocab())}
+            shapes["d2t"] = {"table": (self.draft_vocab,)}
+        return shapes
+
+    def param_pspecs(self) -> Dict:
+        from jax.sharding import PartitionSpec as P
+
+        specs = super().param_pspecs()
+        specs["layers"]["hidden_norm"] = {"weight": P()}
+        specs["fc"] = {"weight": P(None, None)}
+        if self.draft_vocab:
+            specs["d2t"] = {"table": P()}
+        return specs
+
+    def random_params(self, key=None, dtype=None) -> Dict:
+        params = super().random_params(key=key, dtype=dtype)
+        if self.draft_vocab:
+            # identity-offset table keeps random-weight tests in-vocab
+            params["d2t"] = {"table": jnp.zeros(self.draft_vocab, jnp.int32)}
+        return params
+
+    def convert_hf_state_dict(self, sd, dtype=None) -> Dict:
+        dtype = dtype or to_dtype(self.config.tpu_config.dtype)
+        sd = dict(sd)
+        H = self.config.hidden_size
+        sd.setdefault("model.norm.weight", np.ones(H, np.float32))
+        params = super().convert_hf_state_dict(sd, dtype)
+        fc_key = "fc.weight" if "fc.weight" in sd else "model.fc.weight"
+        params["fc"] = {"weight": jnp.asarray(np.asarray(sd[fc_key]).T, dtype)}
+        hn = sd.get("model.layers.0.hidden_norm.weight", np.ones(H, np.float32))
+        params["layers"]["hidden_norm"] = {
+            "weight": jnp.asarray(np.asarray(hn)[None, :], dtype)
+        }
+        if self.draft_vocab:
+            if "d2t" not in sd:
+                raise KeyError(
+                    "draft_vocab_size set but the checkpoint has no d2t "
+                    "draft->target vocab table"
+                )
+            params["d2t"] = {"table": jnp.asarray(np.asarray(sd["d2t"]), jnp.int32)}
+            lm = np.asarray(sd["lm_head.weight"]).T  # (H, draft_vocab)
+            pad = self._padded_draft_vocab() - lm.shape[1]
+            if pad:
+                lm = np.pad(lm, ((0, 0), (0, pad)))
+            params["lm_head"] = {"weight": jnp.asarray(lm, dtype)}
+        return params

@@ -12,9 +12,10 @@ Mapping here: layer_types become LayerGroupSpec runs (models/base.py), sinks
 ride the existing attention sink support (modules/attention.py:130), the
 expert math is MoESpec(act_scale=1.702, act_bias=1, swiglu_limit) with
 HF's interleaved gate_up_proj DE-INTERLEAVED at load so expert ffn sharding
-stays shard-local. The KV cache is full-length for all layers; bounding
-sliding layers to window-size ring buffers is the long-context follow-up.
-MXFP4 checkpoints load through the dequantized HF path (quantization task).
+stays shard-local. The KV cache is sized PER LAYER: sliding layers ring-bind
+to W slots while global layers keep full-length lines
+(modules/kvcache.InterleavedKVCache). MXFP4 checkpoints load through the
+dequantized HF path (quantization task).
 """
 
 from __future__ import annotations
@@ -150,6 +151,8 @@ class GptOssModelBuilder(DecoderModelBuilder):
             act_scale=1.702,
             act_bias=1.0,
             swiglu_limit=float(getattr(cfg, "swiglu_limit", 7.0) or 7.0),
+            capacity_factor=getattr(tc, "capacity_factor", None),
+            ep_degree=tc.ep_degree,
         )
 
     def mlp_fn(self):

@@ -233,6 +233,8 @@ class Llama4TextModelBuilder(DecoderModelBuilder):
             normalize_top_k_affinities=False,
             early_affinity_modulation=True,
             act=getattr(cfg, "hidden_act", "silu"),
+            capacity_factor=getattr(tc, "capacity_factor", None),
+            ep_degree=tc.ep_degree,
         )
 
     def mlp_fn(self):

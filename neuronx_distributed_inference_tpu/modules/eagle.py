@@ -90,6 +90,123 @@ def init_hidden_buffer(kv_batch: int, hidden_size: int, dtype, garbage: int = 1)
     return jnp.zeros((kv_batch + garbage, hidden_size), dtype)
 
 
+def default_eagle_draft_fn(draft_spec: ModelSpec, draft_mlp_fn: Callable, input_norm: bool):
+    """The v1 EAGLE draft as a pluggable ``draft_fn(params, tokens, prev_h,
+    cache, inputs, phase)`` (EAGLE3 substitutes eagle3_draft_hidden)."""
+
+    def draft_fn(params, tokens, prev_h, cache, step_inputs, phase):
+        return eagle_draft_hidden(
+            params, tokens, prev_h, cache, step_inputs,
+            spec=draft_spec, phase=phase, mlp_fn=draft_mlp_fn,
+            input_norm=input_norm,
+        )
+
+    return draft_fn
+
+
+def eagle3_capture_layers(num_layers: int) -> Tuple[int, int, int]:
+    """EAGLE3 target tap points: layers (1, L/2-1, L-4), clipped for tiny
+    models (reference model_base.py:1444-1447). Always three taps — the fc
+    consumes a 3H concat (duplicates are fine for small L)."""
+    L = num_layers
+    return tuple(min(max(i, 0), L - 1) for i in (1, L // 2 - 1, L - 4))
+
+
+def eagle3_draft_hidden(
+    draft_params: dict,
+    token_ids: jax.Array,  # (B, S)
+    prev_hidden: jax.Array,  # (B, S, 3H) target capture OR (B, S, H) chained
+    cache: KVCache,
+    inputs: StepInputs,
+    *,
+    spec: ModelSpec,
+    phase: str,
+    mlp_fn: Callable,
+) -> Tuple[jax.Array, KVCache]:
+    """EAGLE3 single fused draft layer (reference modeling_llama.py:1206-1239
+    eagle3 branch + model_base.py:1647-1650 _process_eagle3_hidden_states):
+
+    - target-capture features (3H) pass through ``fc`` -> H; the draft's own
+      chained features (H) are used directly;
+    - layer input = concat[embed(token) (H), feature (H)] (2H); the two
+      halves are normed SEPARATELY (input_layernorm / hidden_norm) and the
+      qkv projection consumes 2H;
+    - the attention/MLP residual is the PRE-norm feature half;
+    - returns the PRE-final-norm hidden for chaining (the reference's
+      full_hidden_states); apply the final norm before the lm head
+      (:func:`eagle3_lm_hidden`).
+    """
+    from neuronx_distributed_inference_tpu.models.base import (
+        build_mask,
+        contiguous_decode_attend,
+    )
+    from neuronx_distributed_inference_tpu.modules.attention import (
+        attention_prefill,
+        o_project,
+        qkv_project,
+    )
+    from neuronx_distributed_inference_tpu.modules.kvcache import (
+        kv_batch_size,
+        update_cache_at_layer,
+    )
+    from neuronx_distributed_inference_tpu.modules.norm import apply_norm
+    from neuronx_distributed_inference_tpu.modules.rope import rope_cos_sin
+
+    H = spec.hidden_size
+    aspec = spec.attn
+    emb = embed(draft_params, token_ids)
+    if prev_hidden.shape[-1] != H:
+        feat = linear(draft_params["fc"], prev_hidden.astype(emb.dtype))
+    else:
+        feat = prev_hidden.astype(emb.dtype)
+
+    layer_params = jax.tree.map(lambda x: x[0], draft_params["layers"])
+    emb_n = apply_norm(
+        emb, layer_params["input_layernorm"]["weight"], spec.rms_eps, spec.norm_type
+    )
+    feat_n = apply_norm(
+        feat, layer_params["hidden_norm"]["weight"], spec.rms_eps, spec.norm_type
+    )
+    x = jnp.concatenate([emb_n, feat_n], axis=-1)  # (B, S, 2H)
+
+    rope_pos = (
+        inputs.rope_position_ids
+        if inputs.rope_position_ids is not None
+        else inputs.position_ids
+    )
+    cos, sin = rope_cos_sin(rope_pos, draft_params["rope"]["inv_freq"], spec.attention_scaling)
+    q, k, v = qkv_project(layer_params["self_attn"], x, cos, sin, aspec)
+
+    slot_ids = slot_ids_from_seq_ids(inputs.seq_ids, kv_batch_size(cache))
+    li = jnp.int32(0)
+    k_c, v_c = update_cache_at_layer(
+        cache.k, cache.v, k, v, li, slot_ids, inputs.position_ids
+    )
+    mask = build_mask(inputs, spec, phase)
+    if phase == PHASE_CONTEXT_ENCODING:
+        attn_out = attention_prefill(
+            q, k, v, mask, aspec, key_valid=inputs.attention_mask
+        )
+    else:
+        attn_out = contiguous_decode_attend(q, k_c, v_c, li, mask, spec, aspec)
+
+    h = o_project(layer_params["self_attn"], attn_out, aspec) + feat  # prenorm residual
+    residual = h
+    h = apply_norm(
+        h, layer_params["post_attention_layernorm"]["weight"], spec.rms_eps, spec.norm_type
+    )
+    h = residual + mlp_fn(layer_params["mlp"], h, spec)
+    return h, type(cache)(k=k_c, v=v_c)
+
+
+def eagle3_lm_hidden(draft_params: dict, hidden: jax.Array, spec: ModelSpec) -> jax.Array:
+    """Final norm before the draft lm head (the chained feature stays
+    pre-norm; reference model_base.py:1064-1066)."""
+    from neuronx_distributed_inference_tpu.modules.norm import apply_norm
+
+    return apply_norm(hidden, draft_params["norm"]["weight"], spec.rms_eps, spec.norm_type)
+
+
 def eagle_context_encoding(
     draft_params: dict,
     target_params: dict,
@@ -106,21 +223,28 @@ def eagle_context_encoding(
     draft_input_norm: bool = False,
     do_sample: bool = False,
     max_topk: int = 256,
+    draft_fn: Optional[Callable] = None,
+    capture_layers: Optional[Tuple[int, ...]] = None,
 ) -> EagleOutput:
     """Fused EAGLE prefill: target CTE (keeps all hiddens), draft CTE fed the
     1-shifted target hiddens (reference _eagle_context_encoding_forward,
-    model_base.py:2082)."""
+    model_base.py:2082).
+
+    ``draft_fn``/``capture_layers`` switch the EAGLE3 flavor: the target
+    hidden becomes the 3-layer capture concat (3H) and the draft consumes it
+    through its fc (modules/eagle.eagle3_draft_hidden)."""
     tlogits, target_cache, t_hidden = model_logits(
         target_params, target_cache, inputs,
         spec=target_spec, phase=PHASE_CONTEXT_ENCODING, mlp_fn=target_mlp_fn,
-        return_hidden=True,
+        return_hidden=True, capture_layers=capture_layers,
     )
+    if draft_fn is None:
+        draft_fn = default_eagle_draft_fn(draft_spec, draft_mlp_fn, draft_input_norm)
     # draft input hidden_{i-1}: shift right, position 0 gets zeros
     shifted = jnp.pad(t_hidden[:, :-1, :], ((0, 0), (1, 0), (0, 0)))
-    _, draft_cache = eagle_draft_hidden(
+    _, draft_cache = draft_fn(
         draft_params, inputs.input_ids, shifted, draft_cache, inputs,
-        spec=draft_spec, phase=PHASE_CONTEXT_ENCODING, mlp_fn=draft_mlp_fn,
-        input_norm=draft_input_norm,
+        PHASE_CONTEXT_ENCODING,
     )
     token = first_token(
         tlogits[:, -1, :], inputs.sampling_params, key, do_sample, max_topk
@@ -156,12 +280,18 @@ def eagle_token_gen(
     draft_input_norm: bool = False,
     do_sample: bool = False,
     max_topk: int = 256,
+    draft_fn: Optional[Callable] = None,
+    draft_lm_hidden_fn: Optional[Callable] = None,
+    capture_layers: Optional[Tuple[int, ...]] = None,
 ) -> EagleOutput:
     """Fused EAGLE decode step (reference _eagle_token_gen_forward,
     model_base.py:2562): k-1 draft iterations chaining DRAFT hiddens plus a
     final cache-fill iteration (reference final draft run :2708-2746), target
     verify returning hiddens, acceptance (greedy contiguous-match or
-    multinomial accept/reject), buffer update."""
+    multinomial accept/reject), buffer update.
+
+    ``draft_fn``/``draft_lm_hidden_fn``/``capture_layers`` switch the EAGLE3
+    flavor (multi-layer target capture, fc'd 3H features, pre-norm chaining)."""
     k = spec_len
     bucket = inputs.attention_mask.shape[1]
     seq_ids = inputs.seq_ids
@@ -170,10 +300,12 @@ def eagle_token_gen(
     draft_keys = [None] * k
     if do_sample:
         key, *draft_keys = jax.random.split(key, k)
+    if draft_fn is None:
+        draft_fn = default_eagle_draft_fn(draft_spec, draft_mlp_fn, draft_input_norm)
 
     cur = inputs.input_ids  # (B, 1)
     pos = inputs.position_ids
-    prev_h = hidden_buffer[slots][:, None, :]  # (B, 1, H)
+    prev_h = hidden_buffer[slots][:, None, :]  # (B, 1, H | 3H)
     candidates = [cur]
     draft_dists = []
     for i in range(k):
@@ -184,17 +316,24 @@ def eagle_token_gen(
             seq_ids=seq_ids,
             sampling_params=sp,
         )
-        d_hidden, draft_cache = eagle_draft_hidden(
+        d_hidden, draft_cache = draft_fn(
             draft_params, cur, prev_h, draft_cache, step_inputs,
-            spec=draft_spec, phase=PHASE_TOKEN_GENERATION, mlp_fn=draft_mlp_fn,
-            input_norm=draft_input_norm,
+            PHASE_TOKEN_GENERATION,
         )
         if i == k - 1:
             # cache-fill only: after a fully-accepted round, draft position
             # p+k-1 must hold real KV for the next round's attention
             break
-        dlogits = lm_head(draft_params, d_hidden, draft_spec)[..., : draft_spec.vocab_size]
+        lm_h = d_hidden if draft_lm_hidden_fn is None else draft_lm_hidden_fn(
+            draft_params, d_hidden
+        )
+        dlogits = lm_head(draft_params, lm_h, draft_spec)[..., : draft_spec.vocab_size]
         cur, q = propose_next(dlogits[:, -1, :], sp, draft_keys[i], do_sample, max_topk)
+        d2t = (draft_params.get("d2t") or {}).get("table")
+        if d2t is not None:
+            # reduced-vocab EAGLE3 draft: map draft token d -> target token
+            # d + d2t[d] (the HF eagle3 checkpoint's d2t table)
+            cur = (cur + d2t[cur]).astype(jnp.int32)
         if q is not None:
             draft_dists.append(q)
         prev_h = d_hidden[:, -1:, :]  # chain the draft's own feature
@@ -214,7 +353,7 @@ def eagle_token_gen(
     tlogits, target_cache, t_hidden = model_logits(
         target_params, target_cache, target_inputs,
         spec=target_spec, phase=PHASE_TOKEN_GENERATION, mlp_fn=target_mlp_fn,
-        return_hidden=True,
+        return_hidden=True, capture_layers=capture_layers,
     )  # logits/hiddens (B, k, ·)
 
     tokens, counts = verify_and_accept(

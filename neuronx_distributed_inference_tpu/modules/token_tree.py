@@ -1,0 +1,624 @@
+"""Token-tree speculation: EAGLE draft expands a static candidate TREE per
+round; the target verifies every branch in one pass.
+
+TPU-native re-design of the reference token-tree stack
+(reference: modules/eagle/token_tree.py:8-560 ``TokenTree``; tree decode
+forward models/model_base.py:2143; draft per-level expansion with
+``level_child`` / ``topk_permute_index``; accepted-path KV
+``cache_scatter_indices``).
+
+Design here:
+- :class:`TokenTree` precomputes every static tensor HOST-SIDE in numpy
+  (ancestry masks, per-level expansion indices, root-to-leaf paths) — the
+  traced graph sees only constants.
+- Tree nodes occupy DISTINCT cache slots ``p + node`` while RoPE uses the
+  node's DEPTH (``p + level``): StepInputs.rope_position_ids /
+  mask_override carry the split (reference rotary_position_ids,
+  modeling_llama.py:1196).
+- Draft expansion runs one fixed-shape forward PER LEVEL (unrolled at trace
+  time, like the chain draft loop); each internal node's top-`c` draft
+  tokens become its children, rank-ordered (reference level_child).
+- The target verifies all N nodes in one multi-token pass under the tree
+  ancestry mask; greedy path selection picks the deepest root-to-leaf path
+  whose tokens contiguously match the target's predictions, plus a bonus
+  token (reference greedy tree acceptance).
+- Accepted-path KV is then re-scattered to contiguous slots ``p+1..p+a`` in
+  BOTH caches (reference cache_scatter_indices) so later rounds see the
+  position==slot invariant.
+
+Greedy only: a chain-shaped tree reproduces chain-EAGLE (and therefore plain
+greedy decoding) bit-for-bit — the invariant the tests pin. Sampling trees
+are rejected at app construction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.models.base import (
+    PHASE_TOKEN_GENERATION,
+    ModelSpec,
+    StepInputs,
+    lm_head,
+    model_logits,
+)
+from neuronx_distributed_inference_tpu.modules.kvcache import (
+    KVCache,
+    slot_ids_from_seq_ids,
+)
+
+
+class TokenTree:
+    """Static tree structure + precomputed index tensors (host-side numpy).
+
+    ``tree_config``: adjacency dict {node: [children]} (keys/values may be
+    str/int; missing ids are implicit leaves). Node 0 is the root (= the last
+    accepted token). Nodes are relabeled BFS so index order == level order.
+
+    Reference: modules/eagle/token_tree.py:8-160 (parse + init), :239-346
+    (paths + scatter indices), :447-548 (level indices).
+    """
+
+    def __init__(self, tree_config: Dict):
+        adj = {int(k): [int(c) for c in (v or [])] for k, v in tree_config.items()}
+        nodes = set(adj) | {c for cs in adj.values() for c in cs}
+        if 0 not in nodes:
+            raise ValueError("token tree needs a root node 0")
+        for n in sorted(nodes):
+            adj.setdefault(n, [])
+        children_of = {n: list(cs) for n, cs in adj.items()}
+        # every non-root node has exactly one parent; reachable from root
+        parent = {}
+        for n, cs in children_of.items():
+            for c in cs:
+                if c in parent:
+                    raise ValueError(f"node {c} has two parents")
+                if c == 0:
+                    raise ValueError("root cannot be a child")
+                parent[c] = n
+        # BFS relabel: index order == level order
+        order, frontier = [0], [0]
+        while frontier:
+            nxt = []
+            for n in frontier:
+                nxt.extend(children_of[n])
+            order.extend(nxt)
+            frontier = nxt
+        if len(order) != len(nodes):
+            raise ValueError("tree has unreachable or duplicate nodes")
+        relabel = {old: new for new, old in enumerate(order)}
+
+        N = len(order)
+        self.num_nodes = N
+        self.parent = np.full(N, -1, np.int32)
+        self.level_of = np.zeros(N, np.int32)
+        kids: List[List[int]] = [[] for _ in range(N)]
+        for old_c, old_p in parent.items():
+            c, p = relabel[old_c], relabel[old_p]
+            self.parent[c] = p
+            kids[p].append(c)
+        for p in range(N):
+            kids[p].sort()  # child rank r = r-th best draft token
+        self.children = kids
+        for n in range(1, N):
+            self.level_of[n] = self.level_of[self.parent[n]] + 1
+        self.depth = int(self.level_of.max())
+        self.max_width = 0
+
+        # ancestry (ancestor-or-self) mask
+        anc = np.zeros((N, N), bool)
+        for n in range(N):
+            a = n
+            while a != -1:
+                anc[n, a] = True
+                a = self.parent[a]
+        self.anc_mask = anc
+
+        # per-level node lists + expansion indices
+        self.levels: List[np.ndarray] = [
+            np.asarray([n for n in range(N) if self.level_of[n] == l], np.int32)
+            for l in range(self.depth + 1)
+        ]
+        self.max_width = max(len(l) for l in self.levels)
+        # for level l+1 node j: parent_local = parent's index within level l,
+        # child_rank = index among the parent's children (its top-k rank)
+        self.parent_local: List[np.ndarray] = []
+        self.child_rank: List[np.ndarray] = []
+        for l in range(1, self.depth + 1):
+            prev = {int(n): i for i, n in enumerate(self.levels[l - 1])}
+            pl, cr = [], []
+            for n in self.levels[l]:
+                p = int(self.parent[n])
+                pl.append(prev[p])
+                cr.append(self.children[p].index(int(n)))
+            self.parent_local.append(np.asarray(pl, np.int32))
+            self.child_rank.append(np.asarray(cr, np.int32))
+        self.max_children = max((len(c) for c in kids), default=0)
+
+        # root-to-leaf paths (leaves may sit at different depths): (P, depth)
+        # node ids padded with 0 beyond path_len; path_len excludes the root
+        leaves = [n for n in range(N) if not kids[n]]
+        paths, lens = [], []
+        for leaf in leaves:
+            chain = []
+            n = leaf
+            while n != 0:
+                chain.append(n)
+                n = int(self.parent[n])
+            chain.reverse()
+            lens.append(len(chain))
+            paths.append(chain + [0] * (self.depth - len(chain)))
+        self.paths = np.asarray(paths, np.int32)  # (P, depth)
+        self.path_len = np.asarray(lens, np.int32)  # (P,)
+        # parent of each path step (for match-against-parent's-prediction)
+        self.path_parent = np.where(
+            np.arange(self.depth)[None, :] == 0,
+            0,
+            np.concatenate([np.zeros((len(paths), 1), np.int32), self.paths[:, :-1]], 1),
+        ).astype(np.int32)
+        # node sequence [root, n_1, ..., n_depth] per path, for token gather +
+        # cache fixup (reference cache_scatter_indices, token_tree.py:317)
+        self.path_with_root = np.concatenate(
+            [np.zeros((len(paths), 1), np.int32), self.paths], axis=1
+        )  # (P, depth+1)
+
+    @property
+    def k_out(self) -> int:
+        """Max tokens emitted per round (deepest path + bonus)."""
+        return self.depth + 1
+
+
+def place_tree_mask(
+    anc_rows: np.ndarray,  # (Q, N) static ancestry rows for the query nodes
+    p: jax.Array,  # (B, 1) base position (root slot)
+    bucket: int,
+) -> jax.Array:
+    """Build the (B, 1, Q, bucket) decode mask: prior cache (cols < p) plus
+    the in-flight tree slots p+j for ancestors-or-self (reference full tree
+    attention mask, token_tree.py:158-216, placed at the cache tail)."""
+    Q, N = anc_rows.shape
+    cols = jnp.arange(bucket, dtype=jnp.int32)[None, :]  # (1, bucket)
+    rel = cols - p  # (B, bucket)
+    prior = cols < p  # (B, bucket)
+    anc_pad = jnp.asarray(
+        np.concatenate([anc_rows, np.zeros((Q, 1), bool)], axis=1)
+    )  # (Q, N+1)
+    idx = jnp.clip(rel, 0, N)  # (B, bucket); rel >= N or < 0 -> padding col
+    tree_part = anc_pad[:, idx]  # (Q, B, bucket)
+    tree_part = jnp.where((rel >= 0)[None, :, :], tree_part, False)
+    mask = prior[:, None, :] | jnp.transpose(tree_part, (1, 0, 2))  # (B, Q, bucket)
+    return mask[:, None]
+
+
+def fixup_cache_paths(
+    cache: KVCache,
+    slot_ids: jax.Array,  # (B,) cache lines
+    p: jax.Array,  # (B, 1) root position
+    best_nodes: jax.Array,  # (B, depth+1) accepted node sequence (root first)
+) -> KVCache:
+    """Move the accepted path's KV to contiguous slots p..p+depth (reference
+    cache_scatter_indices consumption, token_tree.py:317-346). Slots beyond
+    the accepted count receive junk from padded path tails — harmless: they
+    are past the next round's valid mask and are overwritten (write-then-
+    attend) before any query can reach them."""
+    d1 = best_nodes.shape[1]
+    src = p + best_nodes  # (B, d1)
+    dst = p + jnp.arange(d1, dtype=jnp.int32)[None, :]
+    lines = slot_ids[:, None]  # (B, 1)
+    k_vals = cache.k[:, lines, src]  # (L, B, d1, H, D)
+    v_vals = cache.v[:, lines, src]
+    k = cache.k.at[:, lines, dst].set(k_vals, mode="drop")
+    v = cache.v.at[:, lines, dst].set(v_vals, mode="drop")
+    return type(cache)(k=k, v=v)
+
+
+def greedy_tree_accept(
+    tree: TokenTree,
+    cand: jax.Array,  # (B, N) candidate token per node (target vocab)
+    tlogits: jax.Array,  # (B, N, V) target logits per node
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Greedy path selection (reference tree _tkg_postprocessor shape):
+    pick the deepest root-to-leaf path whose tokens contiguously match the
+    target's prediction at their parent; emit matched tokens + bonus.
+
+    Returns (tokens (B, depth+1) zero-padded, counts (B,), best_nodes
+    (B, depth+1) the accepted node sequence starting at the root)."""
+    greedy = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (B, N)
+    paths = jnp.asarray(tree.paths)  # (P, depth)
+    path_parent = jnp.asarray(tree.path_parent)  # (P, depth)
+    path_len = jnp.asarray(tree.path_len)  # (P,)
+
+    tok_at = cand[:, paths]  # (B, P, depth)
+    pred_at_parent = greedy[:, path_parent]  # (B, P, depth)
+    valid = (jnp.arange(tree.depth)[None, :] < path_len[:, None])[None]  # (1, P, depth)
+    match = (tok_at == pred_at_parent) & valid
+    contig = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=-1), axis=-1)  # (B, P)
+    best = jnp.argmax(contig, axis=-1)  # (B,) deepest match (ties share prefix)
+    a = jnp.take_along_axis(contig, best[:, None], axis=1)[:, 0]  # (B,)
+
+    best_nodes = jnp.asarray(tree.path_with_root)[best]  # (B, depth+1)
+    # token j (1-indexed) = target prediction at node j-1 of the path
+    toks = jnp.take_along_axis(greedy, best_nodes, axis=1)  # (B, depth+1)
+    counts = a + 1
+    idx = jnp.arange(tree.depth + 1, dtype=jnp.int32)[None, :]
+    tokens = jnp.where(idx < counts[:, None], toks, 0)
+    return tokens, counts, best_nodes
+
+
+class DynamicTokenTree:
+    """Dynamic (adaptive) token tree: the tree SHAPE is decided in-graph per
+    round by cumulative draft probability, under a static node budget.
+
+    Reference: modules/eagle/dynamic_token_tree.py:4-153 — params
+    {step, branching_factor, num_inputs, num_verification_token}; node
+    budget ``1 + bf + (step-1)*ni*bf`` (get_spec_len). NOTE the reference
+    ships this module UNWIRED (no importer in its model path); here it runs
+    through :func:`dynamic_tree_token_gen`.
+
+    Static layout (all shapes fixed; only CONNECTIVITY is data-dependent):
+    node 0 = root; step 0 adds nodes 1..bf (root's top-bf tokens); step s>=1
+    adds ``ni*bf`` nodes — the top-``ni`` nodes of the previous level by
+    cumulative draft log-prob each expand ``bf`` children. Every node is
+    draft-forwarded (so the draft cache has KV for any accepted node);
+    selection only gates EXPANSION.
+    """
+
+    def __init__(self, params: Dict):
+        self.steps = int(params["step"])
+        self.bf = int(params["branching_factor"])
+        self.ni = int(params["num_inputs"])
+        self.nv = int(params.get("num_verification_token", 0)) or None
+        if self.steps < 1 or self.bf < 1 or self.ni < 1:
+            raise ValueError("dynamic tree needs step/branching_factor/num_inputs >= 1")
+        if self.ni > self.bf:
+            raise ValueError(
+                "num_inputs must be <= branching_factor (the level-1 frontier "
+                "is root's branching_factor children)"
+            )
+        # node-id offsets per level (static): level widths 1, bf, ni*bf, ...
+        self.level_offsets = [0, 1]
+        self.level_widths = [1, self.bf]
+        for s in range(1, self.steps):
+            self.level_offsets.append(self.level_offsets[-1] + self.level_widths[-1])
+            self.level_widths.append(self.ni * self.bf)
+        self.num_nodes = self.level_offsets[-1] + self.level_widths[-1]
+        self.depth = self.steps
+        if self.nv is not None and self.nv != self.num_nodes:
+            raise NotImplementedError(
+                "num_verification_token subsetting is not implemented: every "
+                "tree node is verified (set it to the node budget "
+                f"{self.num_nodes} or omit it) — refusing to silently ignore "
+                "the knob"
+            )
+
+    @property
+    def k_out(self) -> int:
+        return self.steps + 1
+
+
+def _place_dynamic_mask(
+    anc_rows: jax.Array,  # (B, Q, N) in-graph ancestry rows
+    p: jax.Array,  # (B, 1)
+    bucket: int,
+) -> jax.Array:
+    """In-graph variant of :func:`place_tree_mask` for data-dependent
+    ancestry (dynamic trees)."""
+    B, Q, N = anc_rows.shape
+    cols = jnp.arange(bucket, dtype=jnp.int32)[None, :]
+    rel = cols - p  # (B, bucket)
+    prior = cols < p
+    anc_pad = jnp.concatenate([anc_rows, jnp.zeros((B, Q, 1), bool)], axis=-1)
+    idx = jnp.clip(rel, 0, N)[:, None, :]  # (B, 1, bucket)
+    tree_part = jnp.take_along_axis(anc_pad, jnp.broadcast_to(idx, (B, Q, bucket)), axis=2)
+    tree_part = jnp.where((rel >= 0)[:, None, :], tree_part, False)
+    return (prior[:, None, :] | tree_part)[:, None]  # (B, 1, Q, bucket)
+
+
+def dynamic_tree_token_gen(
+    draft_params: dict,
+    target_params: dict,
+    draft_cache: KVCache,
+    target_cache: KVCache,
+    hidden_buffer: jax.Array,
+    inputs: StepInputs,
+    key=None,
+    *,
+    dyn: DynamicTokenTree,
+    draft_hidden_fn: Callable,
+    draft_spec: ModelSpec,
+    target_spec: ModelSpec,
+    target_mlp_fn: Callable,
+    target_capture_layers: Optional[Tuple[int, ...]] = None,
+    draft_lm_hidden_fn: Optional[Callable] = None,
+):
+    """One fused dynamic-tree decode round (greedy). The tree connectivity
+    (parent of each node) is decided in-graph from cumulative draft
+    log-probs; everything else mirrors :func:`tree_token_gen`."""
+    from neuronx_distributed_inference_tpu.modules.eagle import EagleOutput
+
+    N = dyn.num_nodes
+    bucket = inputs.attention_mask.shape[1]
+    seq_ids = inputs.seq_ids
+    sp = inputs.sampling_params
+    p = inputs.position_ids  # (B, 1)
+    B = p.shape[0]
+    slots = slot_ids_from_seq_ids(seq_ids, hidden_buffer.shape[0] - 1)
+    d2t = (draft_params.get("d2t") or {}).get("table")
+
+    # in-graph tree state
+    tokens = jnp.zeros((B, N), jnp.int32).at[:, 0].set(inputs.input_ids[:, 0])
+    parent = jnp.zeros((B, N), jnp.int32)
+    depth = jnp.zeros((B, N), jnp.int32)
+    cumlp = jnp.full((B, N), -1e30, jnp.float32).at[:, 0].set(0.0)
+    anc = jnp.zeros((B, N, N), bool).at[:, 0, 0].set(True)
+    node_hidden = None  # (B, N, Hd) draft hiddens, filled level by level
+
+    def draft_level(off, w, prev_h, cache):
+        node_ids = off + jnp.arange(w, dtype=jnp.int32)[None, :]  # (1, w)
+        step_inputs = StepInputs(
+            input_ids=jax.lax.dynamic_slice_in_dim(tokens, off, w, axis=1),
+            attention_mask=inputs.attention_mask,
+            position_ids=p + node_ids,
+            rope_position_ids=p + jax.lax.dynamic_slice_in_dim(depth, off, w, axis=1),
+            mask_override=_place_dynamic_mask(
+                jax.lax.dynamic_slice_in_dim(anc, off, w, axis=1), p, bucket
+            ),
+            seq_ids=seq_ids,
+            sampling_params=sp,
+        )
+        return draft_hidden_fn(
+            draft_params,
+            step_inputs.input_ids,
+            prev_h,
+            cache,
+            step_inputs,
+            PHASE_TOKEN_GENERATION,
+        )
+
+    for s in range(dyn.steps + 1):
+        off, w = (dyn.level_offsets[s], dyn.level_widths[s]) if s <= dyn.steps else (0, 0)
+        if s == 0:
+            prev_h = hidden_buffer[slots][:, None, :]
+        else:
+            par = jax.lax.dynamic_slice_in_dim(parent, off, w, axis=1)  # (B, w)
+            prev_h = jnp.take_along_axis(
+                node_hidden, par[:, :, None], axis=1
+            )  # parent draft hidden
+        d_hidden, draft_cache = draft_level(off, w, prev_h, draft_cache)
+        if node_hidden is None:
+            node_hidden = jnp.zeros((B, N, d_hidden.shape[-1]), d_hidden.dtype)
+        ids = off + jnp.arange(w, dtype=jnp.int32)
+        node_hidden = node_hidden.at[:, ids].set(d_hidden)
+        if s == dyn.steps:
+            break  # deepest level: cache fill only
+
+        lm_h = d_hidden if draft_lm_hidden_fn is None else draft_lm_hidden_fn(
+            draft_params, d_hidden
+        )
+        dlogits = lm_head(draft_params, lm_h, draft_spec)[..., : draft_spec.vocab_size]
+        logp = jax.nn.log_softmax(dlogits.astype(jnp.float32), axis=-1)  # (B, w, V)
+        topv, topt = jax.lax.top_k(logp, dyn.bf)  # (B, w, bf)
+        topt = topt.astype(jnp.int32)
+        if d2t is not None:
+            topt = topt + d2t[topt]  # draft vocab -> target vocab (EAGLE3)
+
+        # pick the expansion frontier: top-ni of this level by cumulative lp
+        ni = min(dyn.ni, w) if s > 0 else 1
+        lvl_cum = jax.lax.dynamic_slice_in_dim(cumlp, off, w, axis=1)  # (B, w)
+        _, sel_local = jax.lax.top_k(lvl_cum, ni)  # (B, ni) indices within level
+        sel = off + sel_local  # absolute node ids
+        nxt_off = dyn.level_offsets[s + 1]
+        nw = dyn.level_widths[s + 1]
+        # children: frontier j's bf children at nxt_off + j*bf + r
+        child_tok = jnp.take_along_axis(topt, sel_local[:, :, None], axis=1).reshape(B, -1)
+        child_lp = jnp.take_along_axis(topv, sel_local[:, :, None], axis=1).reshape(B, -1)
+        child_cum = jnp.repeat(
+            jnp.take_along_axis(lvl_cum, sel_local, axis=1), dyn.bf, axis=1
+        ) + child_lp
+        child_par = jnp.repeat(sel, dyn.bf, axis=1)  # (B, nw)
+        cids = nxt_off + jnp.arange(nw, dtype=jnp.int32)
+        tokens = tokens.at[:, cids].set(child_tok[:, :nw])
+        cumlp = cumlp.at[:, cids].set(child_cum[:, :nw])
+        parent = parent.at[:, cids].set(child_par[:, :nw])
+        pd = jnp.take_along_axis(depth, child_par[:, :nw], axis=1)
+        depth = depth.at[:, cids].set(pd + 1)
+        # child ancestry = parent's row + self
+        par_anc = jnp.take_along_axis(
+            anc, child_par[:, :nw, None], axis=1
+        )  # (B, nw, N)
+        self_hot = jax.nn.one_hot(cids, N, dtype=bool)[None]
+        anc = anc.at[:, cids].set(par_anc | self_hot)
+
+    # ---- target verify over all N nodes -----------------------------------
+    target_inputs = StepInputs(
+        input_ids=tokens,
+        attention_mask=inputs.attention_mask,
+        position_ids=p + jnp.arange(N, dtype=jnp.int32)[None, :],
+        rope_position_ids=p + depth,
+        mask_override=_place_dynamic_mask(anc, p, bucket),
+        seq_ids=seq_ids,
+        sampling_params=sp,
+    )
+    tlogits, target_cache, t_hidden = model_logits(
+        target_params, target_cache, target_inputs,
+        spec=target_spec, phase=PHASE_TOKEN_GENERATION, mlp_fn=target_mlp_fn,
+        return_hidden=True, capture_layers=target_capture_layers,
+    )
+    greedy = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (B, N)
+
+    # ---- greedy walk through the dynamic connectivity ---------------------
+    node_ids = jnp.arange(N, dtype=jnp.int32)[None, :]
+    cur = jnp.zeros((B,), jnp.int32)
+    alive = jnp.ones((B,), bool)
+    acc = jnp.zeros((B,), jnp.int32)
+    best_nodes = [cur]
+    for _ in range(dyn.steps):
+        pred = jnp.take_along_axis(greedy, cur[:, None], axis=1)[:, 0]  # (B,)
+        # the child of cur whose token equals the target's prediction
+        is_child = (parent == cur[:, None]) & (node_ids > 0) & (depth > 0)
+        match = is_child & (tokens == pred[:, None])
+        found = jnp.any(match, axis=1) & alive
+        nxt = jnp.argmax(match, axis=1).astype(jnp.int32)
+        cur = jnp.where(found, nxt, cur)
+        acc = acc + found.astype(jnp.int32)
+        alive = found
+        best_nodes.append(cur)
+    best_nodes = jnp.stack(best_nodes, axis=1)  # (B, steps+1)
+    counts = acc + 1
+    toks = jnp.take_along_axis(greedy, best_nodes, axis=1)
+    idx = jnp.arange(dyn.steps + 1, dtype=jnp.int32)[None, :]
+    out_tokens = jnp.where(idx < counts[:, None], toks, 0)
+
+    # ---- accepted-path KV to contiguous slots + buffer update -------------
+    kv_lines = slot_ids_from_seq_ids(seq_ids, target_cache.k.shape[1] - 1)
+    target_cache = fixup_cache_paths(target_cache, kv_lines, p, best_nodes)
+    draft_lines = slot_ids_from_seq_ids(seq_ids, draft_cache.k.shape[1] - 1)
+    draft_cache = fixup_cache_paths(draft_cache, draft_lines, p, best_nodes)
+
+    bonus_node = jnp.take_along_axis(best_nodes, (counts - 1)[:, None], axis=1)
+    bonus_hidden = jnp.take_along_axis(t_hidden, bonus_node[:, :, None], axis=1)[:, 0, :]
+    hidden_buffer = hidden_buffer.at[slots].set(bonus_hidden.astype(hidden_buffer.dtype))
+
+    return EagleOutput(
+        tokens=out_tokens,
+        counts=counts,
+        draft_cache=draft_cache,
+        target_cache=target_cache,
+        hidden_buffer=hidden_buffer,
+    )
+
+
+def tree_token_gen(
+    draft_params: dict,
+    target_params: dict,
+    draft_cache: KVCache,
+    target_cache: KVCache,
+    hidden_buffer: jax.Array,
+    inputs: StepInputs,
+    key=None,
+    *,
+    tree: TokenTree,
+    draft_hidden_fn: Callable,
+    draft_spec: ModelSpec,
+    target_spec: ModelSpec,
+    target_mlp_fn: Callable,
+    target_capture_layers: Optional[Tuple[int, ...]] = None,
+    draft_lm_hidden_fn: Optional[Callable] = None,
+):
+    """One fused tree-decode round (reference tree decode forward,
+    model_base.py:2143). Greedy only.
+
+    ``draft_hidden_fn(params, tokens, prev_hidden, cache, inputs, phase) ->
+    (hidden (B, S, H), cache)`` — the EAGLE (or EAGLE3) draft forward; tree
+    structure/masks arrive via ``inputs``. ``draft_lm_hidden_fn`` (EAGLE3)
+    maps the chained hidden to the lm-head input (final draft norm).
+
+    A ``d2t`` table in the draft params (reduced-vocab EAGLE3 drafts) maps
+    draft token ``d`` to target token ``d + d2t[d]``.
+    """
+    from neuronx_distributed_inference_tpu.modules.eagle import EagleOutput
+
+    N = tree.num_nodes
+    bucket = inputs.attention_mask.shape[1]
+    seq_ids = inputs.seq_ids
+    sp = inputs.sampling_params
+    p = inputs.position_ids  # (B, 1) root position
+    B = p.shape[0]
+    slots = slot_ids_from_seq_ids(seq_ids, hidden_buffer.shape[0] - 1)
+    d2t = (draft_params.get("d2t") or {}).get("table")
+
+    cand = jnp.zeros((B, N), jnp.int32)
+    cand = cand.at[:, 0].set(inputs.input_ids[:, 0])
+    prev_h = hidden_buffer[slots][:, None, :]  # (B, 1, H*) root draft feature
+
+    # ---- draft: one fixed-shape forward per level (all nodes of the level;
+    # leaf levels run cache-fill only — their logits are unused) ------------
+    level_hidden = None
+    for l, nodes in enumerate(tree.levels):
+        w = len(nodes)
+        node_arr = jnp.asarray(nodes)
+        if l > 0:
+            # child tokens were scattered into cand by the previous level;
+            # draft feature = parent's draft hidden from the previous pass
+            prev_h = level_hidden[:, jnp.asarray(tree.parent_local[l - 1]), :]
+        tok_l = cand[:, node_arr]  # (B, w)
+        write_slots = p + node_arr[None, :]  # (B, w)
+        rope_pos = p + l
+        step_inputs = StepInputs(
+            input_ids=tok_l,
+            attention_mask=inputs.attention_mask,
+            position_ids=write_slots,
+            rope_position_ids=jnp.broadcast_to(rope_pos, (B, w)),
+            mask_override=place_tree_mask(tree.anc_mask[nodes], p, bucket),
+            seq_ids=seq_ids,
+            sampling_params=sp,
+        )
+        d_hidden, draft_cache = draft_hidden_fn(
+            draft_params, tok_l, prev_h, draft_cache, step_inputs,
+            PHASE_TOKEN_GENERATION,
+        )
+        level_hidden = d_hidden
+        if l == tree.depth:
+            break  # deepest level: cache fill only
+        lm_h = d_hidden if draft_lm_hidden_fn is None else draft_lm_hidden_fn(
+            draft_params, d_hidden
+        )
+        dlogits = lm_head(draft_params, lm_h, draft_spec)[
+            ..., : draft_spec.vocab_size
+        ]
+        _, top = jax.lax.top_k(dlogits, tree.max_children)
+        top = top.astype(jnp.int32)
+        if d2t is not None:
+            top = top + d2t[top]  # draft vocab -> target vocab (EAGLE3)
+        child_nodes = tree.levels[l + 1]
+        pl = jnp.asarray(tree.parent_local[l])
+        cr = jnp.asarray(tree.child_rank[l])
+        child_tok = top[:, pl, cr]  # (B, w_{l+1})
+        cand = cand.at[:, jnp.asarray(child_nodes)].set(child_tok)
+
+    # ---- target: verify all N nodes in one pass ---------------------------
+    levels_arr = jnp.asarray(tree.level_of)
+    target_inputs = StepInputs(
+        input_ids=cand,
+        attention_mask=inputs.attention_mask,
+        position_ids=p + jnp.arange(N, dtype=jnp.int32)[None, :],  # write slots
+        rope_position_ids=p + levels_arr[None, :],
+        mask_override=place_tree_mask(tree.anc_mask, p, bucket),
+        seq_ids=seq_ids,
+        sampling_params=sp,
+    )
+    tlogits, target_cache, t_hidden = model_logits(
+        target_params, target_cache, target_inputs,
+        spec=target_spec, phase=PHASE_TOKEN_GENERATION, mlp_fn=target_mlp_fn,
+        return_hidden=True, capture_layers=target_capture_layers,
+    )
+
+    tokens, counts, best_nodes = greedy_tree_accept(tree, cand, tlogits)
+
+    # ---- accepted-path KV to contiguous slots (both caches) ---------------
+    kv_lines = slot_ids_from_seq_ids(
+        seq_ids, target_cache.k.shape[1] - 1
+    )
+    target_cache = fixup_cache_paths(target_cache, kv_lines, p, best_nodes)
+    draft_lines = slot_ids_from_seq_ids(seq_ids, draft_cache.k.shape[1] - 1)
+    draft_cache = fixup_cache_paths(draft_cache, draft_lines, p, best_nodes)
+
+    # next round's draft feature = target hidden at the bonus-producing node
+    bonus_node = jnp.take_along_axis(best_nodes, (counts - 1)[:, None], axis=1)  # (B,1)
+    bonus_hidden = jnp.take_along_axis(
+        t_hidden, bonus_node[:, :, None], axis=1
+    )[:, 0, :]
+    hidden_buffer = hidden_buffer.at[slots].set(bonus_hidden.astype(hidden_buffer.dtype))
+
+    return EagleOutput(
+        tokens=tokens,
+        counts=counts,
+        draft_cache=draft_cache,
+        target_cache=target_cache,
+        hidden_buffer=hidden_buffer,
+    )

@@ -73,6 +73,9 @@ class _SpecAppBase:
         self.model_path = model_path
         self.draft_model_path = draft_model_path
         self.k = tc.speculation_length
+        # cache slots a decode round may touch before acceptance: the chain
+        # needs k; a token tree occupies one slot per NODE
+        self.reserve_slots = tc.speculation_length
         ods = tc.on_device_sampling_config
         self.do_sample = bool(ods and ods.do_sample)
         self._rng_key = jax.random.PRNGKey(tc.seed)
@@ -206,8 +209,8 @@ class _SpecAppBase:
 
         done |= np.array([len(c) >= max_new_tokens for c in collected])
         step = 1
-        while not done.all() and int(pos.max()) + self.k <= tc.seq_len:
-            width = int(pos.max()) + self.k
+        while not done.all() and int(pos.max()) + self.reserve_slots <= tc.seq_len:
+            width = int(pos.max()) + self.reserve_slots
             bucket = get_target_bucket(self.tkg_buckets, width)
             inputs = StepInputs(
                 input_ids=jnp.asarray(last[:, None], jnp.int32),
@@ -293,6 +296,11 @@ class TpuEagleSpecModelForCausalLM(_SpecAppBase):
 
     The draft model_type should be ``llama-eagle``
     (models/eagle_draft.EagleLlamaDraftBuilder: llama + fc fusion layer).
+
+    With ``tpu_config.token_tree_config`` set, decode rounds expand a static
+    candidate TREE instead of a chain (modules/token_tree.py; reference
+    eagle/token_tree.py + tree decode forward model_base.py:2143).
+    Tree mode is greedy-only.
     """
 
     def __init__(self, model_path, config, draft_model_path=None, mesh=None):
@@ -300,35 +308,150 @@ class TpuEagleSpecModelForCausalLM(_SpecAppBase):
         if not tc.enable_eagle_speculation:
             raise ValueError("set tpu_config.enable_eagle_speculation=True")
         super().__init__(model_path, config, draft_model_path, mesh)
+        if (
+            self.do_sample
+            and getattr(self.draft_config, "draft_vocab_size", None)
+        ):
+            raise NotImplementedError(
+                "reduced-vocab (d2t) EAGLE3 drafts are greedy-only: the "
+                "accept/reject q distribution lives in draft-vocab space"
+            )
 
     def _make_fns(self):
         tc = self.config.tpu_config
         norm = bool(tc.enable_eagle_draft_input_norm)
+        self.tree = None
+        if tc.token_tree_config:
+            from neuronx_distributed_inference_tpu.modules.eagle import (
+                default_eagle_draft_fn,
+            )
+            from neuronx_distributed_inference_tpu.modules.token_tree import (
+                DynamicTokenTree,
+                TokenTree,
+                dynamic_tree_token_gen,
+                tree_token_gen,
+            )
+
+            if self.do_sample:
+                raise NotImplementedError(
+                    "token-tree speculation is greedy-only (reference static "
+                    "trees verify greedily); disable do_sample"
+                )
+            ts = self.target_spec
+            if (
+                ts.layer_groups is not None
+                or ts.sliding_window
+                or ts.attention_chunk_size
+                or ts.ring_window
+                or ts.bounded_window
+            ):
+                raise NotImplementedError(
+                    "token-tree speculation requires a plain-attention "
+                    "target: the tree ancestry mask replaces the per-layer "
+                    "window/chunk masks (StepInputs.mask_override), which "
+                    "would silently widen windowed layers"
+                )
+            dynamic = "step" in tc.token_tree_config  # dynamic_tree_params
+            common = dict(
+                draft_hidden_fn=self._draft_fn()
+                or default_eagle_draft_fn(
+                    self.draft_spec, self._common["draft_mlp_fn"], norm
+                ),
+                draft_spec=self.draft_spec,
+                target_spec=self.target_spec,
+                target_mlp_fn=self._common["target_mlp_fn"],
+                target_capture_layers=self._capture_layers(),
+                draft_lm_hidden_fn=self._draft_lm_hidden_fn(),
+            )
+            if dynamic:
+                self.tree = DynamicTokenTree(tc.token_tree_config)
+                self._tkg_fn = jax.jit(
+                    partial(dynamic_tree_token_gen, dyn=self.tree, **common),
+                    donate_argnums=(2, 3, 4),
+                )
+            else:
+                self.tree = TokenTree(tc.token_tree_config)
+                self._tkg_fn = jax.jit(
+                    partial(tree_token_gen, tree=self.tree, **common),
+                    donate_argnums=(2, 3, 4),
+                )
+            self.reserve_slots = self.tree.num_nodes
+        else:
+            self._tkg_fn = jax.jit(
+                partial(
+                    eagle_token_gen,
+                    spec_len=self.k,
+                    draft_input_norm=norm,
+                    do_sample=self.do_sample,
+                    max_topk=tc.max_topk,
+                    draft_fn=self._draft_fn(),
+                    draft_lm_hidden_fn=self._draft_lm_hidden_fn(),
+                    capture_layers=self._capture_layers(),
+                    **self._common,
+                ),
+                donate_argnums=(2, 3, 4),
+            )
         self._cte_fn = jax.jit(
             partial(
                 eagle_context_encoding,
                 draft_input_norm=norm,
                 do_sample=self.do_sample,
                 max_topk=tc.max_topk,
-                **self._common,
-            ),
-            donate_argnums=(2, 3, 4),
-        )
-        self._tkg_fn = jax.jit(
-            partial(
-                eagle_token_gen,
-                spec_len=self.k,
-                draft_input_norm=norm,
-                do_sample=self.do_sample,
-                max_topk=tc.max_topk,
+                draft_fn=self._draft_fn(),
+                capture_layers=self._capture_layers(),
                 **self._common,
             ),
             donate_argnums=(2, 3, 4),
         )
 
+    def _is_eagle3(self) -> bool:
+        return bool(getattr(self.config.tpu_config, "is_eagle3", False))
+
+    def _capture_layers(self):
+        if not self._is_eagle3():
+            return None
+        from neuronx_distributed_inference_tpu.modules.eagle import (
+            eagle3_capture_layers,
+        )
+
+        return eagle3_capture_layers(self.target_spec.num_layers)
+
+    def _draft_fn(self):
+        """None selects the default v1 draft inside the eagle functions;
+        EAGLE3 substitutes the fused split-norm 2H-qkv layer."""
+        if not self._is_eagle3():
+            return None
+        from neuronx_distributed_inference_tpu.modules.eagle import (
+            eagle3_draft_hidden,
+        )
+
+        draft_spec = self.draft_spec
+        mlp_fn = self._common["draft_mlp_fn"]
+
+        def draft_fn(params, tokens, prev_h, cache, inputs, phase):
+            return eagle3_draft_hidden(
+                params, tokens, prev_h, cache, inputs,
+                spec=draft_spec, phase=phase, mlp_fn=mlp_fn,
+            )
+
+        return draft_fn
+
+    def _draft_lm_hidden_fn(self):
+        if not self._is_eagle3():
+            return None
+        from neuronx_distributed_inference_tpu.modules.eagle import eagle3_lm_hidden
+
+        draft_spec = self.draft_spec
+        return lambda params, h: eagle3_lm_hidden(params, h, draft_spec)
+
     def _init_extra_state(self, kv_batch: int):
+        # EAGLE3 chains the 3-layer target capture (reference
+        # rolling_buffer_hidden_size = 3H, model_base.py:1671)
+        mult = 3 if self._is_eagle3() else 1
         self.hidden_buffer = init_hidden_buffer(
-            kv_batch, self.target_spec.hidden_size, to_dtype(self.config.tpu_config.dtype)
+            kv_batch,
+            mult * self.target_spec.hidden_size,
+            to_dtype(self.config.tpu_config.dtype),
         )
 
     def _call_cte(self, inputs, key):

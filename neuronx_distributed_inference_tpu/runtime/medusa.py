@@ -35,6 +35,7 @@ class TpuMedusaModelForCausalLM(_SpecAppBase):
     def __init__(self, model_path: Optional[str], config: InferenceConfig, mesh=None):
         tc = config.tpu_config
         self.k = tc.medusa_speculation_length
+        self.reserve_slots = self.k
         self.num_heads = tc.num_medusa_heads
         if self.k < 2:
             raise ValueError("medusa_speculation_length must be >= 2")

@@ -95,6 +95,11 @@ class ServingSession:
 
             cls = PrefixCachingAllocator if self.prefix_caching else BlockAllocator
             self.allocator = cls(tc.pa_num_blocks, tc.pa_block_size)
+        # async 1-ahead decode (reference modules/async_execution.py:190):
+        # the decode step dispatched last step(), not yet fetched —
+        # (device tokens (B, 1), [(req, pos_dispatched), ...])
+        self._pending = None
+        self.async_decode = bool(tc.async_mode)
 
     @property
     def free_slots(self) -> List[int]:
@@ -299,17 +304,60 @@ class ServingSession:
         # requests that finished prefill THIS step start decoding next step,
         # so their prefill-completion token isn't overwritten in results
         active = [r for r in self.decoding if r.req_id not in prefill_finished]
-        if not active:
+
+        if not self.async_decode:
+            # synchronous path (async_mode=False debugging): dispatch + fetch
+            # every step
+            if active:
+                out, snap = self._dispatch_decode([(r, r.pos) for r in active])
+                if out is not None:
+                    self._consume((out.tokens[:, -1:], snap), results)
             return results
+
+        # async 1-ahead (reference modules/async_execution.py:190): dispatch
+        # step k+1 CHAINED on step k's still-on-device tokens BEFORE fetching
+        # step k — the host-side fetch + bookkeeping overlaps with the device
+        # executing k+1. The fetch gates only termination: rows whose request
+        # terminates at step k ran one speculative step whose writes land in
+        # masked/overwritten slots and whose token is discarded at the next
+        # consume.
+        pend = self._pending
+        self._pending = None
+        pend_pos = {id(req): p for req, p, _ in pend[1]} if pend else {}
+        rows: List = []
+        chained_slots: List[int] = []
+        for r in active:
+            if id(r) in pend_pos:
+                rows.append((r, pend_pos[id(r)] + 1))
+                chained_slots.append(r.slot)
+            else:
+                rows.append((r, r.pos))
+        if rows:
+            last_override = (pend[0], chained_slots) if chained_slots else None
+            out2, snap2 = self._dispatch_decode(rows, last_override)
+            if out2 is not None:
+                self._pending = (out2.tokens[:, -1:], snap2)
+        if pend is not None:
+            self._consume(pend, results)
+        return results
+
+    def _dispatch_decode(self, rows, last_override=None):
+        """Dispatch ONE batched decode pass for ``rows`` = [(req, pos), ...]
+        without waiting for its result. ``last_override``: (device tokens
+        (B, 1) from the pending step, chained slot list) — those rows' input
+        tokens come straight from the device (no host round-trip).
+        Returns (StepOutput, snapshot rows) — StepOutput.tokens is an
+        UNFETCHED device array."""
+        import jax.numpy as jnp
+
         B = self.num_slots
         last = np.zeros((B, 1), np.int32)
         pos = np.zeros((B, 1), np.int32)
         seq_ids = np.full((B,), -1, np.int32)
-        for r in active:
+        for r, p in rows:
             last[r.slot, 0] = r.last_token
-            pos[r.slot, 0] = r.pos
+            pos[r.slot, 0] = p
             seq_ids[r.slot] = r.slot
-        slot_mapping = None
         block_table = None
         if self.block_mode:
             bs = self.allocator.block_size
@@ -318,50 +366,219 @@ class ServingSession:
             )
             mb = width // bs
             block_table = np.zeros((B, mb), np.int32)
-            for r in list(active):
+            for r, p in list(rows):
                 try:
-                    self.allocator.alloc_seq(r.slot, r.pos + 1)
+                    self.allocator.alloc_seq(r.slot, p + 1)
                 except RuntimeError:
                     # pool exhausted mid-decode: preempt this request so the
                     # others keep running (vLLM-style preemption; the caller
                     # can re-submit with the tokens generated so far)
                     r.preempted = True
                     self._finish(r)
-                    active.remove(r)
+                    rows.remove((r, p))
                     continue
                 block_table[r.slot] = self.allocator.block_table(r.slot, mb)
-            if not active:
-                return results
+            if not rows:
+                return None, []
             # no host slot mapping: decode writes derive their slots IN-GRAPH
             # from the block table (models/base.run_decoder_layers; reference
             # generate_tokengen_slot_mapping)
         else:
             width = int(pos.max()) + 1
         mask = (np.arange(width)[None, :] <= pos).astype(np.int32)
+        last_arr = last
+        if last_override is not None:
+            pend_tokens, chained = last_override
+            ch = np.zeros((B, 1), bool)
+            ch[np.asarray(chained, np.int64)] = True
+            last_arr = jnp.where(
+                jnp.asarray(ch), pend_tokens.astype(jnp.int32), jnp.asarray(last)
+            )
         # inactive rows: mask garbage anyway
         inputs, _ = self.app.token_generation_model.prepare(
-            last, mask, pos, seq_ids, prepare_sampling_params(B),
+            last_arr, mask, pos, seq_ids, prepare_sampling_params(B),
             block_table=block_table,
         )
-        out = self.app.token_generation_model(self.app.params, self.app.kv_cache, inputs, None)
+        out = self.app.token_generation_model(
+            self.app.params, self.app.kv_cache, inputs, None
+        )
         self.app.kv_cache = out.cache
-        tokens = np.asarray(out.tokens)[:, -1]
+        return out, [(r, p, r.slot) for r, p in rows]
 
-        for r in active:
-            tok = int(tokens[r.slot])
-            r.generated.append(tok)
-            r.pos += 1
-            results[r.req_id] = tok
+    def _consume(self, pend, results: Dict[str, int]):
+        """Fetch a dispatched decode step and apply termination bookkeeping.
+        Rows whose request already finished (terminated after that dispatch)
+        are speculative leftovers — discarded."""
+        tokens = np.asarray(pend[0])[:, -1]  # the only device sync per step
+        for req, p, slot in pend[1]:
+            if req.finished and not req.preempted:
+                continue
+            if req.preempted and req.pos != p:
+                continue  # preempted in an earlier round; row is stale
+            tok = int(tokens[slot])
+            req.generated.append(tok)
+            req.pos = p + 1
+            results[req.req_id] = tok
             done = (
-                (r.eos_token_id is not None and tok == r.eos_token_id)
-                or len(r.generated) >= r.max_new_tokens
-                or r.pos + 1 >= self.app.config.tpu_config.seq_len
+                (req.eos_token_id is not None and tok == req.eos_token_id)
+                or len(req.generated) >= req.max_new_tokens
+                or req.pos + 1 >= self.app.config.tpu_config.seq_len
             )
             if done:
-                self._finish(r)
-        return results
+                self._finish(req)
 
-    def run_to_completion(self) -> Dict[str, List[int]]:
+    def run_to_completion(self, decode_chunk_size: int = 16) -> Dict[str, List[int]]:
+        """Drain the session. When every active request is decoding (no
+        prefill pending) and the cache is contiguous, decode runs in
+        MULTI-STEP device chunks (models/base.decode_steps) — one host sync
+        per ``decode_chunk_size`` tokens instead of per token. Requests that
+        hit EOS mid-chunk overshoot by up to a chunk of discarded tokens
+        (causality makes them independent; they are truncated on consume).
+        Per-step semantics (step()) are unchanged for interactive callers."""
+        spec = self.app.spec
+        ring_cache = bool(spec.bounded_window or spec.ring_window)
         while self.active:
-            self.step()
+            if (
+                self.prefilling
+                or self.block_mode
+                # ring caches: pow2 surplus steps would overwrite live ring
+                # slots MID-stream (slot = pos mod W); generate()'s surplus
+                # is safe only because it is terminal — stay per-step
+                or ring_cache
+                or decode_chunk_size <= 1
+                or not self.decoding
+            ):
+                self.step()
+                continue
+            if all(r.eos_token_id is None for r in self.decoding):
+                # no EOS to observe: every remaining token count is known
+                # host-side — chain ALL chunks with device-resident tokens
+                # and fetch ONCE (generate()'s chained-decode structure)
+                self._decode_drain()
+            else:
+                self._decode_chunk_pass(decode_chunk_size)
         return {rid: r.generated for rid, r in self.requests.items()}
+
+    def _decode_drain(self):
+        """Drain all decoding requests (no EOS) in chained multi-step chunks
+        with a single host sync at the end: rows that finish early keep
+        computing masked/discarded tokens — trading bounded waste for one
+        round trip total."""
+        if self._pending is not None:
+            self._consume(self._pending, {})
+            self._pending = None
+        active = self.decoding
+        if not active:
+            return
+        tc = self.app.config.tpu_config
+        import jax.numpy as jnp
+
+        B = self.num_slots
+        last = np.zeros((B, 1), np.int32)
+        pos0 = np.zeros((B, 1), np.int32)
+        seq_ids = np.full((B,), -1, np.int32)
+        pos_limit = self.app._pos_limit()
+        need = {}
+        for r in list(active):
+            n = min(r.max_new_tokens - len(r.generated), pos_limit - 1 - r.pos)
+            if n < 1:
+                self._finish(r)  # at the sequence/length bound already
+                active.remove(r)
+                continue
+            last[r.slot, 0] = r.last_token
+            pos0[r.slot, 0] = r.pos
+            seq_ids[r.slot] = r.slot
+            need[r.slot] = n
+        if not active:
+            return
+        total = max(need.values())
+        last_dev = jnp.asarray(last)
+        pos = pos0.copy()
+        chunks = []
+        done = 0
+        while done < total:
+            headroom = pos_limit - 1 - int(pos.max())
+            chunk = pow2_bucket(min(total - done, 32))
+            if chunk > headroom:
+                # no surplus headroom: run the exact remainder (bounded by
+                # headroom; need[] already respects pos_limit per row)
+                chunk = min(total - done, headroom)
+            if chunk < 1:
+                break
+            bucket = self.app._decode_bucket(int(pos.max()) + chunk)
+            tokens_c, _, cache = self.app.token_generation_model.decode_chunk(
+                self.app.params, self.app.kv_cache, last_dev, pos, seq_ids,
+                prepare_sampling_params(B), None, num_steps=chunk, bucket=bucket,
+            )
+            self.app.kv_cache = cache
+            take = min(chunk, total - done)
+            chunks.append((tokens_c, take))
+            last_dev = tokens_c[:, take - 1 : take]
+            pos = pos + take
+            done += take
+        toks = np.concatenate(
+            [np.asarray(c)[:, :take] for c, take in chunks], axis=1
+        )  # ONE sync
+        for r in active:
+            n = need[r.slot]
+            r.generated.extend(int(t) for t in toks[r.slot, :n])
+            r.pos += n
+            self._finish(r)
+
+    def _decode_chunk_pass(self, chunk: int):
+        """One multi-step decode dispatch for all decoding requests
+        (contiguous cache only). The 1-ahead pending step is flushed first so
+        chunk inputs start from consistent host state."""
+        if self._pending is not None:
+            self._consume(self._pending, {})
+            self._pending = None
+        active = self.decoding
+        if not active:
+            return
+        tc = self.app.config.tpu_config
+        pos_limit = self.app._pos_limit()
+        max_pos = max(r.pos for r in active)
+        take = min(
+            chunk,
+            min(r.max_new_tokens - len(r.generated) for r in active),
+            pos_limit - 1 - max_pos,
+        )
+        if take < 1:
+            self.step()
+            return
+        # round the compiled step count up to a power of two and discard the
+        # surplus host-side (the generate() chunk-reuse trick) so the jit
+        # cache stays O(log n) programs instead of one per odd remainder.
+        # Safe mid-stream ONLY for full-length caches (run_to_completion
+        # gates ring caches to the per-step path)
+        chunk = pow2_bucket(take)
+        if chunk > pos_limit - 1 - max_pos:
+            chunk = take  # no headroom for surplus steps
+        B = self.num_slots
+        last = np.zeros((B, 1), np.int32)
+        pos = np.zeros((B, 1), np.int32)
+        seq_ids = np.full((B,), -1, np.int32)
+        for r in active:
+            last[r.slot, 0] = r.last_token
+            pos[r.slot, 0] = r.pos
+            seq_ids[r.slot] = r.slot
+        bucket = self.app._decode_bucket(int(pos.max()) + chunk)
+        tokens_c, _, cache = self.app.token_generation_model.decode_chunk(
+            self.app.params, self.app.kv_cache, last, pos, seq_ids,
+            prepare_sampling_params(B), None, num_steps=chunk, bucket=bucket,
+        )
+        self.app.kv_cache = cache
+        toks = np.asarray(tokens_c)  # ONE sync per chunk tokens
+        for r in active:
+            for j in range(take):
+                tok = int(toks[r.slot, j])
+                r.generated.append(tok)
+                r.pos += 1
+                done = (
+                    (r.eos_token_id is not None and tok == r.eos_token_id)
+                    or len(r.generated) >= r.max_new_tokens
+                    or r.pos + 1 >= tc.seq_len
+                )
+                if done:
+                    self._finish(r)
+                    break
