@@ -69,13 +69,14 @@ def main():
             setattr(c, k, v)
 
     batch, seq_len, prompt_len, gen_len = 1, 1024, 128, 256
+    long_prompt = 512  # prefill-throughput point (amortizes the relay sync)
     tc = TpuConfig(
         batch_size=batch,
         seq_len=seq_len,
         dtype="bfloat16",
         enable_bucketing=True,
-        context_encoding_buckets=[prompt_len],
-        token_generation_buckets=[512],
+        context_encoding_buckets=[prompt_len, long_prompt],
+        token_generation_buckets=[512, 1024],
     )
     cfg = LlamaInferenceConfig(tc, load_config=load_cfg)
     app = TpuModelForCausalLM(None, cfg)
@@ -84,12 +85,15 @@ def main():
     rng = np.random.RandomState(0)
     ids = rng.randint(0, 120000, size=(batch, prompt_len))
     mask = np.ones_like(ids)
+    ids_long = rng.randint(0, 120000, size=(batch, long_prompt))
+    mask_long = np.ones_like(ids_long)
 
     # warmup / compile — run the SAME programs the measured runs use
     # (gen_len-sized decode chunk and the 1-token TTFT path)
     t0 = time.time()
     app.generate(ids, mask, max_new_tokens=gen_len)
     app.generate(ids, mask, max_new_tokens=1)
+    app.generate(ids_long, mask_long, max_new_tokens=1)
     print(f"compile+warmup: {time.time()-t0:.1f}s", file=sys.stderr)
 
     # TTFT: context encoding only
@@ -97,12 +101,32 @@ def main():
     app.generate(ids, mask, max_new_tokens=1)
     ttft_ms = (time.time() - t0) * 1e3
 
-    # decode throughput
+    # prefill throughput: 512-token CTE (sync cost amortized over the prompt)
+    t0 = time.time()
+    app.generate(ids_long, mask_long, max_new_tokens=1)
+    prefill_tok_s = long_prompt / (time.time() - t0)
+
+    # decode throughput (headline)
     t0 = time.time()
     out = app.generate(ids, mask, max_new_tokens=gen_len)
     total = time.time() - t0
-    n_tokens = out.num_generated * batch
-    throughput = n_tokens / total
+    throughput = out.num_generated * batch / total
+
+    # batched decode point (continuous-batching shape; VERDICT r2 weak #3)
+    bs4 = 4
+    tc4 = TpuConfig(
+        batch_size=bs4, seq_len=seq_len, dtype="bfloat16",
+        enable_bucketing=True, context_encoding_buckets=[prompt_len],
+        token_generation_buckets=[512],
+    )
+    app4 = TpuModelForCausalLM(None, LlamaInferenceConfig(tc4, load_config=load_cfg))
+    app4.load(random_weights=True)
+    ids4 = rng.randint(0, 120000, size=(bs4, prompt_len))
+    mask4 = np.ones_like(ids4)
+    app4.generate(ids4, mask4, max_new_tokens=gen_len)  # compile+warm
+    t0 = time.time()
+    out4 = app4.generate(ids4, mask4, max_new_tokens=gen_len)
+    decode_bs4 = out4.num_generated * bs4 / (time.time() - t0)
 
     baseline = 1057.0  # reference 1B-class 32-core gate (BASELINE.md)
     print(
@@ -113,6 +137,8 @@ def main():
                 "unit": "tokens/sec",
                 "vs_baseline": round(throughput / baseline, 4),
                 "ttft_ms": round(ttft_ms, 1),
+                "prefill_tok_s": round(prefill_tok_s, 1),
+                "decode_bs4_tok_s": round(decode_bs4, 2),
                 "device": str(devs[0]),
             }
         )

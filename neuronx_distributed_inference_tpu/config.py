@@ -131,6 +131,46 @@ class ChunkedPrefillConfig:
 
 
 @dataclass
+class _TapPointsConfig:
+    """Shared base: a validated list of tensor-tap point names
+    (modules/tensor_taps.TAP_POINTS)."""
+
+    points: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        from neuronx_distributed_inference_tpu.modules.tensor_taps import TAP_POINTS
+
+        unknown = set(self.points) - set(TAP_POINTS)
+        if unknown:
+            raise ValueError(
+                f"unknown tap point(s) {sorted(unknown)} for "
+                f"{type(self).__name__}; available: {TAP_POINTS}"
+            )
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls(**_strict_kwargs(cls, dict(d)))
+
+
+@dataclass
+class TensorCaptureConfig(_TapPointsConfig):
+    """Capture named intermediate tensors from the traced forward
+    (reference TensorCaptureConfig, config.py:987; capture plumbing
+    model_base.py:1120-1226)."""
+
+
+@dataclass
+class TensorReplacementConfig(_TapPointsConfig):
+    """Teacher-force named intermediate tensors with host-provided goldens
+    (reference TensorReplacementConfig, config.py:1038 +
+    utils/tensor_replacement/registry.py). The golden arrays are supplied
+    per call (application.capture_forward replacements=...)."""
+
+
+@dataclass
 class LoraServingConfig:
     """Multi-adapter LoRA serving (reference modules/lora_serving/config.py)."""
 
@@ -341,6 +381,10 @@ class TpuConfig:
     # --- LoRA ------------------------------------------------------------
     lora_config: Optional[LoraServingConfig] = None
 
+    # --- debug taps (reference config.py:987/:1038) -----------------------
+    tensor_capture_config: Optional[TensorCaptureConfig] = None
+    tensor_replacement_config: Optional[TensorReplacementConfig] = None
+
     # --- misc ------------------------------------------------------------
     seed: int = 0
     # True (default): generate() chains CTE -> decode chunks with
@@ -533,6 +577,14 @@ class TpuConfig:
             d["chunked_prefill_config"] = ChunkedPrefillConfig.from_dict(d["chunked_prefill_config"])
         if d.get("lora_config"):
             d["lora_config"] = LoraServingConfig.from_dict(d["lora_config"])
+        if d.get("tensor_capture_config"):
+            d["tensor_capture_config"] = TensorCaptureConfig.from_dict(
+                d["tensor_capture_config"]
+            )
+        if d.get("tensor_replacement_config"):
+            d["tensor_replacement_config"] = TensorReplacementConfig.from_dict(
+                d["tensor_replacement_config"]
+            )
         return cls(**_strict_kwargs(cls, d))
 
 

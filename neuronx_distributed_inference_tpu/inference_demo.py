@@ -40,55 +40,155 @@ def build_parser() -> argparse.ArgumentParser:
     sub = p.add_subparsers(dest="action", required=True)
     run = sub.add_parser("run", help="compile, load, and generate")
 
+    def onoff(name, default, dest=None, help=None):
+        """--name / --no-name boolean pair (reference on/off flag pairs)."""
+        dest = dest or name.replace("-", "_")
+        run.add_argument(f"--{name}", dest=dest, action="store_true",
+                         default=default, help=help)
+        run.add_argument(f"--no-{name}", dest=dest, action="store_false")
+
     # paths
     run.add_argument("--model-path", required=True)
     run.add_argument("--compiled-model-path", default=None)
+    run.add_argument("--compilation-cache-dir", default=None)
     run.add_argument("--random-weights", action="store_true",
                      help="skip checkpoint load; random weights (perf/testing)")
 
     # core shapes (reference inference_demo.py:94-180)
     run.add_argument("--batch-size", type=int, default=1)
+    run.add_argument("--max-batch-size", type=int, default=None)
+    run.add_argument("--ctx-batch-size", type=int, default=None)
+    run.add_argument("--tkg-batch-size", type=int, default=None)
     run.add_argument("--seq-len", type=int, default=1024)
     run.add_argument("--max-context-length", type=int, default=None)
+    run.add_argument("--max-length", type=int, default=None)
+    run.add_argument("--n-active-tokens", type=int, default=None)
     run.add_argument("--dtype", default="bfloat16",
                      choices=["bfloat16", "float32", "float16"])
+    run.add_argument("--padding-side", default="right", choices=["right", "left"])
+    onoff("cast-logits-fp32", True)
+    onoff("attention-softmax-fp32", True)
+    run.add_argument("--seed", type=int, default=0)
+    onoff("async-mode", True, help="chained decode chunks, one sync per call")
+    run.add_argument("--logical-nc-config", type=int, default=1)
+    run.add_argument("--scratchpad-page-size", type=int, default=None)
 
     # parallelism (reference config.py:333-361)
     run.add_argument("--tp-degree", type=int, default=1)
     run.add_argument("--cp-degree", type=int, default=1)
     run.add_argument("--ep-degree", type=int, default=1)
+    run.add_argument("--pp-degree", type=int, default=1)
     run.add_argument("--attention-dp-degree", type=int, default=1)
+    run.add_argument("--data-parallel-degree", type=int, default=1,
+                     help="whole-model DP over the leading ddp mesh axis")
+    run.add_argument("--moe-tp-degree", type=int, default=None)
+    run.add_argument("--moe-ep-degree", type=int, default=None)
+    run.add_argument("--start-rank-id", type=int, default=0)
+    run.add_argument("--local-ranks-size", type=int, default=None)
+    run.add_argument("--sequence-parallel-enabled", action="store_true")
+    run.add_argument("--vocab-parallel", action="store_true")
+    run.add_argument("--flash-decoding-enabled", action="store_true")
+    run.add_argument("--num-cores-per-group", type=int, default=1)
+
+    # attention / kernels (reference ~25 kernel enable flags)
+    run.add_argument("--fused-qkv", action="store_true")
+    run.add_argument("--qk-norm", action="store_true")
+    run.add_argument("--sliding-window", type=int, default=None)
+    run.add_argument("--attention-chunk-size", type=int, default=None)
+    run.add_argument("--attn-kernel-enabled", default=None,
+                     type=lambda s: s.lower() in ("1", "true", "yes"),
+                     help="flash prefill kernel: true/false (default: auto on TPU)")
+    run.add_argument("--attn-block-tkg-kernel-enabled", default=None,
+                     type=lambda s: s.lower() in ("1", "true", "yes"),
+                     help="decode (TKG) attention kernel: true/false (default: auto)")
 
     # bucketing
-    run.add_argument("--enable-bucketing", action="store_true", default=True)
-    run.add_argument("--no-bucketing", dest="enable_bucketing", action="store_false")
+    onoff("enable-bucketing", True)
     run.add_argument("--context-encoding-buckets", type=int, nargs="+", default=None)
     run.add_argument("--token-generation-buckets", type=int, nargs="+", default=None)
 
-    # sampling
+    # KV cache / paged / serving (reference block-KV + chunked-prefill flags)
+    run.add_argument("--kv-cache-dtype", default=None)
+    run.add_argument("--kv-cache-batch-size", type=int, default=None)
+    run.add_argument("--is-continuous-batching", action="store_true")
+    run.add_argument("--is-block-kv-layout", action="store_true")
+    run.add_argument("--pa-num-blocks", type=int, default=None)
+    run.add_argument("--pa-block-size", type=int, default=16)
+    run.add_argument("--is-prefix-caching", action="store_true")
+    run.add_argument("--is-chunked-prefill", action="store_true")
+    run.add_argument("--cp-max-num-seqs", type=int, default=8,
+                     help="chunked prefill: max sequences per chunk batch")
+    run.add_argument("--cp-kernel-q-tile-size", type=int, default=128)
+    run.add_argument("--cp-kernel-kv-tile-size", type=int, default=512)
+
+    # sampling (reference on-device sampling flags)
     run.add_argument("--on-device-sampling", action="store_true")
     run.add_argument("--do-sample", action="store_true")
     run.add_argument("--top-k", type=int, default=1)
     run.add_argument("--top-p", type=float, default=1.0)
     run.add_argument("--temperature", type=float, default=1.0)
+    run.add_argument("--global-topk", type=int, default=256)
+    run.add_argument("--max-topk", type=int, default=256)
+    run.add_argument("--deterministic", action="store_true")
+    onoff("dynamic-sampling", True, dest="dynamic_sampling",
+          help="per-request (top_k, top_p, temperature) tensors")
+    run.add_argument("--output-logits", action="store_true")
 
     # quantization (reference --quantized*)
     run.add_argument("--quantized", action="store_true")
-    run.add_argument("--quantization-type", default="per_channel_symmetric")
+    run.add_argument("--quantization-type", default="per_channel_symmetric",
+                     choices=["per_channel_symmetric", "per_tensor_symmetric",
+                              "blockwise"])
     run.add_argument("--quantization-dtype", default="int8")
-    run.add_argument("--kv-cache-dtype", default=None)
+    run.add_argument("--quantized-checkpoints-path", default=None)
+    run.add_argument("--blockwise-matmul-block-size", type=int, default=128)
+    run.add_argument("--modules-to-not-convert", nargs="+", default=None)
 
-    # speculation
+    # MoE (reference MoENeuronConfig flags)
+    run.add_argument("--capacity-factor", type=float, default=None)
+    run.add_argument("--router-dtype", default="float32")
+    run.add_argument("--early-expert-affinity-modulation", action="store_true")
+    onoff("normalize-top-k-affinities", True)
+    run.add_argument("--hidden-act-scaling-factor", type=float, default=1.0)
+    run.add_argument("--hidden-act-bias", type=float, default=0.0)
+    onoff("glu-mlp", True)
+    run.add_argument("--glu-type", default="glu")
+
+    # LoRA multi-adapter serving (reference lora_serving flags)
+    run.add_argument("--enable-lora", action="store_true")
+    run.add_argument("--max-loras", type=int, default=1)
+    run.add_argument("--max-lora-rank", type=int, default=16)
+    run.add_argument("--max-loras-on-cpu", type=int, default=2)
+    run.add_argument("--lora-ckpt-path", action="append", dest="lora_ckpt_paths",
+                     default=None, metavar="NAME=PATH",
+                     help="adapter checkpoint, repeatable: name=path")
+    run.add_argument("--lora-dtype", default="bfloat16")
+    run.add_argument("--lora-target-modules", nargs="+",
+                     default=["q_proj", "k_proj", "v_proj", "o_proj"])
+    run.add_argument("--adapter-id", action="append", dest="adapter_ids",
+                     default=None, help="adapter name per prompt (repeatable)")
+
+    # speculation (vanilla / fused / EAGLE / EAGLE3 / Medusa / token trees)
     run.add_argument("--draft-model-path", default=None)
     run.add_argument("--draft-model-type", default=None,
                      help="model_type of the draft (default: same as target; "
-                          "llama-eagle for EAGLE drafts)")
+                          "llama-eagle / llama-eagle3 for EAGLE drafts)")
     run.add_argument("--speculation-length", type=int, default=0)
     run.add_argument("--enable-fused-speculation", action="store_true")
     run.add_argument("--enable-eagle-speculation", action="store_true")
+    run.add_argument("--enable-eagle-draft-input-norm", action="store_true")
+    run.add_argument("--is-eagle3", action="store_true",
+                     help="EAGLE3: multi-layer target capture + 2H-qkv draft")
+    run.add_argument("--token-tree-config", default=None,
+                     help="token-tree JSON (inline or @file): adjacency dict "
+                          "for static trees, or {step, branching_factor, "
+                          "num_inputs} for dynamic trees")
     run.add_argument("--assisted-decoding", action="store_true",
                      help="vanilla (unfused) draft-assisted decoding: draft "
                           "and target compiled independently")
+    run.add_argument("--is-medusa", action="store_true")
+    run.add_argument("--medusa-speculation-length", type=int, default=0)
+    run.add_argument("--num-medusa-heads", type=int, default=0)
 
     # generation
     run.add_argument("--prompt", action="append", dest="prompts", default=None)
@@ -113,12 +213,33 @@ def build_parser() -> argparse.ArgumentParser:
                           "into this directory (view with tensorboard/XProf)")
     run.add_argument("--debug-io", action="store_true",
                      help="log every dispatch's input shapes and output tokens")
+    run.add_argument("--capture-points", nargs="+", default=None,
+                     help="tensor-capture tap points (modules/tensor_taps)")
+    run.add_argument("--tensor-replacement-points", nargs="+", default=None,
+                     help="tap points eligible for teacher forcing")
     return p
 
 
+def _parse_token_tree(arg):
+    if arg is None:
+        return None
+    if arg.startswith("@"):
+        with open(arg[1:]) as f:
+            return json.load(f)
+    return json.loads(arg)
+
+
 def create_tpu_config(args) -> TpuConfig:
-    """CLI flags -> TpuConfig (reference create_neuron_config,
+    """CLI flags -> TpuConfig / MoETpuConfig (reference create_neuron_config,
     inference_demo.py:416-422)."""
+    from neuronx_distributed_inference_tpu.config import (
+        ChunkedPrefillConfig,
+        LoraServingConfig,
+        MoETpuConfig,
+        TensorCaptureConfig,
+        TensorReplacementConfig,
+    )
+
     ods = None
     if args.on_device_sampling or args.do_sample:
         ods = OnDeviceSamplingConfig(
@@ -126,29 +247,130 @@ def create_tpu_config(args) -> TpuConfig:
             top_k=args.top_k,
             top_p=args.top_p,
             temperature=args.temperature,
+            dynamic=args.dynamic_sampling,
+            global_topk=args.global_topk,
+            deterministic=args.deterministic,
         )
-    return TpuConfig(
+    lora = None
+    if args.enable_lora or args.lora_ckpt_paths:
+        paths = dict(s.split("=", 1) for s in (args.lora_ckpt_paths or []))
+        lora = LoraServingConfig(
+            max_loras=args.max_loras,
+            max_lora_rank=args.max_lora_rank,
+            max_loras_on_cpu=args.max_loras_on_cpu,
+            lora_ckpt_paths=paths or None,
+            lora_dtype=args.lora_dtype,
+            target_modules=tuple(args.lora_target_modules),
+        )
+    cpc = None
+    if args.is_chunked_prefill:
+        cpc = ChunkedPrefillConfig(
+            max_num_seqs=args.cp_max_num_seqs,
+            kernel_q_tile_size=args.cp_kernel_q_tile_size,
+            kernel_kv_tile_size=args.cp_kernel_kv_tile_size,
+        )
+    kwargs = dict(
         batch_size=args.batch_size,
+        max_batch_size=args.max_batch_size,
+        ctx_batch_size=args.ctx_batch_size,
+        tkg_batch_size=args.tkg_batch_size,
         seq_len=args.seq_len,
         max_context_length=args.max_context_length,
+        max_length=args.max_length,
+        n_active_tokens=args.n_active_tokens,
         dtype=args.dtype,
+        padding_side=args.padding_side,
+        cast_logits_fp32=args.cast_logits_fp32,
+        attention_softmax_fp32=args.attention_softmax_fp32,
+        seed=args.seed,
+        async_mode=args.async_mode,
+        logical_nc_config=args.logical_nc_config,
+        scratchpad_page_size=args.scratchpad_page_size,
+        compilation_cache_dir=args.compilation_cache_dir,
         tp_degree=args.tp_degree,
         cp_degree=args.cp_degree,
         ep_degree=args.ep_degree,
+        pp_degree=args.pp_degree,
         attention_dp_degree=args.attention_dp_degree,
+        data_parallel_degree=args.data_parallel_degree,
+        moe_tp_degree=args.moe_tp_degree,
+        moe_ep_degree=args.moe_ep_degree,
+        start_rank_id=args.start_rank_id,
+        local_ranks_size=args.local_ranks_size,
+        sequence_parallel_enabled=args.sequence_parallel_enabled,
+        vocab_parallel=args.vocab_parallel,
+        flash_decoding_enabled=args.flash_decoding_enabled,
+        num_cores_per_group=args.num_cores_per_group,
+        fused_qkv=args.fused_qkv,
+        qk_norm=args.qk_norm,
+        sliding_window=args.sliding_window,
+        attention_chunk_size=args.attention_chunk_size,
+        attn_kernel_enabled=args.attn_kernel_enabled,
+        attn_block_tkg_kernel_enabled=args.attn_block_tkg_kernel_enabled,
         enable_bucketing=args.enable_bucketing,
         context_encoding_buckets=args.context_encoding_buckets,
         token_generation_buckets=args.token_generation_buckets,
+        kv_cache_dtype=args.kv_cache_dtype,
+        kv_cache_batch_size=args.kv_cache_batch_size,
+        is_continuous_batching=args.is_continuous_batching,
+        is_block_kv_layout=args.is_block_kv_layout,
+        pa_num_blocks=args.pa_num_blocks,
+        pa_block_size=args.pa_block_size,
+        is_prefix_caching=args.is_prefix_caching,
+        is_chunked_prefill=args.is_chunked_prefill,
+        chunked_prefill_config=cpc,
         on_device_sampling_config=ods,
+        max_topk=args.max_topk,
+        output_logits=args.output_logits
+        or args.check_accuracy_mode == "logit-matching",
         quantized=args.quantized,
         quantization_type=args.quantization_type,
         quantization_dtype=args.quantization_dtype,
-        kv_cache_dtype=args.kv_cache_dtype,
+        quantized_checkpoints_path=args.quantized_checkpoints_path,
+        blockwise_matmul_block_size=args.blockwise_matmul_block_size,
+        modules_to_not_convert=args.modules_to_not_convert,
+        lora_config=lora,
         speculation_length=args.speculation_length,
         enable_fused_speculation=args.enable_fused_speculation,
+        enable_eagle_speculation=args.enable_eagle_speculation,
+        enable_eagle_draft_input_norm=args.enable_eagle_draft_input_norm,
+        is_eagle3=args.is_eagle3,
+        token_tree_config=_parse_token_tree(args.token_tree_config),
+        medusa_speculation_length=args.medusa_speculation_length,
+        num_medusa_heads=args.num_medusa_heads,
         skip_warmup=args.skip_warmup,
-        output_logits=args.check_accuracy_mode == "logit-matching",
+        tensor_capture_config=(
+            TensorCaptureConfig(points=args.capture_points)
+            if args.capture_points else None
+        ),
+        tensor_replacement_config=(
+            TensorReplacementConfig(points=args.tensor_replacement_points)
+            if args.tensor_replacement_points else None
+        ),
     )
+    moe = (
+        args.capacity_factor is not None
+        or args.early_expert_affinity_modulation
+        or args.router_dtype != "float32"
+        or args.hidden_act_scaling_factor != 1.0
+        or args.hidden_act_bias != 0.0
+        or not args.normalize_top_k_affinities
+        or not args.glu_mlp
+        or args.glu_type != "glu"
+    )
+    if moe:
+        return MoETpuConfig(
+            capacity_factor=args.capacity_factor,
+            router_dtype=args.router_dtype,
+            early_expert_affinity_modulation=args.early_expert_affinity_modulation,
+            normalize_top_k_affinities=args.normalize_top_k_affinities,
+            hidden_act_scaling_factor=args.hidden_act_scaling_factor,
+            hidden_act_bias=args.hidden_act_bias,
+            glu_mlp=args.glu_mlp,
+            glu_type=args.glu_type,
+            **kwargs,
+        )
+    return TpuConfig(**kwargs)
 
 
 def run_inference(args) -> int:
@@ -184,7 +406,14 @@ def run_inference(args) -> int:
           file=sys.stderr)
     t0 = time.time()
     draft_app = None
-    if fused_spec:
+    if args.is_medusa or args.medusa_speculation_length:
+        from neuronx_distributed_inference_tpu.runtime.medusa import (
+            TpuMedusaModelForCausalLM,
+        )
+
+        app = TpuMedusaModelForCausalLM(args.model_path, config)
+        app.load(random_weights=args.random_weights)
+    elif fused_spec:
         from neuronx_distributed_inference_tpu.config import FusedSpecConfig
         from neuronx_distributed_inference_tpu.runtime.fused_spec import (
             TpuEagleSpecModelForCausalLM,
@@ -196,7 +425,9 @@ def run_inference(args) -> int:
         tpu_config.enable_fused_speculation = True
         tpu_config.enable_eagle_speculation = args.enable_eagle_speculation
         draft_type = args.draft_model_type or (
-            "llama-eagle" if args.enable_eagle_speculation else args.model_type
+            ("llama-eagle3" if args.is_eagle3 else "llama-eagle")
+            if args.enable_eagle_speculation
+            else args.model_type
         )
         draft_builder_cls = get_model_builder(draft_type)
         draft_config_cls = getattr(draft_builder_cls, "config_cls", InferenceConfig)
@@ -217,6 +448,16 @@ def run_inference(args) -> int:
     else:
         app = TpuModelForCausalLM(args.model_path, config)
         app.load(random_weights=args.random_weights)
+        if args.lora_ckpt_paths:
+            from neuronx_distributed_inference_tpu.utils.hf_checkpoint import (
+                load_state_dict,
+            )
+
+            adapters = {}
+            for entry in args.lora_ckpt_paths:
+                name, path = entry.split("=", 1)
+                adapters[name] = load_state_dict(path)
+            app.load_lora_adapters(adapters)
         if assisted:
             if not args.draft_model_path:
                 raise ValueError("--assisted-decoding requires --draft-model-path")
@@ -254,6 +495,8 @@ def run_inference(args) -> int:
 
     eos_token_id = getattr(tok, "eos_token_id", None) if tok else None
     gen_kwargs = dict(max_new_tokens=args.max_new_tokens, eos_token_id=eos_token_id)
+    if args.adapter_ids:
+        gen_kwargs["lora_adapter_names"] = args.adapter_ids
     if args.do_sample:
         gen_kwargs.update(
             top_k=args.top_k, top_p=args.top_p, temperature=args.temperature

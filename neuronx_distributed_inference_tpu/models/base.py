@@ -285,6 +285,12 @@ def decoder_layer(
     # (slot_mapping (B,S), block_table (B,MB), kv_limit (B,)) in block-KV mode
     block_inputs: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
     adapter_ids: Optional[jax.Array] = None,
+    # static prefill attention flavor (sliding-window / chunked) for the
+    # flash kernel; flavor_select = (uniq_flavors, fl) dispatches between
+    # flavors IN-SCAN for prestacked heterogeneous stacks (lax.switch)
+    window: Optional[int] = None,
+    chunk: Optional[int] = None,
+    flavor_select: Optional[Tuple] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decoder layer (reference NeuronLlamaDecoderLayer, modeling_llama.py:1188).
 
@@ -373,7 +379,22 @@ def decoder_layer(
             q = cpx.shard_q(q)
             k = cpx.gather_kv(k)
             v = cpx.gather_kv(v)
-        attn_out = attention_prefill(q, k, v, mask, aspec, sink=sink, key_valid=key_valid)
+        if flavor_select is not None:
+            uniq, fl = flavor_select
+
+            def _mk(wc):
+                w, c = wc
+                return lambda _: attention_prefill(
+                    q, k, v, mask, aspec, sink=sink, key_valid=key_valid,
+                    window=w, chunk=c,
+                )
+
+            attn_out = jax.lax.switch(fl, [_mk(wc) for wc in uniq], None)
+        else:
+            attn_out = attention_prefill(
+                q, k, v, mask, aspec, sink=sink, key_valid=key_valid,
+                window=window, chunk=chunk,
+            )
         if spec.cp_enabled:
             attn_out = cpx.shard_attn_out(attn_out)
     elif is_block:
@@ -462,6 +483,10 @@ def decoder_layer(
             q, k_cache, v_cache, layer_idx, mask, spec, aspec, sink
         )
 
+    if not interleaved:
+        from neuronx_distributed_inference_tpu.modules import tensor_taps
+
+        attn_out = tensor_taps.tap("attn_out", attn_out, layer_idx)
     hidden = o_project(layer_params["self_attn"], attn_out, aspec, adapter_ids=adapter_ids)
     hidden = residual + hidden
 
@@ -475,6 +500,8 @@ def decoder_layer(
         from neuronx_distributed_inference_tpu.parallel import context_parallel as cpx
 
         hidden = cpx.shard_seq(hidden)
+    if not interleaved:
+        hidden = tensor_taps.tap("layer_out", hidden, layer_idx)
     return hidden, k_cache, v_cache
 
 
@@ -649,11 +676,12 @@ def run_decoder_layers(
             return cpx.shard_prefill_mask(mask)
         return mask
 
-    def group_key_valid(window, chunk):
-        # plain-causal prefill exposes key validity so the flash kernel can
-        # run (not under CP: pallas custom calls don't auto-partition — the
-        # CP path uses the GSPMD-partitioned native attention)
-        if phase == PHASE_CONTEXT_ENCODING and not window and not chunk and not spec.cp_enabled:
+    def group_key_valid(*_ignored):
+        # prefill exposes key validity so the flash kernel can run — ALL
+        # flavors (causal/window/chunk masks fuse into the kernel; not under
+        # CP: pallas custom calls don't auto-partition — the CP path uses the
+        # GSPMD-partitioned native attention)
+        if phase == PHASE_CONTEXT_ENCODING and not spec.cp_enabled:
             return inputs.attention_mask
         return None
 
@@ -668,6 +696,18 @@ def run_decoder_layers(
         v_cache = (cache.v_full, cache.v_ring)
     else:
         k_cache, v_cache = cache.k, cache.v
+
+    from neuronx_distributed_inference_tpu.modules import tensor_taps
+
+    taps_ctx = tensor_taps.active()
+    per_layer_taps = taps_ctx is not None and any(
+        p in tensor_taps.PER_LAYER_POINTS
+        for p in (*taps_ctx.capture, *taps_ctx.replacements)
+    )
+    if per_layer_taps and (prestacked or len(groups) > 1):
+        raise NotImplementedError(
+            "per-layer tensor taps require a uniform (single-group) stack"
+        )
 
     if prestacked:
         if capture_layers is not None:
@@ -693,7 +733,7 @@ def run_decoder_layers(
             finalize_mask(build_mask(inputs, spec, phase, window=w, chunk=c))
             for (w, c) in uniq
         ]
-        key_valid = group_key_valid(*uniq[0]) if len(uniq) == 1 else None
+        key_valid = group_key_valid()
         flavor_ids = []
         for f, g in zip(flavors, group_specs):
             flavor_ids.extend([uniq.index(f)] * g.num_layers)
@@ -730,12 +770,17 @@ def run_decoder_layers(
             global_mask = flavor_masks[uniq.index((None, None))]
             sliding_mask = flavor_masks[1 - uniq.index((None, None))]
 
+            sw = next(w for (w, _) in uniq if w is not None)
+
             def fused_body(carry, xs):
                 h, k_c, v_c = carry
                 layer_params, full_i, ring_i, sl = xs
+                fs = None
                 if phase == PHASE_CONTEXT_ENCODING:
                     # prefill attends the in-flight chunk only: per-flavor mask
+                    # (native path) / per-flavor kernel via lax.switch
                     mask = jnp.where(sl == 1, sliding_mask, global_mask)
+                    fs = (((None, None), (sw, None)), sl)
                 else:
                     # decode: global layers use this mask; sliding layers build
                     # their ring mask from positions inside decoder_layer
@@ -744,7 +789,7 @@ def run_decoder_layers(
                     layer_params, h, cos, sin, k_c, v_c, (full_i, ring_i, sl),
                     mask, slot_ids, positions, spec, phase, g_mlp,
                     key_valid=key_valid, block_inputs=block_inputs,
-                    adapter_ids=inputs.adapter_ids,
+                    adapter_ids=inputs.adapter_ids, flavor_select=fs,
                 )
                 return (h, k_c, v_c), None
 
@@ -758,14 +803,22 @@ def run_decoder_layers(
             def fused_body(carry, xs):
                 h, k_c, v_c = carry
                 layer_params, li, fl = xs
+                fs = None
                 if len(flavor_masks) == 1:
                     mask = flavor_masks[0]
                 else:
                     mask = jnp.where(fl == 1, flavor_masks[1], flavor_masks[0])
+                    if phase == PHASE_CONTEXT_ENCODING:
+                        fs = (tuple(uniq), fl)
+                kw = {}
+                if fs is not None:
+                    kw["flavor_select"] = fs
+                elif phase == PHASE_CONTEXT_ENCODING:
+                    kw["window"], kw["chunk"] = uniq[0]
                 h, k_c, v_c = g_layer(
                     layer_params, h, cos, sin, k_c, v_c, li, mask, slot_ids, positions,
                     spec, phase, g_mlp, key_valid=key_valid, block_inputs=block_inputs,
-                    adapter_ids=inputs.adapter_ids,
+                    adapter_ids=inputs.adapter_ids, **kw,
                 )
                 return (h, k_c, v_c), None
 
@@ -803,26 +856,30 @@ def run_decoder_layers(
                     f"params carry {num_layers}"
                 )
 
-            def scan_body(carry, xs, g_mlp=g_mlp, g_layer=g_layer, mask=mask, key_valid=key_valid):
+            def scan_body(carry, xs, g_mlp=g_mlp, g_layer=g_layer, mask=mask,
+                          key_valid=key_valid, window=window, chunk=chunk):
                 h, k_c, v_c, cap = carry
                 layer_params, li = xs
                 h, k_c, v_c = g_layer(
                     layer_params, h, cos, sin, k_c, v_c, li, mask, slot_ids, positions,
                     spec, phase, g_mlp, key_valid=key_valid, block_inputs=block_inputs,
-                    adapter_ids=inputs.adapter_ids,
+                    adapter_ids=inputs.adapter_ids, window=window, chunk=chunk,
                 )
                 if cap is not None:
                     hit = (cap_idx == li)[:, None, None, None]
                     cap = jnp.where(hit, h[None].astype(cap.dtype), cap)
-                return (h, k_c, v_c, cap), None
+                # per-layer tensor-tap captures ride the scan ys (stacked to
+                # (L, ...) — modules/tensor_taps)
+                return (h, k_c, v_c, cap), tensor_taps.collect_layer_taps(taps_ctx)
 
             # the full cache rides the CARRY (updated in place per layer); only
             # the layer params are scanned xs — no stacked-ys cache rebuild
-            (hidden, k_cache, v_cache, captured), _ = jax.lax.scan(
+            (hidden, k_cache, v_cache, captured), tap_ys = jax.lax.scan(
                 scan_body,
                 (hidden, k_cache, v_cache, captured),
                 (group_params, offset + jnp.arange(num_layers, dtype=jnp.int32)),
             )
+            tensor_taps.merge_layer_taps(taps_ctx, tap_ys)
             offset += num_layers
     if interleaved:
         new_cache = type(cache)(
@@ -832,6 +889,7 @@ def run_decoder_layers(
         new_cache = type(cache)(k=k_cache, v=v_cache)
 
     hidden = apply_norm(hidden, params["norm"]["weight"], spec.rms_eps, spec.norm_type)
+    hidden = tensor_taps.tap("final_hidden", hidden)
     if capture_layers is not None:
         # (C, B, S, H) -> (B, S, C*H) concat in tap order
         C = captured.shape[0]
@@ -862,10 +920,13 @@ def model_logits(
     The composable core — fused speculation chains several of these in one
     graph (reference NeuronFusedSpecModel, model_base.py:1656).
     """
+    from neuronx_distributed_inference_tpu.modules import tensor_taps
+
     if inputs.inputs_embeds is not None:
         hidden = inputs.inputs_embeds
     else:
         hidden = embed(params, inputs.input_ids)
+    hidden = tensor_taps.tap("embed", hidden)
     if capture_layers is not None:
         hidden, new_cache, full_hidden = run_decoder_layers(
             params, hidden, cache, inputs, spec=spec, phase=phase, mlp_fn=mlp_fn,
@@ -883,6 +944,7 @@ def model_logits(
     # TKG: all n_active positions produce logits
 
     logits = lm_head(params, hidden, spec)[..., : spec.vocab_size]  # (B, K, V)
+    logits = tensor_taps.tap("logits", logits)
     if return_hidden:
         return logits, new_cache, full_hidden
     return logits, new_cache

@@ -103,6 +103,9 @@ def mla_decoder_layer(
     key_valid=None,
     block_inputs=None,
     adapter_ids=None,
+    # prefill flavor hints from run_decoder_layers; this layer's native
+    # attention already encodes the flavor in `mask`
+    **_flavor_hints,
 ):
     """One MLA decoder layer (reference DeepseekV3Attention.forward with
     weight absorption, modeling_deepseek.py:205-260).

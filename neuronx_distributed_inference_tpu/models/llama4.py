@@ -95,6 +95,12 @@ def llama4_decoder_layer(
     key_valid=None,
     block_inputs=None,
     adapter_ids=None,
+    # static prefill flavor from this layer's group (chunked-attention rope
+    # layers / global NoPE layers) — forwarded so the flash kernel applies
+    # the right fused mask
+    window=None,
+    chunk=None,
+    flavor_select=None,
 ):
     """One Llama4 decoder layer (reference Llama4TextAttention.forward):
     interleaved-pair rope (rope layers only), weightless L2 qk-norm after
@@ -138,7 +144,9 @@ def llama4_decoder_layer(
         k_cache, v_cache, k, v, layer_idx, slot_ids, positions
     )
     if phase == PHASE_CONTEXT_ENCODING:
-        attn_out = attention_prefill(q, k, v, mask, aspec, key_valid=key_valid)
+        attn_out = attention_prefill(
+            q, k, v, mask, aspec, key_valid=key_valid, window=window, chunk=chunk
+        )
     else:
         bucket = mask.shape[-1]
         k_r, v_r = read_cache_at_layer(k_cache, v_cache, layer_idx, B, bucket)

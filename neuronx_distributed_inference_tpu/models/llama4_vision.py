@@ -1,0 +1,234 @@
+"""Llama4 vision tower: unfold-conv patch embed, 2-D rope ViT, pixel-shuffle
+adapter.
+
+TPU-native re-design of the reference Llama4 vision model (reference:
+models/llama4/modeling_llama4_vision.py). Oracle: HF Llama4VisionModel —
+patch unfold + linear (no bias) -> cls token appended LAST -> learned
+position embedding -> pre-LN -> encoder layers (biased qkv/o + LayerNorms,
+2-D rotary from the patch grid, non-causal) -> post-LN -> strip cls ->
+pixel-shuffle + 2-layer gelu MLP adapter (HF Llama4VisionPixelShuffleMLP).
+
+The projected features splice into the text embeddings at image-placeholder
+positions (embed-merge, the same inputs_embeds path as Pixtral/llava —
+runtime/image_to_text.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class Llama4VisionSpec:
+    hidden_size: int
+    num_heads: int
+    intermediate_size: int
+    num_layers: int
+    image_size: int
+    patch_size: int
+    rope_theta: float
+    pixel_shuffle_ratio: float
+    projector_input_dim: int
+    projector_output_dim: int
+    norm_eps: float = 1e-5
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def num_patches(self) -> int:
+        return self.grid**2 + 1
+
+
+def llama4_vision_spec_from_config(vision_cfg) -> Llama4VisionSpec:
+    """Build the spec from an HF vision config (dict or object) — the ONE
+    config->spec mapping shared by the image-to-text app and the encoder
+    registry."""
+    vg = (
+        vision_cfg.get
+        if isinstance(vision_cfg, dict)
+        else lambda k, d=None: getattr(vision_cfg, k, d)
+    )
+    return Llama4VisionSpec(
+        hidden_size=vg("hidden_size"),
+        num_heads=vg("num_attention_heads"),
+        intermediate_size=vg("intermediate_size"),
+        num_layers=vg("num_hidden_layers"),
+        image_size=vg("image_size"),
+        patch_size=vg("patch_size"),
+        rope_theta=vg("rope_theta", 10000.0),
+        pixel_shuffle_ratio=vg("pixel_shuffle_ratio", 0.5),
+        projector_input_dim=vg("projector_input_dim"),
+        projector_output_dim=vg("projector_output_dim"),
+        norm_eps=vg("norm_eps", 1e-5),
+    )
+
+
+def llama4_vision_rope(spec: Llama4VisionSpec) -> Tuple[np.ndarray, np.ndarray]:
+    """(cos, sin) tables of shape (np, 1, D/2) for the 2-D patch-grid rope
+    (HF Llama4VisionRotaryEmbedding; the cls row is zero-rotation)."""
+    idx = spec.grid
+    img_idx = np.arange(idx**2, dtype=np.int32).reshape(idx**2, 1)
+    img_idx = np.concatenate([img_idx, img_idx[:1]], axis=0)
+    img_idx[-1, -1] = -2  # cls token
+    fx = img_idx % idx
+    fy = img_idx // idx
+    fd = spec.hidden_size // spec.num_heads // 2
+    rope_freq = 1.0 / (
+        spec.rope_theta ** (np.arange(0, fd, 2)[: fd // 2].astype(np.float32) / fd)
+    )
+    freqs_x = np.repeat((fx + 1)[..., None] * rope_freq[None, None, :], 2, axis=-1)
+    freqs_y = np.repeat((fy + 1)[..., None] * rope_freq[None, None, :], 2, axis=-1)
+    freqs = np.concatenate([freqs_x, freqs_y], axis=-1)[..., ::2]
+    freqs = np.where(img_idx.reshape(-1, 1, 1) < 0, 0.0, freqs)
+    return np.cos(freqs).astype(np.float32), np.sin(freqs).astype(np.float32)
+
+
+def _rope_2d(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Complex rotation on (B, S, H, D) with tables (S, 1, D/2)."""
+    a = x[..., 0::2]
+    b = x[..., 1::2]
+    c = cos[None]
+    s = sin[None]
+    out = jnp.stack([a * c - b * s, a * s + b * c], axis=-1)
+    return out.reshape(x.shape)
+
+
+def _ln(x, p, eps):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * p["weight"] + p["bias"]
+
+
+def _pixel_shuffle(x: jax.Array, ratio: float) -> jax.Array:
+    """HF pixel_shuffle (modeling_llama4.py:707-726)."""
+    B, NP, C = x.shape
+    side = int(np.sqrt(NP))
+    x = x.reshape(B, side, side, C)
+    x = x.reshape(B, side, int(side * ratio), int(C / ratio))
+    x = x.transpose(0, 2, 1, 3)
+    x = x.reshape(B, int(side * ratio), int(side * ratio), int(C / ratio**2))
+    x = x.transpose(0, 2, 1, 3)
+    return x.reshape(B, -1, x.shape[-1])
+
+
+def llama4_vision_encoder(params: Dict, pixel_values: jax.Array, spec: Llama4VisionSpec):
+    """(N, C, Hpx, Wpx) -> (N, shuffled_patches, projector_output_dim)."""
+    N, C, Hp, Wp = pixel_values.shape
+    P = spec.patch_size
+    g = spec.grid
+    # unfold: (N, C, g, P, g, P) -> (N, g*g, C*P*P) in HF's unfold order
+    x = pixel_values.reshape(N, C, g, P, g, P)
+    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(N, g * g, C * P * P)
+    hidden = x.astype(params["patch_embedding"]["weight"].dtype) @ params[
+        "patch_embedding"
+    ]["weight"]
+    # cls token appended at the END (unlike mllama)
+    cls = jnp.broadcast_to(params["class_embedding"], (N, 1, spec.hidden_size))
+    hidden = jnp.concatenate([hidden, cls.astype(hidden.dtype)], axis=1)
+    hidden = hidden + params["positional_embedding_vlm"]
+    hidden = _ln(hidden, params["layernorm_pre"], spec.norm_eps)
+
+    cos = params["rope"]["cos"]
+    sin = params["rope"]["sin"]
+    nh = spec.num_heads
+    d = spec.hidden_size // nh
+
+    def layer(h, p):
+        x = _ln(h, p["input_layernorm"], spec.norm_eps)
+        B, S, H = x.shape
+        q = (x @ p["self_attn"]["q_proj"]["weight"] + p["self_attn"]["q_proj"]["bias"]).reshape(B, S, nh, d)
+        k = (x @ p["self_attn"]["k_proj"]["weight"] + p["self_attn"]["k_proj"]["bias"]).reshape(B, S, nh, d)
+        v = (x @ p["self_attn"]["v_proj"]["weight"] + p["self_attn"]["v_proj"]["bias"]).reshape(B, S, nh, d)
+        q = _rope_2d(q, cos, sin)
+        k = _rope_2d(k, cos, sin)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        s = s * (d**-0.5)
+        a = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", a, v).reshape(B, S, H)
+        attn = attn @ p["self_attn"]["o_proj"]["weight"] + p["self_attn"]["o_proj"]["bias"]
+        h = h + attn
+        x = _ln(h, p["post_attention_layernorm"], spec.norm_eps)
+        x = jax.nn.gelu(x @ p["mlp"]["fc1"]["weight"] + p["mlp"]["fc1"]["bias"], approximate=False)
+        x = x @ p["mlp"]["fc2"]["weight"] + p["mlp"]["fc2"]["bias"]
+        return h + x, None
+
+    hidden, _ = jax.lax.scan(layer, hidden, params["layers"])
+    hidden = _ln(hidden, params["layernorm_post"], spec.norm_eps)
+    hidden = hidden[:, :-1, :]  # strip cls
+
+    # vision adapter: pixel shuffle + gelu MLP (HF Llama4VisionPixelShuffleMLP)
+    hidden = _pixel_shuffle(hidden, spec.pixel_shuffle_ratio)
+    va = params["vision_adapter"]
+    hidden = jax.nn.gelu(hidden @ va["fc1"]["weight"], approximate=False)
+    return jax.nn.gelu(hidden @ va["fc2"]["weight"], approximate=False)
+
+
+def convert_llama4_vision_state_dict(
+    sd: Dict, spec: Llama4VisionSpec, prefix: str, dtype
+) -> Dict:
+    """HF Llama4VisionModel weights -> the params tree above."""
+
+    def get(name):
+        if prefix + name not in sd:
+            raise KeyError(f"missing HF weight {prefix + name}")
+        return np.asarray(sd[prefix + name]).astype(np.float32)
+
+    def lt(name):
+        return get(name).T
+
+    def layer(i):
+        p = f"model.layers.{i}."
+        return {
+            "input_layernorm": {
+                "weight": get(p + "input_layernorm.weight"),
+                "bias": get(p + "input_layernorm.bias"),
+            },
+            "post_attention_layernorm": {
+                "weight": get(p + "post_attention_layernorm.weight"),
+                "bias": get(p + "post_attention_layernorm.bias"),
+            },
+            "self_attn": {
+                n: {
+                    "weight": lt(p + f"self_attn.{n}.weight"),
+                    "bias": get(p + f"self_attn.{n}.bias"),
+                }
+                for n in ("q_proj", "k_proj", "v_proj", "o_proj")
+            },
+            "mlp": {
+                "fc1": {"weight": lt(p + "mlp.fc1.weight"), "bias": get(p + "mlp.fc1.bias")},
+                "fc2": {"weight": lt(p + "mlp.fc2.weight"), "bias": get(p + "mlp.fc2.bias")},
+            },
+        }
+
+    cos, sin = llama4_vision_rope(spec)
+    layers = jax.tree.map(
+        lambda *xs: jnp.asarray(np.stack(xs), dtype),
+        *[layer(i) for i in range(spec.num_layers)],
+    )
+    return {
+        "patch_embedding": {
+            "weight": jnp.asarray(lt("patch_embedding.linear.weight"), dtype)
+        },
+        "class_embedding": jnp.asarray(get("class_embedding"), dtype),
+        "positional_embedding_vlm": jnp.asarray(get("positional_embedding_vlm"), dtype),
+        "layernorm_pre": {
+            "weight": jnp.asarray(get("layernorm_pre.weight"), dtype),
+            "bias": jnp.asarray(get("layernorm_pre.bias"), dtype),
+        },
+        "layernorm_post": {
+            "weight": jnp.asarray(get("layernorm_post.weight"), dtype),
+            "bias": jnp.asarray(get("layernorm_post.bias"), dtype),
+        },
+        "layers": layers,
+        "rope": {"cos": jnp.asarray(cos), "sin": jnp.asarray(sin)},
+        "vision_adapter": {
+            "fc1": {"weight": jnp.asarray(lt("vision_adapter.mlp.fc1.weight"), dtype)},
+            "fc2": {"weight": jnp.asarray(lt("vision_adapter.mlp.fc2.weight"), dtype)},
+        },
+    }

@@ -1,0 +1,507 @@
+"""Mllama (Llama-3.2-Vision): tiled ViT vision tower + cross-attention text
+decoder with a separate vision-KV cache.
+
+TPU-native re-design of the reference mllama stack (reference:
+models/mllama/modeling_mllama.py — NeuronMllamaTextModel with a cross-attn
+fusion schedule, NeuronLlamaCrossAttentionBlock tanh-gated blocks :553-631,
+MultimodalKVCacheManager storing the vision KV next to the text KV,
+modeling_mllama_vision.py tiled encoder; aspect_ratio_utils.py).
+
+Design here (whisper-style self-contained model functions; oracle = HF
+MllamaForConditionalGeneration):
+
+- the VISION tower is a pure jittable function: patch conv -> gated
+  pre-tile / position / post-tile embeddings -> local encoder (uniform
+  layers under ``lax.scan`` with in-scan capture of the
+  intermediate_layers_indices taps) -> gated global encoder scan ->
+  [final | intermediates] concat (vision_output_dim).
+- the TEXT decoder interleaves llama self-attention RUNS (each a
+  ``lax.scan`` over its stacked params, sharing decoder-layer math via
+  modules/attention) with single CROSS-attention layers at the config's
+  cross_attention_layers indices. Cross K/V is computed ONCE from the
+  vision states at prefill and lives in its own cache stream
+  (``MllamaCache.cross_k/v`` — the reference MultimodalKVCacheManager);
+  decode steps only read it.
+- cross-attention masking matches HF exactly: per-token tile masks expand
+  to vision-token granularity; rows with no visible tile attend uniformly
+  (their additive mask is neutralized) and their MLP delta is zeroed by the
+  full-text-row mask.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig, to_dtype
+from neuronx_distributed_inference_tpu.models.base import (
+    PHASE_CONTEXT_ENCODING,
+    ModelSpec,
+    StepInputs,
+    build_mask,
+    decoder_layer,
+    gather_last_token,
+    gated_mlp,
+)
+from neuronx_distributed_inference_tpu.modules.attention import (
+    AttnSpec,
+    o_project,
+    qkv_project,
+)
+from neuronx_distributed_inference_tpu.modules.kvcache import (
+    KVCache,
+    kv_batch_size,
+    slot_ids_from_seq_ids,
+)
+from neuronx_distributed_inference_tpu.modules.norm import rms_norm
+from neuronx_distributed_inference_tpu.modules.rope import compute_inv_freq, rope_cos_sin
+from neuronx_distributed_inference_tpu.parallel.sharding import TENSOR
+
+NEG_INF = -1e30
+LEARNABLE_EMBEDDING_SIZE = 8  # HF model constant (reference modeling_mllama.py:764)
+
+
+class MllamaInferenceConfig(InferenceConfig):
+    _REQUIRED_ATTRS = ("text_config", "vision_config")
+
+
+# ---------------------------------------------------------------------------
+# vision tower
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MllamaVisionSpec:
+    hidden_size: int
+    num_heads: int
+    intermediate_size: int
+    num_layers: int
+    num_global_layers: int
+    image_size: int
+    patch_size: int
+    max_num_tiles: int
+    intermediate_layers_indices: Tuple[int, ...]
+    norm_eps: float = 1e-5
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2 + 1
+
+    @property
+    def output_dim(self) -> int:
+        return self.hidden_size * (1 + len(self.intermediate_layers_indices))
+
+
+def _layer_norm(x, w, b, eps):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * w + b
+
+
+def _vision_attention(p, x, mask, spec: MllamaVisionSpec):
+    """Full (non-causal) attention with an additive mask (B, 1, S, S)."""
+    B, S, H = x.shape
+    nh, d = spec.num_heads, spec.hidden_size // spec.num_heads
+    q = (x @ p["q_proj"]["weight"]).reshape(B, S, nh, d)
+    k = (x @ p["k_proj"]["weight"]).reshape(B, S, nh, d)
+    v = (x @ p["v_proj"]["weight"]).reshape(B, S, nh, d)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * (d**-0.5) + mask.astype(jnp.float32)
+    a = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", a, v).reshape(B, S, nh * d)
+    return out @ p["o_proj"]["weight"]
+
+
+def _vision_layer(p, x, mask, spec: MllamaVisionSpec, gated: bool):
+    h = _layer_norm(x, p["input_layernorm"]["weight"], p["input_layernorm"]["bias"], spec.norm_eps)
+    h = _vision_attention(p["self_attn"], h, mask, spec)
+    if gated:
+        h = jnp.tanh(p["gate_attn"]) * h
+    x = x + h
+    h = _layer_norm(
+        x, p["post_attention_layernorm"]["weight"], p["post_attention_layernorm"]["bias"],
+        spec.norm_eps,
+    )
+    h = jax.nn.gelu(h @ p["mlp"]["fc1"]["weight"] + p["mlp"]["fc1"]["bias"], approximate=False)
+    h = h @ p["mlp"]["fc2"]["weight"] + p["mlp"]["fc2"]["bias"]
+    if gated:
+        h = jnp.tanh(p["gate_ffn"]) * h
+    return x + h
+
+
+def mllama_vision_encoder(
+    params: dict,
+    pixel_values: jax.Array,  # (B, num_img, tiles, C, Hpx, Wpx)
+    aspect_ratio_ids: jax.Array,  # (B, num_img)
+    aspect_ratio_mask: jax.Array,  # (B, num_img, tiles)
+    spec: MllamaVisionSpec,
+) -> jax.Array:
+    """HF MllamaVisionModel.forward, functional (reference
+    modeling_mllama_vision.py; HF modeling_mllama.py:998-1140).
+    Returns (B, num_img, tiles, num_patches, output_dim)."""
+    B, NI, T, C, Hp, Wp = pixel_values.shape
+    hs = spec.hidden_size
+    px = pixel_values.reshape(B * NI * T, C, Hp, Wp)
+    # patch conv == strided conv, valid padding
+    patches = jax.lax.conv_general_dilated(
+        px.astype(params["patch_embedding"]["weight"].dtype),
+        params["patch_embedding"]["weight"],
+        window_strides=(spec.patch_size, spec.patch_size),
+        padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )  # (N, hs, hp, wp)
+    hidden = patches.reshape(B * NI * T, hs, -1).swapaxes(1, 2)  # (N, np0, hs)
+    np0 = hidden.shape[1]
+    ar = aspect_ratio_ids.reshape(B * NI)
+
+    # pre-tile positional embedding (gated)
+    pre = params["pre_tile_positional_embedding"]
+    emb = pre["embedding"]["weight"][ar].reshape(B * NI, spec.max_num_tiles, 1, hs)
+    hidden = hidden.reshape(B * NI, T, np0, hs) + jnp.tanh(pre["gate"]) * emb[:, :T]
+
+    # class token
+    hidden = hidden.reshape(B * NI * T, np0, hs)
+    cls = jnp.broadcast_to(params["class_embedding"], (B * NI * T, 1, hs))
+    hidden = jnp.concatenate([cls.astype(hidden.dtype), hidden], axis=1)
+    npatch = np0 + 1
+
+    # gated position embedding + per-aspect tile position embedding
+    gpe = params["gated_positional_embedding"]
+    gate = jnp.tanh(gpe["gate"])
+    hidden = hidden.reshape(B * NI, T, npatch, hs)
+    hidden = hidden + (1 - gate) * gpe["embedding"][None, None]
+    tile_pos = gpe["tile_embedding"]["weight"][ar].reshape(
+        B * NI, spec.max_num_tiles, spec.num_patches, hs
+    )
+    hidden = hidden + gate * tile_pos[:, :T]
+
+    hidden = _layer_norm(
+        hidden, params["layernorm_pre"]["weight"], params["layernorm_pre"]["bias"],
+        spec.norm_eps,
+    )
+
+    # pad the patch dim to a multiple of 8 (HF does the same)
+    pad = (8 - npatch % 8) % 8
+    hidden = jnp.pad(hidden, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    plen = npatch + pad
+
+    # aspect-ratio attention mask (HF _prepare_aspect_ratio_attention_mask)
+    am = aspect_ratio_mask.reshape(B * NI, T).astype(jnp.float32)  # 1 = live tile
+    am = jnp.repeat(am[:, :, None], plen, axis=2)  # (N, T, plen)
+    am = am.at[:, :, npatch:].set(0.0)
+    am = 1.0 - am.reshape(B * NI, T * plen, 1)
+    mask = (am @ am.swapaxes(1, 2)) * NEG_INF  # (N, S, S)
+    mask = mask[:, None]
+
+    hidden = hidden.reshape(B * NI, T * plen, hs)
+
+    # local encoder: uniform layers under scan, capturing the intermediate
+    # taps in-scan (HF collects output.hidden_states[i])
+    taps = jnp.asarray(spec.intermediate_layers_indices, jnp.int32)
+    inter = jnp.zeros((len(spec.intermediate_layers_indices),) + hidden.shape, hidden.dtype)
+
+    def local_body(carry, xs):
+        h, acc = carry
+        lp, li = xs
+        h = _vision_layer(lp, h, mask, spec, gated=False)
+        hit = (taps == li)[:, None, None, None]
+        acc = jnp.where(hit, h[None], acc)
+        return (h, acc), None
+
+    (hidden, inter), _ = jax.lax.scan(
+        local_body,
+        (hidden, inter),
+        (params["transformer"]["layers"], jnp.arange(spec.num_layers, dtype=jnp.int32)),
+    )
+
+    hidden = _layer_norm(
+        hidden, params["layernorm_post"]["weight"], params["layernorm_post"]["bias"],
+        spec.norm_eps,
+    )
+
+    # post-tile embedding + global encoder (gated layers)
+    post = params["post_tile_positional_embedding"]
+    emb = post["embedding"]["weight"][ar].reshape(B * NI, spec.max_num_tiles, 1, hs)
+    hidden = hidden.reshape(B * NI, T, plen, hs) + jnp.tanh(post["gate"]) * emb[:, :T]
+    hidden = hidden.reshape(B * NI, T * plen, hs)
+
+    def global_body(h, lp):
+        return _vision_layer(lp, h, mask, spec, gated=True), None
+
+    hidden, _ = jax.lax.scan(global_body, hidden, params["global_transformer"]["layers"])
+
+    # unpad + concat [final | intermediates] on the feature dim
+    hidden = hidden.reshape(B * NI, T, plen, hs)[:, :, :npatch]
+    inter = jnp.stack([inter[i] for i in range(inter.shape[0])], axis=-1)
+    inter = inter.reshape(B * NI, T, plen, -1)[:, :, :npatch]
+    out = jnp.concatenate([hidden, inter], axis=-1)
+    return out.reshape(B, NI, T, npatch, spec.output_dim)
+
+
+# ---------------------------------------------------------------------------
+# text decoder with interleaved cross-attention
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class MllamaCache:
+    """Text self-attn KV (stacked over SELF layers) + the vision cross-KV
+    stream (stacked over CROSS layers; written once at prefill) — the
+    reference MultimodalKVCacheManager's two streams."""
+
+    k: jax.Array  # (L_self, B+G, S_max, Hkv, D)
+    v: jax.Array
+    cross_k: jax.Array  # (L_cross, B+G, Sv, Hkv, D)
+    cross_v: jax.Array
+
+
+def _cross_layer(
+    p: dict,
+    hidden: jax.Array,  # (B, S, H)
+    cross_k: jax.Array,  # (B, Sv, Hkv, D)
+    cross_v: jax.Array,
+    cross_mask: jax.Array,  # (B, 1, S, Sv) additive fp32 (HF-prepared)
+    full_row: jax.Array,  # (B, S, 1) 1 = row attends some tile
+    aspec: AttnSpec,
+    rms_eps: float,
+) -> jax.Array:
+    """HF MllamaCrossAttentionDecoderLayer (modeling_mllama.py:673-722):
+    tanh-gated cross attention + tanh-gated, full-row-masked MLP."""
+    B, S, H = hidden.shape
+    x = rms_norm(hidden, p["input_layernorm"]["weight"], rms_eps)
+    q = (x @ p["cross_attn"]["q_proj"]["weight"]).reshape(B, S, aspec.num_heads, aspec.head_dim)
+    q = rms_norm(q, p["cross_attn"]["q_norm"]["weight"], rms_eps)
+    n_rep = aspec.num_heads // aspec.num_kv_heads
+    k = jnp.repeat(cross_k, n_rep, axis=2).astype(q.dtype)
+    v = jnp.repeat(cross_v, n_rep, axis=2).astype(q.dtype)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * aspec.softmax_scale + cross_mask.astype(jnp.float32)
+    a = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", a, v).reshape(B, S, -1)
+    attn = attn @ p["cross_attn"]["o_proj"]["weight"]
+    h = hidden + jnp.tanh(p["cross_attn_attn_gate"]) * attn
+
+    m = rms_norm(h, p["post_attention_layernorm"]["weight"], rms_eps)
+    m = gated_mlp(p["mlp"], m, _MLP_SPEC_STUB)
+    m = full_row.astype(m.dtype) * m
+    return h + jnp.tanh(p["cross_attn_mlp_gate"]) * m
+
+
+class _MlpSpecStub:
+    act = "silu"
+
+
+_MLP_SPEC_STUB = _MlpSpecStub()
+
+
+def write_cross_kv(
+    params: dict,
+    cache: MllamaCache,
+    cross_states: jax.Array,  # (B, Sv, H) projected vision tokens
+    slot_ids: jax.Array,  # (B,)
+    aspec: AttnSpec,
+    rms_eps: float,
+) -> MllamaCache:
+    """Project + k-norm the vision states through every cross layer's k/v and
+    scatter into the cross-KV stream (prefill only; reference multimodal KV
+    manager's vision cache update)."""
+    B, Sv, H = cross_states.shape
+    ck, cv = cache.cross_k, cache.cross_v
+    for i, p in enumerate(params["cross_layers"]):
+        k = (cross_states @ p["cross_attn"]["k_proj"]["weight"]).reshape(
+            B, Sv, aspec.num_kv_heads, aspec.head_dim
+        )
+        k = rms_norm(k, p["cross_attn"]["k_norm"]["weight"], rms_eps)
+        v = (cross_states @ p["cross_attn"]["v_proj"]["weight"]).reshape(
+            B, Sv, aspec.num_kv_heads, aspec.head_dim
+        )
+        ck = ck.at[i, slot_ids].set(k.astype(ck.dtype), mode="drop")
+        cv = cv.at[i, slot_ids].set(v.astype(cv.dtype), mode="drop")
+    return MllamaCache(k=cache.k, v=cache.v, cross_k=ck, cross_v=cv)
+
+
+def mllama_text_forward(
+    params: dict,
+    cache: MllamaCache,
+    inputs: StepInputs,
+    cross_mask: jax.Array,  # (B, 1, S, Sv) additive
+    full_row: jax.Array,  # (B, S, 1)
+    cross_states: Optional[jax.Array],  # (B, Sv, H) at prefill, None at decode
+    *,
+    spec: ModelSpec,
+    runs: Tuple,  # (('self', count) | ('cross', local_idx), ...) in layer order
+    phase: str,
+):
+    """Text decoder: llama self-attn runs (lax.scan per run over stacked
+    params) interleaved with cross-attn layers at the fusion schedule
+    (reference NeuronMllamaTextModel.init_model fusion_schedule;
+    HF cross_attention_layers). Returns (logits, cache)."""
+    hidden = params["embed_tokens"]["weight"][inputs.input_ids]
+    cos, sin = rope_cos_sin(
+        inputs.position_ids, params["rope"]["inv_freq"], spec.attention_scaling
+    )
+    mask = build_mask(inputs, spec, phase)
+    key_valid = inputs.attention_mask if phase == PHASE_CONTEXT_ENCODING else None
+    slot_ids = slot_ids_from_seq_ids(inputs.seq_ids, kv_batch_size(cache))
+    positions = inputs.position_ids
+
+    if cross_states is not None:
+        cache = write_cross_kv(
+            params, cache, cross_states, slot_ids, spec.attn, spec.rms_eps
+        )
+
+    k_c, v_c = cache.k, cache.v
+    B = hidden.shape[0]
+    self_offset = 0
+    self_run = 0
+    for kind, n in runs:
+        if kind == "self":
+            stack = params["self_runs"][self_run]
+            self_run += 1
+
+            def body(carry, xs):
+                h, kk, vv = carry
+                lp, li = xs
+                h, kk, vv = decoder_layer(
+                    lp, h, cos, sin, kk, vv, li, mask, slot_ids, positions,
+                    spec, phase, gated_mlp, key_valid=key_valid,
+                )
+                return (h, kk, vv), None
+
+            (hidden, k_c, v_c), _ = jax.lax.scan(
+                body,
+                (hidden, k_c, v_c),
+                (stack, self_offset + jnp.arange(n, dtype=jnp.int32)),
+            )
+            self_offset += n
+        else:
+            ck = cache.cross_k[n][slot_ids]  # (B, Sv, Hkv, D) per live row
+            cv_ = cache.cross_v[n][slot_ids]
+            hidden = _cross_layer(
+                params["cross_layers"][n], hidden, ck, cv_, cross_mask, full_row,
+                spec.attn, spec.rms_eps,
+            )
+    cache = MllamaCache(k=k_c, v=v_c, cross_k=cache.cross_k, cross_v=cache.cross_v)
+
+    hidden = rms_norm(hidden, params["norm"]["weight"], spec.rms_eps)
+    if phase == PHASE_CONTEXT_ENCODING:
+        hidden = gather_last_token(hidden, inputs.attention_mask)
+    logits = (hidden @ params["lm_head"]["weight"]).astype(jnp.float32)
+    return logits[..., : spec.vocab_size], cache
+
+
+def convert_mllama_vision_state_dict(
+    sd: Dict, spec: MllamaVisionSpec, prefix: str, dtype
+) -> Dict:
+    """HF MllamaVisionModel weights -> the vision params tree used by
+    :func:`mllama_vision_encoder`. Shared by the mllama application and the
+    generic encoder registry (runtime/encoder.py)."""
+
+    def get(name):
+        if prefix + name not in sd:
+            raise KeyError(f"missing HF weight {prefix + name}")
+        return np.asarray(sd[prefix + name]).astype(np.float32)
+
+    def lt(name):
+        return get(name).T
+
+    def vlayer(p, gated):
+        d = {
+            "input_layernorm": {
+                "weight": get(p + "input_layernorm.weight"),
+                "bias": get(p + "input_layernorm.bias"),
+            },
+            "post_attention_layernorm": {
+                "weight": get(p + "post_attention_layernorm.weight"),
+                "bias": get(p + "post_attention_layernorm.bias"),
+            },
+            "self_attn": {
+                n: {"weight": lt(p + f"self_attn.{n}.weight")}
+                for n in ("q_proj", "k_proj", "v_proj", "o_proj")
+            },
+            "mlp": {
+                "fc1": {"weight": lt(p + "mlp.fc1.weight"), "bias": get(p + "mlp.fc1.bias")},
+                "fc2": {"weight": lt(p + "mlp.fc2.weight"), "bias": get(p + "mlp.fc2.bias")},
+            },
+        }
+        if gated:
+            d["gate_attn"] = get(p + "gate_attn")
+            d["gate_ffn"] = get(p + "gate_ffn")
+        return d
+
+    def stack(items):
+        return jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs), dtype), *items)
+
+    return {
+        "patch_embedding": {"weight": jnp.asarray(get("patch_embedding.weight"), dtype)},
+        "class_embedding": jnp.asarray(get("class_embedding"), dtype),
+        "gated_positional_embedding": {
+            "embedding": jnp.asarray(get("gated_positional_embedding.embedding"), dtype),
+            "gate": jnp.asarray(get("gated_positional_embedding.gate"), dtype),
+            "tile_embedding": {
+                "weight": jnp.asarray(
+                    get("gated_positional_embedding.tile_embedding.weight"), dtype
+                )
+            },
+        },
+        "pre_tile_positional_embedding": {
+            "embedding": {
+                "weight": jnp.asarray(
+                    get("pre_tile_positional_embedding.embedding.weight"), dtype
+                )
+            },
+            "gate": jnp.asarray(get("pre_tile_positional_embedding.gate"), dtype),
+        },
+        "post_tile_positional_embedding": {
+            "embedding": {
+                "weight": jnp.asarray(
+                    get("post_tile_positional_embedding.embedding.weight"), dtype
+                )
+            },
+            "gate": jnp.asarray(get("post_tile_positional_embedding.gate"), dtype),
+        },
+        "layernorm_pre": {
+            "weight": jnp.asarray(get("layernorm_pre.weight"), dtype),
+            "bias": jnp.asarray(get("layernorm_pre.bias"), dtype),
+        },
+        "layernorm_post": {
+            "weight": jnp.asarray(get("layernorm_post.weight"), dtype),
+            "bias": jnp.asarray(get("layernorm_post.bias"), dtype),
+        },
+        "transformer": {
+            "layers": stack(
+                [vlayer(f"transformer.layers.{i}.", False) for i in range(spec.num_layers)]
+            )
+        },
+        "global_transformer": {
+            "layers": stack(
+                [
+                    vlayer(f"global_transformer.layers.{i}.", True)
+                    for i in range(spec.num_global_layers)
+                ]
+            )
+        },
+    }
+
+
+def prepare_cross_attention_mask(
+    cross_attention_mask: np.ndarray,  # (B, S, num_img, tiles) 1 = attend
+    num_vision_tokens: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """HF _prepare_cross_attention_mask (modeling_mllama.py:49-73): expand
+    tiles to vision-token granularity; fully-masked rows are neutralized
+    (attend-all) and flagged in the full-text-row mask."""
+    B, S = cross_attention_mask.shape[:2]
+    m = np.repeat(cross_attention_mask, num_vision_tokens, axis=3).reshape(B, S, -1)
+    inv = 1.0 - m
+    add = np.where(inv > 0, NEG_INF, 0.0)[:, None]  # (B, 1, S, Sv)
+    full_row = (add != NEG_INF).any(axis=-1).astype(np.float32)[..., None]  # (B,1,S,1)
+    add = add * full_row  # fully-masked rows -> additive 0 (attend all)
+    return add, full_row[:, 0]  # (B, 1, S, Sv), (B, S, 1)

@@ -205,17 +205,25 @@ def attention_prefill(
     sink: Optional[jnp.ndarray] = None,
     causal: bool = True,
     key_valid: Optional[jnp.ndarray] = None,
+    window: Optional[int] = None,
+    chunk: Optional[int] = None,
 ) -> jnp.ndarray:
     """Context-encoding attention (reference perform_prefill, attention_base.py:720).
 
-    ``key_valid`` (B, S) marks valid key positions; when provided (plain causal
-    masks only) the Pallas flash kernel is eligible.
+    ``key_valid`` (B, S) marks valid key positions; when provided the Pallas
+    flash kernel is eligible — including the sliding-window / chunked-
+    attention flavors (fused masks + dead-tile skip; reference
+    sliding_window/attention.py:61-233) and learned sinks (folded via the
+    kernel's emitted softmax stats).
     """
     n_rep = spec.num_heads // spec.num_kv_heads
-    if key_valid is not None and sink is None and causal and _use_flash(spec, q.shape[1]):
+    if key_valid is not None and causal and _use_flash(spec, q.shape[1]):
         from neuronx_distributed_inference_tpu.ops.flash_attention import flash_attention
 
-        return flash_attention(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), key_valid, spec)
+        return flash_attention(
+            q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), key_valid, spec,
+            window=window, chunk=chunk, sink=sink,
+        )
     return _masked_softmax_attention(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), mask, spec, sink)
 
 

@@ -142,6 +142,7 @@ def attach_lora_params(
     manager: LoraWeightManager,
     num_layers: int,
     dtype=jnp.float32,
+    init_all: bool = False,
 ) -> dict:
     """Stack adapter checkpoints into the param tree.
 
@@ -177,7 +178,9 @@ def attach_lora_params(
             A = np.zeros((N, L, d_in, r_max), np.float32)
             B = np.zeros((N, L, r_max, d_out), np.float32)
             scaling = np.zeros((N,), np.float32)
-            found_any = False
+            # init_all: dynamic serving initializes zero slots on every target
+            # module even before any adapter is registered
+            found_any = init_all
             for name, (sd, alpha, use_rslora) in normalized.items():
                 idx = manager.register(name)
                 for layer in range(num_layers):
@@ -201,6 +204,170 @@ def attach_lora_params(
                     np.tile(scaling[None, :], (L, 1)), jnp.float32
                 )
     return params
+
+
+def extract_adapter_arrays(
+    params: dict,
+    sd: dict,
+    alpha,
+    use_rslora: bool,
+    num_layers: int,
+    r_max: int,
+    target: set,
+):
+    """One adapter's PEFT weights -> {(group, module): (A (L,in,r_max),
+    B (L,r_max,out), scaling float)} numpy stacks matching the device layout."""
+
+    def find_key(layer, module, piece):
+        for pattern in (
+            f"base_model.model.model.layers.{layer}.self_attn.{module}.{piece}.weight",
+            f"base_model.model.model.layers.{layer}.mlp.{module}.{piece}.weight",
+            f"model.layers.{layer}.self_attn.{module}.{piece}.weight",
+            f"model.layers.{layer}.mlp.{module}.{piece}.weight",
+        ):
+            if pattern in sd:
+                return sd[pattern]
+        return None
+
+    out = {}
+    for group in ("self_attn", "mlp"):
+        node = params["layers"].get(group, {})
+        for module, entry in node.items():
+            if module not in target or "weight" not in entry:
+                continue
+            L, d_in, d_out = entry["weight"].shape
+            A = np.zeros((L, d_in, r_max), np.float32)
+            B = np.zeros((L, r_max, d_out), np.float32)
+            scaling = 0.0
+            found = False
+            for layer in range(num_layers):
+                a = find_key(layer, module, "lora_A")
+                b = find_key(layer, module, "lora_B")
+                if a is None or b is None:
+                    continue
+                found = True
+                r = a.shape[0]
+                if r > r_max:
+                    raise ValueError(f"adapter rank {r} > max_lora_rank {r_max}")
+                A[layer, :, :r] = np.asarray(a).T
+                B[layer, :r, :] = np.asarray(b).T
+                denom = math.sqrt(r) if use_rslora else r
+                scaling = (alpha if alpha is not None else r) / denom
+            if found:
+                out[(group, module)] = (A, B, float(scaling))
+    return out
+
+
+class DynamicLoraManager(LoraWeightManager):
+    """Dynamic multi-adapter cache: more adapters than device slots
+    (reference AdapterCache, lora_serving/lora_model.py:262-392 — CPU cache
+    with LRU eviction + on-device swap via aliased tensors).
+
+    Device state: the stacked (N, ...) adapter rows in the param tree are
+    SLOTS; a host table maps adapter name -> slot. Adapters beyond
+    ``max_loras`` live preprocessed on the host (bounded by
+    ``max_loras_on_cpu`` beyond the resident set, LRU-evicted). A cache miss
+    evicts the least-recently-used resident adapter not needed by the current
+    batch and scatters the newcomer's rows into its slot (small tensors; the
+    writes are async device updates)."""
+
+    def __init__(self, lora_config):
+        super().__init__(lora_config)
+        from collections import OrderedDict
+
+        self.cpu_cache: "OrderedDict[str, dict]" = OrderedDict()
+        self.slot_of: Dict[str, int] = {}
+        self.name_of_slot: Dict[int, str] = {}
+        self.lru: List[str] = []  # least-recent first
+        self.swaps = 0  # observability: device swap count
+
+    # LoraWeightManager.resolve uses adapter_ids; keep it in sync with slots
+    @property
+    def adapter_ids(self):
+        return self.slot_of
+
+    @adapter_ids.setter
+    def adapter_ids(self, value):  # base __init__ assigns {}
+        self.slot_of = dict(value)
+
+    def register_cpu(self, name: str, value, params: dict, num_layers: int):
+        """Preprocess + host-cache one adapter (any _normalize_adapter form)."""
+        if name in self.cpu_cache:
+            return
+        sd, alpha, use_rslora = _normalize_adapter(name, value)
+        arrays = extract_adapter_arrays(
+            params, sd, alpha, use_rslora, num_layers,
+            self.config.max_lora_rank, set(self.config.target_modules),
+        )
+        if not arrays:
+            raise ValueError(f"adapter {name!r} matched no target modules")
+        self.cpu_cache[name] = arrays
+        # bound host memory: resident adapters must stay materialized (their
+        # arrays are the swap source); beyond that keep max_loras_on_cpu
+        overflow = [
+            n for n in self.cpu_cache
+            if n not in self.slot_of and n != name
+        ]
+        while len(overflow) > self.config.max_loras_on_cpu:
+            victim = overflow.pop(0)
+            del self.cpu_cache[victim]
+            logger.info("LoRA CPU cache evicted %r", victim)
+
+    def _touch(self, name: str):
+        if name in self.lru:
+            self.lru.remove(name)
+        self.lru.append(name)
+
+    def ensure_on_device(self, params: dict, names) -> dict:
+        """Make every named adapter device-resident, swapping slots as needed.
+        Returns the (possibly updated) param tree."""
+        needed = [n for n in dict.fromkeys(names) if n is not None]
+        missing = [n for n in needed if n not in self.slot_of]
+        if not missing:
+            for n in needed:
+                self._touch(n)
+            return params
+        if len(needed) > self.config.max_loras:
+            raise RuntimeError(
+                f"batch needs {len(needed)} distinct adapters > "
+                f"max_loras={self.config.max_loras}"
+            )
+        for name in missing:
+            if name not in self.cpu_cache:
+                raise KeyError(
+                    f"unknown LoRA adapter {name!r}; register it first "
+                    f"(app.register_lora_adapter)"
+                )
+            # pick a slot: a free one, else the LRU resident not in `needed`
+            free = [
+                s for s in range(1, self.config.max_loras + 1)
+                if s not in self.name_of_slot
+            ]
+            if free:
+                slot = free[0]
+            else:
+                victim = next(n for n in self.lru if n not in needed)
+                slot = self.slot_of.pop(victim)
+                del self.name_of_slot[slot]
+                self.lru.remove(victim)
+                logger.info("LoRA slot %d: evicted %r for %r", slot, victim, name)
+            params = self._write_slot(params, slot, self.cpu_cache[name])
+            self.slot_of[name] = slot
+            self.name_of_slot[slot] = name
+            self.swaps += 1
+            self._touch(name)
+        for n in needed:
+            self._touch(n)
+        return params
+
+    def _write_slot(self, params: dict, slot: int, arrays: dict) -> dict:
+        for (group, module), (A, B, scaling) in arrays.items():
+            entry = params["layers"][group][module]
+            dt = entry["lora_A"].dtype
+            entry["lora_A"] = entry["lora_A"].at[:, slot].set(jnp.asarray(A, dt))
+            entry["lora_B"] = entry["lora_B"].at[:, slot].set(jnp.asarray(B, dt))
+            entry["lora_scaling"] = entry["lora_scaling"].at[:, slot].set(scaling)
+        return params
 
 
 def lora_pspecs(pspecs: dict, params: dict) -> dict:

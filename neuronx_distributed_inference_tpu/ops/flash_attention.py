@@ -1,16 +1,23 @@
-"""Pallas flash attention (causal prefill) for TPU.
+"""Pallas flash attention (causal/windowed/chunked prefill) for TPU.
 
 TPU-native replacement for the reference's NKI flash-attention kernels
 (reference: neuronxcc ``attention_isa_kernel`` used at
-modules/attention/attention_base.py:54,720; in-tree core
-modules/chunked_prefill/flash_attn_core.py:70).
+modules/attention/attention_base.py:54,720; in-tree cores
+modules/chunked_prefill/flash_attn_core.py:70 and the sliding-window
+``flash_fwd`` modules/sliding_window/attention.py:61-233).
 
 Design: classic online-softmax flash attention tiled for the MXU.
 Grid = (batch, heads, q_blocks, kv_blocks); the kv_blocks axis is the
 innermost sequential loop; running max/denominator/accumulator live in VMEM
-scratch across kv steps. Causal tiles entirely above the diagonal are skipped
-(reference's tile scheduler skips fully-masked tiles,
-modules/sliding_window/attention.py:61-233).
+scratch across kv steps. Tiles entirely outside the mask are skipped:
+above the causal diagonal, fully below the sliding window, or in a
+non-overlapping attention chunk (the reference sliding-window kernel's
+fully-masked-tile skip, sliding_window/attention.py:61-233).
+
+Learned attention sinks are folded in OUTSIDE the kernel: the kernel emits
+per-row (m, l) softmax stats and the wrapper rescales the output by
+``l / (l + exp(sink - m))`` — exactly the sink-in-denominator semantics
+(reference attention_base.py:879-889) with no extra kernel passes.
 
 Falls back to an XLA masked-softmax path off-TPU or for shapes the kernel
 doesn't support (the reference similarly keeps a native softmax path,
@@ -21,6 +28,7 @@ from __future__ import annotations
 
 import functools
 import math
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -40,6 +48,8 @@ def _flash_kernel(
     v_ref,  # (1, 1, bkv, D)
     valid_ref,  # (1, bkv) int32 key-validity
     o_ref,  # (1, 1, bq, D)
+    m_ref,  # (1, 1, bq, 1) f32 row max (for sink folding)
+    l_ref,  # (1, 1, bq, 1) f32 row denom
     m_scr,  # (bq, 1) f32 running max
     l_scr,  # (bq, 1) f32 running denom
     acc_scr,  # (bq, D) f32 accumulator
@@ -49,6 +59,8 @@ def _flash_kernel(
     bkv: int,
     nkv: int,
     causal: bool,
+    window: Optional[int],
+    chunk: Optional[int],
 ):
     iq = pl.program_id(2)
     ik = pl.program_id(3)
@@ -61,9 +73,19 @@ def _flash_kernel(
 
     q_start = iq * bq
     kv_start = ik * bkv
+    q_last = q_start + bq - 1
 
-    # skip tiles entirely above the causal diagonal
-    run = (not causal) or (kv_start <= q_start + bq - 1)
+    # skip tiles entirely outside the mask: above the causal diagonal,
+    # fully below the sliding window, or in a non-overlapping chunk
+    run = jnp.bool_(True) if not causal else (kv_start <= q_last)
+    if window is not None:
+        # rows attend (row - window, row]: a tile is dead when its LAST kv
+        # column is <= the FIRST row - window
+        run = jnp.logical_and(run, kv_start + bkv - 1 > q_start - window)
+    if chunk is not None:
+        # same-chunk attention only: tile chunk ranges must overlap
+        run = jnp.logical_and(run, (kv_start // chunk) <= (q_last // chunk))
+        run = jnp.logical_and(run, ((kv_start + bkv - 1) // chunk) >= (q_start // chunk))
 
     @pl.when(run)
     def _compute():
@@ -76,16 +98,21 @@ def _flash_kernel(
 
         valid = valid_ref[0, :] > 0  # (bkv,)
         mask = jnp.broadcast_to(valid[None, :], (bq, bkv))
+        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+        cols = kv_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
         if causal:
-            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
-            cols = kv_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
             mask = mask & (cols <= rows)
+        if window is not None:
+            mask = mask & (cols > rows - window)
+        if chunk is not None:
+            mask = mask & ((cols // chunk) == (rows // chunk))
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:]  # (bq, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)  # (bq, bkv)
+        p = jnp.where(mask, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)  # (bq, 1)
 
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
@@ -100,9 +127,14 @@ def _flash_kernel(
     def _finalize():
         denom = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0, 0, :, :] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        m_ref[0, 0, :, :] = m_scr[:]
+        l_ref[0, 0, :, :] = l_scr[:]
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "scale", "bq", "bkv", "interpret"))
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "window", "chunk", "bq", "bkv", "interpret"),
+)
 def flash_attention_bhsd(
     q: jax.Array,  # (B, H, S, D)
     k: jax.Array,  # (B, H, S, D)
@@ -111,10 +143,13 @@ def flash_attention_bhsd(
     *,
     scale: float,
     causal: bool = True,
+    window: Optional[int] = None,
+    chunk: Optional[int] = None,
     bq: int = 128,
     bkv: int = 128,
     interpret: bool = False,
-) -> jax.Array:
+):
+    """Returns (out (B,H,S,D), m (B,H,S,1), l (B,H,S,1))."""
     B, H, S, D = q.shape
     bq = min(bq, S)
     bkv = min(bkv, S)
@@ -122,7 +157,8 @@ def flash_attention_bhsd(
     nkv = pl.cdiv(S, bkv)
 
     kernel = functools.partial(
-        _flash_kernel, scale=scale, bq=bq, bkv=bkv, nkv=nkv, causal=causal
+        _flash_kernel, scale=scale, bq=bq, bkv=bkv, nkv=nkv, causal=causal,
+        window=window, chunk=chunk,
     )
     grid = (B, H, nq, nkv)
     return pl.pallas_call(
@@ -134,8 +170,16 @@ def flash_attention_bhsd(
             pl.BlockSpec((1, 1, bkv, D), lambda b, h, iq, ik: (b, h, ik, 0)),
             pl.BlockSpec((1, bkv), lambda b, h, iq, ik: (b, ik)),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda b, h, iq, ik: (b, h, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, S, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, S, 1), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),
             pltpu.VMEM((bq, 1), jnp.float32),
@@ -148,19 +192,33 @@ def flash_attention_bhsd(
     )(q, k, v, key_valid)
 
 
-def flash_attention(q, k, v, key_valid, spec, causal: bool = True):
+def flash_attention(
+    q, k, v, key_valid, spec, causal: bool = True,
+    window: Optional[int] = None, chunk: Optional[int] = None, sink=None,
+):
     """Flash attention entry. q/k/v: (B, S, H, D) with H already GQA-repeated;
-    key_valid: (B, S). Returns (B, S, H, D)."""
+    key_valid: (B, S). ``window``/``chunk`` select the sliding-window /
+    chunked-attention prefill masks; ``sink`` (Hq,) folds learned sink logits
+    into the softmax denominator via the emitted (m, l) stats. Returns
+    (B, S, H, D)."""
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out = flash_attention_bhsd(
+    out, m, l = flash_attention_bhsd(
         qt,
         kt,
         vt,
         key_valid.astype(jnp.int32),
         scale=spec.softmax_scale,
         causal=causal,
+        window=window,
+        chunk=chunk,
         interpret=jax.default_backend() != "tpu",
     )
+    if sink is not None:
+        # softmax-with-sink = softmax * l / (l + exp(sink - m))
+        # (reference sink-in-denominator, attention_base.py:879-889)
+        sk = sink.astype(jnp.float32)[None, :, None, None]  # (1, H, 1, 1)
+        factor = l / (l + jnp.exp(sk - m))
+        out = (out.astype(jnp.float32) * factor).astype(out.dtype)
     return jnp.swapaxes(out, 1, 2)

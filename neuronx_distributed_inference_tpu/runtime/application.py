@@ -222,13 +222,18 @@ class TpuModelForCausalLM:
             return
         self.kv_cache = self.builder.init_kv_cache(self.mesh)
 
-    def load_lora_adapters(self, adapters):
+    def load_lora_adapters(self, adapters=None, dynamic: bool = False):
         """Attach multi-adapter LoRA weights (reference LoraModel.inject_adapter
         + LoraWeightManager, lora_serving/lora_model.py:35-260).
 
-        ``adapters``: {adapter_name: PEFT-format state dict}.
+        ``adapters``: {adapter_name: PEFT-format state dict | directory path}.
+        ``dynamic``: serve MORE adapters than device slots — a host cache with
+        LRU slot eviction + on-device swap (reference AdapterCache,
+        lora_model.py:262-392); register further adapters any time with
+        :meth:`register_lora_adapter`.
         """
         from neuronx_distributed_inference_tpu.modules.lora import (
+            DynamicLoraManager,
             LoraWeightManager,
             attach_lora_params,
             lora_pspecs,
@@ -239,13 +244,36 @@ class TpuModelForCausalLM:
             raise ValueError("lora_config must be set to serve LoRA adapters")
         if self.params is None:
             raise RuntimeError("call load() before load_lora_adapters()")
-        self.lora_manager = LoraWeightManager(tc.lora_config)
-        params = attach_lora_params(
-            self.params, adapters, self.lora_manager, self.spec.num_layers,
-            dtype=to_dtype(tc.dtype),
-        )
+        adapters = adapters or {}
+        if dynamic:
+            self.lora_manager = DynamicLoraManager(tc.lora_config)
+            params = attach_lora_params(
+                self.params, {}, self.lora_manager, self.spec.num_layers,
+                dtype=to_dtype(tc.dtype), init_all=True,
+            )
+        else:
+            self.lora_manager = LoraWeightManager(tc.lora_config)
+            params = attach_lora_params(
+                self.params, adapters, self.lora_manager, self.spec.num_layers,
+                dtype=to_dtype(tc.dtype),
+            )
         self._pspecs = lora_pspecs(self._pspecs, params)
         self.params = shard_pytree(params, self._pspecs, self.mesh)
+        if dynamic:
+            for name, value in adapters.items():
+                self.register_lora_adapter(name, value)
+        return self
+
+    def register_lora_adapter(self, name: str, value):
+        """Host-register an adapter for dynamic serving (preprocessed into
+        the CPU cache; swapped on device on first use)."""
+        from neuronx_distributed_inference_tpu.modules.lora import DynamicLoraManager
+
+        if not isinstance(self.lora_manager, DynamicLoraManager):
+            raise RuntimeError(
+                "register_lora_adapter needs load_lora_adapters(dynamic=True)"
+            )
+        self.lora_manager.register_cpu(name, value, self.params, self.spec.num_layers)
         return self
 
     def resolve_adapter_ids(self, adapter_names) -> Optional[np.ndarray]:
@@ -253,6 +281,13 @@ class TpuModelForCausalLM:
             return None
         if self.lora_manager is None:
             raise RuntimeError("no LoRA adapters loaded (call load_lora_adapters)")
+        from neuronx_distributed_inference_tpu.modules.lora import DynamicLoraManager
+
+        if isinstance(self.lora_manager, DynamicLoraManager):
+            # cache-miss adapters swap into device slots before dispatch
+            self.params = self.lora_manager.ensure_on_device(
+                self.params, adapter_names
+            )
         return self.lora_manager.resolve(adapter_names)
 
     def compile(self, compiled_model_path: Optional[str] = None):
@@ -294,6 +329,86 @@ class TpuModelForCausalLM:
                 self.params, self.kv_cache, self._sample_key(0),
                 chunk_q_lens=chunk_q if runner is self.token_generation_model else None,
             )
+
+    def capture_forward(
+        self,
+        input_ids: np.ndarray,
+        attention_mask: Optional[np.ndarray] = None,
+        replacements: Optional[dict] = None,
+    ):
+        """Debug prefill pass with tensor taps (reference tensor capture +
+        teacher-forcing replacement, config.py:987/:1038 +
+        utils/tensor_replacement/registry.py).
+
+        Captures the points named in ``tpu_config.tensor_capture_config`` and
+        substitutes host goldens for the points named in
+        ``tensor_replacement_config`` (``replacements`` maps point name ->
+        array; per-layer points use (L, ...) stacked goldens).
+
+        Returns (tokens (B, 1) np, captured {point: np array}). The KV cache
+        is left untouched (a debug pass must not corrupt live state).
+        """
+        from functools import partial as _partial
+
+        from neuronx_distributed_inference_tpu.models.base import forward
+        from neuronx_distributed_inference_tpu.modules import tensor_taps
+
+        tc = self.config.tpu_config
+        cap_cfg = tc.tensor_capture_config
+        rep_cfg = tc.tensor_replacement_config
+        if cap_cfg is None and rep_cfg is None:
+            raise ValueError(
+                "set tpu_config.tensor_capture_config and/or "
+                "tensor_replacement_config to use capture_forward"
+            )
+        points = tuple(cap_cfg.points) if cap_cfg else ()
+        allowed = tuple(rep_cfg.points) if rep_cfg else ()
+        replacements = dict(replacements or {})
+        unknown = set(replacements) - set(allowed)
+        if unknown:
+            raise ValueError(
+                f"replacement(s) {sorted(unknown)} not declared in "
+                f"tensor_replacement_config.points {list(allowed)}"
+            )
+
+        input_ids = np.asarray(input_ids)
+        B, S = input_ids.shape
+        if attention_mask is None:
+            attention_mask = np.ones_like(input_ids)
+        position_ids = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+        inputs, _ = self.context_encoding_model.prepare(
+            input_ids, np.asarray(attention_mask), position_ids,
+            np.arange(B, dtype=np.int32),
+        )
+
+        mlp_fn = self.builder.mlp_fn()
+        layer_fn = self.builder.layer_fn()
+
+        key = (points, allowed, tuple(sorted(replacements)))
+        if not hasattr(self, "_tap_fns"):
+            self._tap_fns = {}
+        fn = self._tap_fns.get(key)
+        if fn is None:
+
+            def tapped(params, cache, step_inputs, goldens):
+                with tensor_taps.TapContext(capture=points, replacements=goldens) as ctx:
+                    out = forward(
+                        params, cache, step_inputs, None,
+                        spec=self.spec, phase=PHASE_CONTEXT_ENCODING,
+                        mlp_fn=mlp_fn, layer_fn=layer_fn,
+                    )
+                    return out.tokens, dict(ctx.captured)
+
+            fn = self._tap_fns[key] = jax.jit(tapped)
+        with jax.set_mesh(self.mesh):
+            tokens, captured = fn(
+                self.params, self.kv_cache, inputs,
+                {k: jnp.asarray(v) for k, v in replacements.items()},
+            )
+        return (
+            np.asarray(tokens)[:B],
+            {k: np.asarray(v) for k, v in captured.items()},
+        )
 
     def _sample_key(self, step: int):
         if not self.spec.do_sample:

@@ -1,0 +1,192 @@
+"""Generic encoder application base.
+
+TPU-native re-design of the reference encoder application family
+(reference: models/encoder_base.py:24 ``NeuronEncoderApplication`` — the
+compile/load/forward lifecycle shared by ViT/CLIP/T5-encoder/VAE submodels,
+each declared as [model_cls, wrapper_cls] and traced into its own NEFF).
+
+On TPU the lifecycle collapses to: a pure ``encode_fn(params, *arrays)``,
+a checkpoint converter, optional GSPMD shardings, and one jitted program per
+input shape (the jit cache plays the per-bucket NEFF role). Concrete towers
+register through :func:`register_encoder`; the multimodal apps
+(runtime/image_to_text.py, runtime/encoder_decoder.py, runtime/mllama.py)
+are the in-tree instances.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import to_dtype
+from neuronx_distributed_inference_tpu.parallel.mesh import mesh_from_config
+from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
+
+_ENCODER_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_encoder(name: str):
+    """Register a factory: (config) -> (encode_fn, convert_fn, spec)."""
+
+    def deco(fn):
+        _ENCODER_REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def get_encoder_factory(name: str):
+    if name not in _ENCODER_REGISTRY:
+        raise KeyError(
+            f"unknown encoder {name!r}; registered: {sorted(_ENCODER_REGISTRY)}"
+        )
+    return _ENCODER_REGISTRY[name]
+
+
+class TpuEncoderApplication:
+    """Encoder-only application: load -> (shard) -> jitted encode.
+
+    ``encode_fn(params, *arrays) -> array`` must be pure/jittable;
+    ``convert_fn(state_dict, dtype) -> params`` maps an HF checkpoint onto
+    the params pytree; ``pspec_fn(params) -> spec tree`` (optional) gives
+    GSPMD shardings (defaults to replicated).
+    """
+
+    def __init__(
+        self,
+        encode_fn: Callable,
+        convert_fn: Optional[Callable] = None,
+        config=None,
+        mesh=None,
+        pspec_fn: Optional[Callable] = None,
+        static_kwargs: Optional[dict] = None,
+    ):
+        self.config = config
+        self.convert_fn = convert_fn
+        self.pspec_fn = pspec_fn
+        tc = getattr(config, "tpu_config", None)
+        self.mesh = mesh if mesh is not None else (mesh_from_config(tc) if tc else None)
+        self._fn = jax.jit(partial(encode_fn, **(static_kwargs or {})))
+        self.params = None
+
+    @classmethod
+    def from_registry(cls, name: str, config, mesh=None):
+        encode_fn, convert_fn, static_kwargs = get_encoder_factory(name)(config)
+        return cls(
+            encode_fn, convert_fn, config=config, mesh=mesh, static_kwargs=static_kwargs
+        )
+
+    def load(self, state_dict=None, params=None, model_path=None):
+        if params is None:
+            if state_dict is None:
+                from neuronx_distributed_inference_tpu.utils.hf_checkpoint import (
+                    load_state_dict,
+                )
+
+                state_dict = load_state_dict(model_path)
+            dt = to_dtype(self.config.tpu_config.dtype) if self.config else jnp.float32
+            params = self.convert_fn(state_dict, dt)
+        if self.mesh is not None and self.pspec_fn is not None:
+            params = shard_pytree(params, self.pspec_fn(params), self.mesh)
+        self.params = params
+        return self
+
+    def warmup(self, *example_arrays):
+        """Compile + run once per example shape (reference warmup,
+        application_base.py:348-372)."""
+        out = self._fn(self.params, *(jnp.asarray(a) for a in example_arrays))
+        jax.block_until_ready(out)
+        return self
+
+    def __call__(self, *arrays):
+        if self.params is None:
+            raise RuntimeError("call load() first")
+        return self._fn(self.params, *(jnp.asarray(a) for a in arrays))
+
+    encode = __call__
+
+
+# ---------------------------------------------------------------------------
+# in-tree encoder factories
+# ---------------------------------------------------------------------------
+
+
+@register_encoder("pixtral")
+def _pixtral_factory(config):
+    from neuronx_distributed_inference_tpu.models.pixtral import (
+        convert_pixtral_vision_state_dict,
+        pixtral_vision_encoder,
+        pixtral_vision_spec,
+    )
+
+    spec = pixtral_vision_spec(getattr(config, "vision_config", config))
+
+    def convert(sd, dt):
+        return convert_pixtral_vision_state_dict(sd, spec, "model.vision_tower.", dt)
+
+    return pixtral_vision_encoder, convert, {"spec": spec}
+
+
+@register_encoder("llama4_vision")
+def _llama4_vision_factory(config):
+    from neuronx_distributed_inference_tpu.models.llama4_vision import (
+        convert_llama4_vision_state_dict,
+        llama4_vision_encoder,
+        llama4_vision_spec_from_config,
+    )
+
+    spec = llama4_vision_spec_from_config(getattr(config, "vision_config", config))
+
+    def convert(sd, dt):
+        return convert_llama4_vision_state_dict(sd, spec, "vision_model.", dt)
+
+    return llama4_vision_encoder, convert, {"spec": spec}
+
+
+@register_encoder("mllama_vision")
+def _mllama_vision_factory(config):
+    from neuronx_distributed_inference_tpu.models.mllama import (
+        MllamaVisionSpec,
+        convert_mllama_vision_state_dict,
+        mllama_vision_encoder,
+    )
+
+    vc = getattr(config, "vision_config", config)
+    vg = vc.get if isinstance(vc, dict) else lambda k, d=None: getattr(vc, k, d)
+    spec = MllamaVisionSpec(
+        hidden_size=vg("hidden_size"),
+        num_heads=vg("attention_heads"),
+        intermediate_size=vg("intermediate_size"),
+        num_layers=vg("num_hidden_layers"),
+        num_global_layers=vg("num_global_layers"),
+        image_size=vg("image_size"),
+        patch_size=vg("patch_size"),
+        max_num_tiles=vg("max_num_tiles"),
+        intermediate_layers_indices=tuple(vg("intermediate_layers_indices")),
+        norm_eps=vg("norm_eps", 1e-5),
+    )
+
+    def convert(sd, dt):
+        return convert_mllama_vision_state_dict(sd, spec, "model.vision_model.", dt)
+
+    return mllama_vision_encoder, convert, {"spec": spec}
+
+
+@register_encoder("whisper_encoder")
+def _whisper_encoder_factory(config):
+    from neuronx_distributed_inference_tpu.models.whisper import (
+        convert_whisper_state_dict,
+        whisper_encoder,
+        whisper_spec,
+    )
+
+    spec = whisper_spec(config)
+
+    def convert(sd, dt):
+        return convert_whisper_state_dict(sd, spec, dt)["encoder"]
+
+    return whisper_encoder, convert, {"spec": spec}

@@ -51,7 +51,25 @@ class TpuImageToTextModel:
         text_cfg = getattr(config, "text_config", None)
         if vision_cfg is None or text_cfg is None:
             raise ValueError("multimodal config needs vision_config and text_config")
-        self.vision_spec = pixtral_vision_spec(vision_cfg)
+        vg = (
+            vision_cfg.get
+            if isinstance(vision_cfg, dict)
+            else lambda k, d=None: getattr(vision_cfg, k, d)
+        )
+        vtype = vg("model_type", "pixtral")
+        # vision backend dispatch: pixtral/llava embed-merge or the llama4
+        # unfold-conv + pixel-shuffle tower (reference
+        # models/llama4/modeling_llama4_vision.py) — both feed the SAME
+        # inputs_embeds splice
+        self.vision_kind = "llama4" if "llama4" in str(vtype) else "pixtral"
+        if self.vision_kind == "llama4":
+            from neuronx_distributed_inference_tpu.models.llama4_vision import (
+                llama4_vision_spec_from_config,
+            )
+
+            self.vision_spec = llama4_vision_spec_from_config(vision_cfg)
+        else:
+            self.vision_spec = pixtral_vision_spec(vision_cfg)
         self.image_token = getattr(config, "image_token_index", None)
         if self.image_token is None:
             raise ValueError("config.image_token_index required")
@@ -72,9 +90,18 @@ class TpuImageToTextModel:
         self.text = TpuModelForCausalLM(model_path, text_conf, mesh=mesh)
         self.vision_params = None
         self.projector = None
-        self._encode_fn = jax.jit(
-            partial(pixtral_vision_encoder, spec=self.vision_spec)
-        )
+        if self.vision_kind == "llama4":
+            from neuronx_distributed_inference_tpu.models.llama4_vision import (
+                llama4_vision_encoder,
+            )
+
+            self._encode_fn = jax.jit(
+                partial(llama4_vision_encoder, spec=self.vision_spec)
+            )
+        else:
+            self._encode_fn = jax.jit(
+                partial(pixtral_vision_encoder, spec=self.vision_spec)
+            )
         from neuronx_distributed_inference_tpu.models.base import embed
 
         self._embed_fn = jax.jit(embed)
@@ -89,6 +116,10 @@ class TpuImageToTextModel:
             )
 
             state_dict = load_state_dict(model_path or self.model_path)
+        if random_weights and self.vision_kind == "llama4":
+            raise NotImplementedError(
+                "llama4-vision random init is not wired; pass an HF state dict"
+            )
         if random_weights:
             self.text.load(random_weights=True)
             self.vision_params = self._random_vision_params(dt)
@@ -107,6 +138,35 @@ class TpuImageToTextModel:
                 },
             }
             return self
+        if self.vision_kind == "llama4":
+            # HF llama4 layout: vision_model.* / multi_modal_projector.* /
+            # language_model.model.* / language_model.lm_head.weight, with a
+            # single bias-free projector linear
+            from neuronx_distributed_inference_tpu.models.llama4_vision import (
+                convert_llama4_vision_state_dict,
+            )
+
+            text_sd = {}
+            for k, v in state_dict.items():
+                if k.startswith("language_model.model."):
+                    text_sd["model." + k[len("language_model.model."):]] = v
+                elif k == "language_model.lm_head.weight":
+                    text_sd["lm_head.weight"] = v
+            self.text.load(state_dict=text_sd)
+            self.vision_params = convert_llama4_vision_state_dict(
+                state_dict, self.vision_spec, "vision_model.", dt
+            )
+            self.projector = {
+                "linear_1": {
+                    "weight": jnp.asarray(
+                        np.asarray(
+                            state_dict["multi_modal_projector.linear_1.weight"]
+                        ).T,
+                        dt,
+                    )
+                }
+            }
+            return self
         # HF llava layout: model.vision_tower.* / model.multi_modal_projector.*
         # / model.language_model.* / lm_head.weight
         text_sd = {}
@@ -116,10 +176,10 @@ class TpuImageToTextModel:
             elif k == "lm_head.weight":
                 text_sd[k] = v
         self.text.load(state_dict=text_sd)
+        proj = "model.multi_modal_projector."
         self.vision_params = convert_pixtral_vision_state_dict(
             state_dict, self.vision_spec, "model.vision_tower.", dt
         )
-        proj = "model.multi_modal_projector."
         self.projector = {
             "linear_1": {
                 "weight": jnp.asarray(np.asarray(state_dict[proj + "linear_1.weight"]).T, dt),
@@ -190,10 +250,14 @@ class TpuImageToTextModel:
 
     def encode_images(self, pixel_values: np.ndarray) -> jax.Array:
         """(N, C, H, W) -> (N, patches, H_text) projected image features
-        (vision tower + llava projector)."""
+        (vision tower + projector)."""
         from neuronx_distributed_inference_tpu.models.base import act_fn
 
         feats = self._encode_fn(self.vision_params, jnp.asarray(pixel_values))
+        if self.vision_kind == "llama4":
+            # llama4 projector: single bias-free linear, no activation
+            # (HF Llama4MultiModalProjector)
+            return feats @ self.projector["linear_1"]["weight"]
         act = act_fn(self.projector_act)
         x = feats @ self.projector["linear_1"]["weight"] + self.projector["linear_1"]["bias"]
         x = act(x)

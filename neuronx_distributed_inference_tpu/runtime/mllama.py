@@ -1,0 +1,405 @@
+"""Mllama (Llama-3.2-Vision) application: vision tower -> cross-KV prefill ->
+cross-attention decode.
+
+Reference: models/mllama/model_wrapper_mllama.py + NeuronMllamaForCausalLM
+(modeling_mllama.py:1083-1280) — the vision model runs once per prompt, its
+states feed a separate vision-KV cache, and the text decoder interleaves
+self/cross layers. Oracle for tests: HF MllamaForConditionalGeneration.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_inference_tpu.config import InferenceConfig, to_dtype
+from neuronx_distributed_inference_tpu.models.base import (
+    PHASE_CONTEXT_ENCODING,
+    PHASE_TOKEN_GENERATION,
+    ModelSpec,
+    StepInputs,
+)
+from neuronx_distributed_inference_tpu.models.mllama import (
+    MllamaCache,
+    MllamaVisionSpec,
+    mllama_text_forward,
+    mllama_vision_encoder,
+    prepare_cross_attention_mask,
+    LEARNABLE_EMBEDDING_SIZE,
+)
+from neuronx_distributed_inference_tpu.modules.attention import AttnSpec
+from neuronx_distributed_inference_tpu.modules.kvcache import GARBAGE_LINES
+from neuronx_distributed_inference_tpu.modules.rope import compute_inv_freq
+from neuronx_distributed_inference_tpu.modules.sampling import prepare_sampling_params
+from neuronx_distributed_inference_tpu.parallel.mesh import mesh_from_config
+from neuronx_distributed_inference_tpu.parallel.sharding import TENSOR, shard_pytree
+from neuronx_distributed_inference_tpu.runtime.application import GenerationOutput
+
+
+class _AttrView:
+    def __init__(self, d):
+        self._d = dict(d)
+
+    def __getattr__(self, k):
+        try:
+            return self._d[k]
+        except KeyError:
+            raise AttributeError(k)
+
+    def get(self, k, default=None):
+        return self._d.get(k, default)
+
+
+class MllamaForConditionalGeneration:
+    """Cross-attention multimodal app (reference NeuronMllamaForCausalLM)."""
+
+    def __init__(self, model_path: Optional[str], config: InferenceConfig, mesh=None):
+        self.config = config
+        self.model_path = model_path
+        tc = config.tpu_config
+        txt = config.text_config
+        vis = config.vision_config
+        tg = txt.get if isinstance(txt, dict) else lambda k, d=None: getattr(txt, k, d)
+        vg = vis.get if isinstance(vis, dict) else lambda k, d=None: getattr(vis, k, d)
+        self._tg, self._vg = tg, vg
+
+        H = tg("hidden_size")
+        heads = tg("num_attention_heads")
+        kv = tg("num_key_value_heads", heads)
+        self.head_dim = H // heads
+        self.cross_layers: List[int] = sorted(tg("cross_attention_layers"))
+        self.num_layers = tg("num_hidden_layers")
+        self.num_self = self.num_layers - len(self.cross_layers)
+        # layer order -> ('self', run_len) / ('cross', local_idx) schedule
+        runs: List[Tuple] = []
+        local = 0
+        run_len = 0
+        for i in range(self.num_layers):
+            if i in self.cross_layers:
+                if run_len:
+                    runs.append(("self", run_len))
+                    run_len = 0
+                runs.append(("cross", local))
+                local += 1
+            else:
+                run_len += 1
+        if run_len:
+            runs.append(("self", run_len))
+        self.runs = tuple(runs)
+
+        self.vision_spec = MllamaVisionSpec(
+            hidden_size=vg("hidden_size"),
+            num_heads=vg("attention_heads"),
+            intermediate_size=vg("intermediate_size"),
+            num_layers=vg("num_hidden_layers"),
+            num_global_layers=vg("num_global_layers"),
+            image_size=vg("image_size"),
+            patch_size=vg("patch_size"),
+            max_num_tiles=vg("max_num_tiles"),
+            intermediate_layers_indices=tuple(vg("intermediate_layers_indices")),
+            norm_eps=vg("norm_eps", 1e-5),
+        )
+        self.spec = ModelSpec(
+            num_layers=self.num_self,
+            hidden_size=H,
+            vocab_size=tg("vocab_size"),
+            padded_vocab_size=tg("vocab_size"),
+            intermediate_size=tg("intermediate_size"),
+            attn=AttnSpec(
+                num_heads=heads,
+                num_kv_heads=kv,
+                head_dim=self.head_dim,
+                rms_norm_eps=tg("rms_norm_eps", 1e-5),
+            ),
+            rms_eps=tg("rms_norm_eps", 1e-5),
+            act=tg("hidden_act", "silu"),
+            attention_scaling=1.0,
+            on_device_sampling=False,
+            output_logits=tc.output_logits,
+        )
+        self.mesh = mesh if mesh is not None else mesh_from_config(tc)
+        self.params = None
+        self.cache = None
+        self._vision_fn = jax.jit(
+            partial(mllama_vision_encoder, spec=self.vision_spec)
+        )
+        self._cte_fn = jax.jit(
+            partial(
+                mllama_text_forward, spec=self.spec, runs=self.runs,
+                phase=PHASE_CONTEXT_ENCODING,
+            ),
+            donate_argnums=(1,),
+        )
+        self._tkg_fn = jax.jit(
+            partial(
+                mllama_text_forward, spec=self.spec, runs=self.runs,
+                phase=PHASE_TOKEN_GENERATION, cross_states=None,
+            ),
+            donate_argnums=(1,),
+        )
+
+    # ---- params ----------------------------------------------------------
+
+    def _llama_layer(self, get, lt, p):
+        return {
+            "input_layernorm": {"weight": get(p + "input_layernorm.weight")},
+            "post_attention_layernorm": {
+                "weight": get(p + "post_attention_layernorm.weight")
+            },
+            "self_attn": {
+                "q_proj": {"weight": lt(p + "self_attn.q_proj.weight")},
+                "k_proj": {"weight": lt(p + "self_attn.k_proj.weight")},
+                "v_proj": {"weight": lt(p + "self_attn.v_proj.weight")},
+                "o_proj": {"weight": lt(p + "self_attn.o_proj.weight")},
+            },
+            "mlp": {
+                "gate_proj": {"weight": lt(p + "mlp.gate_proj.weight")},
+                "up_proj": {"weight": lt(p + "mlp.up_proj.weight")},
+                "down_proj": {"weight": lt(p + "mlp.down_proj.weight")},
+            },
+        }
+
+    def convert_hf_state_dict(self, sd: Dict[str, np.ndarray], dtype=None) -> Dict:
+        dtype = dtype or to_dtype(self.config.tpu_config.dtype)
+
+        def get(name):
+            if name not in sd:
+                raise KeyError(f"missing HF weight {name}")
+            return np.asarray(sd[name]).astype(np.float32)
+
+        def lt(name):
+            return get(name).T
+
+        from neuronx_distributed_inference_tpu.models.mllama import (
+            convert_mllama_vision_state_dict,
+        )
+
+        def stack(items):
+            return jax.tree.map(lambda *xs: jnp.asarray(np.stack(xs), dtype), *items)
+
+        vision = convert_mllama_vision_state_dict(
+            sd, self.vision_spec, "model.vision_model.", dtype
+        )
+
+        tpre = "model.language_model."
+        self_runs = []
+        cross_params = []
+        i = 0
+        for kind, n in self.runs:
+            if kind == "self":
+                self_runs.append(
+                    stack(
+                        [
+                            self._llama_layer(get, lt, tpre + f"layers.{j}.")
+                            for j in range(i, i + n)
+                        ]
+                    )
+                )
+                i += n
+            else:
+                p = tpre + f"layers.{i}."
+                cross_params.append(
+                    jax.tree.map(
+                        lambda x: jnp.asarray(x, dtype),
+                        {
+                            "input_layernorm": {"weight": get(p + "input_layernorm.weight")},
+                            "post_attention_layernorm": {
+                                "weight": get(p + "post_attention_layernorm.weight")
+                            },
+                            "cross_attn": {
+                                "q_proj": {"weight": lt(p + "cross_attn.q_proj.weight")},
+                                "k_proj": {"weight": lt(p + "cross_attn.k_proj.weight")},
+                                "v_proj": {"weight": lt(p + "cross_attn.v_proj.weight")},
+                                "o_proj": {"weight": lt(p + "cross_attn.o_proj.weight")},
+                                "q_norm": {"weight": get(p + "cross_attn.q_norm.weight")},
+                                "k_norm": {"weight": get(p + "cross_attn.k_norm.weight")},
+                            },
+                            "cross_attn_attn_gate": get(p + "cross_attn_attn_gate"),
+                            "cross_attn_mlp_gate": get(p + "cross_attn_mlp_gate"),
+                            "mlp": {
+                                "gate_proj": {"weight": lt(p + "mlp.gate_proj.weight")},
+                                "up_proj": {"weight": lt(p + "mlp.up_proj.weight")},
+                                "down_proj": {"weight": lt(p + "mlp.down_proj.weight")},
+                            },
+                        },
+                    )
+                )
+                i += 1
+
+        cfg_view = _AttrView(
+            dict(
+                hidden_size=self.spec.hidden_size,
+                num_attention_heads=self.spec.attn.num_heads,
+                rope_theta=self._tg("rope_theta", 500000.0),
+                rope_scaling=self._tg("rope_scaling"),
+                head_dim=self.head_dim,
+            )
+        )
+        params = {
+            "vision": vision,
+            "projector": {
+                "weight": jnp.asarray(lt("model.multi_modal_projector.weight"), dtype),
+                "bias": jnp.asarray(get("model.multi_modal_projector.bias"), dtype),
+            },
+            "embed_tokens": {
+                "weight": jnp.asarray(get(tpre + "embed_tokens.weight"), dtype)
+            },
+            "rope": {"inv_freq": compute_inv_freq(cfg_view)},
+            "self_runs": self_runs,
+            "cross_layers": cross_params,
+            "norm": {"weight": jnp.asarray(get(tpre + "norm.weight"), dtype)},
+            "lm_head": {"weight": jnp.asarray(lt("lm_head.weight"), dtype)},
+        }
+        return params
+
+    def param_pspecs(self, params) -> Dict:
+        t = TENSOR
+
+        def llama_stack_spec(_):
+            return {
+                "input_layernorm": {"weight": P()},
+                "post_attention_layernorm": {"weight": P()},
+                "self_attn": {
+                    "q_proj": {"weight": P(None, None, t)},
+                    "k_proj": {"weight": P(None, None, t)},
+                    "v_proj": {"weight": P(None, None, t)},
+                    "o_proj": {"weight": P(None, t, None)},
+                },
+                "mlp": {
+                    "gate_proj": {"weight": P(None, None, t)},
+                    "up_proj": {"weight": P(None, None, t)},
+                    "down_proj": {"weight": P(None, t, None)},
+                },
+            }
+
+        specs = jax.tree.map(lambda _: P(), params)
+        specs["self_runs"] = [llama_stack_spec(s) for s in params["self_runs"]]
+        specs["embed_tokens"] = {"weight": P(None, t)}
+        specs["lm_head"] = {"weight": P(None, t)}
+        return specs
+
+    def load(self, model_path=None, state_dict=None, random_weights: bool = False):
+        tc = self.config.tpu_config
+        if state_dict is None and not random_weights:
+            from neuronx_distributed_inference_tpu.utils.hf_checkpoint import (
+                load_state_dict,
+            )
+
+            state_dict = load_state_dict(model_path or self.model_path)
+        if random_weights:
+            raise NotImplementedError(
+                "mllama random-weight init is test-only; pass an HF state dict"
+            )
+        params = self.convert_hf_state_dict(state_dict)
+        self.params = shard_pytree(params, self.param_pspecs(params), self.mesh)
+        dt = to_dtype(tc.kv_cache_dtype or tc.dtype)
+        rows = tc.max_batch_size + GARBAGE_LINES
+        aspec = self.spec.attn
+        vs = self.vision_spec
+        sv = self._tg("max_num_images", 1) * vs.max_num_tiles * vs.num_patches
+        self.num_vision_tokens = vs.num_patches
+        self.max_sv = sv
+        self.cache = MllamaCache(
+            k=jnp.zeros((self.num_self, rows, tc.seq_len, aspec.num_kv_heads, aspec.head_dim), dt),
+            v=jnp.zeros((self.num_self, rows, tc.seq_len, aspec.num_kv_heads, aspec.head_dim), dt),
+            cross_k=jnp.zeros(
+                (len(self.cross_layers), rows, sv, aspec.num_kv_heads, aspec.head_dim), dt
+            ),
+            cross_v=jnp.zeros(
+                (len(self.cross_layers), rows, sv, aspec.num_kv_heads, aspec.head_dim), dt
+            ),
+        )
+        return self
+
+    # ---- generation ------------------------------------------------------
+
+    def generate(
+        self,
+        input_ids: np.ndarray,  # (B, S)
+        attention_mask: Optional[np.ndarray],
+        pixel_values: np.ndarray,  # (B, num_img, tiles, C, Hp, Wp)
+        aspect_ratio_ids: np.ndarray,  # (B, num_img)
+        aspect_ratio_mask: np.ndarray,  # (B, num_img, tiles)
+        cross_attention_mask: np.ndarray,  # (B, S, num_img, tiles)
+        max_new_tokens: int = 16,
+    ) -> GenerationOutput:
+        tc = self.config.tpu_config
+        input_ids = np.asarray(input_ids)
+        B, S = input_ids.shape
+        if attention_mask is None:
+            attention_mask = np.ones_like(input_ids)
+        seq_ids = np.arange(B, dtype=np.int32)
+        sp = prepare_sampling_params(B)
+
+        vision_out = self._vision_fn(
+            self.params["vision"],
+            jnp.asarray(pixel_values),
+            jnp.asarray(aspect_ratio_ids, jnp.int32),
+            jnp.asarray(aspect_ratio_mask, jnp.int32),
+        )  # (B, NI, T, np, vd)
+        proj = self.params["projector"]
+        cross_states = (
+            vision_out @ proj["weight"] + proj["bias"]
+        ).reshape(B, -1, self.spec.hidden_size)
+        sv = cross_states.shape[1]
+        if sv != self.max_sv:
+            # pad the vision-token axis to the cache width
+            cross_states = jnp.pad(
+                cross_states, ((0, 0), (0, self.max_sv - sv), (0, 0))
+            )
+
+        add_mask, full_row = prepare_cross_attention_mask(
+            np.asarray(cross_attention_mask, np.float32), self.num_vision_tokens
+        )
+        if add_mask.shape[-1] != self.max_sv:
+            pad = self.max_sv - add_mask.shape[-1]
+            # padded vision tokens must never be attended
+            add_mask = np.pad(add_mask, ((0, 0), (0, 0), (0, 0), (0, pad)),
+                              constant_values=NEG_INF_F)
+
+        inputs = StepInputs(
+            input_ids=jnp.asarray(input_ids, jnp.int32),
+            attention_mask=jnp.asarray(attention_mask, jnp.int32),
+            position_ids=jnp.tile(jnp.arange(S, dtype=jnp.int32), (B, 1)),
+            seq_ids=jnp.asarray(seq_ids),
+            sampling_params=jnp.asarray(sp),
+        )
+        logits, self.cache = self._cte_fn(
+            self.params, self.cache, inputs, jnp.asarray(add_mask),
+            jnp.asarray(full_row), cross_states,
+        )
+        tokens = [np.asarray(jnp.argmax(logits[:, -1], -1))]
+
+        # decode: every new token reuses the prompt's LAST cross-mask row
+        # (HF prepare_inputs_for_generation extends the mask the same way)
+        last_add = add_mask[:, :, -1:, :]
+        last_row = full_row[:, -1:, :]
+        pos = attention_mask.sum(axis=1).astype(np.int32)
+        bucket = tc.seq_len
+        for step in range(1, max_new_tokens):
+            cols = np.arange(bucket)[None, :]
+            dec_inputs = StepInputs(
+                input_ids=jnp.asarray(tokens[-1][:, None], jnp.int32),
+                attention_mask=jnp.asarray((cols <= pos[:, None]).astype(np.int32)),
+                position_ids=jnp.asarray(pos[:, None], jnp.int32),
+                seq_ids=jnp.asarray(seq_ids),
+                sampling_params=jnp.asarray(sp),
+            )
+            logits, self.cache = self._tkg_fn(
+                self.params, self.cache, dec_inputs, jnp.asarray(last_add),
+                jnp.asarray(last_row),
+            )
+            tokens.append(np.asarray(jnp.argmax(logits[:, -1], -1)))
+            pos = pos + 1
+
+        gen = np.stack(tokens, axis=1).astype(np.int64)
+        sequences = np.concatenate([input_ids, gen], axis=1)
+        return GenerationOutput(sequences=sequences, logits=None, num_generated=gen.shape[1])
+
+
+NEG_INF_F = -1e30

@@ -519,11 +519,16 @@ class ServingSession:
         toks = np.concatenate(
             [np.asarray(c)[:, :take] for c, take in chunks], axis=1
         )  # ONE sync
+        # rows advance in LOCKSTEP, so the highest-position row's headroom
+        # caps this pass at `done` steps; rows needing more loop back through
+        # run_to_completion (the capped row finishes at its bound first and
+        # frees the headroom) — never silently under-generate
         for r in active:
-            n = need[r.slot]
+            n = min(need[r.slot], done)
             r.generated.extend(int(t) for t in toks[r.slot, :n])
             r.pos += n
-            self._finish(r)
+            if len(r.generated) >= r.max_new_tokens:
+                self._finish(r)
 
     def _decode_chunk_pass(self, chunk: int):
         """One multi-step decode dispatch for all decoding requests

@@ -37,7 +37,7 @@ def test_flash_matches_reference_causal_ragged():
     key_valid[1, :77] = 1
     scale = D**-0.5
 
-    out = flash_attention_bhsd(
+    out, _m, _l = flash_attention_bhsd(
         jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(key_valid),
         scale=scale, causal=True, interpret=True,
     )
@@ -61,7 +61,7 @@ def test_flash_head_dim_64():
     key_valid = np.zeros((B, S), np.int32)
     key_valid[0, :256] = 1
     key_valid[1, :130] = 1
-    out = flash_attention_bhsd(
+    out, _m, _l = flash_attention_bhsd(
         jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(key_valid),
         scale=D**-0.5, causal=True, interpret=True,
     )
@@ -93,10 +93,124 @@ def test_flash_bf16():
     k = (rng.randn(B, H, S, D) * 0.3).astype(np.float32)
     v = (rng.randn(B, H, S, D) * 0.3).astype(np.float32)
     valid = np.ones((B, S), np.int32)
-    out = flash_attention_bhsd(
+    out, _m, _l = flash_attention_bhsd(
         jnp.asarray(q, jnp.bfloat16), jnp.asarray(k, jnp.bfloat16),
         jnp.asarray(v, jnp.bfloat16), jnp.asarray(valid),
         scale=D**-0.5, causal=True, interpret=True,
     )
     ref = _ref(q, k, v, valid, D**-0.5)
     np.testing.assert_allclose(np.asarray(out, np.float32), ref, atol=2e-2, rtol=2e-2)
+
+
+def test_flash_window_and_chunk_masks():
+    """Sliding-window / chunked-attention flavors fused into the kernel
+    (VERDICT r2 next #8; reference sliding_window/attention.py:61-233)."""
+    rng = np.random.RandomState(3)
+    B, H, S, D = 1, 2, 256, 64
+    q = rng.randn(B, H, S, D).astype(np.float32) * 0.3
+    k = rng.randn(B, H, S, D).astype(np.float32) * 0.3
+    v = rng.randn(B, H, S, D).astype(np.float32) * 0.3
+    key_valid = np.ones((B, S), np.int32)
+    key_valid[0, 200:] = 0
+    scale = D**-0.5
+    rows = np.arange(S)[:, None]
+    cols = np.arange(S)[None, :]
+
+    for kw, extra in [
+        ({"window": 64}, cols > rows - 64),
+        ({"chunk": 64}, (cols // 64) == (rows // 64)),
+    ]:
+        out, _m, _l = flash_attention_bhsd(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(key_valid),
+            scale=scale, causal=True, interpret=True, **kw,
+        )
+        spec = AttnSpec(num_heads=H, num_kv_heads=H, head_dim=D, scale=scale)
+        mask = (np.tril(np.ones((S, S), bool)) & extra)[None, None] & (
+            key_valid[:, None, None, :] > 0
+        )
+        ref = _masked_softmax_attention(
+            jnp.asarray(np.swapaxes(q, 1, 2)), jnp.asarray(np.swapaxes(k, 1, 2)),
+            jnp.asarray(np.swapaxes(v, 1, 2)), jnp.asarray(mask), spec,
+        )
+        ref = np.swapaxes(np.asarray(ref), 1, 2)
+        np.testing.assert_allclose(
+            np.asarray(out)[0, :, :200], ref[0, :, :200], atol=2e-5, rtol=2e-5
+        )
+
+
+def test_flash_sink_folding():
+    """Learned sinks folded via the kernel's (m, l) stats match the native
+    sink-in-denominator softmax (reference attention_base.py:879-889)."""
+    from neuronx_distributed_inference_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(4)
+    B, S, H, D = 1, 128, 2, 64
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.3)
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.3)
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.3)
+    sink = jnp.asarray(rng.randn(H).astype(np.float32))
+    key_valid = np.ones((B, S), np.int32)
+    spec = AttnSpec(num_heads=H, num_kv_heads=H, head_dim=D, has_sink=True)
+
+    out = flash_attention(q, k, v, jnp.asarray(key_valid), spec, sink=sink)
+    mask = np.tril(np.ones((S, S), bool))[None, None]
+    ref = _masked_softmax_attention(q, k, v, jnp.asarray(mask), spec, sink=sink)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_windowed_prefill_takes_kernel_path():
+    """Mistral-style windowed CTE and GPT-OSS interleaved CTE route through
+    the flash kernel (asserted via tap on the kernel entry), with tokens
+    unchanged vs the native path."""
+    from unittest import mock
+
+    import pytest as _pytest
+
+    torch = _pytest.importorskip("torch")
+    transformers = _pytest.importorskip("transformers")
+    from neuronx_distributed_inference_tpu.ops import flash_attention as fa_mod
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.llama import LlamaInferenceConfig
+    from neuronx_distributed_inference_tpu.runtime.application import (
+        TpuModelForCausalLM,
+    )
+
+    attrs = dict(
+        model_type="mistral", hidden_size=256, intermediate_size=256,
+        num_attention_heads=4, num_key_value_heads=2, num_hidden_layers=2,
+        vocab_size=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        sliding_window=128, hidden_act="silu", tie_word_embeddings=False,
+    )
+
+    def load_cfg(c):
+        for kk, vv in attrs.items():
+            setattr(c, kk, vv)
+
+    calls = []
+    orig = fa_mod.flash_attention
+
+    def spy(*a, **kw):
+        calls.append(kw.get("window"))
+        return orig(*a, **kw)
+
+    ids = np.tile(np.arange(1, 65, dtype=np.int64), (1, 2))  # 128-token prompt
+    # window 128 so the ring-chunked CTE still meets the kernel's S>=128 gate
+    with mock.patch.dict(fa_mod.__dict__, {"flash_attention": spy}):
+        # force the kernel on CPU (interpret mode); auto mode is TPU-only
+        tc = TpuConfig(
+            batch_size=1, seq_len=256, dtype="float32", attn_kernel_enabled=True
+        )
+        cfg = LlamaInferenceConfig(tc, load_config=load_cfg)
+        app = TpuModelForCausalLM(None, cfg)
+        app.load(random_weights=True)
+        out = app.generate(ids, np.ones_like(ids), max_new_tokens=4)
+    assert 128 in calls, f"windowed CTE did not take the kernel path: {calls}"
+
+    # tokens must match the native masked-softmax path
+    tc_native = TpuConfig(
+        batch_size=1, seq_len=256, dtype="float32", attn_kernel_enabled=False
+    )
+    ref_app = TpuModelForCausalLM(None, LlamaInferenceConfig(tc_native, load_config=load_cfg))
+    ref_app.load(random_weights=True)
+    ref = ref_app.generate(ids, np.ones_like(ids), max_new_tokens=4)
+    np.testing.assert_array_equal(out.sequences, ref.sequences)

@@ -1,0 +1,152 @@
+"""Mllama (Llama-3.2-Vision) HF parity (VERDICT r2 missing #3): tiled vision
+tower + cross-attention text decoder with a separate vision-KV cache. Oracle
+is transformers' MllamaForConditionalGeneration with random weights."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+from neuronx_distributed_inference_tpu.config import TpuConfig
+
+
+def _tiny_hf():
+    from transformers import MllamaConfig
+    from transformers.models.mllama.configuration_mllama import (
+        MllamaTextConfig,
+        MllamaVisionConfig,
+    )
+
+    vision = MllamaVisionConfig(
+        hidden_size=32,
+        attention_heads=4,
+        intermediate_size=64,
+        num_hidden_layers=3,
+        num_global_layers=2,
+        image_size=16,
+        patch_size=8,
+        max_num_tiles=2,
+        intermediate_layers_indices=[0, 2],
+        supported_aspect_ratios=[[1, 1], [1, 2], [2, 1]],
+        vision_output_dim=96,  # 32 * (1 + 2 taps)
+    )
+    text = MllamaTextConfig(
+        hidden_size=48,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        intermediate_size=96,
+        num_hidden_layers=5,
+        cross_attention_layers=[1, 3],
+        vocab_size=256,
+        rope_theta=10000.0,
+        rope_scaling={"rope_type": "default"},
+        rms_norm_eps=1e-5,
+        max_position_embeddings=256,
+        tie_word_embeddings=False,
+        bos_token_id=None,
+        eos_token_id=None,
+        pad_token_id=0,
+    )
+    cfg = MllamaConfig(vision_config=vision, text_config=text, image_token_index=255)
+    torch.manual_seed(0)
+    from transformers import MllamaForConditionalGeneration
+
+    return MllamaForConditionalGeneration(cfg).eval().float()
+
+
+def _inputs(S=10, B=1):
+    rng = np.random.RandomState(0)
+    ids = rng.randint(1, 250, size=(B, S))
+    ids[:, 0] = 255  # image token
+    mask = np.ones((B, S), np.int64)
+    pixels = rng.randn(B, 1, 2, 3, 16, 16).astype(np.float32) * 0.3
+    ar_ids = np.array([[2]] * B)  # aspect ratio [1, 2] -> 2 tiles
+    ar_mask = np.ones((B, 1, 2), np.int64)
+    # every token attends both tiles of image 0 (post-image-token layout)
+    xmask = np.ones((B, S, 1, 2), np.int64)
+    return ids, mask, pixels, ar_ids, ar_mask, xmask
+
+
+def test_mllama_hf_parity():
+    hf = _tiny_hf()
+    ids, mask, pixels, ar_ids, ar_mask, xmask = _inputs()
+    n = 8
+    with torch.no_grad():
+        out = hf.generate(
+            input_ids=torch.tensor(ids),
+            attention_mask=torch.tensor(mask),
+            pixel_values=torch.tensor(pixels),
+            aspect_ratio_ids=torch.tensor(ar_ids),
+            aspect_ratio_mask=torch.tensor(ar_mask),
+            cross_attention_mask=torch.tensor(xmask),
+            max_new_tokens=n,
+            do_sample=False,
+        )
+    ref = out.numpy()
+
+    from neuronx_distributed_inference_tpu.runtime.mllama import (
+        MllamaForConditionalGeneration as TpuMllama,
+    )
+    from neuronx_distributed_inference_tpu.models.mllama import MllamaInferenceConfig
+
+    hf_cfg = hf.config
+
+    def load_config(c):
+        c.model_type = "mllama"
+        c.text_config = hf_cfg.text_config.to_dict()
+        c.vision_config = hf_cfg.vision_config.to_dict()
+        c.image_token_index = hf_cfg.image_token_index
+
+    tc = TpuConfig(batch_size=1, seq_len=64, dtype="float32")
+    cfg = MllamaInferenceConfig(tc, load_config=load_config)
+    app = TpuMllama(None, cfg)
+    sd = {k: v.float().numpy() for k, v in hf.state_dict().items()}
+    app.load(state_dict=sd)
+    got = app.generate(
+        ids, mask, pixels, ar_ids, ar_mask, xmask, max_new_tokens=n
+    )
+    np.testing.assert_array_equal(got.sequences, ref)
+
+
+def test_mllama_mixed_image_rows():
+    """Batch with one image row and one row whose tokens attend nothing
+    (full-text-row mask path): parity must hold for both rows."""
+    hf = _tiny_hf()
+    ids, mask, pixels, ar_ids, ar_mask, xmask = _inputs(S=8, B=2)
+    # row 1: no token attends any tile -> full_text_row mask all-zero
+    xmask[1] = 0
+    n = 6
+    with torch.no_grad():
+        out = hf.generate(
+            input_ids=torch.tensor(ids),
+            attention_mask=torch.tensor(mask),
+            pixel_values=torch.tensor(pixels),
+            aspect_ratio_ids=torch.tensor(ar_ids),
+            aspect_ratio_mask=torch.tensor(ar_mask),
+            cross_attention_mask=torch.tensor(xmask),
+            max_new_tokens=n,
+            do_sample=False,
+        )
+    ref = out.numpy()
+
+    from neuronx_distributed_inference_tpu.runtime.mllama import (
+        MllamaForConditionalGeneration as TpuMllama,
+    )
+    from neuronx_distributed_inference_tpu.models.mllama import MllamaInferenceConfig
+
+    hf_cfg = hf.config
+
+    def load_config(c):
+        c.model_type = "mllama"
+        c.text_config = hf_cfg.text_config.to_dict()
+        c.vision_config = hf_cfg.vision_config.to_dict()
+        c.image_token_index = hf_cfg.image_token_index
+
+    tc = TpuConfig(batch_size=2, seq_len=64, dtype="float32")
+    cfg = MllamaInferenceConfig(tc, load_config=load_config)
+    app = TpuMllama(None, cfg)
+    sd = {k: v.float().numpy() for k, v in hf.state_dict().items()}
+    app.load(state_dict=sd)
+    got = app.generate(ids, mask, pixels, ar_ids, ar_mask, xmask, max_new_tokens=n)
+    np.testing.assert_array_equal(got.sequences, ref)

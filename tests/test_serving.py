@@ -96,3 +96,26 @@ def test_async_one_ahead_matches_sync():
         assert sess.add_request("r2", [64, 3, 27, 9, 14, 33], max_new_tokens=6)
         outs[async_mode] = sess.run_to_completion()
     assert outs[True] == outs[False]
+
+
+def test_drain_mixed_positions_no_eos():
+    """Mixed-position no-EOS drain: a row near the position bound must not
+    cap other rows' token counts (the lockstep chunk headroom caps one PASS;
+    the loop continues after the bounded row finishes)."""
+    cfg = make_tiny_config(
+        tpu=dict(is_continuous_batching=True, batch_size=4, ctx_batch_size=1,
+                 seq_len=64)
+    )
+    sd = make_random_hf_state_dict(cfg)
+    a = TpuModelForCausalLM(None, cfg)
+    a.load(state_dict=sd)
+    p_long = list(range(1, 51))  # near the 64-position bound
+    p_short = [5, 17, 92, 41]
+    g_short = _plain_golden(a, p_short, 40)
+    sess = ServingSession(a)
+    assert sess.add_request("r1", p_long, max_new_tokens=5)
+    assert sess.add_request("r2", p_short, max_new_tokens=40)
+    out = sess.run_to_completion()
+    assert len(out["r2"]) == 40, len(out["r2"])
+    assert out["r2"] == g_short
+    assert len(out["r1"]) == 5
