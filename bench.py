@@ -1,20 +1,78 @@
 #!/usr/bin/env python
 """Benchmark driver entry: prints ONE JSON line with the headline metric.
 
-Measures steady-state decode throughput (tokens/sec) for a Llama-3.2-1B-shaped
-model (full size, random weights, bf16) on the available chip, mirroring the
-reference's benchmark_sampling metric definitions
-(reference: utils/benchmark.py:479-499 — throughput = runs·tokens·batch/total).
+Measures steady-state decode throughput (tokens/sec) on the available chip for
+full-size random-weight models, mirroring the reference's benchmark_sampling
+metric definitions (reference: utils/benchmark.py:479-499 —
+throughput = runs·tokens·batch/total).
+
+Points (VERDICT r3 next-steps #1/#3):
+- llama-3.2-1B bf16: bs=1 decode (headline), TTFT, 512-token prefill, bs=4 decode
+- llama-3.2-1B int8: bs=1 decode + TTFT (HBM-bound decode ⇒ int8 halves traffic)
+- llama-3.1-8B int8: bs=1 decode + TTFT (the closest single-chip proxy for the
+  BASELINE.json 8B north star; int8 8B fits one 16G v5e chip)
 
 vs_baseline anchors against the reference's Llama3.2-1B-class integration
 throughput gate (~1057 tok/s on 32 trainium cores,
 test_llama3_2_1b_4layer_context_parallel.py:36-44). We run on ONE v5e chip,
 so >1.0 means one TPU chip beats the 32-core trn gate.
+
+The whole measurement path (build → load → warmup → measure) is importable and
+size-parameterized so the test suite smoke-runs the EXACT code path on CPU
+(tests/test_bench_smoke.py) — two of three rounds shipped a bench-only crash
+the suite missed (VERDICT r3 weak #2).
 """
 
 import json
 import sys
 import time
+
+LLAMA_1B = dict(
+    model_type="llama",
+    hidden_size=2048,
+    intermediate_size=8192,
+    num_attention_heads=32,
+    num_key_value_heads=8,
+    num_hidden_layers=16,
+    vocab_size=128256,
+    rms_norm_eps=1e-5,
+    rope_theta=500000.0,
+    max_position_embeddings=2048,
+    hidden_act="silu",
+    tie_word_embeddings=True,
+    head_dim=64,
+)
+
+LLAMA_8B = dict(
+    model_type="llama",
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_attention_heads=32,
+    num_key_value_heads=8,
+    num_hidden_layers=32,
+    vocab_size=128256,
+    rms_norm_eps=1e-5,
+    rope_theta=500000.0,
+    max_position_embeddings=2048,
+    hidden_act="silu",
+    tie_word_embeddings=False,
+    head_dim=128,
+)
+
+TINY = dict(  # smoke-test model (CPU suite)
+    model_type="llama",
+    hidden_size=64,
+    intermediate_size=128,
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    num_hidden_layers=2,
+    vocab_size=128,
+    rms_norm_eps=1e-5,
+    rope_theta=10000.0,
+    max_position_embeddings=256,
+    hidden_act="silu",
+    tie_word_embeddings=False,
+)
 
 
 def _wait_for_backend(max_wait_s=300):
@@ -40,106 +98,187 @@ def _wait_for_backend(max_wait_s=300):
                 pass
 
 
-def main():
-    devs = _wait_for_backend()
-    import numpy as np
-
+def build_app(
+    hf_attrs,
+    *,
+    batch,
+    seq_len,
+    ce_buckets,
+    tkg_buckets,
+    dtype="bfloat16",
+    quantized=False,
+):
+    """Build + load a random-weight app — the exact production code path."""
     from neuronx_distributed_inference_tpu.config import TpuConfig
     from neuronx_distributed_inference_tpu.models.llama import LlamaInferenceConfig
-    from neuronx_distributed_inference_tpu.runtime.application import TpuModelForCausalLM
-
-    hf_attrs = dict(
-        model_type="llama",
-        hidden_size=2048,
-        intermediate_size=8192,
-        num_attention_heads=32,
-        num_key_value_heads=8,
-        num_hidden_layers=16,
-        vocab_size=128256,
-        rms_norm_eps=1e-5,
-        rope_theta=500000.0,
-        max_position_embeddings=2048,
-        hidden_act="silu",
-        tie_word_embeddings=True,
-        head_dim=64,
+    from neuronx_distributed_inference_tpu.runtime.application import (
+        TpuModelForCausalLM,
     )
 
     def load_cfg(c):
         for k, v in hf_attrs.items():
             setattr(c, k, v)
 
-    batch, seq_len, prompt_len, gen_len = 1, 1024, 128, 256
-    long_prompt = 512  # prefill-throughput point (amortizes the relay sync)
     tc = TpuConfig(
         batch_size=batch,
         seq_len=seq_len,
-        dtype="bfloat16",
+        dtype=dtype,
         enable_bucketing=True,
-        context_encoding_buckets=[prompt_len, long_prompt],
-        token_generation_buckets=[512, 1024],
+        context_encoding_buckets=list(ce_buckets),
+        token_generation_buckets=list(tkg_buckets),
+        quantized=quantized,
     )
-    cfg = LlamaInferenceConfig(tc, load_config=load_cfg)
-    app = TpuModelForCausalLM(None, cfg)
+    app = TpuModelForCausalLM(None, LlamaInferenceConfig(tc, load_config=load_cfg))
     app.load(random_weights=True)
+    return app
+
+
+def measure_point(app, *, batch, prompt_len, gen_len, long_prompt=None):
+    """Warmup-compile then measure TTFT / decode throughput (+ optional
+    long-prompt prefill throughput). Returns a dict of metrics."""
+    import numpy as np
 
     rng = np.random.RandomState(0)
-    ids = rng.randint(0, 120000, size=(batch, prompt_len))
+    vocab = app.config.vocab_size - 10
+    ids = rng.randint(0, vocab, size=(batch, prompt_len))
     mask = np.ones_like(ids)
-    ids_long = rng.randint(0, 120000, size=(batch, long_prompt))
-    mask_long = np.ones_like(ids_long)
 
     # warmup / compile — run the SAME programs the measured runs use
     # (gen_len-sized decode chunk and the 1-token TTFT path)
     t0 = time.time()
     app.generate(ids, mask, max_new_tokens=gen_len)
     app.generate(ids, mask, max_new_tokens=1)
-    app.generate(ids_long, mask_long, max_new_tokens=1)
-    print(f"compile+warmup: {time.time()-t0:.1f}s", file=sys.stderr)
+    compile_s = time.time() - t0
 
-    # TTFT: context encoding only
     t0 = time.time()
     app.generate(ids, mask, max_new_tokens=1)
     ttft_ms = (time.time() - t0) * 1e3
 
-    # prefill throughput: 512-token CTE (sync cost amortized over the prompt)
-    t0 = time.time()
-    app.generate(ids_long, mask_long, max_new_tokens=1)
-    prefill_tok_s = long_prompt / (time.time() - t0)
-
-    # decode throughput (headline)
     t0 = time.time()
     out = app.generate(ids, mask, max_new_tokens=gen_len)
-    total = time.time() - t0
-    throughput = out.num_generated * batch / total
+    decode_tok_s = out.num_generated * batch / (time.time() - t0)
 
-    # batched decode point (continuous-batching shape; VERDICT r2 weak #3)
-    bs4 = 4
-    tc4 = TpuConfig(
-        batch_size=bs4, seq_len=seq_len, dtype="bfloat16",
-        enable_bucketing=True, context_encoding_buckets=[prompt_len],
-        token_generation_buckets=[512],
+    res = {
+        "ttft_ms": round(ttft_ms, 1),
+        "decode_tok_s": round(decode_tok_s, 2),
+        "compile_s": round(compile_s, 1),
+    }
+    if long_prompt:
+        ids_l = rng.randint(0, vocab, size=(batch, long_prompt))
+        mask_l = np.ones_like(ids_l)
+        app.generate(ids_l, mask_l, max_new_tokens=1)  # compile
+        t0 = time.time()
+        app.generate(ids_l, mask_l, max_new_tokens=1)
+        res["prefill_tok_s"] = round(long_prompt / (time.time() - t0), 1)
+    return res
+
+
+def _suite_params(tiny):
+    if tiny:
+        attrs_1b = attrs_8b = TINY
+        prompt, gen, long_prompt = 16, 8, 32
+        seq, ce, tkg = 64, [16, 32], [32, 64]
+        ce4, tkg4 = [16], [32]
+    else:
+        attrs_1b, attrs_8b = LLAMA_1B, LLAMA_8B
+        prompt, gen, long_prompt = 128, 256, 512
+        seq, ce, tkg = 1024, [128, 512], [512, 1024]
+        ce4, tkg4 = [128], [512]
+    return {
+        "bf16_1b_bs1": dict(
+            attrs=attrs_1b, batch=1, seq=seq, ce=ce, tkg=tkg,
+            prompt=prompt, gen=gen, long_prompt=long_prompt, quantized=False,
+        ),
+        "bf16_1b_bs4": dict(
+            attrs=attrs_1b, batch=4, seq=seq, ce=ce4, tkg=tkg4,
+            prompt=prompt, gen=gen, long_prompt=None, quantized=False,
+        ),
+        "int8_1b_bs1": dict(
+            attrs=attrs_1b, batch=1, seq=seq, ce=ce[:1], tkg=tkg[:1],
+            prompt=prompt, gen=gen, long_prompt=None, quantized=True,
+        ),
+        # single-chip proxy for the BASELINE 8B north star: int8 8B fits 16G
+        "int8_8b_bs1": dict(
+            attrs=attrs_8b, batch=1, seq=seq, ce=ce[:1], tkg=tkg[:1],
+            prompt=prompt, gen=gen, long_prompt=None, quantized=True,
+        ),
+    }
+
+
+def run_point(name, tiny=False):
+    """Build + measure one benchmark point in THIS process."""
+    import jax
+
+    p = _suite_params(tiny)[name]
+    app = build_app(
+        p["attrs"], batch=p["batch"], seq_len=p["seq"], ce_buckets=p["ce"],
+        tkg_buckets=p["tkg"], quantized=p["quantized"],
     )
-    app4 = TpuModelForCausalLM(None, LlamaInferenceConfig(tc4, load_config=load_cfg))
-    app4.load(random_weights=True)
-    ids4 = rng.randint(0, 120000, size=(bs4, prompt_len))
-    mask4 = np.ones_like(ids4)
-    app4.generate(ids4, mask4, max_new_tokens=gen_len)  # compile+warm
-    t0 = time.time()
-    out4 = app4.generate(ids4, mask4, max_new_tokens=gen_len)
-    decode_bs4 = out4.num_generated * bs4 / (time.time() - t0)
+    res = measure_point(
+        app, batch=p["batch"], prompt_len=p["prompt"], gen_len=p["gen"],
+        long_prompt=p["long_prompt"],
+    )
+    res["device"] = str(jax.devices()[0])
+    return res
 
+
+def run_suite(tiny=False):
+    """The full benchmark point set. ``tiny=True`` runs in-process (the CPU
+    test suite exercises the identical code path in seconds); otherwise each
+    point runs in its own subprocess — the TPU lease is per-process and HBM is
+    fully reclaimed between points (an int8 8B point cannot share a 16G chip
+    with an earlier resident 1B model)."""
+    points = {}
+    if tiny:
+        for name in _suite_params(True):
+            points[name] = run_point(name, tiny=True)
+        return points
+    import subprocess
+
+    for name in _suite_params(False):
+        proc = subprocess.run(
+            [sys.executable, __file__, "--point", name],
+            capture_output=True, text=True, timeout=3600,
+        )
+        if proc.returncode != 0:
+            print(proc.stderr[-4000:], file=sys.stderr)
+            raise RuntimeError(f"bench point {name} failed (rc={proc.returncode})")
+        points[name] = json.loads(proc.stdout.strip().splitlines()[-1])
+        print(f"{name}: {points[name]}", file=sys.stderr)
+    return points
+
+
+def main():
+    if len(sys.argv) >= 3 and sys.argv[1] == "--point":
+        _wait_for_backend()
+        print(json.dumps(run_point(sys.argv[2], tiny=False)))
+        return
+    # suite mode: do NOT touch the TPU here — the lease is per-process and
+    # each point's subprocess needs it
+    points = run_suite(tiny=False)
+
+    headline = points["bf16_1b_bs1"]["decode_tok_s"]
     baseline = 1057.0  # reference 1B-class 32-core gate (BASELINE.md)
     print(
         json.dumps(
             {
                 "metric": "llama3.2-1b-bf16 decode throughput (bs=1, 1 chip)",
-                "value": round(throughput, 2),
+                "value": headline,
                 "unit": "tokens/sec",
-                "vs_baseline": round(throughput / baseline, 4),
-                "ttft_ms": round(ttft_ms, 1),
-                "prefill_tok_s": round(prefill_tok_s, 1),
-                "decode_bs4_tok_s": round(decode_bs4, 2),
-                "device": str(devs[0]),
+                "vs_baseline": round(headline / baseline, 4),
+                "ttft_ms": points["bf16_1b_bs1"]["ttft_ms"],
+                "prefill_tok_s": points["bf16_1b_bs1"].get("prefill_tok_s"),
+                "decode_bs4_tok_s": points["bf16_1b_bs4"]["decode_tok_s"],
+                "int8_1b_tok_s": points["int8_1b_bs1"]["decode_tok_s"],
+                "int8_1b_ttft_ms": points["int8_1b_bs1"]["ttft_ms"],
+                "int8_8b_tok_s": points["int8_8b_bs1"]["decode_tok_s"],
+                "int8_8b_ttft_ms": points["int8_8b_bs1"]["ttft_ms"],
+                # 1332 = reference 8B bf16 trn1-32-core throughput gate
+                # (1665 * 0.8, BASELINE.md test_llama3_1_8b_4layer_dtype.py row)
+                "int8_8b_vs_8b_gate": round(
+                    points["int8_8b_bs1"]["decode_tok_s"] / 1332.0, 4
+                ),
+                "device": points["bf16_1b_bs1"].get("device"),
             }
         )
     )

@@ -38,6 +38,7 @@ from neuronx_distributed_inference_tpu.modules.attention import (
     o_project,
     qkv_project,
 )
+from neuronx_distributed_inference_tpu.ops.kernel_mode import kernel_interpret
 from neuronx_distributed_inference_tpu.modules.kvcache import (
     KVCache,
     kv_batch_size,
@@ -248,7 +249,7 @@ def contiguous_decode_attend(
             q, k_cache, v_cache, layer_idx, mask, sink,
             scale=aspec.softmax_scale,
             n_kv=aspec.num_kv_heads,
-            interpret=jax.default_backend() != "tpu",
+            interpret=kernel_interpret(),
         )
     if spec.attention_dp > 1 or spec.data_parallel > 1:
         # batch-parallel decode attention over (ddp, dp): GSPMD all-to-alls
@@ -428,7 +429,7 @@ def decoder_layer(
                 q, k_l, v_l, block_table, positions, kv_limit,
                 scale=aspec.softmax_scale,
                 n_rep=aspec.num_heads // aspec.num_kv_heads,
-                interpret=jax.default_backend() != "tpu",
+                interpret=kernel_interpret(),
             )
         else:
             from neuronx_distributed_inference_tpu.ops.decode_attention import (
@@ -436,7 +437,7 @@ def decoder_layer(
                 use_tkg_kernel,
             )
 
-            bs = k_cache.shape[2]
+            bs = k_cache.shape[3]  # (L, NB+1, Hkv, bs, D) head-major
             width_ok = mask.shape[-1] == block_table.shape[1] * bs
             if (
                 width_ok
@@ -450,7 +451,7 @@ def decoder_layer(
                     q, k_cache, v_cache, layer_idx, block_table, mask, sink,
                     scale=aspec.softmax_scale,
                     n_kv=aspec.num_kv_heads,
-                    interpret=jax.default_backend() != "tpu",
+                    interpret=kernel_interpret(),
                 )
             else:
                 k_r, v_r = read_block_cache_at_layer(

@@ -91,6 +91,7 @@ class DecoderModelBuilder:
             use_flash_kernel=tc.attn_kernel_enabled,
             use_tkg_kernel=tc.attn_block_tkg_kernel_enabled,
             qkv_shards=self.degree if tc.fused_qkv else 1,
+            model_parallel=self.degree,
         )
 
     def model_spec(self) -> ModelSpec:
@@ -241,17 +242,47 @@ class DecoderModelBuilder:
 
     # ---- weights ---------------------------------------------------------
 
-    def random_params(self, key: Optional[jax.Array] = None, dtype=None) -> Dict:
-        """Random init for tests (reference utils/testing.py:292)."""
+    def random_tree(self, shapes, key=None, dtype=None, on_host=False, std=0.02):
+        """Generate a random pytree matching a shapes tree — the one leaf
+        generator every builder's random_params goes through, so ``on_host``
+        (host-RAM generation for quantize-at-load near the HBM limit) works
+        for every model family."""
         dtype = dtype or to_dtype(self.config.tpu_config.dtype)
-        key = key if key is not None else jax.random.PRNGKey(self.config.tpu_config.seed)
-        shapes = self.param_shapes()
         leaves, treedef = jax.tree.flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
-        keys = jax.random.split(key, len(leaves))
-        vals = [
-            (0.02 * jax.random.normal(k, s)).astype(dtype) for k, s in zip(keys, leaves)
-        ]
-        params = jax.tree.unflatten(treedef, vals)
+        if on_host:
+            import ml_dtypes
+            import numpy as np
+
+            np_dtype = np.dtype(
+                {jnp.bfloat16: ml_dtypes.bfloat16, jnp.float16: np.float16}.get(
+                    dtype, np.float32
+                )
+            )
+            rng = np.random.RandomState(self.config.tpu_config.seed)
+            vals = [
+                (std * rng.standard_normal(s).astype(np.float32)).astype(np_dtype)
+                for s in leaves
+            ]
+        else:
+            key = key if key is not None else jax.random.PRNGKey(self.config.tpu_config.seed)
+            keys = jax.random.split(key, len(leaves))
+            vals = [
+                (std * jax.random.normal(k, s)).astype(dtype) for k, s in zip(keys, leaves)
+            ]
+        return jax.tree.unflatten(treedef, vals)
+
+    def random_params(
+        self, key: Optional[jax.Array] = None, dtype=None, on_host: bool = False
+    ) -> Dict:
+        """Random init for tests (reference utils/testing.py:292).
+
+        ``on_host=True`` generates numpy leaves (host RAM) — required for
+        models near the HBM limit that quantize at load (int8 8B on a 16G
+        chip): generation and quantization stay on host, only the int8 result
+        is device-put by ``shard_pytree``.
+        """
+        dtype = dtype or to_dtype(self.config.tpu_config.dtype)
+        params = self.random_tree(self.param_shapes(), key, dtype, on_host, std=0.02)
         params["rope"]["inv_freq"] = compute_inv_freq(self.config)
         # norms init to 1
         params["layers"]["input_layernorm"]["weight"] = jnp.ones_like(

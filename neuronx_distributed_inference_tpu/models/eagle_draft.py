@@ -46,8 +46,8 @@ class EagleLlamaDraftBuilder(DecoderModelBuilder):
             specs["input_norm"] = {"weight": P()}
         return specs
 
-    def random_params(self, key=None, dtype=None) -> Dict:
-        params = super().random_params(key=key, dtype=dtype)
+    def random_params(self, key=None, dtype=None, on_host: bool = False) -> Dict:
+        params = super().random_params(key=key, dtype=dtype, on_host=on_host)
         if self._input_norm:
             params["input_norm"]["weight"] = jnp.ones_like(params["input_norm"]["weight"])
         return params
@@ -142,8 +142,8 @@ class Eagle3LlamaDraftBuilder(DecoderModelBuilder):
             specs["d2t"] = {"table": P()}
         return specs
 
-    def random_params(self, key=None, dtype=None) -> Dict:
-        params = super().random_params(key=key, dtype=dtype)
+    def random_params(self, key=None, dtype=None, on_host: bool = False) -> Dict:
+        params = super().random_params(key=key, dtype=dtype, on_host=on_host)
         if self.draft_vocab:
             # identity-offset table keeps random-weight tests in-vocab
             params["d2t"] = {"table": jnp.zeros(self.draft_vocab, jnp.int32)}

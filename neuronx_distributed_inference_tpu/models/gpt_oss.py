@@ -240,14 +240,9 @@ class GptOssModelBuilder(DecoderModelBuilder):
             "lm_head": {"weight": P(None, TENSOR)},
         }
 
-    def random_params(self, key=None, dtype=None) -> Dict:
+    def random_params(self, key=None, dtype=None, on_host: bool = False) -> Dict:
         dtype = dtype or to_dtype(self.config.tpu_config.dtype)
-        key = key if key is not None else jax.random.PRNGKey(self.config.tpu_config.seed)
-        shapes = self.param_shapes()
-        leaves, treedef = jax.tree.flatten(shapes, is_leaf=lambda x: isinstance(x, tuple))
-        keys = jax.random.split(key, len(leaves))
-        vals = [(0.05 * jax.random.normal(k, s)).astype(dtype) for k, s in zip(keys, leaves)]
-        params = jax.tree.unflatten(treedef, vals)
+        params = self.random_tree(self.param_shapes(), key, dtype, on_host, std=0.05)
         from neuronx_distributed_inference_tpu.modules.rope import compute_inv_freq
 
         params["rope"]["inv_freq"] = compute_inv_freq(self.config)

@@ -54,6 +54,11 @@ class AttnSpec:
     # model-parallel degree of the rank-interleaved fused-qkv layout
     # (builder._fuse_qkv); 1 when fused_qkv is off
     qkv_shards: int = 1
+    # full model-parallel degree (tp*ep). pallas_call carries no GSPMD
+    # partitioning rule, so with sharded operands XLA replicates them
+    # (all-gathering the head-sharded cache per layer per step) — the kernel
+    # AUTO paths therefore require degree 1; force-enable opts in regardless.
+    model_parallel: int = 1
     # clamp qkv projection outputs to [-clip, clip] (DBRX clip_qkv)
     qkv_clip: Optional[float] = None
 
@@ -193,7 +198,7 @@ def _use_flash(spec: AttnSpec, seq_len: int) -> bool:
                 spec.head_dim,
             )
         return ok
-    return ok and jax.default_backend() == "tpu"
+    return ok and spec.model_parallel == 1 and jax.default_backend() == "tpu"
 
 
 def attention_prefill(

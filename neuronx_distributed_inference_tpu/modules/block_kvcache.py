@@ -6,6 +6,12 @@ TPU-native re-design of the reference paged KV stack
 gather-by-block-table reads, scatter-by-slot-mapping writes; vLLM
 ``get_active_block_table`` in modules/kvcache/utils.py).
 
+Layout here is HEAD-MAJOR ``(L, num_blocks+1, H_kv, block_size, d)`` — unlike
+the reference's token-major blocks — so a Pallas kernel can DMA one head's
+block as a ``(block_size, d)`` tile whose last-two block dims equal the array
+dims (Mosaic's (8, 128) divisibility rule would reject a ``(1, d)`` slice over
+a token-major ``(block_size, H_kv, d)`` block for H_kv > 1).
+
 Device side (pure functions used inside the jitted step):
 - writes scatter token K/V through a flat ``slot_mapping`` (block *
   block_size + offset); invalid slots (< 0) land in the reserved garbage
@@ -35,7 +41,7 @@ GARBAGE_BLOCK = 0  # block id 0 reserved for invalid-slot writes
 @jax.tree_util.register_dataclass
 @dataclass
 class BlockKVCache:
-    """k/v: (L, num_blocks+1, block_size, H_kv, D)."""
+    """k/v: (L, num_blocks+1, H_kv, block_size, D) — head-major blocks."""
 
     k: jax.Array
     v: jax.Array
@@ -50,7 +56,7 @@ class BlockKVCache:
 
     @property
     def block_size(self):
-        return self.k.shape[2]
+        return self.k.shape[3]
 
 
 def init_block_cache(
@@ -61,7 +67,7 @@ def init_block_cache(
     head_dim: int,
     dtype=jnp.bfloat16,
 ) -> BlockKVCache:
-    shape = (num_layers, num_blocks + 1, block_size, num_kv_heads, head_dim)
+    shape = (num_layers, num_blocks + 1, num_kv_heads, block_size, head_dim)
     return BlockKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
@@ -71,12 +77,12 @@ def block_cache_spec():
     from neuronx_distributed_inference_tpu.parallel.mesh import MODEL_AXES
 
     return BlockKVCache(
-        k=P(None, None, None, MODEL_AXES, None), v=P(None, None, None, MODEL_AXES, None)
+        k=P(None, None, MODEL_AXES, None, None), v=P(None, None, MODEL_AXES, None, None)
     )
 
 
 def update_block_cache_at_layer(
-    k_cache: jax.Array,  # (L, NB+1, bs, H, D)
+    k_cache: jax.Array,  # (L, NB+1, H, bs, D)
     v_cache: jax.Array,
     k_new: jax.Array,  # (B, S, H, D)
     v_new: jax.Array,
@@ -86,19 +92,22 @@ def update_block_cache_at_layer(
     """Scatter token K/V into the paged cache at one layer (reference
     scatter-by-slot, block_kv_cache_manager.py). The full stacked cache is
     carried through the layer scan and updated in place (see
-    kvcache.update_cache_at_layer for why)."""
-    L, NB1, bs, H, D = k_cache.shape
-    flat_k = k_cache.reshape(L, NB1 * bs, H, D)
-    flat_v = v_cache.reshape(L, NB1 * bs, H, D)
+    kvcache.update_cache_at_layer for why). Negative slots are DROPPED by
+    mapping them PAST the last block (scatter mode="drop" discards
+    out-of-range indices; -1 would WRAP to the last real block and corrupt
+    it) — same net effect as the reference's garbage-block writes."""
+    L, NB1, H, bs, D = k_cache.shape
     B, S = slot_mapping.shape
-    slots = jnp.where(slot_mapping >= 0, slot_mapping, slot_mapping % bs).reshape(B * S)
-    flat_k = flat_k.at[layer_idx, slots].set(
-        k_new.reshape(B * S, H, D).astype(flat_k.dtype), mode="drop"
+    slots = slot_mapping.reshape(B * S)
+    blocks = jnp.where(slots >= 0, slots // bs, NB1)
+    offs = jnp.where(slots >= 0, slots % bs, 0)
+    k_cache = k_cache.at[layer_idx, blocks, :, offs].set(
+        k_new.reshape(B * S, H, D).astype(k_cache.dtype), mode="drop"
     )
-    flat_v = flat_v.at[layer_idx, slots].set(
-        v_new.reshape(B * S, H, D).astype(flat_v.dtype), mode="drop"
+    v_cache = v_cache.at[layer_idx, blocks, :, offs].set(
+        v_new.reshape(B * S, H, D).astype(v_cache.dtype), mode="drop"
     )
-    return flat_k.reshape(L, NB1, bs, H, D), flat_v.reshape(L, NB1, bs, H, D)
+    return k_cache, v_cache
 
 
 def slot_mapping_from_block_table(
@@ -120,7 +129,7 @@ def slot_mapping_from_block_table(
 
 
 def read_block_cache_at_layer(
-    k_cache: jax.Array,  # (L, NB+1, bs, H, D)
+    k_cache: jax.Array,  # (L, NB+1, H, bs, D)
     v_cache: jax.Array,
     layer_idx: jax.Array,
     block_table: jax.Array,  # (B, MB) block ids; 0 for unused tail entries
@@ -128,12 +137,14 @@ def read_block_cache_at_layer(
     """Gather one layer's active blocks into a contiguous per-sequence view
     (reference gather-by-active-block-table reads)."""
     B, MB = block_table.shape
-    _, _, bs, H, D = k_cache.shape
+    _, _, H, bs, D = k_cache.shape
     k_l = jax.lax.dynamic_index_in_dim(k_cache, layer_idx, axis=0, keepdims=False)
     v_l = jax.lax.dynamic_index_in_dim(v_cache, layer_idx, axis=0, keepdims=False)
-    k = k_l[block_table]  # (B, MB, bs, H, D)
+    k = k_l[block_table]  # (B, MB, H, bs, D)
     v = v_l[block_table]
-    return k.reshape(B, MB * bs, H, D), v.reshape(B, MB * bs, H, D)
+    k = k.transpose(0, 1, 3, 2, 4).reshape(B, MB * bs, H, D)
+    v = v.transpose(0, 1, 3, 2, 4).reshape(B, MB * bs, H, D)
+    return k, v
 
 
 # ---------------------------------------------------------------------------

@@ -60,20 +60,34 @@ def use_tkg_kernel(spec, q_len: int, kv_width: int) -> bool:
     )
     if enabled:
         return ok
-    return ok and kv_width >= 512 and jax.default_backend() == "tpu"
+    # auto path: single model-parallel shard only — pallas_call has no GSPMD
+    # partitioning rule, so a head-sharded cache operand would be all-gathered
+    # per layer per step (force-enable opts in regardless)
+    return (
+        ok
+        and kv_width >= 512
+        and spec.model_parallel == 1
+        and jax.default_backend() == "tpu"
+    )
 
 
-def _body(q_ref, mask_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *, scale, n_kv, rk, K):
-    """One cache tile: unrolled loop over the Hkv head groups."""
-    k_all = k_ref[0, 0].astype(jnp.float32)  # (bs, Hkv, D)
+def _body(
+    q_ref, mask_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
+    *, scale, n_kv, rk, K, head_major=False,
+):
+    """One cache tile: unrolled loop over the Hkv head groups.
+
+    ``head_major`` selects the cache tile layout: (Hkv, bs, D) for the paged
+    cache (head-major blocks, see block_kvcache), (bs, Hkv, D) contiguous."""
+    k_all = k_ref[0, 0].astype(jnp.float32)
     v_all = v_ref[0, 0].astype(jnp.float32)
     mt = mask_ref[0, 0] > 0  # (K, bs)
-    bs = k_all.shape[0]
+    bs = k_all.shape[1] if head_major else k_all.shape[0]
     row_mask = jnp.repeat(mt[None], rk // K, axis=0).reshape(rk, bs)
     for g in range(n_kv):
         rows = slice(g * rk, (g + 1) * rk)
         q = q_ref[0, rows, :].astype(jnp.float32)  # (rk, D)
-        k = k_all[:, g, :]  # (bs, D)
+        k = k_all[g] if head_major else k_all[:, g, :]  # (bs, D)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (rk, bs)
@@ -84,7 +98,7 @@ def _body(q_ref, mask_ref, k_ref, v_ref, m_scr, l_scr, acc_scr, *, scale, n_kv, 
         p = jnp.where(row_mask, jnp.exp(s - m_new), 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_scr[rows, :] = l_scr[rows, :] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        v = v_all[:, g, :]
+        v = v_all[g] if head_major else v_all[:, g, :]
         acc_scr[rows, :] = acc_scr[rows, :] * alpha + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -107,7 +121,7 @@ def _finalize(o_ref, m_scr, l_scr, acc_scr, sink_ref, all_rows, K):
         o_ref[0] = (acc_scr[:] * alpha / denom).astype(o_ref.dtype)
 
 
-def _tkg_kernel(*args, scale, n_kv, rk, K, nkv, has_sink, n_prefetch):
+def _tkg_kernel(*args, scale, n_kv, rk, K, nkv, has_sink, n_prefetch, head_major=False):
     prefetch, rest = args[:n_prefetch], args[n_prefetch:]
     tile_any_ref = prefetch[-1]
     if has_sink:
@@ -128,7 +142,7 @@ def _tkg_kernel(*args, scale, n_kv, rk, K, nkv, has_sink, n_prefetch):
     def _compute():
         _body(
             q_ref, mask_ref, k_ref, v_ref, m_scr, l_scr, acc_scr,
-            scale=scale, n_kv=n_kv, rk=rk, K=K,
+            scale=scale, n_kv=n_kv, rk=rk, K=K, head_major=head_major,
         )
 
     @pl.when(j == nkv - 1)
@@ -242,7 +256,7 @@ def tkg_decode_attention(
 @functools.partial(jax.jit, static_argnames=("scale", "n_kv", "interpret"))
 def paged_tkg_decode_attention(
     q: jax.Array,  # (B, K, Hq, D)
-    k_cache: jax.Array,  # (L, NB+1, bs, Hkv, D) FULL stacked paged cache
+    k_cache: jax.Array,  # (L, NB+1, Hkv, bs, D) FULL stacked head-major paged cache
     v_cache: jax.Array,
     layer_idx: jax.Array,  # int32 scalar
     block_table: jax.Array,  # (B, MB) int32
@@ -259,7 +273,7 @@ def paged_tkg_decode_attention(
     (reference attention_block_tokengen kernel, attention_base.py:1609).
     Returns (B, K, Hq, D)."""
     B, K, Hq, D = q.shape
-    _, _, bs, Hkv, _ = k_cache.shape
+    _, _, Hkv, bs, _ = k_cache.shape
     MB = block_table.shape[1]
     assert mask.shape[-1] == MB * bs, (mask.shape, MB, bs)
     n_rep = Hq // n_kv
@@ -270,7 +284,7 @@ def paged_tkg_decode_attention(
 
     kernel = functools.partial(
         _tkg_kernel, scale=scale, n_kv=n_kv, rk=rk, K=K, nkv=MB,
-        has_sink=sink is not None, n_prefetch=3,
+        has_sink=sink is not None, n_prefetch=3, head_major=True,
     )
     in_specs = [
         pl.BlockSpec((1, Hq * K, D), lambda b, j, li, bt, ta: (b, 0, 0)),
@@ -282,10 +296,10 @@ def paged_tkg_decode_attention(
         tensors.append(sink.reshape(1, Hq))
     in_specs += [
         pl.BlockSpec(
-            (1, 1, bs, n_kv, D), lambda b, j, li, bt, ta: (li[0], bt[b, j], 0, 0, 0)
+            (1, 1, n_kv, bs, D), lambda b, j, li, bt, ta: (li[0], bt[b, j], 0, 0, 0)
         ),
         pl.BlockSpec(
-            (1, 1, bs, n_kv, D), lambda b, j, li, bt, ta: (li[0], bt[b, j], 0, 0, 0)
+            (1, 1, n_kv, bs, D), lambda b, j, li, bt, ta: (li[0], bt[b, j], 0, 0, 0)
         ),
     ]
     tensors += [k_cache, v_cache]

@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from neuronx_distributed_inference_tpu.ops.kernel_mode import kernel_interpret
+
 try:  # pallas TPU backend
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -46,7 +48,7 @@ def _flash_kernel(
     q_ref,  # (1, 1, bq, D)
     k_ref,  # (1, 1, bkv, D)
     v_ref,  # (1, 1, bkv, D)
-    valid_ref,  # (1, bkv) int32 key-validity
+    valid_ref,  # (1, 1, bkv) int32 key-validity
     o_ref,  # (1, 1, bq, D)
     m_ref,  # (1, 1, bq, 1) f32 row max (for sink folding)
     l_ref,  # (1, 1, bq, 1) f32 row denom
@@ -96,7 +98,7 @@ def _flash_kernel(
         )
         s = s * scale  # (bq, bkv)
 
-        valid = valid_ref[0, :] > 0  # (bkv,)
+        valid = valid_ref[0, 0, :] > 0  # (bkv,)
         mask = jnp.broadcast_to(valid[None, :], (bq, bkv))
         rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
         cols = kv_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
@@ -168,7 +170,12 @@ def flash_attention_bhsd(
             pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
             pl.BlockSpec((1, 1, bkv, D), lambda b, h, iq, ik: (b, h, ik, 0)),
             pl.BlockSpec((1, 1, bkv, D), lambda b, h, iq, ik: (b, h, ik, 0)),
-            pl.BlockSpec((1, bkv), lambda b, h, iq, ik: (b, ik)),
+            # (B, 1, S) with a unit middle axis: Mosaic requires the block's
+            # last-two dims divisible by (8, 128) OR equal to the array dims —
+            # block (1, bkv) over a (B, S) array fails for B > 1, so the
+            # validity mask carries a dummy axis making the block (1, bkv)
+            # sit over array dims (1, S).
+            pl.BlockSpec((1, 1, bkv), lambda b, h, iq, ik: (b, 0, ik)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, bq, D), lambda b, h, iq, ik: (b, h, iq, 0)),
@@ -189,7 +196,7 @@ def flash_attention_bhsd(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(q, k, v, key_valid)
+    )(q, k, v, key_valid[:, None, :])
 
 
 def flash_attention(
@@ -213,7 +220,7 @@ def flash_attention(
         causal=causal,
         window=window,
         chunk=chunk,
-        interpret=jax.default_backend() != "tpu",
+        interpret=kernel_interpret(),
     )
     if sink is not None:
         # softmax-with-sink = softmax * l / (l + exp(sink - m))

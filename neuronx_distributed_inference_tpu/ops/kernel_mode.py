@@ -1,0 +1,40 @@
+"""Pallas kernel execution-mode switch.
+
+Off-TPU hosts run every Pallas kernel in interpret mode (pure-Python
+emulation) so the CPU test mesh exercises kernel numerics. That also means no
+CPU test can ever hit a **Mosaic lowering** error — the class of bug that
+breaks only on hardware (r1 ``_pick_chunk``; r3 the flash ``key_valid``
+BlockSpec). :func:`force_compiled_kernels` flips the wrappers to emit real
+Mosaic kernels regardless of host backend, so the suite can AOT-lower every
+kernel (and whole model programs) for the TPU target from a CPU host via
+``jax.export(..., platforms=["tpu"])`` — see tests/test_tpu_lowering.py.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax
+
+_FORCE_COMPILED = False
+
+
+@contextmanager
+def force_compiled_kernels():
+    """Within this context, kernel wrappers emit real Mosaic kernels (no
+    interpret fallback) even on non-TPU hosts. Only useful together with AOT
+    lowering for a TPU target — actually EXECUTING the result on CPU fails."""
+    global _FORCE_COMPILED
+    prev = _FORCE_COMPILED
+    _FORCE_COMPILED = True
+    try:
+        yield
+    finally:
+        _FORCE_COMPILED = prev
+
+
+def kernel_interpret() -> bool:
+    """Interpret-mode decision for every Pallas wrapper call site."""
+    if _FORCE_COMPILED:
+        return False
+    return jax.default_backend() != "tpu"

@@ -45,7 +45,12 @@ def _use_paged_flash(spec, q_len: int) -> bool:
         return False
     if spec.use_flash_kernel:
         return True
-    return q_len >= 64 and jax.default_backend() == "tpu"
+    # auto path requires one model-parallel shard (see AttnSpec.model_parallel)
+    return (
+        q_len >= 64
+        and spec.model_parallel == 1
+        and jax.default_backend() == "tpu"
+    )
 
 
 def _paged_kernel(
@@ -55,9 +60,9 @@ def _paged_kernel(
     tile_max_ref,  # (B, nq) int32 max q position per q tile
     # blocked operands
     q_ref,  # (1, 1, tq, D)
-    pos_ref,  # (1, tq) int32 q positions
-    k_ref,  # (1, bs, 1, D)
-    v_ref,  # (1, bs, 1, D)
+    pos_ref,  # (1, 1, tq) int32 q positions (dummy middle axis for Mosaic)
+    k_ref,  # (1, 1, bs, D) one head's cache block
+    v_ref,  # (1, 1, bs, D)
     o_ref,  # (1, 1, tq, D)
     m_scr,
     l_scr,
@@ -85,12 +90,12 @@ def _paged_kernel(
     @pl.when(run)
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)  # (tq, D)
-        k = k_ref[0, :, 0, :].astype(jnp.float32)  # (bs, D)
+        k = k_ref[0, 0].astype(jnp.float32)  # (bs, D)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * scale  # (tq, bs)
 
-        q_pos = pos_ref[0]  # (tq,)
+        q_pos = pos_ref[0, 0]  # (tq,)
         kv_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (tq, bs), 1)
         mask = (kv_pos <= q_pos[:, None]) & (kv_pos < kv_limit_ref[b])
         s = jnp.where(mask, s, NEG_INF)
@@ -105,7 +110,7 @@ def _paged_kernel(
         alpha = jnp.exp(m_prev - m_new)
 
         l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        v = v_ref[0, :, 0, :].astype(jnp.float32)  # (bs, D)
+        v = v_ref[0, 0].astype(jnp.float32)  # (bs, D)
         pv = jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -123,7 +128,7 @@ def _paged_kernel(
 )
 def paged_flash_attention(
     q: jax.Array,  # (B, Sq, Hq, D)
-    k_cache: jax.Array,  # (NB+1, bs, Hkv, D) one layer's paged cache
+    k_cache: jax.Array,  # (NB+1, Hkv, bs, D) one layer's head-major paged cache
     v_cache: jax.Array,
     block_table: jax.Array,  # (B, MB) int32
     positions: jax.Array,  # (B, Sq) int32 query positions
@@ -142,7 +147,7 @@ def paged_flash_attention(
     write-then-attend as everywhere else).
     """
     B, Sq, Hq, D = q.shape
-    _, bs, Hkv, _ = k_cache.shape
+    _, Hkv, bs, _ = k_cache.shape
     MB = block_table.shape[1]
     tq = min(tq, Sq)
     nq = pl.cdiv(Sq, tq)
@@ -160,14 +165,18 @@ def paged_flash_attention(
         grid=(B, Hq, nq, MB),
         in_specs=[
             pl.BlockSpec((1, 1, tq, D), lambda b, h, iq, j, bt, lim, tm: (b, h, iq, 0)),
-            pl.BlockSpec((1, tq), lambda b, h, iq, j, bt, lim, tm: (b, iq)),
+            # dummy middle axis: block (1, tq) over a (B, Sq) array violates
+            # Mosaic's (8, 128) last-two-dims rule for B > 1
+            pl.BlockSpec((1, 1, tq), lambda b, h, iq, j, bt, lim, tm: (b, 0, iq)),
+            # head-major cache: one head's block is a (bs, D) tile whose
+            # last-two block dims equal the array dims
             pl.BlockSpec(
-                (1, bs, 1, D),
-                lambda b, h, iq, j, bt, lim, tm: (bt[b, j], 0, h // n_rep, 0),
+                (1, 1, bs, D),
+                lambda b, h, iq, j, bt, lim, tm: (bt[b, j], h // n_rep, 0, 0),
             ),
             pl.BlockSpec(
-                (1, bs, 1, D),
-                lambda b, h, iq, j, bt, lim, tm: (bt[b, j], 0, h // n_rep, 0),
+                (1, 1, bs, D),
+                lambda b, h, iq, j, bt, lim, tm: (bt[b, j], h // n_rep, 0, 0),
             ),
         ],
         out_specs=pl.BlockSpec(
@@ -192,7 +201,7 @@ def paged_flash_attention(
         kv_limit.astype(jnp.int32),
         tile_max,
         qt,
-        positions.astype(jnp.int32),
+        positions.astype(jnp.int32)[:, None, :],
         k_cache,
         v_cache,
     )

@@ -17,6 +17,7 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 QUANT_DTYPES = {
     "int8": jnp.int8,
@@ -39,22 +40,32 @@ def quantize_tensor(
     """Symmetric quantization along the last (output) axis.
 
     Returns {"weight": q, "scale": s} with w ≈ q * s.
+
+    Host (numpy) inputs quantize WITH numpy and return numpy — quantize-at-load
+    of models near the HBM limit (int8 8B on a 16G chip) must not stage the
+    fp32 intermediate on device; ``shard_pytree`` device-puts the int8 result.
     """
     dt = QUANT_DTYPES[quant_dtype]
-    wf = w.astype(jnp.float32)
+    xp = np if isinstance(w, np.ndarray) else jnp
+    wf = w.astype(xp.float32)
     if per_channel:
         # reduce ONLY the input axis (-2): stacked-layer / stacked-expert
         # weights (L, ..., in, out) keep one scale per (leading dims, out)
-        absmax = jnp.max(jnp.abs(wf), axis=-2)  # (..., out)
+        absmax = xp.max(xp.abs(wf), axis=-2)  # (..., out)
     else:
         # per-tensor per leading slice: reduce the last two axes
-        absmax = jnp.max(jnp.abs(wf), axis=(-2, -1), keepdims=True)[..., 0]  # (..., 1)
-    absmax = jnp.maximum(absmax, 1e-8)
+        absmax = xp.max(xp.abs(wf), axis=(-2, -1), keepdims=True)[..., 0]  # (..., 1)
+    absmax = xp.maximum(absmax, 1e-8)
     qmax = 127.0 if dt == jnp.int8 else float(jnp.finfo(dt).max)
     scale = absmax / qmax
     q = wf / scale[..., None, :]
     if dt == jnp.int8:
-        q = jnp.clip(jnp.round(q), -127, 127)
+        q = xp.clip(xp.round(q), -127, 127)
+    if xp is np:
+        import ml_dtypes  # numpy fp8/bf16 dtype support
+
+        np_dt = np.int8 if dt == jnp.int8 else np.dtype(ml_dtypes.float8_e4m3fn if dt == jnp.float8_e4m3fn else ml_dtypes.float8_e5m2)
+        return {"weight": q.astype(np_dt), "scale": scale.astype(np.float32)}
     return {"weight": q.astype(dt), "scale": scale.astype(jnp.float32)}
 
 
@@ -69,9 +80,11 @@ def quantize_tensor_blockwise(
     MoENeuronConfig config.py:665-713).
 
     Returns {"weight": q (..., in, out), "scale": s (..., in/bs, out)}.
+    Numpy inputs stay on host (see quantize_tensor).
     """
     dt = QUANT_DTYPES[quant_dtype]
-    wf = w.astype(jnp.float32)
+    xp = np if isinstance(w, np.ndarray) else jnp
+    wf = w.astype(xp.float32)
     *lead, d_in, d_out = wf.shape
     if d_in % block_size != 0:
         raise ValueError(
@@ -80,12 +93,20 @@ def quantize_tensor_blockwise(
         )
     nb = d_in // block_size
     wb = wf.reshape(*lead, nb, block_size, d_out)
-    absmax = jnp.maximum(jnp.max(jnp.abs(wb), axis=-2), 1e-8)  # (..., nb, out)
+    absmax = xp.maximum(xp.max(xp.abs(wb), axis=-2), 1e-8)  # (..., nb, out)
     qmax = 127.0 if dt == jnp.int8 else float(jnp.finfo(dt).max)
     scale = absmax / qmax
     q = wb / scale[..., None, :]
     if dt == jnp.int8:
-        q = jnp.clip(jnp.round(q), -127, 127)
+        q = xp.clip(xp.round(q), -127, 127)
+    if xp is np:
+        import ml_dtypes
+
+        np_dt = np.int8 if dt == jnp.int8 else np.dtype(ml_dtypes.float8_e4m3fn if dt == jnp.float8_e4m3fn else ml_dtypes.float8_e5m2)
+        return {
+            "weight": q.astype(np_dt).reshape(*lead, d_in, d_out),
+            "scale": scale.astype(np.float32),
+        }
     return {
         "weight": q.astype(dt).reshape(*lead, d_in, d_out),
         "scale": scale.astype(jnp.float32),
@@ -132,6 +153,11 @@ def quantize_params(
 ):
     """Walk the param pytree quantizing every eligible 'weight' leaf.
 
+    DONATING: the tree is mutated in place and each source weight's reference
+    is dropped as soon as its quantized replacement exists, so peak memory is
+    (quantized model) + (one full-precision leaf) — not two full models. An
+    int8 8B quantize-at-load on a 16G chip depends on this.
+
     Reference: save_quantized_state_dict / convert()
     (application_base.py:744-797).
     """
@@ -146,15 +172,15 @@ def quantize_params(
                 and node["weight"].ndim >= min_ndim
                 and "bias" not in path
             ):
-                out = dict(node)
                 if block_size:
-                    out.update(
-                        quantize_tensor_blockwise(node["weight"], quant_dtype, block_size)
-                    )
+                    q = quantize_tensor_blockwise(node["weight"], quant_dtype, block_size)
                 else:
-                    out.update(quantize_tensor(node["weight"], quant_dtype, per_channel))
-                return out
-            return {k: walk(v, path + (k,)) for k, v in node.items()}
+                    q = quantize_tensor(node["weight"], quant_dtype, per_channel)
+                node.update(q)  # drops the source weight's last reference
+                return node
+            for k in list(node):
+                node[k] = walk(node[k], path + (k,))
+            return node
         return node
 
     return walk(params, ())

@@ -185,7 +185,9 @@ class TpuModelForCausalLM:
             pspecs = quantized_pspecs(self.builder.param_pspecs(), params)
         else:
             if random_weights:
-                params = self.builder.random_params()
+                # quantize-at-load: generate on host so the full-precision
+                # model never stages in HBM (int8 8B on a 16G chip)
+                params = self.builder.random_params(on_host=tc.quantized)
             else:
                 sd = state_dict if state_dict is not None else load_state_dict(
                     model_path or self.model_path

@@ -113,8 +113,8 @@ class _SpecAppBase:
     ):
         tc = self.config.tpu_config
         if random_weights:
-            tparams = self.target_builder.random_params()
-            dparams = self.draft_builder.random_params(key=jax.random.PRNGKey(tc.seed + 1))
+            tparams = self.target_builder.random_params(on_host=tc.quantized)
+            dparams = self.draft_builder.random_params(key=jax.random.PRNGKey(tc.seed + 1), on_host=tc.quantized)
         else:
             tsd = target_state_dict if target_state_dict is not None else load_state_dict(
                 self.model_path

@@ -115,6 +115,7 @@ class MllamaForConditionalGeneration:
                 num_kv_heads=kv,
                 head_dim=self.head_dim,
                 rms_norm_eps=tg("rms_norm_eps", 1e-5),
+                model_parallel=tc.tp_degree * tc.ep_degree,
             ),
             rms_eps=tg("rms_norm_eps", 1e-5),
             act=tg("hidden_act", "silu"),

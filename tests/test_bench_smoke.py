@@ -1,0 +1,24 @@
+"""bench.py smoke test: run the EXACT benchmark code path (build → load →
+warmup → measure, every point) with a tiny model on the CPU mesh.
+
+Two of the first three rounds shipped a crash only bench.py could hit
+(VERDICT r3 weak #2: r1 ``_pick_chunk`` NameError, r3 the flash B>1
+BlockSpec). The suite must execute bench's code path, not a parallel copy —
+hence bench.run_suite(tiny=True) runs the same functions main() runs.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_bench_suite_tiny():
+    import bench
+
+    points = bench.run_suite(tiny=True)
+    assert set(points) == {"bf16_1b_bs1", "bf16_1b_bs4", "int8_1b_bs1", "int8_8b_bs1"}
+    for name, p in points.items():
+        assert p["decode_tok_s"] > 0, (name, p)
+        assert p["ttft_ms"] > 0, (name, p)
+    assert points["bf16_1b_bs1"]["prefill_tok_s"] > 0

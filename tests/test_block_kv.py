@@ -26,6 +26,32 @@ def test_allocator_lifecycle():
         a.alloc_seq(1, 100)  # too many tokens
 
 
+def test_update_drops_negative_slots():
+    """Invalid (-1) slots must write NOWHERE — in particular not wrap to the
+    LAST block (jnp negative-index normalization happens before mode=\"drop\",
+    so a naive -1 block index corrupts a real allocatable block)."""
+    import jax.numpy as jnp
+
+    from neuronx_distributed_inference_tpu.modules.block_kvcache import (
+        init_block_cache,
+        update_block_cache_at_layer,
+    )
+
+    L, NB, bs, H, D = 2, 4, 4, 2, 8
+    cache = init_block_cache(L, NB, bs, H, D, dtype=jnp.float32)
+    k0 = np.asarray(cache.k)
+    # one valid slot (block 2, off 1) + one invalid (-1) per row
+    slot_mapping = jnp.asarray([[2 * bs + 1, -1]], jnp.int32)
+    k_new = jnp.ones((1, 2, H, D), jnp.float32)
+    k_up, v_up = update_block_cache_at_layer(
+        cache.k, cache.v, k_new, k_new, jnp.int32(0), slot_mapping
+    )
+    k_up = np.array(k_up)
+    assert (k_up[0, 2, :, 1] == 1.0).all()  # valid slot written
+    k_up[0, 2, :, 1] = k0[0, 2, :, 1]
+    np.testing.assert_array_equal(k_up, k0)  # NOTHING else (esp. last block)
+
+
 def _session_apps():
     sd = None
     apps = []

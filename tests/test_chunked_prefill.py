@@ -53,8 +53,9 @@ def test_paged_flash_kernel_parity():
     NB = 12
     n_rep = Hq // Hkv
     q = (rng.randn(B, Sq, Hq, D) * 0.3).astype(np.float32)
-    k_cache = (rng.randn(NB + 1, bs, Hkv, D) * 0.3).astype(np.float32)
-    v_cache = (rng.randn(NB + 1, bs, Hkv, D) * 0.3).astype(np.float32)
+    # head-major paged layout (NB+1, Hkv, bs, D)
+    k_cache = (rng.randn(NB + 1, Hkv, bs, D) * 0.3).astype(np.float32)
+    v_cache = (rng.randn(NB + 1, Hkv, bs, D) * 0.3).astype(np.float32)
     # row 0: ctx 20 prior + 16 new (positions 20..35); row 1: 5 prior + 16 new
     starts = np.array([20, 5])
     positions = starts[:, None] + np.arange(Sq)[None, :]
@@ -72,8 +73,12 @@ def test_paged_flash_kernel_parity():
     # native reference: gather blocks, masked softmax
     ref = np.zeros_like(q)
     for b in range(B):
-        kv = np.concatenate([k_cache[i] for i in block_table[b]], axis=0)  # (MB*bs, Hkv, D)
-        vv = np.concatenate([v_cache[i] for i in block_table[b]], axis=0)
+        kv = np.concatenate(
+            [k_cache[i].transpose(1, 0, 2) for i in block_table[b]], axis=0
+        )  # (MB*bs, Hkv, D)
+        vv = np.concatenate(
+            [v_cache[i].transpose(1, 0, 2) for i in block_table[b]], axis=0
+        )
         kv = np.repeat(kv, n_rep, axis=1)
         vv = np.repeat(vv, n_rep, axis=1)
         for t in range(Sq):

@@ -97,8 +97,9 @@ def test_tkg_paged_parity(K):
     B, NB, bs, MB = 2, 12, 16, 8
     layer = 2
     q = _rand(rng, B, K, HQ, D)
-    k_cache = _rand(rng, L, NB + 1, bs, HKV, D)
-    v_cache = _rand(rng, L, NB + 1, bs, HKV, D)
+    # head-major paged layout (L, NB+1, Hkv, bs, D)
+    k_cache = _rand(rng, L, NB + 1, HKV, bs, D)
+    v_cache = _rand(rng, L, NB + 1, HKV, bs, D)
     # distinct non-garbage blocks per row; unused tail -> 0 (garbage)
     bt = np.zeros((B, MB), np.int32)
     bt[0, :6] = rng.permutation(np.arange(1, NB + 1))[:6]
