@@ -127,6 +127,9 @@ def build_app(
         context_encoding_buckets=list(ce_buckets),
         token_generation_buckets=list(tkg_buckets),
         quantized=quantized,
+        # fused decode-layer kernels need the fused QKV weight layout; with it
+        # they auto-enable on TPU (quantized configs fall back structurally)
+        fused_qkv=not quantized,
     )
     app = TpuModelForCausalLM(None, LlamaInferenceConfig(tc, load_config=load_cfg))
     app.load(random_weights=True)
@@ -236,9 +239,11 @@ def run_suite(tiny=False):
     import subprocess
 
     for name in _suite_params(False):
+        # generous per-point ceiling: the int8 8B point moves ~9 GB of
+        # weights to the device, which through a tunneled chip is slow
         proc = subprocess.run(
             [sys.executable, __file__, "--point", name],
-            capture_output=True, text=True, timeout=3600,
+            capture_output=True, text=True, timeout=7200,
         )
         if proc.returncode != 0:
             print(proc.stderr[-4000:], file=sys.stderr)

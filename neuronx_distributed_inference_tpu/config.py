@@ -255,9 +255,7 @@ UNIMPLEMENTED_FLAGS: Dict[str, Tuple[Any, str]] = {
 
 # MoETpuConfig-only parity flags, same contract
 UNIMPLEMENTED_MOE_FLAGS: Dict[str, Tuple[Any, str]] = {
-    "fused_shared_experts": (False, "fused shared-expert path (DeepSeek)"),
     "moe_fused_kernel_enabled": (None, "fused MoE kernel"),
-    "hybrid_sharding_config": (None, "hybrid expert sharding"),
 }
 
 
@@ -330,6 +328,12 @@ class TpuConfig:
     # inert default `false`, which now pins the native path — re-save the
     # artifact (or edit tpu_config.json to null) to restore auto.
     attn_block_tkg_kernel_enabled: Optional[bool] = None
+    # fused decode-layer Pallas kernels (ops/decode_block.py): the attention
+    # BLOCK (rmsnorm+fused-QKV+rope+attention+o-proj, reference
+    # attention_block_tokengen_nki_kernel, attention_base.py:1609 — requires
+    # fused_qkv) and the gated-MLP block. Tri-state like the other kernels.
+    fused_attn_block_kernel_enabled: Optional[bool] = None
+    fused_mlp_kernel_enabled: Optional[bool] = None
     k_cache_transposed: bool = False
     qk_norm: bool = False
 
@@ -442,11 +446,9 @@ class TpuConfig:
             raise ValueError("attention_dp_degree > 1 requires is_continuous_batching")
         if self.attention_dp_degree > 1 and self.max_batch_size % self.attention_dp_degree != 0:
             raise ValueError("batch size must divide evenly across attention DP ranks")
-        if self.attention_dp_degree > 1 and self.is_block_kv_layout:
-            raise NotImplementedError(
-                "attention-DP with the paged cache is not implemented; use "
-                "the contiguous cache (kv_cache_batch_size slots)"
-            )
+        # attention-DP + paged cache: the block pool replicates over the dp
+        # axis (batch-parallel attention reads any block); the contiguous
+        # cache dp-shards its batch dim instead — see parallel/attention_dp.py
         if self.data_parallel_degree > 1:
             shards = self.attention_dp_degree * self.data_parallel_degree
             if (self.kv_cache_batch_size or self.max_batch_size) % shards != 0:
@@ -611,6 +613,31 @@ class MoETpuConfig(TpuConfig):
                 "non-GLU expert MLPs are not implemented (experts are "
                 "gate/up/down GLU, modules/moe.py)"
             )
+        if self.hybrid_sharding_config is not None:
+            h = dict(self.hybrid_sharding_config)
+            total = self.tp_degree * self.ep_degree
+            cte_tp = int(h.get("moe_cte_tp_degree", total))
+            cte_ep = int(h.get("moe_cte_ep_degree", 1))
+            tkg_tp = int(h.get("moe_tkg_tp_degree", self.tp_degree))
+            tkg_ep = int(h.get("moe_tkg_ep_degree", self.ep_degree))
+            if tkg_tp * tkg_ep != total or cte_tp * cte_ep != total:
+                raise ValueError(
+                    "hybrid_sharding_config degrees must multiply to "
+                    f"tp_degree*ep_degree={total}: got cte {cte_tp}x{cte_ep}, "
+                    f"tkg {tkg_tp}x{tkg_ep}"
+                )
+            if tkg_tp != self.tp_degree or tkg_ep != self.ep_degree:
+                raise NotImplementedError(
+                    "the PERSISTENT (decode) expert layout is the mesh's "
+                    "tp_degree x ep_degree — set moe_tkg_tp/ep to match and "
+                    "express the prefill preference via moe_cte_tp/ep"
+                )
+            if cte_ep != 1:
+                raise NotImplementedError(
+                    "hybrid prefill sharding supports moe_cte_ep_degree=1 "
+                    "(full-TP prefill experts, GSPMD-resharded in the CTE "
+                    "program); other factorings need a second weight copy"
+                )
         if self.capacity_factor is not None:
             # loud-fail contract: combinations the capacity path cannot honor
             # must not silently fall back to dense-dropless (modules/moe.py)

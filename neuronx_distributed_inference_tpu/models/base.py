@@ -91,6 +91,7 @@ class ModelSpec:
     attention_chunk_size: Optional[int] = None
     # context/sequence parallelism (reference CP/SP, SURVEY §2.9)
     cp_enabled: bool = False
+    cp_degree: int = 1
     sequence_parallel: bool = False
     # attention-DP decode: batch-parallel attention over the dp mesh axis
     # (reference attention_base.py:2308-2321)
@@ -117,6 +118,9 @@ class ModelSpec:
     # heterogeneous layer stacks: None = one uniform group (spec-level
     # sliding_window / attention_chunk_size apply)
     layer_groups: Optional[Tuple[LayerGroupSpec, ...]] = None
+    # fused decode MLP kernel (config fused_mlp_kernel_enabled):
+    # None = auto on TPU (single shard), True = force, False = off
+    use_fused_mlp: Optional[bool] = None
 
 
 @jax.tree_util.register_dataclass
@@ -268,6 +272,91 @@ def contiguous_decode_attend(
     return attn_out
 
 
+def _plain_weight(entry) -> bool:
+    """True when a projection entry is a plain unquantized, un-LoRA'd,
+    bias-free weight the fused kernels can stream directly."""
+    return (
+        isinstance(entry, dict)
+        and "weight" in entry
+        and "scale" not in entry
+        and "lora_A" not in entry
+        and "bias" not in entry
+    )
+
+
+def _fused_attn_eligible(
+    layer_params, k_cache, v_cache, mask, spec, cos, window, chunk
+) -> bool:
+    """LAYER-level preconditions for the fused decode attention block (the
+    step-level ones — plain decode, no overrides — are certified by
+    run_decoder_layers via ``fused_block_ok``)."""
+    from neuronx_distributed_inference_tpu.ops.decode_block import use_fused_attn_block
+
+    aspec = spec.attn
+    sa = layer_params.get("self_attn", {})
+    K = mask.shape[-2]
+    # the kernel's ACTIVE (in-flight) part is pure causal over the K new
+    # tokens: windowed/chunked models are only eligible at K == 1 (a token
+    # always attends itself; the PRIOR mask carries the window/chunk bounds)
+    plain_flavor = (
+        window is None
+        and chunk is None
+        and not spec.sliding_window
+        and not spec.attention_chunk_size
+    )
+    return (
+        (plain_flavor or K == 1)
+        and not isinstance(k_cache, tuple)  # contiguous cache only
+        and spec.bounded_window is None
+        and spec.norm_type == "rmsnorm"
+        and "qkv_proj" in sa
+        and _plain_weight(sa["qkv_proj"])
+        and _plain_weight(sa.get("o_proj"))
+        and not aspec.has_sink
+        and aspec.qkv_shards == 1
+        and k_cache.shape == v_cache.shape
+        and cos.shape[-1] * 2 == aspec.head_dim
+        and use_fused_attn_block(aspec, mask.shape[-2], mask.shape[-1])
+    )
+
+
+def _decoder_layer_mlp(layer_params, hidden, spec, mlp_fn, adapter_ids, fused_ok):
+    """post-attention norm + MLP + residual, with the fused decode-MLP Pallas
+    path when the step and the layer's MLP structure allow it."""
+    mp = layer_params["mlp"]
+    if (
+        fused_ok
+        and mlp_fn is gated_mlp
+        and spec.use_fused_mlp is not False
+        and spec.norm_type == "rmsnorm"
+        and spec.act in ("silu", "gelu", "gelu_pytorch_tanh")
+        and adapter_ids is None
+        and all(_plain_weight(mp.get(k)) for k in ("gate_proj", "up_proj", "down_proj"))
+        # AUTO = OFF (see ops/decode_block.use_fused_attn_block): measured
+        # slower than the XLA fusion at bs=1; force with
+        # fused_mlp_kernel_enabled=True
+        and spec.use_fused_mlp
+    ):
+        from neuronx_distributed_inference_tpu.ops.decode_block import fused_mlp_block
+
+        return fused_mlp_block(
+            hidden,
+            layer_params["post_attention_layernorm"]["weight"],
+            mp["gate_proj"]["weight"],
+            mp["up_proj"]["weight"],
+            mp["down_proj"]["weight"],
+            eps=spec.rms_eps,
+            act=spec.act,
+            interpret=kernel_interpret(),
+        )
+    residual = hidden
+    hidden = apply_norm(
+        hidden, layer_params["post_attention_layernorm"]["weight"], spec.rms_eps,
+        spec.norm_type,
+    )
+    return residual + mlp_fn(mp, hidden, spec)
+
+
 def decoder_layer(
     layer_params: dict,
     hidden: jax.Array,
@@ -292,6 +381,9 @@ def decoder_layer(
     window: Optional[int] = None,
     chunk: Optional[int] = None,
     flavor_select: Optional[Tuple] = None,
+    # run_decoder_layers certifies the STEP-level fused-kernel preconditions
+    # (plain decode, no rope/mask overrides, no taps/adapters, single shard)
+    fused_block_ok: bool = False,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decoder layer (reference NeuronLlamaDecoderLayer, modeling_llama.py:1188).
 
@@ -300,6 +392,41 @@ def decoder_layer(
     kvcache.update_cache_at_layer). Returns (hidden, k_cache, v_cache).
     """
     aspec = spec.attn
+    if fused_block_ok and _fused_attn_eligible(
+        layer_params, k_cache, v_cache, mask, spec, cos, window, chunk
+    ):
+        # fused decode attention block: rmsnorm + fused-QKV + rope + prior/
+        # active attention + o-proj + residual in ONE Pallas pipeline; the
+        # kernel returns k_new/v_new for the normal cache scatter (reference
+        # attention_block_tokengen kernel with update_cache_in_kernel=False,
+        # attention_base.py:1609)
+        from neuronx_distributed_inference_tpu.ops.decode_block import fused_attn_block
+
+        sa = layer_params["self_attn"]
+        hidden, k_new, v_new = fused_attn_block(
+            hidden,
+            layer_params["input_layernorm"]["weight"],
+            sa["qkv_proj"]["weight"],
+            sa["o_proj"]["weight"],
+            cos,
+            sin,
+            k_cache,
+            v_cache,
+            layer_idx,
+            slot_ids,
+            mask,
+            positions,
+            scale=aspec.softmax_scale,
+            eps=spec.rms_eps,
+            n_kv=aspec.num_kv_heads,
+            interpret=kernel_interpret(),
+        )
+        k_cache, v_cache = update_cache_at_layer(
+            k_cache, v_cache, k_new, v_new, layer_idx, slot_ids, positions
+        )
+        return _decoder_layer_mlp(
+            layer_params, hidden, spec, mlp_fn, adapter_ids, fused_block_ok
+        ), k_cache, v_cache
     residual = hidden
     hidden = apply_norm(
         hidden, layer_params["input_layernorm"]["weight"], spec.rms_eps, spec.norm_type
@@ -439,8 +566,10 @@ def decoder_layer(
 
             bs = k_cache.shape[3]  # (L, NB+1, Hkv, bs, D) head-major
             width_ok = mask.shape[-1] == block_table.shape[1] * bs
+            dp_shards = spec.attention_dp * spec.data_parallel
             if (
-                width_ok
+                dp_shards == 1
+                and width_ok
                 and k_cache.shape == v_cache.shape
                 and use_tkg_kernel(aspec, Sq, mask.shape[-1])
             ):
@@ -454,10 +583,23 @@ def decoder_layer(
                     interpret=kernel_interpret(),
                 )
             else:
+                if dp_shards > 1:
+                    # attention-DP over the paged cache: the batch shards over
+                    # dp around the attention (GSPMD all-to-all heads<->batch)
+                    # while the block pool stays REPLICATED over dp — any
+                    # shard reads any block (the contiguous cache dp-shards
+                    # its batch dim instead; reference attention_base.py:2308)
+                    from neuronx_distributed_inference_tpu.parallel import (
+                        attention_dp as adp,
+                    )
+
+                    q = adp.shard_decode_q(q)
                 k_r, v_r = read_block_cache_at_layer(
                     k_cache, v_cache, layer_idx, block_table
                 )
                 attn_out = attention_decode(q, k_r, v_r, mask, aspec, sink=sink)
+                if dp_shards > 1:
+                    attn_out = adp.unshard_attn_out(attn_out)
     elif bounded:
         attn_out = ring_attention(
             q, k, v, k_prior, v_prior, positions, spec.bounded_window, aspec, sink
@@ -491,12 +633,9 @@ def decoder_layer(
     hidden = o_project(layer_params["self_attn"], attn_out, aspec, adapter_ids=adapter_ids)
     hidden = residual + hidden
 
-    residual = hidden
-    hidden = apply_norm(
-        hidden, layer_params["post_attention_layernorm"]["weight"], spec.rms_eps,
-        spec.norm_type,
+    hidden = _decoder_layer_mlp(
+        layer_params, hidden, spec, mlp_fn, adapter_ids, fused_block_ok
     )
-    hidden = residual + mlp_fn(layer_params["mlp"], hidden, spec)
     if spec.cp_enabled and phase == PHASE_CONTEXT_ENCODING:
         from neuronx_distributed_inference_tpu.parallel import context_parallel as cpx
 
@@ -504,6 +643,30 @@ def decoder_layer(
     if not interleaved:
         hidden = tensor_taps.tap("layer_out", hidden, layer_idx)
     return hidden, k_cache, v_cache
+
+
+def zigzag_cp_perm(S: int, cp: int):
+    """Causal-load-balancing sequence permutation for CP prefill (reference
+    strided-CP Q split, attention_base.py:698-711 + model_base.py:929-937).
+
+    A contiguous S/cp stripe gives rank 0 the cheap top of the causal
+    triangle and rank cp-1 the expensive bottom. Split S into 2*cp chunks and
+    give rank r chunks (r, 2cp-1-r): every rank then owns an equal share of
+    the triangle (the "zigzag" schedule). Returns (perm, inv) index arrays —
+    ``x[:, perm]`` reorders so GSPMD's contiguous cp stripes are balanced,
+    ``x[:, inv]`` restores natural order.
+    """
+    import numpy as np
+
+    nch = 2 * cp
+    chunk = S // nch
+    order = []
+    for r in range(cp):
+        order += [r, nch - 1 - r]
+    perm = np.concatenate([np.arange(c * chunk, (c + 1) * chunk) for c in order])
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(S)
+    return jnp.asarray(perm), jnp.asarray(inv)
 
 
 def build_mask(
@@ -670,7 +833,31 @@ def run_decoder_layers(
         kv_limit = jnp.sum(inputs.attention_mask.astype(jnp.int32), axis=-1)
         block_inputs = (slot_mapping, inputs.block_table, kv_limit)
 
+    # strided-CP causal load balancing (reference attention_base.py:698-711):
+    # zigzag-permute the sequence so each cp rank's contiguous stripe owns an
+    # equal share of the causal triangle. Q/K/V inherit the permuted order
+    # (masks permute on both axes below); cache writes use the permuted
+    # POSITIONS so KV lands at absolute slots; hidden is unpermuted at the
+    # end. Decode is untouched (it reads the cache by position).
+    cp_perm = cp_inv = None
+    if (
+        sp_prefill
+        and spec.cp_enabled
+        and spec.cp_degree > 1
+        and not is_block
+        and spec.ring_window is None
+        and capture_layers is None
+        and hidden.shape[1] % (2 * spec.cp_degree) == 0
+    ):
+        cp_perm, cp_inv = zigzag_cp_perm(hidden.shape[1], spec.cp_degree)
+        hidden = hidden[:, cp_perm]
+        cos = cos[:, cp_perm]
+        sin = sin[:, cp_perm]
+        positions = positions[:, cp_perm]
+
     def finalize_mask(mask):
+        if cp_perm is not None:
+            mask = mask[:, :, cp_perm][:, :, :, cp_perm]
         if sp_prefill and spec.cp_enabled:
             from neuronx_distributed_inference_tpu.parallel import context_parallel as cpx
 
@@ -709,6 +896,23 @@ def run_decoder_layers(
         raise NotImplementedError(
             "per-layer tensor taps require a uniform (single-group) stack"
         )
+
+    # step-level preconditions for the fused decode-layer kernels: plain
+    # contiguous-cache decode, contiguous write positions (no token-tree rope
+    # or mask overrides), no taps/adapters, one model-parallel shard. The
+    # layer-level structure checks happen inside decoder_layer.
+    fused_eligible = (
+        phase != PHASE_CONTEXT_ENCODING
+        and not is_block
+        and not interleaved
+        and inputs.rope_position_ids is None
+        and inputs.mask_override is None
+        and inputs.adapter_ids is None
+        and spec.attention_dp == 1
+        and spec.data_parallel == 1
+        and not spec.cp_enabled
+        and taps_ctx is None
+    )
 
     if prestacked:
         if capture_layers is not None:
@@ -812,6 +1016,8 @@ def run_decoder_layers(
                     if phase == PHASE_CONTEXT_ENCODING:
                         fs = (tuple(uniq), fl)
                 kw = {}
+                if g_layer is decoder_layer:
+                    kw["fused_block_ok"] = fused_eligible
                 if fs is not None:
                     kw["flavor_select"] = fs
                 elif phase == PHASE_CONTEXT_ENCODING:
@@ -861,10 +1067,13 @@ def run_decoder_layers(
                           key_valid=key_valid, window=window, chunk=chunk):
                 h, k_c, v_c, cap = carry
                 layer_params, li = xs
+                kw = {}
+                if g_layer is decoder_layer:
+                    kw["fused_block_ok"] = fused_eligible
                 h, k_c, v_c = g_layer(
                     layer_params, h, cos, sin, k_c, v_c, li, mask, slot_ids, positions,
                     spec, phase, g_mlp, key_valid=key_valid, block_inputs=block_inputs,
-                    adapter_ids=inputs.adapter_ids, window=window, chunk=chunk,
+                    adapter_ids=inputs.adapter_ids, window=window, chunk=chunk, **kw,
                 )
                 if cap is not None:
                     hit = (cap_idx == li)[:, None, None, None]
@@ -888,6 +1097,12 @@ def run_decoder_layers(
         )
     else:
         new_cache = type(cache)(k=k_cache, v=v_cache)
+
+    if cp_perm is not None:
+        hidden = hidden[:, cp_inv]  # natural order for last-token gather
+        from neuronx_distributed_inference_tpu.parallel import context_parallel as cpx
+
+        hidden = cpx.shard_seq(hidden)
 
     hidden = apply_norm(hidden, params["norm"]["weight"], spec.rms_eps, spec.norm_type)
     hidden = tensor_taps.tap("final_hidden", hidden)

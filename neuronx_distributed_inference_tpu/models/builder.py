@@ -90,6 +90,7 @@ class DecoderModelBuilder:
             rms_norm_eps=getattr(self.config, "rms_norm_eps", 1e-6),
             use_flash_kernel=tc.attn_kernel_enabled,
             use_tkg_kernel=tc.attn_block_tkg_kernel_enabled,
+            use_fused_block=tc.fused_attn_block_kernel_enabled,
             qkv_shards=self.degree if tc.fused_qkv else 1,
             model_parallel=self.degree,
         )
@@ -110,6 +111,7 @@ class DecoderModelBuilder:
             sliding_window=tc.sliding_window,
             attention_chunk_size=tc.attention_chunk_size,
             cp_enabled=tc.cp_degree > 1,
+            cp_degree=tc.cp_degree,
             sequence_parallel=tc.sequence_parallel_enabled,
             attention_dp=tc.attention_dp_degree,
             data_parallel=tc.data_parallel_degree,
@@ -120,6 +122,7 @@ class DecoderModelBuilder:
             cast_logits_fp32=tc.cast_logits_fp32,
             attention_scaling=rope_attention_scaling(cfg),
             norm_type=self.norm_type,
+            use_fused_mlp=tc.fused_mlp_kernel_enabled,
         )
         return self._finalize_bounded(spec)
 

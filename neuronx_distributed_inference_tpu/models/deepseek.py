@@ -237,6 +237,7 @@ class DeepseekV3ModelBuilder(DecoderModelBuilder):
             topk_group=getattr(cfg, "topk_group", 1),
             capacity_factor=getattr(tc, "capacity_factor", None),
             ep_degree=tc.ep_degree,
+            hybrid_cte_full_tp=bool(getattr(tc, "hybrid_sharding_config", None)),
         )
 
     def model_spec(self):
@@ -254,11 +255,15 @@ class DeepseekV3ModelBuilder(DecoderModelBuilder):
         mspec = self.moe_spec()
         has_shared = bool(getattr(self.config, "n_shared_experts", 0))
 
+        from neuronx_distributed_inference_tpu.modules.moe import shared_expert_mlp
+
+        act = getattr(self.config, "hidden_act", "silu")
+
         def moe_mlp_fn(mlp_params, hidden, model_spec):
             return moe_layer(
                 mlp_params, hidden, mspec,
                 shared_mlp_fn=(
-                    (lambda p, x: gated_mlp(p, x, model_spec)) if has_shared else None
+                    (lambda p, x: shared_expert_mlp(p, x, act)) if has_shared else None
                 ),
             )
 
@@ -362,12 +367,14 @@ class DeepseekV3ModelBuilder(DecoderModelBuilder):
         }
         n_shared = getattr(cfg, "n_shared_experts", 0)
         if n_shared:
+            from neuronx_distributed_inference_tpu.modules.moe import (
+                shared_expert_shapes,
+            )
+
             Is = I * n_shared
-            shapes["shared_experts"] = {
-                "gate_proj": {"weight": (L, H, Is)},
-                "up_proj": {"weight": (L, H, Is)},
-                "down_proj": {"weight": (L, Is, H)},
-            }
+            shapes["shared_experts"] = shared_expert_shapes(
+                L, H, Is, bool(getattr(cfg.tpu_config, "fused_shared_experts", False))
+            )
         return shapes
 
     def param_shapes(self) -> Dict:
@@ -422,7 +429,13 @@ class DeepseekV3ModelBuilder(DecoderModelBuilder):
             },
         }
         if getattr(self.config, "n_shared_experts", 0):
-            moe_specs["shared_experts"] = dense_specs()
+            from neuronx_distributed_inference_tpu.modules.moe import (
+                shared_expert_pspecs,
+            )
+
+            moe_specs["shared_experts"] = shared_expert_pspecs(
+                bool(getattr(tc, "fused_shared_experts", False)), ffn
+            )
         groups = []
         if nd:
             groups.append(
@@ -599,6 +612,14 @@ class DeepseekV3ModelBuilder(DecoderModelBuilder):
                     "up_proj": {"weight": lt(p + "shared_experts.up_proj.weight")},
                     "down_proj": {"weight": lt(p + "shared_experts.down_proj.weight")},
                 }
+                if getattr(cfg.tpu_config, "fused_shared_experts", False):
+                    from neuronx_distributed_inference_tpu.modules.moe import (
+                        fuse_shared_expert_params,
+                    )
+
+                    out["shared_experts"] = fuse_shared_expert_params(
+                        out["shared_experts"]
+                    )
             return out
 
         def stack_group(layer_ids, mlp_fn_):

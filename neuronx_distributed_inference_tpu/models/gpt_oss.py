@@ -153,6 +153,7 @@ class GptOssModelBuilder(DecoderModelBuilder):
             swiglu_limit=float(getattr(cfg, "swiglu_limit", 7.0) or 7.0),
             capacity_factor=getattr(tc, "capacity_factor", None),
             ep_degree=tc.ep_degree,
+            hybrid_cte_full_tp=bool(getattr(tc, "hybrid_sharding_config", None)),
         )
 
     def mlp_fn(self):

@@ -45,7 +45,14 @@ from neuronx_distributed_inference_tpu.modules.kvcache import (
     read_cache_at_layer,
     update_cache_at_layer,
 )
-from neuronx_distributed_inference_tpu.modules.moe import MoESpec, moe_layer
+from neuronx_distributed_inference_tpu.modules.moe import (
+    MoESpec,
+    fuse_shared_expert_params,
+    moe_layer,
+    shared_expert_mlp,
+    shared_expert_pspecs,
+    shared_expert_shapes,
+)
 from neuronx_distributed_inference_tpu.modules.norm import rms_norm
 from neuronx_distributed_inference_tpu.modules.rope import apply_rope_interleaved
 from neuronx_distributed_inference_tpu.ops.quant import linear
@@ -243,15 +250,18 @@ class Llama4TextModelBuilder(DecoderModelBuilder):
             act=getattr(cfg, "hidden_act", "silu"),
             capacity_factor=getattr(tc, "capacity_factor", None),
             ep_degree=tc.ep_degree,
+            hybrid_cte_full_tp=bool(getattr(tc, "hybrid_sharding_config", None)),
         )
 
     def mlp_fn(self):
         mspec = self.moe_spec()
 
+        act = getattr(self.config, "hidden_act", "silu")
+
         def moe_mlp_fn(mlp_params, hidden, model_spec):
             return moe_layer(
                 mlp_params, hidden, mspec,
-                shared_mlp_fn=lambda p, x: gated_mlp(p, x, model_spec),
+                shared_mlp_fn=lambda p, x: shared_expert_mlp(p, x, act),
             )
 
         # fn_idx layout: 0/1 dense (rope/nope), 2/3 moe (rope/nope)
@@ -304,11 +314,10 @@ class Llama4TextModelBuilder(DecoderModelBuilder):
                     "up_proj": {"weight": (Lg, E, H, I)},
                     "down_proj": {"weight": (Lg, E, I, H)},
                 },
-                "shared_experts": {
-                    "gate_proj": {"weight": (Lg, H, I)},
-                    "up_proj": {"weight": (Lg, H, I)},
-                    "down_proj": {"weight": (Lg, I, H)},
-                },
+                "shared_experts": shared_expert_shapes(
+                    Lg, H, I,
+                    bool(getattr(cfg.tpu_config, "fused_shared_experts", False)),
+                ),
             }
         else:
             I = getattr(cfg, "intermediate_size_mlp", cfg.intermediate_size)
@@ -351,11 +360,10 @@ class Llama4TextModelBuilder(DecoderModelBuilder):
                     "up_proj": {"weight": P(None, "ep", None, ffn)},
                     "down_proj": {"weight": P(None, "ep", ffn, None)},
                 },
-                "shared_experts": {
-                    "gate_proj": {"weight": P(None, None, t)},
-                    "up_proj": {"weight": P(None, None, t)},
-                    "down_proj": {"weight": P(None, t, None)},
-                },
+                "shared_experts": shared_expert_pspecs(
+                    bool(getattr(self.config.tpu_config, "fused_shared_experts", False)),
+                    t,
+                ),
             }
         else:
             specs["mlp"] = {
@@ -432,6 +440,10 @@ class Llama4TextModelBuilder(DecoderModelBuilder):
                         "down_proj": {"weight": lt(f + "shared_expert.down_proj.weight")},
                     },
                 }
+                if getattr(cfg.tpu_config, "fused_shared_experts", False):
+                    out["mlp"]["shared_experts"] = fuse_shared_expert_params(
+                        out["mlp"]["shared_experts"]
+                    )
             else:
                 f = p + "feed_forward."
                 out["mlp"] = {

@@ -98,6 +98,7 @@ class MoEDecoderModelBuilder(DecoderModelBuilder):
             act_bias=float(getattr(tc, "hidden_act_bias", 0.0)),
             capacity_factor=getattr(tc, "capacity_factor", None),
             ep_degree=tc.ep_degree,
+            hybrid_cte_full_tp=bool(getattr(tc, "hybrid_sharding_config", None)),
         )
 
     def param_shapes(self) -> Dict:

@@ -335,6 +335,13 @@ def eagle_token_gen(
             # d + d2t[d] (the HF eagle3 checkpoint's d2t table)
             cur = (cur + d2t[cur]).astype(jnp.int32)
         if q is not None:
+            if d2t is not None:
+                # accept ratio compares against TARGET-vocab probabilities
+                from neuronx_distributed_inference_tpu.modules.token_tree import (
+                    q_to_target_vocab,
+                )
+
+                q = q_to_target_vocab(q, d2t, target_spec.vocab_size)
             draft_dists.append(q)
         prev_h = d_hidden[:, -1:, :]  # chain the draft's own feature
         pos = pos + 1

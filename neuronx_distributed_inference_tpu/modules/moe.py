@@ -24,7 +24,7 @@ Design:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -69,14 +69,25 @@ class MoESpec:
     # decode (S = 1..spec_len, any batch) stays dense (reference
     # moe_token_gen_all_experts)
     sparse_dispatch_threshold: int = 64
+    # hybrid CTE/TKG expert sharding (reference HybridShardingConfig,
+    # models/config.py:694 + moe_v2.py:135-144): decode keeps the persistent
+    # ep x tp expert layout; prefill-sized calls constrain the expert weights
+    # to FULL tensor parallel (moe_cte_ep=1) — GSPMD reshards them inside the
+    # prefill program, amortized over the prompt (per-phase weight layouts
+    # are a Neuron notion; on TPU one physical layout + an in-program
+    # constraint is the equivalent lever)
+    hybrid_cte_full_tp: bool = False
 
 
 def router_top_k(
     router_logits: jax.Array,  # (T, E) fp32
     spec: MoESpec,
     correction_bias: Optional[jax.Array] = None,  # (E,) DeepSeek-V3 e_score_correction_bias
-) -> jax.Array:
-    """Full (T, E) affinity matrix, zero outside the top-k
+) -> Tuple[jax.Array, jax.Array]:
+    """Full (T, E) affinity matrix, zero outside the top-k, plus the (T, E)
+    bool SELECTION mask derived from the top-k indices — selection must not
+    be inferred from affinity nonzero-ness (an underflowed-to-zero weight of
+    a selected expert would silently drop it; matters for biased experts)
     (reference RouterTopK semantics; sigmoid/group-limited variant =
     DeepSeek-V3 MoEGate noaux_tc, modeling_deepseek.py)."""
     T, E = router_logits.shape
@@ -92,7 +103,7 @@ def router_top_k(
         )
         weights = weigh(top_vals) * spec.routed_scaling_factor
         onehot = jax.nn.one_hot(top_idx, E, dtype=router_logits.dtype)
-        return jnp.einsum("tke,tk->te", onehot, weights)
+        return jnp.einsum("tke,tk->te", onehot, weights), onehot.sum(axis=1) > 0
     if spec.scoring_func == "sigmoid":
         scores = jax.nn.sigmoid(router_logits)
     else:
@@ -126,7 +137,7 @@ def router_top_k(
         weights = weights / (jnp.sum(weights, axis=-1, keepdims=True) + 1e-20)
     weights = weights * spec.routed_scaling_factor
     onehot = jax.nn.one_hot(top_idx, E, dtype=scores.dtype)  # (T, k, E)
-    return jnp.einsum("tke,tk->te", onehot, weights)  # (T, E)
+    return jnp.einsum("tke,tk->te", onehot, weights), onehot.sum(axis=1) > 0
 
 
 def _glu_fn(spec: MoESpec):
@@ -264,6 +275,7 @@ def expert_mlps_dense(
     x: jax.Array,  # (T, H)
     affinities: jax.Array,  # (T, E)
     spec: MoESpec,
+    selected: Optional[jax.Array] = None,  # (T, E) bool top-k selection
 ) -> jax.Array:
     """All-experts dense compute + affinity-weighted combine
     (reference moe_token_gen_all_experts kernel strategy, §2.10).
@@ -309,7 +321,11 @@ def expert_mlps_dense(
         g = expert_mm(params["gate_proj"], xe, "eth,ehi->eti")
         u = expert_mm(params["up_proj"], xe, "eth,ehi->eti")
         y = expert_mm(params["down_proj"], glu(g, u), "eti,eih->eth")
-        sel = (affinities != 0).astype(x.dtype)  # (T, E)
+        # combine over the SELECTED experts (top-k indices, not affinity
+        # nonzero-ness — an underflowed weight must not drop its expert)
+        sel = (
+            selected if selected is not None else (affinities != 0)
+        ).astype(x.dtype)  # (T, E)
         return jnp.einsum("te,eth->th", sel, y)
     g = expert_mm(params["gate_proj"], x, "th,ehi->eti")
     u = expert_mm(params["up_proj"], x, "th,ehi->eti")
@@ -337,9 +353,9 @@ def moe_layer(
     correction = params["router"].get("e_score_correction_bias")
     if correction is not None:
         correction = correction.astype(jnp.float32)
-    affinities = router_top_k(
+    affinities, selected = router_top_k(
         router_logits.astype(jnp.float32), spec, correction_bias=correction
-    )  # (T, E) fp32
+    )  # (T, E) fp32, (T, E) bool
     # dispatch strategy: decode (tiny T) and EP-sharded experts stay on the
     # dense all-experts path (reference moe_token_gen_all_experts); large-T
     # prefill takes a sparse dispatch — dropless grouped matmuls, or
@@ -351,20 +367,110 @@ def moe_layer(
     # stays dense-dropless by design (the reference's all-experts decode).
     # Unsupported capacity combinations (EP sharding, blockwise-quantized
     # experts) are rejected at config validation, not silently ignored.
+    prefill_sized = n_active >= spec.sparse_dispatch_threshold
+    expert_params = params["experts"]
+    if spec.hybrid_cte_full_tp and prefill_sized:
+        # hybrid sharding, prefill side: constrain the expert weights to full
+        # tensor parallel (ep folded into the ffn axes) — GSPMD inserts the
+        # reshard inside this (CTE-sized) program only; decode keeps the
+        # stored ep x tp layout untouched (reference HybridShardingConfig
+        # moe_cte_tp/ep, moe_v2.py:135-144)
+        from jax.sharding import PartitionSpec as P
+
+        from neuronx_distributed_inference_tpu.parallel.sharding import constrain
+
+        full = ("ep", "cp", "tp")
+
+        def _cte_constrain(entry, in_axis_last):
+            out = dict(entry)
+            w = entry["weight"]
+            spec_w = (
+                P(None, None, full) if in_axis_last else P(None, full, None)
+            )
+            out["weight"] = constrain(w, spec_w)
+            return out
+
+        expert_params = dict(expert_params)
+        expert_params["gate_proj"] = _cte_constrain(expert_params["gate_proj"], True)
+        expert_params["up_proj"] = _cte_constrain(expert_params["up_proj"], True)
+        expert_params["down_proj"] = _cte_constrain(expert_params["down_proj"], False)
+
     big_ratio = spec.num_experts >= 16 * spec.top_k or spec.capacity_factor is not None
+    # hybrid prefill is logically ep=1 (experts replicated over ep after the
+    # constraint), so the token-sorted sparse paths apply
+    ep_ok = spec.ep_degree == 1 or (spec.hybrid_cte_full_tp and prefill_sized)
     sparse_ok = (
-        n_active >= spec.sparse_dispatch_threshold
+        prefill_sized
         and big_ratio
-        and spec.ep_degree == 1
+        and ep_ok
         and spec.top_k < spec.num_experts
         and not _has_blockwise_scales(params["experts"])
     )
     if sparse_ok and spec.capacity_factor is not None:
-        out = expert_mlps_capacity(params["experts"], x, affinities, spec)
+        out = expert_mlps_capacity(expert_params, x, affinities, spec)
     elif sparse_ok:
-        out = expert_mlps_grouped(params["experts"], x, affinities, spec)
+        out = expert_mlps_grouped(expert_params, x, affinities, spec)
     else:
-        out = expert_mlps_dense(params["experts"], x, affinities, spec)
+        out = expert_mlps_dense(expert_params, x, affinities, spec, selected)
     if shared_mlp_fn is not None:
         out = out + shared_mlp_fn(params["shared_experts"], x)
     return out.reshape(B, S, H).astype(hidden.dtype)
+
+
+def shared_expert_shapes(L: int, H: int, I: int, fused: bool) -> dict:
+    """Shape tree for the shared expert under either layout (builders call
+    this so fused_shared_experts stays one switch)."""
+    if fused:
+        return {"gate_up_proj": {"weight": (L, H, 2 * I)}, "down_proj": {"weight": (L, I, H)}}
+    return {
+        "gate_proj": {"weight": (L, H, I)},
+        "up_proj": {"weight": (L, H, I)},
+        "down_proj": {"weight": (L, I, H)},
+    }
+
+
+def shared_expert_pspecs(fused: bool, tensor_axes):
+    from jax.sharding import PartitionSpec as P
+
+    if fused:
+        return {
+            "gate_up_proj": {"weight": P(None, None, tensor_axes)},
+            "down_proj": {"weight": P(None, tensor_axes, None)},
+        }
+    return {
+        "gate_proj": {"weight": P(None, None, tensor_axes)},
+        "up_proj": {"weight": P(None, None, tensor_axes)},
+        "down_proj": {"weight": P(None, tensor_axes, None)},
+    }
+
+
+def fuse_shared_expert_params(node: dict) -> dict:
+    """Separate gate/up -> fused gate_up (checkpoint conversion; reference
+    llama4 fused_shared_experts key concat, modeling_llama4_text.py:722)."""
+    return {
+        "gate_up_proj": {
+            "weight": jnp.concatenate(
+                [node["gate_proj"]["weight"], node["up_proj"]["weight"]], axis=-1
+            )
+        },
+        "down_proj": node["down_proj"],
+    }
+
+
+def shared_expert_mlp(params: dict, x: jax.Array, act_name: str = "silu") -> jax.Array:
+    """Shared-expert GLU MLP supporting BOTH weight layouts: separate
+    gate/up projections, or the FUSED gate_up projection
+    (config fused_shared_experts; reference SharedExperts
+    fused_gate_up_projection, moe_v2.py:90-101) — one column-parallel matmul
+    split in halves after."""
+    from neuronx_distributed_inference_tpu.models.base import act_fn
+    from neuronx_distributed_inference_tpu.ops.quant import linear
+
+    act = act_fn(act_name)
+    if "gate_up_proj" in params:
+        gu = linear(params["gate_up_proj"], x)
+        g, u = jnp.split(gu, 2, axis=-1)
+    else:
+        g = linear(params["gate_proj"], x)
+        u = linear(params["up_proj"], x)
+    return linear(params["down_proj"], act(g) * u)

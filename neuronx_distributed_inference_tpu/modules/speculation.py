@@ -97,10 +97,13 @@ def verify_and_accept(
     -> (tokens (B, k) zero-padded, counts (B,)). Shared by fused and EAGLE."""
     B, k = cand.shape
     if do_sample:
+        q = jnp.stack(draft_dists, axis=1)  # (B, k-1, V) TRUE-vocab dists
+        # drop any padded-vocab tail so p and q share one width (padded
+        # columns are -inf in tlogits, so nothing real is lost)
+        tl = tlogits[..., : q.shape[-1]]
         p = warped_probs(
-            tlogits.reshape(B * k, -1), jnp.repeat(sampling_params, k, axis=0), max_topk
+            tl.reshape(B * k, -1), jnp.repeat(sampling_params, k, axis=0), max_topk
         ).reshape(B, k, -1)
-        q = jnp.stack(draft_dists, axis=1)  # (B, k-1, V)
         return speculative_token_selection(cand, q, p, key)
     greedy = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (B, k) = g_0..g_{k-1}
     # contiguous-match acceptance (reference _tkg_postprocessor :2844):

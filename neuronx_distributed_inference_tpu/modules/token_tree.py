@@ -26,9 +26,13 @@ Design here:
   BOTH caches (reference cache_scatter_indices) so later rounds see the
   position==slot invariant.
 
-Greedy only: a chain-shaped tree reproduces chain-EAGLE (and therefore plain
-greedy decoding) bit-for-bit — the invariant the tests pin. Sampling trees
-are rejected at app construction.
+Verification: greedy (deepest contiguous argmax match — a chain-shaped tree
+reproduces chain-EAGLE and plain greedy decoding bit-for-bit, the invariant
+the tests pin) or SAMPLED (children drawn i.i.d. from the warped draft
+distribution; recursive rejection sampling walks the tree —
+:func:`sampled_tree_accept` — with an exact target-marginal guarantee).
+Dynamic trees remain greedy-only (their expansion selects by cumulative
+argmax log-prob).
 """
 
 from __future__ import annotations
@@ -139,6 +143,12 @@ class TokenTree:
             self.parent_local.append(np.asarray(pl, np.int32))
             self.child_rank.append(np.asarray(cr, np.int32))
         self.max_children = max((len(c) for c in kids), default=0)
+        # (N, max_children) child ids in rank order, -1 padded — the walk
+        # order of sampled-tree verification
+        self.children_table = np.full((N, max(self.max_children, 1)), -1, np.int32)
+        for n in range(N):
+            for r, c in enumerate(kids[n]):
+                self.children_table[n, r] = c
 
         # root-to-leaf paths (leaves may sit at different depths): (P, depth)
         # node ids padded with 0 beyond path_len; path_len excludes the root
@@ -248,6 +258,112 @@ def greedy_tree_accept(
     idx = jnp.arange(tree.depth + 1, dtype=jnp.int32)[None, :]
     tokens = jnp.where(idx < counts[:, None], toks, 0)
     return tokens, counts, best_nodes
+
+
+def sampled_tree_accept(
+    tree: TokenTree,
+    cand: jax.Array,  # (B, N) candidate token per node (target vocab)
+    tlogits: jax.Array,  # (B, N, V) target logits per node
+    q_nodes: jax.Array,  # (B, N, V) warped draft dist at each INTERNAL node
+    sampling_params: jax.Array,  # (B, 3)
+    key: jax.Array,
+    max_topk: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Multinomial tree verification: recursive rejection sampling over the
+    tree (SpecInfer-style multi-candidate accept/reject; reference chain
+    analogue _speculative_token_selection, model_base.py:1727-1797).
+
+    Children of a node were drawn i.i.d. from that node's warped draft
+    distribution q (see tree_token_gen's sampled expansion). The walk keeps a
+    residual target distribution p_res: at the current node, children are
+    tried in rank order — child token x accepts with prob
+    min(1, p_res(x)/q(x)); each rejection updates
+    p_res <- norm(relu(p_res - q)). On an accept the walk descends (p_res
+    resets to the child's warped target dist); when all children reject (or a
+    leaf is reached) the final token samples from p_res. The emitted-token
+    marginal equals sampling every token from the target (multi-candidate
+    spec-sampling theorem).
+
+    Returns (tokens (B, depth+1) zero-padded, counts (B,), best_nodes
+    (B, depth+1) accepted node sequence starting at the root).
+    """
+    from neuronx_distributed_inference_tpu.modules.sampling import warped_probs
+
+    # q distributions live on the TRUE target vocab; drop any padded-vocab
+    # tail from the target logits so p and q share one width
+    tlogits = tlogits[..., : q_nodes.shape[-1]]
+    B, N, V = tlogits.shape
+    mc = tree.children_table.shape[1]
+    p_warp = warped_probs(
+        tlogits.reshape(B * N, V),
+        jnp.repeat(sampling_params, N, axis=0),
+        max_topk,
+    ).reshape(B, N, V)
+    ctab = jnp.asarray(tree.children_table)  # (N, mc)
+
+    cur = jnp.zeros((B,), jnp.int32)
+    p_res = p_warp[:, 0]  # (B, V)
+    stopped = jnp.zeros((B,), bool)
+    counts = jnp.ones((B,), jnp.int32)
+    tok_out = jnp.zeros((B, tree.depth + 1), jnp.int32)
+    node_out = jnp.zeros((B, tree.depth + 1), jnp.int32)
+    bi = jnp.arange(B)
+
+    for d in range(tree.depth):
+        accepted = jnp.zeros((B,), bool)
+        next_cur = cur
+        tok_d = jnp.zeros((B,), jnp.int32)
+        q_cur = q_nodes[bi, cur]  # (B, V) draft dist at the current node
+        for r in range(mc):
+            key, ku = jax.random.split(key)
+            child = ctab[cur, r]  # (B,) -1 when absent
+            has = (child >= 0) & ~stopped & ~accepted
+            x = cand[bi, jnp.maximum(child, 0)]  # (B,)
+            px = p_res[bi, x]
+            qx = q_cur[bi, x]
+            u = jax.random.uniform(ku, (B,))
+            acc = has & (u * jnp.maximum(qx, 1e-20) < px)
+            next_cur = jnp.where(acc, child, next_cur)
+            tok_d = jnp.where(acc, x, tok_d)
+            accepted = accepted | acc
+            # rejection: subtract this node's draft dist from the residual
+            rej = has & ~acc
+            resid = jnp.maximum(p_res - q_cur, 0.0)
+            norm = jnp.sum(resid, axis=-1, keepdims=True)
+            resid = jnp.where(norm > 1e-20, resid / jnp.maximum(norm, 1e-20), p_res)
+            p_res = jnp.where(rej[:, None], resid, p_res)
+        # descend on accept: residual resets to the child's target dist
+        p_res = jnp.where(
+            accepted[:, None], p_warp[bi, jnp.maximum(next_cur, 0)], p_res
+        )
+        tok_out = tok_out.at[:, d].set(jnp.where(accepted, tok_d, 0))
+        node_out = node_out.at[:, d + 1].set(jnp.where(accepted, next_cur, 0))
+        counts = counts + accepted.astype(jnp.int32)
+        stopped = stopped | ~accepted
+        cur = next_cur
+
+    # final token (bonus on full walk, residual sample otherwise) lands at
+    # index counts-1
+    key, kf = jax.random.split(key)
+    final = jax.random.categorical(
+        kf, jnp.log(jnp.maximum(p_res, 1e-30)), axis=-1
+    ).astype(jnp.int32)
+    tok_out = tok_out.at[bi, counts - 1].set(final)
+    idx = jnp.arange(tree.depth + 1, dtype=jnp.int32)[None, :]
+    tok_out = jnp.where(idx < counts[:, None], tok_out, 0)
+    # node_out beyond counts holds zeros (the root) — fixup_cache_paths
+    # tolerates junk past the accepted count
+    return tok_out, counts, node_out
+
+
+def q_to_target_vocab(q_draft: jax.Array, d2t: jax.Array, target_vocab: int) -> jax.Array:
+    """Scatter a draft-vocab distribution onto the target vocab via the d2t
+    offset table (EAGLE3 reduced-vocab drafts): target id of draft d is
+    d + d2t[d]."""
+    Vd = q_draft.shape[-1]
+    tgt = jnp.arange(Vd, dtype=jnp.int32) + d2t[:Vd].astype(jnp.int32)
+    out = jnp.zeros(q_draft.shape[:-1] + (target_vocab,), q_draft.dtype)
+    return out.at[..., tgt].add(q_draft)
 
 
 class DynamicTokenTree:
@@ -509,9 +625,17 @@ def tree_token_gen(
     target_mlp_fn: Callable,
     target_capture_layers: Optional[Tuple[int, ...]] = None,
     draft_lm_hidden_fn: Optional[Callable] = None,
+    do_sample: bool = False,
+    max_topk: int = 256,
 ):
     """One fused tree-decode round (reference tree decode forward,
-    model_base.py:2143). Greedy only.
+    model_base.py:2143).
+
+    Greedy mode expands each node's top-k draft tokens and verifies by
+    deepest contiguous argmax match. Sampled mode (``do_sample``) draws each
+    node's children i.i.d. from the node's WARPED draft distribution and
+    verifies by recursive rejection sampling (:func:`sampled_tree_accept`) —
+    the emitted marginal equals sampling from the target.
 
     ``draft_hidden_fn(params, tokens, prev_hidden, cache, inputs, phase) ->
     (hidden (B, S, H), cache)`` — the EAGLE (or EAGLE3) draft forward; tree
@@ -519,7 +643,9 @@ def tree_token_gen(
     maps the chained hidden to the lm-head input (final draft norm).
 
     A ``d2t`` table in the draft params (reduced-vocab EAGLE3 drafts) maps
-    draft token ``d`` to target token ``d + d2t[d]``.
+    draft token ``d`` to target token ``d + d2t[d]``; in sampled mode the
+    draft q distribution is scattered onto the target vocab for the accept
+    ratio (:func:`q_to_target_vocab`).
     """
     from neuronx_distributed_inference_tpu.modules.eagle import EagleOutput
 
@@ -535,6 +661,9 @@ def tree_token_gen(
     cand = jnp.zeros((B, N), jnp.int32)
     cand = cand.at[:, 0].set(inputs.input_ids[:, 0])
     prev_h = hidden_buffer[slots][:, None, :]  # (B, 1, H*) root draft feature
+    q_nodes = (
+        jnp.zeros((B, N, target_spec.vocab_size), jnp.float32) if do_sample else None
+    )
 
     # ---- draft: one fixed-shape forward per level (all nodes of the level;
     # leaf levels run cache-fill only — their logits are unused) ------------
@@ -571,14 +700,42 @@ def tree_token_gen(
         dlogits = lm_head(draft_params, lm_h, draft_spec)[
             ..., : draft_spec.vocab_size
         ]
-        _, top = jax.lax.top_k(dlogits, tree.max_children)
-        top = top.astype(jnp.int32)
-        if d2t is not None:
-            top = top + d2t[top]  # draft vocab -> target vocab (EAGLE3)
         child_nodes = tree.levels[l + 1]
         pl = jnp.asarray(tree.parent_local[l])
         cr = jnp.asarray(tree.child_rank[l])
-        child_tok = top[:, pl, cr]  # (B, w_{l+1})
+        if do_sample:
+            # children drawn i.i.d. from the node's WARPED draft dist — the
+            # q that sampled_tree_accept's accept ratio assumes
+            from neuronx_distributed_inference_tpu.modules.sampling import (
+                warped_probs,
+            )
+
+            Vd = dlogits.shape[-1]
+            q_l = warped_probs(
+                dlogits.reshape(B * w, Vd), jnp.repeat(sp, w, axis=0), max_topk
+            ).reshape(B, w, Vd)
+            key, kl = jax.random.split(key)
+            draws = jax.random.categorical(
+                kl, jnp.log(jnp.maximum(q_l, 1e-30)),
+                shape=(tree.max_children, B, w),
+            ).astype(jnp.int32)  # (mc, B, w)
+            draws = jnp.transpose(draws, (1, 2, 0))  # (B, w, mc)
+            if d2t is not None:
+                q_t = q_to_target_vocab(q_l, d2t, target_spec.vocab_size)
+                draws = draws + d2t[draws]
+            else:
+                q_t = q_l
+            Vp = q_nodes.shape[-1]
+            if q_t.shape[-1] < Vp:
+                q_t = jnp.pad(q_t, ((0, 0), (0, 0), (0, Vp - q_t.shape[-1])))
+            q_nodes = q_nodes.at[:, node_arr].set(q_t)
+            child_tok = draws[:, pl, cr]  # (B, w_{l+1})
+        else:
+            _, top = jax.lax.top_k(dlogits, tree.max_children)
+            top = top.astype(jnp.int32)
+            if d2t is not None:
+                top = top + d2t[top]  # draft vocab -> target vocab (EAGLE3)
+            child_tok = top[:, pl, cr]  # (B, w_{l+1})
         cand = cand.at[:, jnp.asarray(child_nodes)].set(child_tok)
 
     # ---- target: verify all N nodes in one pass ---------------------------
@@ -598,7 +755,13 @@ def tree_token_gen(
         return_hidden=True, capture_layers=target_capture_layers,
     )
 
-    tokens, counts, best_nodes = greedy_tree_accept(tree, cand, tlogits)
+    if do_sample:
+        key, ka = jax.random.split(key)
+        tokens, counts, best_nodes = sampled_tree_accept(
+            tree, cand, tlogits, q_nodes, sp, ka, max_topk
+        )
+    else:
+        tokens, counts, best_nodes = greedy_tree_accept(tree, cand, tlogits)
 
     # ---- accepted-path KV to contiguous slots (both caches) ---------------
     kv_lines = slot_ids_from_seq_ids(

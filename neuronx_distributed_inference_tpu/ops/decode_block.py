@@ -1,0 +1,517 @@
+"""Fused decode-layer Pallas kernels: the attention BLOCK and the MLP BLOCK.
+
+TPU-native re-design of the reference's token-generation "mega" kernel
+(reference: modules/attention/attention_base.py:1609
+``attention_block_tokengen_nki_kernel`` — rmsnorm + fused-QKV + RoPE +
+attention + output projection in one kernel, with the K/V returned for an
+outside cache update when ``update_cache_in_kernel`` is off; plus the fused
+MLP kernels the reference pairs with it).
+
+Why: the bf16 decode step is HBM-bound; profiling (PERF.md) shows ~37 us/layer
+of overhead over the bandwidth ideal, split between small-op dispatch around
+the attention block (cache bucket read, norm/rope/scatter glue) and the
+gate/up/down MLP running as two XLA fusions. These kernels stream every weight
+tile exactly once through a single software pipeline per block, so the layer
+approaches the pure weight-DMA roofline.
+
+Design — one flat grid per batch row, phase-switched by step index:
+
+  ``fused_attn_block``: grid (B, nA + nkv + nC)
+    phase A (nA steps): rms-normed x @ W_qkv tile -> qkv accumulator (VMEM)
+    step nA: per-head RoPE + rep-major row relayout; ACTIVE (in-flight)
+      attention among the K new tokens; emits k_new/v_new for the cache
+      scatter OUTSIDE the kernel (reference update_cache_in_kernel=False)
+    phase B (nkv steps): online-softmax attention over PRIOR cache tiles
+      DMA'd straight from the full stacked cache (layer + row via scalar
+      prefetch); fully-masked tiles skipped
+    phase C (nC steps): finalized attention rows @ W_out tile + residual ->
+      output hidden tile
+
+  ``fused_mlp_block``: grid (B, nI)
+    each step streams one (H, TI) gate tile, one (H, TI) up tile and one
+    (TI, H) down tile: acc += act(norm(x) @ Wg_t) * (norm(x) @ Wu_t) @ Wd_t;
+    the last step writes x + acc.
+
+The prior-cache mask must EXCLUDE the slots being written this step (the
+cache scatter happens after the kernel; in-flight tokens are handled by the
+ACTIVE part) — the wrapper prunes columns [pos, pos+K) from the decode mask,
+the exact prior/active decomposition of the reference kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from neuronx_distributed_inference_tpu.ops.decode_attention import _mask_tiles
+
+try:  # pallas TPU backend
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def use_fused_attn_block(spec, q_len: int, kv_width: int) -> bool:
+    """Gate for the fused attention-block kernel (``spec`` is an AttnSpec).
+    Config flag semantics match the other kernels: None = auto on TPU,
+    True = force (still honoring shape guards), False = off."""
+    enabled = spec.use_fused_block
+    if enabled is False:
+        return False
+    ok = (
+        q_len <= 16
+        and spec.head_dim % 64 == 0
+        and not spec.qkv_bias
+        and not spec.o_bias
+        and not spec.qk_norm
+        and spec.qkv_clip is None
+        and not spec.has_sink
+        and kv_width >= 128
+        and kv_width % min(512, kv_width) == 0
+    )
+    if enabled:
+        return ok
+    # AUTO = OFF: measured on a v5e (PERF.md round 4), the fused block loses
+    # ~5% to the XLA-fused native path at bs=1 — per-grid-step pipeline
+    # overhead outweighs the DMA savings when XLA is already at 80-92% of
+    # the bandwidth roofline. The kernel stays available (force True) and
+    # fully parity/lowering-tested; revisit on hardware where XLA fuses
+    # worse or at batch sizes where the step count amortizes.
+    return False
+
+
+def _rms(x, gamma, eps):
+    """(K, H) f32 rmsnorm, matching modules/norm.rms_norm numerics."""
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + eps) * gamma
+
+
+def _rope_rows(x, cos, sin):
+    """Half-rotation RoPE on (R, D) rows with (R, D/2) cos/sin
+    (modules/rope.apply_rope convention)."""
+    d2 = x.shape[-1] // 2
+    x1 = x[:, :d2]
+    x2 = x[:, d2:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def _attn_block_kernel(
+    # scalar prefetch
+    li_ref,  # (1,) layer index
+    slots_ref,  # (B,) cache line per row
+    tile_any_ref,  # (B, nkv) tile-skip bits
+    # operands
+    x_ref,  # (1, K, H) residual-stream input
+    gamma_ref,  # (1, H)
+    wqkv_ref,  # (H, TA) tile
+    cos_ref,  # (1, K, D/2)
+    sin_ref,  # (1, K, D/2)
+    mask_ref,  # (1, 1, K, bs) pruned prior-mask tile
+    k_ref,  # (1, 1, bs, Hkv, D) prior cache tile
+    v_ref,
+    wout_ref,  # (HqD, TC) tile
+    # outputs
+    o_ref,  # (1, K, H) hidden out (residual included)
+    knew_ref,  # (1, K, Hkv, D) rope'd new K for the outside cache scatter
+    vnew_ref,  # (1, K, Hkv, D)
+    # scratch
+    normed_scr,  # (K, H) f32
+    qkv_scr,  # (K, N3) f32
+    rows_scr,  # ((Hq+2Hkv)*K, D) f32 rep-major rows
+    m_scr,  # (Hq*K, 1)
+    l_scr,
+    acc_scr,  # (Hq*K, D)
+    attn_scr,  # (K, Hq*D) f32 finalized attention (t-major)
+    *,
+    scale: float,
+    eps: float,
+    K: int,
+    Hq: int,
+    Hkv: int,
+    D: int,
+    TA: int,
+    TC: int,
+    nA: int,
+    nkv: int,
+    nC: int,
+    bs: int,
+):
+    b = pl.program_id(0)
+    i = pl.program_id(1)
+    n_rep = Hq // Hkv
+    rk = n_rep * K
+    HqK = Hq * K
+
+    @pl.when(i == 0)
+    def _init():
+        x = x_ref[0].astype(jnp.float32)  # (K, H)
+        normed_scr[:] = _rms(x, gamma_ref[0].astype(jnp.float32), eps)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # ---- phase A: QKV projection tile ----------------------------------
+    @pl.when(i < nA)
+    def _qkv():
+        t = (
+            jax.lax.dot_general(
+                normed_scr[:].astype(wqkv_ref.dtype),
+                wqkv_ref[:],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        )  # (K, TA)
+        qkv_scr[:, pl.ds(i * TA, TA)] = t
+
+    # ---- step nA: rope + row relayout + new-KV emit + ACTIVE attention --
+    @pl.when(i == nA)
+    def _rope_active():
+        cos = cos_ref[0].astype(jnp.float32)  # (K, D/2)
+        sin = sin_ref[0].astype(jnp.float32)
+        # rep-major q rows (row h*K + t) + rope'd k rows + v rows
+        for h in range(Hq):
+            qh = qkv_scr[:, h * D : (h + 1) * D]  # (K, D)
+            rows_scr[h * K : (h + 1) * K, :] = _rope_rows(qh, cos, sin)
+        for h in range(Hkv):
+            kh = qkv_scr[:, (Hq + h) * D : (Hq + h + 1) * D]
+            rows_scr[HqK + h * K : HqK + (h + 1) * K, :] = _rope_rows(kh, cos, sin)
+        for h in range(Hkv):
+            vh = qkv_scr[:, (Hq + Hkv + h) * D : (Hq + Hkv + h + 1) * D]
+            rows_scr[HqK + Hkv * K + h * K : HqK + Hkv * K + (h + 1) * K, :] = vh
+        # emit new K/V (the caller scatters them into the cache)
+        for h in range(Hkv):
+            knew_ref[0, :, h, :] = rows_scr[HqK + h * K : HqK + (h + 1) * K, :].astype(
+                knew_ref.dtype
+            )
+            vnew_ref[0, :, h, :] = rows_scr[
+                HqK + Hkv * K + h * K : HqK + Hkv * K + (h + 1) * K, :
+            ].astype(vnew_ref.dtype)
+        # active (in-flight) attention among the K new tokens, causal in t
+        tri = (
+            jax.lax.broadcasted_iota(jnp.int32, (rk, K), 0) % K
+            >= jax.lax.broadcasted_iota(jnp.int32, (rk, K), 1)
+        )
+        for g in range(Hkv):
+            rows = slice(g * rk, (g + 1) * rk)
+            q = rows_scr[rows, :]
+            k = rows_scr[HqK + g * K : HqK + (g + 1) * K, :]  # (K, D)
+            v = rows_scr[HqK + Hkv * K + g * K : HqK + Hkv * K + (g + 1) * K, :]
+            s = (
+                jax.lax.dot_general(
+                    q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+                )
+                * scale
+            )  # (rk, K)
+            s = jnp.where(tri, s, NEG_INF)
+            m_prev = m_scr[rows, :]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.where(tri, jnp.exp(s - m_new), 0.0)
+            alpha = jnp.exp(m_prev - m_new)
+            l_scr[rows, :] = l_scr[rows, :] * alpha + jnp.sum(p, axis=1, keepdims=True)
+            acc_scr[rows, :] = acc_scr[rows, :] * alpha + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            )
+            m_scr[rows, :] = m_new
+
+    # ---- phase B: prior-cache attention tiles ---------------------------
+    j = jnp.clip(i - nA, 0, nkv - 1)
+
+    @pl.when((i >= nA) & (i < nA + nkv) & (tile_any_ref[b, j] > 0))
+    def _prior():
+        k_all = k_ref[0, 0].astype(jnp.float32)  # (bs, Hkv, D)
+        v_all = v_ref[0, 0].astype(jnp.float32)
+        mt = mask_ref[0, 0] > 0  # (K, bs)
+        row_mask = jnp.repeat(mt[None], n_rep, axis=0).reshape(rk, bs)
+        for g in range(Hkv):
+            rows = slice(g * rk, (g + 1) * rk)
+            q = rows_scr[rows, :]
+            s = (
+                jax.lax.dot_general(
+                    q,
+                    k_all[:, g, :],
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * scale
+            )  # (rk, bs)
+            s = jnp.where(row_mask, s, NEG_INF)
+            m_prev = m_scr[rows, :]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.where(row_mask, jnp.exp(s - m_new), 0.0)
+            alpha = jnp.exp(m_prev - m_new)
+            l_scr[rows, :] = l_scr[rows, :] * alpha + jnp.sum(p, axis=1, keepdims=True)
+            acc_scr[rows, :] = acc_scr[rows, :] * alpha + jax.lax.dot_general(
+                p,
+                v_all[:, g, :],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_scr[rows, :] = m_new
+
+    # ---- phase C: finalize + output projection + residual ---------------
+    @pl.when(i == nA + nkv)
+    def _finalize():
+        denom = jnp.maximum(l_scr[:], 1e-30)
+        out_rows = acc_scr[:] / denom  # (HqK, D)
+        for h in range(Hq):
+            attn_scr[:, h * D : (h + 1) * D] = out_rows[h * K : (h + 1) * K, :]
+
+    @pl.when(i >= nA + nkv)
+    def _oproj():
+        cc = jnp.clip(i - nA - nkv, 0, nC - 1)
+        t = jax.lax.dot_general(
+            attn_scr[:].astype(wout_ref.dtype),
+            wout_ref[:],
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (K, TC)
+        resid = x_ref[0, :, pl.ds(cc * TC, TC)].astype(jnp.float32)
+        o_ref[0, :, pl.ds(cc * TC, TC)] = (resid + t).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("scale", "eps", "n_kv", "bs", "interpret"),
+)
+def fused_attn_block(
+    x: jax.Array,  # (B, K, H) residual-stream input (pre-norm)
+    gamma: jax.Array,  # (H,) input_layernorm weight
+    wqkv: jax.Array,  # (H, (Hq+2Hkv)*D) fused QKV weight
+    wout: jax.Array,  # (Hq*D, H) output projection weight
+    cos: jax.Array,  # (B, K, D/2)
+    sin: jax.Array,
+    k_cache: jax.Array,  # (L, R, S_max, Hkv, D) FULL stacked cache
+    v_cache: jax.Array,
+    layer_idx: jax.Array,  # int32 scalar
+    slot_ids: jax.Array,  # (B,) cache line per row
+    mask: jax.Array,  # (B, 1, K, S_kv) decode mask INCLUDING current slots
+    positions: jax.Array,  # (B, K) absolute positions of the new tokens
+    *,
+    scale: float,
+    eps: float,
+    n_kv: int,
+    bs: int = 512,
+    interpret: bool = False,
+):
+    """Fused decode attention block. Returns (hidden (B,K,H) with residual
+    added, k_new (B,K,Hkv,D), v_new (B,K,Hkv,D)); the caller scatters
+    k_new/v_new into the cache (reference update_cache_in_kernel=False)."""
+    B, K, H = x.shape
+    Hkv = n_kv
+    D = k_cache.shape[-1]
+    N3 = wqkv.shape[1]
+    Hq = N3 // D - 2 * Hkv
+    HqD = Hq * D
+    S_kv = mask.shape[-1]
+    bs = min(bs, S_kv)
+    nkv = S_kv // bs
+
+    # tile widths trade per-step pipeline overhead against the ~16M
+    # scoped-VMEM budget (TA=TC=512 at 1B shapes measured 16.27M — over);
+    # TA=256/TC=512 keeps the big operand windows at 1M/2M double-buffered
+    TA = min(256, N3)
+    while N3 % TA:
+        TA //= 2
+    nA = N3 // TA
+    TC = min(512, H)
+    while H % TC:
+        TC //= 2
+    nC = H // TC
+
+    # prune the slots being written this step from the prior mask: the cache
+    # scatter happens AFTER the kernel; the ACTIVE part covers those tokens
+    cols = jnp.arange(S_kv, dtype=jnp.int32)[None, None, None, :]
+    p0 = positions[:, 0][:, None, None, None]
+    pruned = mask & ~((cols >= p0) & (cols < p0 + K))
+    m, tile_any = _mask_tiles(pruned, nkv, bs)
+
+    li = jnp.reshape(layer_idx, (1,)).astype(jnp.int32)
+    kernel = functools.partial(
+        _attn_block_kernel,
+        scale=scale, eps=eps, K=K, Hq=Hq, Hkv=Hkv, D=D,
+        TA=TA, TC=TC, nA=nA, nkv=nkv, nC=nC, bs=bs,
+    )
+    steps = nA + nkv + nC
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, steps),
+        in_specs=[
+            pl.BlockSpec((1, K, H), lambda b, i, li, sl, ta: (b, 0, 0)),
+            pl.BlockSpec((1, H), lambda b, i, li, sl, ta: (0, 0)),
+            pl.BlockSpec(
+                (H, TA),
+                lambda b, i, li, sl, ta, nA=nA: (0, jnp.clip(i, 0, nA - 1)),
+            ),
+            pl.BlockSpec((1, K, D // 2), lambda b, i, li, sl, ta: (b, 0, 0)),
+            pl.BlockSpec((1, K, D // 2), lambda b, i, li, sl, ta: (b, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, K, bs),
+                lambda b, i, li, sl, ta, nA=nA, nkv=nkv: (
+                    b, jnp.clip(i - nA, 0, nkv - 1), 0, 0,
+                ),
+            ),
+            pl.BlockSpec(
+                (1, 1, bs, Hkv, D),
+                lambda b, i, li, sl, ta, nA=nA, nkv=nkv: (
+                    li[0], sl[b], jnp.clip(i - nA, 0, nkv - 1), 0, 0,
+                ),
+            ),
+            pl.BlockSpec(
+                (1, 1, bs, Hkv, D),
+                lambda b, i, li, sl, ta, nA=nA, nkv=nkv: (
+                    li[0], sl[b], jnp.clip(i - nA, 0, nkv - 1), 0, 0,
+                ),
+            ),
+            pl.BlockSpec(
+                (HqD, TC),
+                lambda b, i, li, sl, ta, nA=nA, nkv=nkv, nC=nC: (
+                    0, jnp.clip(i - nA - nkv, 0, nC - 1),
+                ),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, K, H), lambda b, i, li, sl, ta: (b, 0, 0)),
+            pl.BlockSpec((1, K, Hkv, D), lambda b, i, li, sl, ta: (b, 0, 0, 0)),
+            pl.BlockSpec((1, K, Hkv, D), lambda b, i, li, sl, ta: (b, 0, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((K, H), jnp.float32),
+            pltpu.VMEM((K, N3), jnp.float32),
+            pltpu.VMEM(((Hq + 2 * Hkv) * K, D), jnp.float32),
+            pltpu.VMEM((Hq * K, 1), jnp.float32),
+            pltpu.VMEM((Hq * K, 1), jnp.float32),
+            pltpu.VMEM((Hq * K, D), jnp.float32),
+            pltpu.VMEM((K, HqD), jnp.float32),
+        ],
+    )
+    out, k_new, v_new = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, K, H), x.dtype),
+            jax.ShapeDtypeStruct((B, K, Hkv, D), x.dtype),
+            jax.ShapeDtypeStruct((B, K, Hkv, D), x.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(
+        li,
+        slot_ids.astype(jnp.int32),
+        tile_any,
+        x,
+        gamma.reshape(1, H),
+        wqkv,
+        cos,
+        sin,
+        m,
+        k_cache,
+        v_cache,
+        wout,
+    )
+    return out, k_new, v_new
+
+
+# ---------------------------------------------------------------------------
+# fused MLP block
+# ---------------------------------------------------------------------------
+
+
+def _mlp_kernel(
+    x_ref,  # (1, K, H)
+    gamma_ref,  # (1, H)
+    wg_ref,  # (H, TI)
+    wu_ref,  # (H, TI)
+    wd_ref,  # (TI, H)
+    o_ref,  # (1, K, H)
+    normed_scr,  # (K, H) f32
+    acc_scr,  # (K, H) f32
+    *,
+    eps: float,
+    nI: int,
+    act: str,
+):
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        x = x_ref[0].astype(jnp.float32)
+        normed_scr[:] = _rms(x, gamma_ref[0].astype(jnp.float32), eps)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    normed = normed_scr[:].astype(wg_ref.dtype)
+    g = jax.lax.dot_general(
+        normed, wg_ref[:], (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    u = jax.lax.dot_general(
+        normed, wu_ref[:], (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    if act == "silu":
+        a = jax.nn.silu(g) * u
+    else:  # "gelu" / "gelu_pytorch_tanh" — models/base.act_fn maps BOTH to
+        # the tanh approximation (jax.nn.gelu's default); the fused path must
+        # match the native numerics exactly
+        a = jax.nn.gelu(g, approximate=True) * u
+    acc_scr[:] += jax.lax.dot_general(
+        a.astype(wd_ref.dtype),
+        wd_ref[:],
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == nI - 1)
+    def _fin():
+        o_ref[0] = (x_ref[0].astype(jnp.float32) + acc_scr[:]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "act", "interpret"))
+def fused_mlp_block(
+    x: jax.Array,  # (B, K, H) residual-stream input (pre-norm)
+    gamma: jax.Array,  # (H,) post_attention_layernorm weight
+    w_gate: jax.Array,  # (H, I)
+    w_up: jax.Array,  # (H, I)
+    w_down: jax.Array,  # (I, H)
+    *,
+    eps: float,
+    act: str = "silu",
+    interpret: bool = False,
+):
+    """Fused gated-MLP block for decode: returns x + down(act(gate) * up) of
+    the rms-normed input, streaming each weight tile exactly once."""
+    B, K, H = x.shape
+    I = w_gate.shape[1]
+    # the MLP kernel is its own pallas_call with its own VMEM budget: three
+    # (·, TI) streams at TI=512 double-buffer to ~12M and halve the step
+    # count (per-step pipeline overhead is the cost driver at K=1)
+    TI = min(512, I)
+    while I % TI:
+        TI //= 2
+    nI = I // TI
+    kernel = functools.partial(_mlp_kernel, eps=eps, nI=nI, act=act)
+    return pl.pallas_call(
+        kernel,
+        grid=(B, nI),
+        in_specs=[
+            pl.BlockSpec((1, K, H), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, H), lambda b, i: (0, 0)),
+            pl.BlockSpec((H, TI), lambda b, i: (0, i)),
+            pl.BlockSpec((H, TI), lambda b, i: (0, i)),
+            pl.BlockSpec((TI, H), lambda b, i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, K, H), lambda b, i: (b, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, K, H), x.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((K, H), jnp.float32),
+            pltpu.VMEM((K, H), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(x, gamma.reshape(1, H), w_gate, w_up, w_down)

@@ -5,9 +5,15 @@ separate draft application (utils/hf_adapter.py:427-607) — the draft and
 target are compiled independently (no fused graph), the host orchestrates
 propose -> verify -> accept.
 
-Greedy verification: the emitted sequence is byte-equal to the target's own
-greedy decoding (every emitted token is a target argmax), so a wrong draft
-only costs speed, never correctness.
+Verification modes (reference _speculative_token_selection,
+model_base.py:1727-1797):
+- greedy (default): contiguous argmax matching — the emitted sequence is
+  byte-equal to the target's own greedy decoding.
+- sampled: multinomial accept/reject — draft token d accepted with prob
+  min(1, p(d)/q(d)), residual-resampled at the first rejection; the emitted
+  marginal equals sampling from the target directly (spec-sampling theorem).
+  Requires both apps loaded with do_sample on-device sampling AND
+  output_logits=True (the host needs p and q).
 """
 
 from __future__ import annotations
@@ -15,10 +21,16 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from neuronx_distributed_inference_tpu.modules.autobucketing import get_target_bucket
-from neuronx_distributed_inference_tpu.modules.sampling import prepare_sampling_params
+from neuronx_distributed_inference_tpu.modules.sampling import (
+    prepare_sampling_params,
+    validate_sampling_params,
+    warped_probs,
+)
+from neuronx_distributed_inference_tpu.modules.speculation import verify_and_accept
 from neuronx_distributed_inference_tpu.runtime.application import (
     GenerationOutput,
     TpuModelForCausalLM,
@@ -33,16 +45,20 @@ def assisted_generate(
     max_new_tokens: int = 32,
     eos_token_id: Optional[int] = None,
     speculation_length: Optional[int] = None,
+    top_k=None,
+    top_p=None,
+    temperature=None,
 ) -> GenerationOutput:
-    """Draft-assisted greedy generation (reference hf_adapter.py:427).
+    """Draft-assisted generation (reference hf_adapter.py:427).
 
     ``target`` and ``draft`` are independently loaded apps sharing a
-    tokenizer/vocab. Each round: the draft proposes k-1 greedy tokens with
-    k-1 single-token decodes, the target verifies all k candidates in ONE
+    tokenizer/vocab. Each round: the draft proposes k-1 tokens with k-1
+    single-token decodes, the target verifies all k candidates in ONE
     multi-token pass (PHASE_TOKEN_GENERATION with n_active=k), and the
-    contiguous prefix matching the target's argmax is accepted plus one bonus
-    token. Cache discipline is write-then-attend on both sides, so rejected
-    candidates leave only masked-stale entries that later writes overwrite.
+    accepted prefix (greedy contiguous match, or multinomial accept/reject
+    when both apps sample) is emitted plus one bonus/residual token. Cache
+    discipline is write-then-attend on both sides, so rejected candidates
+    leave only masked-stale entries that later writes overwrite.
     """
     k = speculation_length or max(target.config.tpu_config.speculation_length, 2)
     if k < 2:
@@ -50,17 +66,34 @@ def assisted_generate(
     if target.spec.bounded_window or draft.spec.bounded_window:
         raise NotImplementedError(
             "assisted decoding over a ring-bounded sliding-window cache is "
-            "not implemented (rejected speculative writes would corrupt ring "
-            "slots); disable the window bound or use plain decoding"
+            "not implemented (a REJECTED speculative write at position p+j "
+            "lands in ring slot (p+j) %% W, overwriting the still-live KV of "
+            "position p+j-W — unrecoverable without cache snapshots); "
+            "disable the window bound or use plain decoding"
         )
     tc = target.config.tpu_config
+    do_sample = bool(target.spec.do_sample)
+    if do_sample:
+        if not (target.spec.output_logits and draft.spec.output_logits):
+            raise ValueError(
+                "sampled assisted decoding needs output_logits=True on both "
+                "apps (the host computes the p/q accept ratio)"
+            )
+        if not draft.spec.do_sample:
+            raise ValueError(
+                "sampled assisted decoding needs the draft app loaded with "
+                "do_sample on-device sampling (proposals must be drawn from "
+                "the warped draft distribution q)"
+            )
     input_ids = np.asarray(input_ids)
     B, S_in = input_ids.shape
     if attention_mask is None:
         attention_mask = np.ones_like(input_ids)
     attention_mask = np.asarray(attention_mask)
     seq_ids = np.arange(B, dtype=np.int32)
-    sp = prepare_sampling_params(B)
+    sp = prepare_sampling_params(B, top_k, top_p, temperature)
+    validate_sampling_params(sp, tc.max_topk)
+    accept_key = jax.random.PRNGKey(tc.seed + 7919)
 
     # --- prefill both apps on the prompt ---
     ctx_lens = attention_mask.sum(axis=1).astype(np.int32)
@@ -68,7 +101,10 @@ def assisted_generate(
     t_inputs, _ = target.context_encoding_model.prepare(
         input_ids, attention_mask, position_ids, seq_ids, sp
     )
-    t_out = target.context_encoding_model(target.params, target.kv_cache, t_inputs)
+    cte_key = jax.random.PRNGKey(tc.seed + 104729) if do_sample else None
+    t_out = target.context_encoding_model(
+        target.params, target.kv_cache, t_inputs, cte_key
+    )
     target.kv_cache = t_out.cache
     d_inputs, _ = draft.context_encoding_model.prepare(
         input_ids, attention_mask, position_ids, seq_ids, sp
@@ -88,16 +124,20 @@ def assisted_generate(
     last = first.astype(np.int32)
 
     tkg = target.token_generation_model
+    draft_key = jax.random.PRNGKey(tc.seed + 15485863) if do_sample else None
+    rnd = 0
     while not done.all() and int(pos.max()) + k <= tc.seq_len and not all(
         len(c) >= max_new_tokens for c in collected
     ):
-        # --- draft proposes k-1 greedy tokens (k-1 single-token decodes) ---
+        rnd += 1
+        # --- draft proposes k-1 tokens (k-1 single-token decodes) ---
         bucket = get_target_bucket(
             draft.token_generation_model.buckets, int(pos.max()) + k
         )
-        d_tokens, _, d_cache = draft.token_generation_model.decode_chunk(
+        step_key = jax.random.fold_in(draft_key, rnd) if do_sample else None
+        d_tokens, d_logits, d_cache = draft.token_generation_model.decode_chunk(
             draft.params, draft.kv_cache, last[:, None], pos[:, None], seq_ids, sp,
-            None, num_steps=k - 1, bucket=bucket,
+            step_key, num_steps=k - 1, bucket=bucket,
         )
         draft.kv_cache = d_cache
         proposals = np.asarray(jax.device_get(d_tokens))[:B]  # (B, k-1)
@@ -110,16 +150,31 @@ def assisted_generate(
         v_inputs, _ = tkg.prepare(cand, cache_mask, cand_pos, seq_ids, sp)
         v_out = tkg(target.params, target.kv_cache, v_inputs)
         target.kv_cache = v_out.cache
-        greedy = np.asarray(jax.device_get(v_out.tokens))[:B]  # (B, k)
 
-        # --- contiguous-match acceptance ---
-        matches = (cand[:, 1:] == greedy[:, :-1]).astype(np.int64)
-        accepted = np.cumprod(matches, axis=1).sum(axis=1)  # (B,) in [0, k-1]
-        counts = accepted + 1
+        if do_sample:
+            # multinomial accept/reject on the warped p/q distributions
+            # (reference _speculative_token_selection, model_base.py:1727)
+            tlogits = jnp.asarray(jax.device_get(v_out.logits))[:B]  # (B, k, V)
+            dlog = jnp.asarray(jax.device_get(d_logits))[:B]  # (B, k-1, V)
+            spj = jnp.asarray(sp)
+            draft_dists = [
+                warped_probs(dlog[:, i], spj, tc.max_topk) for i in range(k - 1)
+            ]
+            accept_key, sub = jax.random.split(accept_key)
+            toks_j, counts_j = verify_and_accept(
+                jnp.asarray(cand), tlogits, draft_dists, spj, sub, True, tc.max_topk
+            )
+            toks = np.asarray(toks_j)
+            counts = np.asarray(counts_j).astype(np.int64)
+        else:
+            # contiguous-match acceptance against the target argmax
+            toks = np.asarray(jax.device_get(v_out.tokens))[:B]  # (B, k)
+            matches = (cand[:, 1:] == toks[:, :-1]).astype(np.int64)
+            counts = np.cumprod(matches, axis=1).sum(axis=1) + 1  # in [1, k]
         for b in range(B):
             if done[b]:
                 continue
-            row = greedy[b, : counts[b]].tolist()
+            row = toks[b, : counts[b]].tolist()
             if eos_arr is not None:
                 hits = [i for i, t in enumerate(row) if t in eos_arr]
                 if hits:
@@ -128,7 +183,7 @@ def assisted_generate(
             collected[b].extend(row)
             if len(collected[b]) >= max_new_tokens:
                 done[b] = True
-        last = greedy[np.arange(B), counts - 1].astype(np.int32)
+        last = toks[np.arange(B), counts - 1].astype(np.int32)
         pos = pos + counts.astype(np.int32)
 
     n_new = min(max_new_tokens, max(len(c) for c in collected))

@@ -299,8 +299,9 @@ class TpuEagleSpecModelForCausalLM(_SpecAppBase):
 
     With ``tpu_config.token_tree_config`` set, decode rounds expand a static
     candidate TREE instead of a chain (modules/token_tree.py; reference
-    eagle/token_tree.py + tree decode forward model_base.py:2143).
-    Tree mode is greedy-only.
+    eagle/token_tree.py + tree decode forward model_base.py:2143). Static
+    trees support greedy AND sampled verification (recursive rejection
+    sampling); dynamic trees are greedy-only.
     """
 
     def __init__(self, model_path, config, draft_model_path=None, mesh=None):
@@ -308,14 +309,6 @@ class TpuEagleSpecModelForCausalLM(_SpecAppBase):
         if not tc.enable_eagle_speculation:
             raise ValueError("set tpu_config.enable_eagle_speculation=True")
         super().__init__(model_path, config, draft_model_path, mesh)
-        if (
-            self.do_sample
-            and getattr(self.draft_config, "draft_vocab_size", None)
-        ):
-            raise NotImplementedError(
-                "reduced-vocab (d2t) EAGLE3 drafts are greedy-only: the "
-                "accept/reject q distribution lives in draft-vocab space"
-            )
 
     def _make_fns(self):
         tc = self.config.tpu_config
@@ -332,11 +325,6 @@ class TpuEagleSpecModelForCausalLM(_SpecAppBase):
                 tree_token_gen,
             )
 
-            if self.do_sample:
-                raise NotImplementedError(
-                    "token-tree speculation is greedy-only (reference static "
-                    "trees verify greedily); disable do_sample"
-                )
             ts = self.target_spec
             if (
                 ts.layer_groups is not None
@@ -364,6 +352,12 @@ class TpuEagleSpecModelForCausalLM(_SpecAppBase):
                 draft_lm_hidden_fn=self._draft_lm_hidden_fn(),
             )
             if dynamic:
+                if self.do_sample:
+                    raise NotImplementedError(
+                        "dynamic-tree speculation is greedy-only (the "
+                        "cumulative-log-prob expansion selects by argmax); "
+                        "use a static token tree for sampled tree decoding"
+                    )
                 self.tree = DynamicTokenTree(tc.token_tree_config)
                 self._tkg_fn = jax.jit(
                     partial(dynamic_tree_token_gen, dyn=self.tree, **common),
@@ -372,7 +366,11 @@ class TpuEagleSpecModelForCausalLM(_SpecAppBase):
             else:
                 self.tree = TokenTree(tc.token_tree_config)
                 self._tkg_fn = jax.jit(
-                    partial(tree_token_gen, tree=self.tree, **common),
+                    partial(
+                        tree_token_gen, tree=self.tree,
+                        do_sample=self.do_sample, max_topk=tc.max_topk,
+                        **common,
+                    ),
                     donate_argnums=(2, 3, 4),
                 )
             self.reserve_slots = self.tree.num_nodes

@@ -36,6 +36,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+import jax
 import numpy as np
 
 from neuronx_distributed_inference_tpu.modules.autobucketing import (
@@ -153,15 +154,40 @@ class ServingSession:
         self.requests.pop(req.req_id, None)
 
     def _full_prefill(self, req: Request) -> bool:
-        """Whole-prompt context encoding (flash-kernel eligible CTE path)."""
+        """Whole-prompt context encoding (flash-kernel eligible CTE path).
+
+        Prompts longer than a ring-bounded window (or the largest CTE
+        program) run through the app's windowed prefill instead — chunk 0 via
+        the CTE program, later chunks as multi-token prior-KV passes
+        (application._windowed_prefill; reference windowed context encoding,
+        model_base.py:957-1010). Other live rows are untouched: padded rows
+        carry seq_id -1 (garbage line) and sentinel positions drop their
+        writes.
+        """
         S = req.prompt_len
         W = self.app.spec.bounded_window
-        if W and S > W:
-            raise NotImplementedError(
-                f"serving a prompt of {S} tokens over a ring-bounded cache "
-                f"(W={W}) needs chunked prefill; generate() handles this via "
-                f"windowed prefill — serving support is a follow-up"
+        ring_w = W or self.app.spec.ring_window
+        cte_max = self.app.context_encoding_model.buckets[-1]
+        if ((ring_w and S > ring_w) or S > cte_max) and self.block_mode:
+            # the contiguous-cache windowed prefill cannot write a paged
+            # cache (no slot mapping, no block reservation)
+            raise ValueError(
+                f"prompt of {S} tokens exceeds the largest context program "
+                f"({cte_max}) on a paged cache: enable chunked prefill "
+                "(is_chunked_prefill) to admit long prompts"
             )
+        if (ring_w and S > ring_w) or S > cte_max:
+            self.app.validate_prefill_length(S)
+            first_tok, _ = self.app._windowed_prefill(
+                req.input_ids[None, :],
+                np.ones((1, S), np.int32),
+                np.array([req.slot], np.int32),
+                prepare_sampling_params(1),
+                None,
+            )
+            req.prefill_pos = S
+            self._finish_prefill(req, int(np.asarray(jax.device_get(first_tok))[0, 0]))
+            return True
         ids = req.input_ids[None, :]
         mask = np.ones((1, S), np.int32)
         pos = np.arange(S, dtype=np.int32)[None, :]
@@ -289,7 +315,18 @@ class ServingSession:
     def step(self) -> Dict[str, int]:
         """Advance the session: one chunked-prefill pass (if pending) + one
         decode step for every decoding request. Returns {req_id: token} for
-        tokens produced this step."""
+        tokens produced this step.
+
+        Async 1-ahead semantics (``async_mode=True``, the default): decode
+        results are consumed one step() LATE — a request's first decode token
+        appears on the step() AFTER the one that dispatched it, and its final
+        token/termination is observed on the following step()'s consume
+        (each terminating request runs one extra speculative device step
+        whose writes land in masked slots and whose token is discarded).
+        Per-step-latency-sensitive callers should construct the session's app
+        with ``async_mode=False`` for dispatch+fetch-per-step behavior;
+        :meth:`run_to_completion` always uses the fastest chained modes.
+        """
         results: Dict[str, int] = {}
         prefill_finished: set = set()
         if self.chunked and self.prefilling:

@@ -73,3 +73,22 @@ def test_sequence_parallel_only():
     ref = _app(1, 1, sd).generate(PROMPTS, MASK, max_new_tokens=4)
     sp = _app(4, 1, sd, sp=True).generate(PROMPTS, MASK, max_new_tokens=4)
     np.testing.assert_allclose(ref.logits, sp.logits, atol=3e-3, rtol=3e-3)
+
+
+def test_zigzag_cp_perm_balances_causal_work():
+    """Each cp rank's contiguous stripe of the permuted order must own an
+    equal share of the causal triangle (reference strided-CP Q split,
+    attention_base.py:698-711)."""
+    import numpy as np
+
+    from neuronx_distributed_inference_tpu.models.base import zigzag_cp_perm
+
+    S, cp = 64, 4
+    perm, inv = zigzag_cp_perm(S, cp)
+    perm = np.asarray(perm)
+    inv = np.asarray(inv)
+    np.testing.assert_array_equal(np.asarray(perm)[inv], np.arange(S))
+    stripe = S // cp
+    # causal work of query position p is p+1 key visits
+    work = [int((perm[r * stripe : (r + 1) * stripe] + 1).sum()) for r in range(cp)]
+    assert max(work) - min(work) <= stripe  # balanced to within one row

@@ -1,0 +1,205 @@
+"""Fused decode-layer kernel tests (ops/decode_block.py): the attention BLOCK
+(norm+QKV+rope+prior/active attention+o-proj+residual) and the MLP block,
+checked against the exact native-path composition they replace (reference
+attention_block_tokengen_nki_kernel semantics, attention_base.py:1609)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_inference_tpu.modules.attention import (
+    AttnSpec,
+    attention_decode,
+    o_project,
+    qkv_project,
+    repeat_kv,
+)
+from neuronx_distributed_inference_tpu.modules.kvcache import (
+    init_cache,
+    read_cache_at_layer,
+    update_cache_at_layer,
+)
+from neuronx_distributed_inference_tpu.modules.norm import rms_norm
+from neuronx_distributed_inference_tpu.modules.rope import rope_cos_sin, default_inv_freq
+from neuronx_distributed_inference_tpu.ops.decode_block import (
+    fused_attn_block,
+    fused_mlp_block,
+    use_fused_attn_block,
+)
+
+B, K1, Hq, Hkv, D, H = 2, 1, 8, 2, 64, 512
+L, S_MAX, BUCKET = 3, 1024, 512
+
+
+def _rand(rng, *s):
+    return jnp.asarray(rng.randn(*s).astype(np.float32) * 0.15)
+
+
+def _native_attn_block(x, gamma, wqkv, wout, cos, sin, k_cache, v_cache,
+                       layer_idx, slot_ids, mask, positions, spec, eps):
+    """The exact native composition the fused kernel replaces:
+    write-then-attend with the same mask."""
+    normed = rms_norm(x, gamma, eps)
+    params = {"qkv_proj": {"weight": wqkv}, "o_proj": {"weight": wout}}
+    q, k, v = qkv_project(params, normed, cos, sin, spec)
+    k_cache, v_cache = update_cache_at_layer(
+        k_cache, v_cache, k, v, layer_idx, slot_ids, positions
+    )
+    k_r, v_r = read_cache_at_layer(
+        k_cache, v_cache, layer_idx, x.shape[0], mask.shape[-1]
+    )
+    attn = attention_decode(q, k_r, v_r, mask, spec)
+    return x + o_project(params, attn, spec), k_cache, v_cache
+
+
+@pytest.mark.parametrize("K", [1, 4])
+def test_fused_attn_block_parity(K):
+    rng = np.random.RandomState(7 + K)
+    spec = AttnSpec(num_heads=Hq, num_kv_heads=Hkv, head_dim=D, use_fused_block=True)
+    eps = 1e-5
+    x = _rand(rng, B, K, H)
+    gamma = jnp.asarray(1.0 + 0.1 * rng.randn(H).astype(np.float32))
+    wqkv = _rand(rng, H, (Hq + 2 * Hkv) * D)
+    wout = _rand(rng, Hq * D, H)
+    cache = init_cache(L, B + 1, S_MAX, Hkv, D, dtype=jnp.float32)
+    # pre-populate some history
+    hist = 37
+    k0 = _rand(rng, L, B + 1, S_MAX, Hkv, D)
+    cache_k = k0
+    cache_v = _rand(rng, L, B + 1, S_MAX, Hkv, D)
+    slot_ids = jnp.arange(B, dtype=jnp.int32)
+    positions = jnp.asarray(
+        np.stack([np.arange(hist, hist + K), np.arange(5, 5 + K)]), jnp.int32
+    )
+    layer_idx = jnp.int32(1)
+    # decode mask over the bucket: cache-valid prior + the current slots
+    cols = np.arange(BUCKET)
+    mask = np.zeros((B, 1, K, BUCKET), bool)
+    for b, start in enumerate((hist, 5)):
+        for t in range(K):
+            mask[b, 0, t] = cols <= start + t
+    mask = jnp.asarray(mask)
+
+    out_f, k_new, v_new = fused_attn_block(
+        x, gamma, wqkv, wout,
+        *rope_cos_sin(positions, default_inv_freq(D), 1.0),
+        cache_k, cache_v, layer_idx, slot_ids, mask, positions,
+        scale=D**-0.5, eps=eps, n_kv=Hkv, interpret=True,
+    )
+    kc_f, vc_f = update_cache_at_layer(
+        cache_k, cache_v, k_new, v_new, layer_idx, slot_ids, positions
+    )
+
+    cos, sin = rope_cos_sin(positions, default_inv_freq(D), 1.0)
+    out_n, kc_n, vc_n = _native_attn_block(
+        x, gamma, wqkv, wout, cos, sin, cache_k, cache_v,
+        layer_idx, slot_ids, mask, positions, spec, eps,
+    )
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_n), atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(kc_f), np.asarray(kc_n), atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(vc_f), np.asarray(vc_n), atol=2e-5, rtol=2e-5)
+
+
+def test_fused_attn_block_garbage_row():
+    """Invalid rows (garbage cache line, empty mask) must not produce NaNs."""
+    rng = np.random.RandomState(3)
+    x = _rand(rng, B, 1, H)
+    gamma = jnp.ones(H)
+    wqkv = _rand(rng, H, (Hq + 2 * Hkv) * D)
+    wout = _rand(rng, Hq * D, H)
+    cache_k = jnp.zeros((L, B + 1, S_MAX, Hkv, D), jnp.float32)
+    cache_v = jnp.zeros((L, B + 1, S_MAX, Hkv, D), jnp.float32)
+    slot_ids = jnp.asarray([0, B], jnp.int32)  # row 1 -> garbage line
+    positions = jnp.asarray([[10], [0]], jnp.int32)
+    mask = np.zeros((B, 1, 1, BUCKET), bool)
+    mask[0, 0, 0, :11] = True  # row 1: all-false
+    out, k_new, v_new = fused_attn_block(
+        x, gamma, wqkv, wout,
+        *rope_cos_sin(positions, default_inv_freq(D), 1.0),
+        cache_k, cache_v, jnp.int32(0), slot_ids, jnp.asarray(mask), positions,
+        scale=D**-0.5, eps=1e-5, n_kv=Hkv, interpret=True,
+    )
+    assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.parametrize("act", ["silu", "gelu_pytorch_tanh"])
+def test_fused_mlp_block_parity(act):
+    from neuronx_distributed_inference_tpu.models.base import act_fn
+
+    rng = np.random.RandomState(11)
+    I = 768
+    x = _rand(rng, B, 2, H)
+    gamma = jnp.asarray(1.0 + 0.1 * rng.randn(H).astype(np.float32))
+    wg = _rand(rng, H, I)
+    wu = _rand(rng, H, I)
+    wd = _rand(rng, I, H)
+    out = fused_mlp_block(x, gamma, wg, wu, wd, eps=1e-5, act=act, interpret=True)
+    normed = rms_norm(x, gamma, 1e-5)
+    ref = x + act_fn(act)(normed @ wg) * (normed @ wu) @ wd
+    # the kernel accumulates the down-proj over I-tiles: f32 summation order
+    # differs from the single-matmul reference
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-4, rtol=1e-3)
+
+
+def test_use_fused_attn_block_gates():
+    spec = AttnSpec(num_heads=Hq, num_kv_heads=Hkv, head_dim=D, use_fused_block=True)
+    assert use_fused_attn_block(spec, 1, 512)
+    assert use_fused_attn_block(spec, 4, 1024)
+    assert not use_fused_attn_block(spec, 32, 512)  # q too long
+    assert not use_fused_attn_block(spec, 1, 96)  # non-tileable width
+    import dataclasses
+
+    assert not use_fused_attn_block(
+        dataclasses.replace(spec, qkv_bias=True), 1, 512
+    )
+    assert not use_fused_attn_block(
+        dataclasses.replace(spec, has_sink=True), 1, 512
+    )
+    off = dataclasses.replace(spec, use_fused_block=False)
+    assert not use_fused_attn_block(off, 1, 512)
+    auto = dataclasses.replace(spec, use_fused_block=None)
+    assert use_fused_attn_block(auto, 1, 512) == (jax.default_backend() == "tpu")
+
+
+def test_fused_block_e2e_token_match():
+    """generate() with the fused decode-layer kernels forced (interpret mode
+    on CPU) matches the native path bit-for-bit on tokens."""
+    import os, sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from conftest import make_tiny_config, make_random_hf_state_dict
+
+    from neuronx_distributed_inference_tpu.runtime.application import (
+        TpuModelForCausalLM,
+    )
+
+    outs = []
+    for fused in (False, True):
+        cfg = make_tiny_config(
+            hidden_size=256,
+            intermediate_size=512,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            tpu=dict(
+                batch_size=2,
+                seq_len=1024,
+                dtype="float32",
+                fused_qkv=True,
+                fused_attn_block_kernel_enabled=fused,
+                fused_mlp_kernel_enabled=fused,
+                token_generation_buckets=[512],
+                output_logits=True,
+            ),
+        )
+        sd = make_random_hf_state_dict(cfg)
+        app = TpuModelForCausalLM(None, cfg)
+        app.load(state_dict=sd)
+        ids = np.array([[1, 2, 3, 4, 5, 6, 7, 8], [9, 10, 11, 0, 0, 0, 0, 0]])
+        mask = np.array([[1] * 8, [1, 1, 1, 0, 0, 0, 0, 0]])
+        outs.append(app.generate(ids, mask, max_new_tokens=12))
+    assert outs[0].sequences.tolist() == outs[1].sequences.tolist()
+    np.testing.assert_allclose(
+        outs[0].logits, outs[1].logits, atol=2e-4, rtol=2e-4
+    )

@@ -241,3 +241,55 @@ def test_llama4_text_hf_parity(interleave_step):
     out = app.generate(PROMPT, MASK, max_new_tokens=6)
     np.testing.assert_array_equal(out.sequences[:, 8:], ref_seq)
     np.testing.assert_allclose(out.logits, ref_logits, atol=2e-3, rtol=2e-3)
+
+
+def test_deepseek_fused_shared_experts_parity():
+    """fused_shared_experts (one gate_up matmul split after — reference
+    SharedExperts fused_gate_up_projection, moe_v2.py:99) matches the
+    separate-projection path."""
+    from transformers.models.deepseek_v3 import (
+        DeepseekV3Config,
+        DeepseekV3ForCausalLM,
+    )
+
+    from neuronx_distributed_inference_tpu.config import MoETpuConfig
+    from neuronx_distributed_inference_tpu.models.deepseek import (
+        DeepseekV3InferenceConfig,
+    )
+
+    hf_cfg = DeepseekV3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        moe_intermediate_size=32, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=4, n_shared_experts=2, n_routed_experts=4,
+        routed_scaling_factor=1.0, kv_lora_rank=16, q_lora_rank=None,
+        qk_rope_head_dim=8, v_head_dim=16, qk_nope_head_dim=16,
+        n_group=1, topk_group=1, num_experts_per_tok=2,
+        first_k_dense_replace=0, norm_topk_prob=True,
+        rope_interleave=False, attention_bias=False,
+        rms_norm_eps=1e-5, max_position_embeddings=256,
+        eos_token_id=None, bos_token_id=None, tie_word_embeddings=False,
+    )
+    torch.manual_seed(2)
+    hf = DeepseekV3ForCausalLM(hf_cfg).eval().float()
+
+    sd = {k: v.float().numpy() for k, v in hf.state_dict().items()}
+
+    def load_config(cfg):
+        cfg.model_type = "deepseek_v3"
+        for k, v in hf_cfg.to_dict().items():
+            setattr(cfg, k, v)
+
+    outs = {}
+    for fused in (False, True):
+        tc = MoETpuConfig(
+            batch_size=2, seq_len=64, dtype="float32", output_logits=True,
+            fused_shared_experts=fused,
+        )
+        cfg = DeepseekV3InferenceConfig(tc, load_config=load_config)
+        app = TpuModelForCausalLM(None, cfg)
+        app.load(state_dict=sd)
+        outs[fused] = app.generate(PROMPT, MASK, max_new_tokens=5)
+    np.testing.assert_array_equal(outs[True].sequences, outs[False].sequences)
+    np.testing.assert_allclose(
+        outs[True].logits, outs[False].logits, atol=2e-5, rtol=2e-5
+    )

@@ -67,7 +67,7 @@ def _attrs_from(hf_config, model_type):
     return a
 
 
-def _build_app(hf, hf_config, model_type, tp=1, ep=1, output_logits=True):
+def _build_app(hf, hf_config, model_type, tp=1, ep=1, output_logits=True, **tc_kwargs):
     sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
     attrs = _attrs_from(hf_config, model_type)
 
@@ -75,9 +75,12 @@ def _build_app(hf, hf_config, model_type, tp=1, ep=1, output_logits=True):
         for k, v in attrs.items():
             setattr(c, k, v)
 
-    tc = TpuConfig(
+    from neuronx_distributed_inference_tpu.config import MoETpuConfig
+
+    tc_cls = MoETpuConfig if tc_kwargs else TpuConfig
+    tc = tc_cls(
         batch_size=1, seq_len=64, dtype="float32", tp_degree=tp, ep_degree=ep,
-        output_logits=output_logits,
+        output_logits=output_logits, **tc_kwargs,
     )
     cfg = LlamaInferenceConfig(tc, load_config=load_cfg)
     app = TpuModelForCausalLM(None, cfg)
@@ -117,7 +120,7 @@ def test_mixtral_expert_parallel():
     np.testing.assert_allclose(out_ref.logits, out_ep.logits, atol=2e-3, rtol=2e-3)
 
 
-def test_qwen3_moe_parity():
+def _qwen3_moe():
     torch.manual_seed(0)
     hf_config = transformers.Qwen3MoeConfig(
         vocab_size=128,
@@ -139,6 +142,60 @@ def test_qwen3_moe_parity():
         eos_token_id=None,
         bos_token_id=None,
     )
-    hf = transformers.Qwen3MoeForCausalLM(hf_config).eval().float()
+    return transformers.Qwen3MoeForCausalLM(hf_config).eval().float(), hf_config
+
+
+def test_qwen3_moe_parity():
+    hf, hf_config = _qwen3_moe()
     app = _build_app(hf, hf_config, "qwen3_moe")
     _check_parity(app, hf)
+
+
+def test_mixtral_hybrid_sharding_parity():
+    """Hybrid expert sharding (decode ep x tp layout, prefill constrained to
+    full TP — reference HybridShardingConfig): logits must match tp=1
+    (VERDICT r3 next #6)."""
+    hf, hf_config = _mixtral()
+    ref = _build_app(hf, hf_config, "mixtral", tp=1, ep=1)
+    out_ref = ref.generate(PROMPTS, np.ones_like(PROMPTS), max_new_tokens=4)
+    hyb = _build_app(
+        hf, hf_config, "mixtral", tp=2, ep=2,
+        hybrid_sharding_config=dict(
+            moe_cte_tp_degree=4, moe_cte_ep_degree=1,
+            moe_tkg_tp_degree=2, moe_tkg_ep_degree=2,
+        ),
+    )
+    out_hyb = hyb.generate(PROMPTS, np.ones_like(PROMPTS), max_new_tokens=4)
+    np.testing.assert_array_equal(out_hyb.sequences, out_ref.sequences)
+    np.testing.assert_allclose(out_hyb.logits, out_ref.logits, atol=2e-3, rtol=2e-3)
+
+
+def test_hybrid_sharding_config_validation():
+    from neuronx_distributed_inference_tpu.config import MoETpuConfig
+
+    with pytest.raises(ValueError, match="multiply"):
+        MoETpuConfig(
+            tp_degree=2, ep_degree=2,
+            hybrid_sharding_config=dict(moe_cte_tp_degree=3, moe_cte_ep_degree=1),
+        )
+    with pytest.raises(NotImplementedError, match="moe_cte_ep_degree=1"):
+        MoETpuConfig(
+            tp_degree=2, ep_degree=2,
+            hybrid_sharding_config=dict(moe_cte_tp_degree=2, moe_cte_ep_degree=2),
+        )
+
+
+def test_qwen3_moe_hybrid_sharding_parity():
+    hf, hf_config = _qwen3_moe()
+    ref = _build_app(hf, hf_config, "qwen3_moe", tp=1, ep=1)
+    out_ref = ref.generate(PROMPTS, np.ones_like(PROMPTS), max_new_tokens=4)
+    hyb = _build_app(
+        hf, hf_config, "qwen3_moe", tp=2, ep=2,
+        hybrid_sharding_config=dict(
+            moe_cte_tp_degree=4, moe_cte_ep_degree=1,
+            moe_tkg_tp_degree=2, moe_tkg_ep_degree=2,
+        ),
+    )
+    out_hyb = hyb.generate(PROMPTS, np.ones_like(PROMPTS), max_new_tokens=4)
+    np.testing.assert_array_equal(out_hyb.sequences, out_ref.sequences)
+    np.testing.assert_allclose(out_hyb.logits, out_ref.logits, atol=2e-3, rtol=2e-3)

@@ -284,3 +284,113 @@ def test_dynamic_tree_params_validation():
         DynamicTokenTree({"step": 0, "branching_factor": 3, "num_inputs": 2})
     with pytest.raises(ValueError):
         DynamicTokenTree({"step": 2, "branching_factor": 2, "num_inputs": 4})
+
+
+# ---------------------------------------------------------------------------
+# sampled (non-greedy) tree verification (VERDICT r3 next #5)
+# ---------------------------------------------------------------------------
+
+
+def test_sampled_tree_accept_marginal_matches_target():
+    """Empirical marginal of the FIRST emitted token equals the warped target
+    distribution at the root, whatever the draft q's are (multi-candidate
+    spec-sampling theorem for recursive rejection sampling)."""
+    from neuronx_distributed_inference_tpu.modules.sampling import (
+        prepare_sampling_params,
+    )
+    from neuronx_distributed_inference_tpu.modules.token_tree import (
+        sampled_tree_accept,
+    )
+
+    V = 12
+    t = TokenTree(TREE)  # root->(1,2), 1->(3,4)
+    rng = np.random.RandomState(1)
+    p = rng.dirichlet(np.ones(V), size=t.num_nodes).astype(np.float32)  # (N, V)
+    q = rng.dirichlet(np.ones(V), size=t.num_nodes).astype(np.float32)
+    tlogits = jnp.asarray(np.log(p))[None]  # (1, N, V)
+    q_nodes = jnp.asarray(q)[None]
+    sp = jnp.asarray(prepare_sampling_params(1, top_k=-1))  # neutral warp
+
+    n = 6000
+
+    def one(key):
+        kd, ka = jax.random.split(key)
+        # children drawn i.i.d. from the parent's q (as the real expansion
+        # does in sampled mode)
+        qj = jnp.asarray(q)
+        draws = jax.vmap(
+            lambda kk, nn: jax.random.categorical(kk, jnp.log(qj[nn]))
+        )(jax.random.split(kd, t.num_nodes - 1), jnp.asarray(t.parent[1:]))
+        cand = jnp.concatenate([jnp.zeros((1,), jnp.int32), draws.astype(jnp.int32)])
+        tokens, counts, best = sampled_tree_accept(
+            t, cand[None], tlogits, q_nodes, sp, ka, 256
+        )
+        return tokens[0, 0]
+
+    keys = jax.random.split(jax.random.PRNGKey(9), n)
+    first = np.asarray(jax.vmap(one)(keys))
+    emp = np.bincount(first, minlength=V) / n
+    tv = 0.5 * np.abs(emp - p[0]).sum()
+    assert tv < 0.05, f"TV(emp, p_root) = {tv:.3f}; marginal deviates from target"
+
+
+def test_sampled_tree_topk1_equals_greedy_tree():
+    """top_k=1 sampling collapses every distribution to the argmax: the
+    sampled tree must emit exactly the greedy tree's tokens."""
+    target_sd = make_random_hf_state_dict(make_tiny_config(), seed=0)
+    greedy_out = _tree_app(TREE, target_sd).generate(
+        PROMPTS, MASK, max_new_tokens=12
+    )
+
+    from neuronx_distributed_inference_tpu.config import OnDeviceSamplingConfig
+    from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
+    from neuronx_distributed_inference_tpu.runtime.fused_spec import (
+        TpuEagleSpecModelForCausalLM,
+    )
+
+    cfg = _eagle_cfg(TREE)
+    cfg.tpu_config.on_device_sampling_config = OnDeviceSamplingConfig(do_sample=True)
+    app = TpuEagleSpecModelForCausalLM(None, cfg)
+    app.load(random_weights=True)
+    app.target_params = shard_pytree(
+        app.target_builder.convert_hf_state_dict(target_sd),
+        app.target_builder.param_pspecs(),
+        app.mesh,
+    )
+    out = app.generate(PROMPTS, MASK, max_new_tokens=12, top_k=1)
+    np.testing.assert_array_equal(out.sequences, greedy_out.sequences)
+
+
+def test_sampled_tree_runs_and_differs_by_seed():
+    """Sampled tree decoding with temperature produces valid, seed-varying,
+    seed-reproducible output."""
+    from neuronx_distributed_inference_tpu.config import OnDeviceSamplingConfig
+    from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
+    from neuronx_distributed_inference_tpu.runtime.fused_spec import (
+        TpuEagleSpecModelForCausalLM,
+    )
+
+    target_sd = make_random_hf_state_dict(make_tiny_config(), seed=0)
+
+    def run(seed):
+        cfg = _eagle_cfg(TREE)
+        cfg.tpu_config.on_device_sampling_config = OnDeviceSamplingConfig(
+            do_sample=True
+        )
+        cfg.tpu_config.seed = seed
+        app = TpuEagleSpecModelForCausalLM(None, cfg)
+        app.load(random_weights=True)
+        app.target_params = shard_pytree(
+            app.target_builder.convert_hf_state_dict(target_sd),
+            app.target_builder.param_pspecs(),
+            app.mesh,
+        )
+        return app.generate(
+            PROMPTS, MASK, max_new_tokens=10, temperature=4.0, top_k=50
+        ).sequences
+
+    a, b, a2 = run(0), run(123), run(0)
+    V = make_tiny_config().vocab_size
+    assert (a >= 0).all() and (a < V).all()
+    np.testing.assert_array_equal(a, a2)
+    assert a.tolist() != b.tolist()
