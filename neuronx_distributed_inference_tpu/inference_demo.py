@@ -36,7 +36,8 @@ from neuronx_distributed_inference_tpu.utils.hf_adapter import load_pretrained_c
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="inference_demo", description=__doc__)
     p.add_argument("--model-type", default="llama", choices=sorted(MODEL_REGISTRY))
-    p.add_argument("--task-type", default="causal-lm", choices=["causal-lm"])
+    p.add_argument("--task-type", default="causal-lm",
+               choices=["causal-lm", "image-gen"])
     sub = p.add_subparsers(dest="action", required=True)
     run = sub.add_parser("run", help="compile, load, and generate")
 
@@ -576,8 +577,59 @@ def run_inference(args) -> int:
     return 0
 
 
+def run_image_gen(args) -> int:
+    """FLUX text-to-image (reference NeuronFluxApplication demo path,
+    models/diffusers/flux/application.py): random-weight smoke or checkpoint
+    generation with the four-sub-model pipeline."""
+    import numpy as np
+
+    from neuronx_distributed_inference_tpu.models.flux import FluxSpec
+    from neuronx_distributed_inference_tpu.models.flux_text import (
+        ClipTextSpec,
+        T5EncoderSpec,
+    )
+    from neuronx_distributed_inference_tpu.models.flux_vae import VaeDecoderSpec
+    from neuronx_distributed_inference_tpu.runtime.flux import (
+        FluxPipelineConfig,
+        TpuFluxPipeline,
+    )
+
+    if not args.random_weights:
+        raise NotImplementedError(
+            "image-gen demo currently drives random-weight pipelines; load "
+            "checkpoints through runtime.flux.TpuFluxPipeline.load(...)"
+        )
+    cfg = FluxPipelineConfig(
+        backbone=FluxSpec(
+            dim=128, num_heads=4, head_dim=32, num_dual=2, num_single=2,
+            in_channels=64, joint_dim=64, pooled_dim=48,
+            axes_dims_rope=(8, 12, 12),
+        ),
+        clip=ClipTextSpec(
+            hidden_size=48, num_heads=4, num_layers=2, intermediate_size=96,
+            vocab_size=1024, max_positions=77,
+        ),
+        t5=T5EncoderSpec(
+            d_model=64, num_heads=4, d_kv=16, num_layers=2, d_ff=128,
+            vocab_size=1024,
+        ),
+        vae=VaeDecoderSpec(latent_channels=16, block_out_channels=(32, 32, 32, 32)),
+        height=128, width=128, dtype=args.dtype,
+    )
+    pipe = TpuFluxPipeline(cfg).load(random_weights=True, seed=args.seed)
+    rng = np.random.RandomState(args.seed)
+    clip_ids = rng.randint(1, 1000, size=(1, 8))
+    t5_ids = rng.randint(1, 1000, size=(1, 16))
+    img = pipe.generate(clip_ids, t5_ids, num_inference_steps=4, seed=args.seed)
+    print(f"generated image batch: shape={img.shape}, "
+          f"range=[{img.min():.3f}, {img.max():.3f}]")
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.task_type == "image-gen":
+        return run_image_gen(args)
     return run_inference(args)
 
 

@@ -1,0 +1,240 @@
+"""Flux text encoders: CLIP (pooled prompt vector) and T5 (sequence features).
+
+TPU-native re-design of the reference Flux text-encoder applications
+(reference: models/diffusers/flux/clip/modeling_clip.py ``NeuronClipApplication``
+and .../t5/modeling_t5.py ``NeuronT5Application`` — per-model torch module
+trees + ModelWrappers; here each is a pure encode function + checkpoint
+converter registered with runtime/encoder.register_encoder).
+
+Parity oracles: transformers CLIPTextModel / T5EncoderModel
+(tests/test_flux.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.modules.norm import layer_norm, rms_norm
+from neuronx_distributed_inference_tpu.ops.quant import linear
+
+
+# ---------------------------------------------------------------------------
+# CLIP text encoder
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ClipTextSpec:
+    hidden_size: int
+    num_heads: int
+    num_layers: int
+    intermediate_size: int
+    vocab_size: int
+    max_positions: int
+    eos_token_id: int = 2
+    act: str = "quick_gelu"  # openai/clip-vit-large-patch14 uses quick_gelu
+    eps: float = 1e-5
+
+
+def _clip_act(name):
+    if name == "quick_gelu":
+        return lambda x: x * jax.nn.sigmoid(1.702 * x)
+    return lambda x: jax.nn.gelu(x, approximate=False)
+
+
+def clip_text_encode(params: Dict, input_ids: jax.Array, *, spec: ClipTextSpec):
+    """-> (last_hidden (B, L, H), pooled (B, H)).
+
+    Pooled = final-LN hidden at the first EOS position per row (HF
+    CLIPTextModel pooled_output semantics). Causal attention mask.
+    """
+    B, L = input_ids.shape
+    H = spec.hidden_size
+    nh = spec.num_heads
+    hd = H // nh
+    act = _clip_act(spec.act)
+
+    x = params["token_embedding"]["weight"][input_ids] + params["position_embedding"][
+        "weight"
+    ][None, :L]
+    causal = jnp.tril(jnp.ones((L, L), bool))[None, None]
+
+    def body(x, lp):
+        h = layer_norm(x, lp["layer_norm1"]["weight"], lp["layer_norm1"]["bias"], spec.eps)
+        q = (linear(lp["q_proj"], h) + lp["q_proj"]["bias"]).reshape(B, L, nh, hd)
+        k = (linear(lp["k_proj"], h) + lp["k_proj"]["bias"]).reshape(B, L, nh, hd)
+        v = (linear(lp["v_proj"], h) + lp["v_proj"]["bias"]).reshape(B, L, nh, hd)
+        s = jnp.einsum("blhd,bmhd->bhlm", q, k, preferred_element_type=jnp.float32)
+        s = jnp.where(causal, s * hd**-0.5, -jnp.inf)
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        o = jnp.einsum("bhlm,bmhd->blhd", p, v).reshape(B, L, H)
+        x = x + linear(lp["out_proj"], o) + lp["out_proj"]["bias"]
+        h = layer_norm(x, lp["layer_norm2"]["weight"], lp["layer_norm2"]["bias"], spec.eps)
+        h = act(linear(lp["fc1"], h) + lp["fc1"]["bias"])
+        x = x + linear(lp["fc2"], h) + lp["fc2"]["bias"]
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = layer_norm(
+        x, params["final_layer_norm"]["weight"], params["final_layer_norm"]["bias"], spec.eps
+    )
+    # pooled position, HF CLIPTextTransformer semantics: configs with the
+    # LEGACY eos_token_id == 2 (openai/clip-vit-large-patch14, the FLUX CLIP
+    # — its tokenizer emits 49407, so id 2 never appears) pool at
+    # input_ids.argmax(-1) (the highest id IS the end token); newer configs
+    # pool at the first true-EOS position
+    if spec.eos_token_id == 2:
+        eos_pos = jnp.argmax(input_ids, axis=1)
+    else:
+        eos_pos = jnp.argmax((input_ids == spec.eos_token_id).astype(jnp.int32), axis=1)
+    pooled = x[jnp.arange(B), eos_pos]
+    return x, pooled
+
+
+def convert_clip_text_state_dict(sd: Dict, spec: ClipTextSpec, dtype=jnp.float32) -> Dict:
+    def lt(n):
+        return jnp.asarray(np.asarray(sd[n]).T, dtype)
+
+    def b(n):
+        return jnp.asarray(np.asarray(sd[n]), dtype)
+
+    pre = "text_model."
+
+    def layer(i):
+        p = f"{pre}encoder.layers.{i}."
+        out = {}
+        for name, hf in (
+            ("q_proj", "self_attn.q_proj"), ("k_proj", "self_attn.k_proj"),
+            ("v_proj", "self_attn.v_proj"), ("out_proj", "self_attn.out_proj"),
+            ("fc1", "mlp.fc1"), ("fc2", "mlp.fc2"),
+        ):
+            out[name] = {"weight": lt(p + hf + ".weight"), "bias": b(p + hf + ".bias")}
+        for name, hf in (("layer_norm1", "layer_norm1"), ("layer_norm2", "layer_norm2")):
+            out[name] = {"weight": b(p + hf + ".weight"), "bias": b(p + hf + ".bias")}
+        return out
+
+    layers = [layer(i) for i in range(spec.num_layers)]
+    return {
+        "token_embedding": {"weight": jnp.asarray(np.asarray(sd[pre + "embeddings.token_embedding.weight"]), dtype)},
+        "position_embedding": {"weight": jnp.asarray(np.asarray(sd[pre + "embeddings.position_embedding.weight"]), dtype)},
+        "layers": jax.tree.map(lambda *xs: jnp.stack(xs), *layers),
+        "final_layer_norm": {
+            "weight": b(pre + "final_layer_norm.weight"),
+            "bias": b(pre + "final_layer_norm.bias"),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# T5 encoder
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class T5EncoderSpec:
+    d_model: int
+    num_heads: int
+    d_kv: int  # per-head dim (T5 does NOT tie d_kv to d_model/heads)
+    num_layers: int
+    d_ff: int
+    vocab_size: int
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    eps: float = 1e-6
+    gated_act: bool = True  # v1.1/xxl use gated-gelu
+
+
+def _t5_rel_bucket(rel: jax.Array, num_buckets: int, max_distance: int) -> jax.Array:
+    """Bidirectional relative-position bucketing (HF T5Attention
+    _relative_position_bucket, bidirectional=True)."""
+    nb = num_buckets // 2
+    out = jnp.where(rel > 0, nb, 0)
+    n = jnp.abs(rel)
+    max_exact = nb // 2
+    large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-20)
+        / np.log(max_distance / max_exact)
+        * (nb - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, nb - 1)
+    return out + jnp.where(n < max_exact, n, large)
+
+
+def t5_encode(params: Dict, input_ids: jax.Array, attention_mask: jax.Array, *, spec: T5EncoderSpec):
+    """-> last_hidden (B, L, d_model). HF T5EncoderModel semantics: layer-0's
+    relative attention bias is shared by every layer; attention is unscaled;
+    pre-RMSNorm blocks with gated-gelu FFN."""
+    B, L = input_ids.shape
+    nh, dk = spec.num_heads, spec.d_kv
+    x = params["embed_tokens"]["weight"][input_ids]
+
+    pos = jnp.arange(L)
+    rel = pos[None, :] - pos[:, None]  # memory - query
+    bucket = _t5_rel_bucket(rel, spec.rel_buckets, spec.rel_max_distance)
+    bias = params["rel_bias"]["weight"][bucket]  # (L, L, nh)
+    bias = jnp.transpose(bias, (2, 0, 1))[None]  # (1, nh, L, L)
+    key_ok = attention_mask.astype(bool)[:, None, None, :]
+
+    def body(x, lp):
+        h = rms_norm(x, lp["ln1"]["weight"], spec.eps)
+        q = linear(lp["q"], h).reshape(B, L, nh, dk)
+        k = linear(lp["k"], h).reshape(B, L, nh, dk)
+        v = linear(lp["v"], h).reshape(B, L, nh, dk)
+        s = jnp.einsum("blhd,bmhd->bhlm", q, k, preferred_element_type=jnp.float32)
+        s = jnp.where(key_ok, s + bias, -jnp.inf)  # T5: NO 1/sqrt(d) scaling
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        o = jnp.einsum("bhlm,bmhd->blhd", p, v).reshape(B, L, nh * dk)
+        x = x + linear(lp["o"], o)
+        h = rms_norm(x, lp["ln2"]["weight"], spec.eps)
+        if spec.gated_act:
+            ff = jax.nn.gelu(linear(lp["wi_0"], h), approximate=True) * linear(lp["wi_1"], h)
+        else:
+            ff = jax.nn.relu(linear(lp["wi_0"], h))
+        x = x + linear(lp["wo"], ff)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    return rms_norm(x, params["final_norm"]["weight"], spec.eps)
+
+
+def convert_t5_state_dict(sd: Dict, spec: T5EncoderSpec, dtype=jnp.float32) -> Dict:
+    def lt(n):
+        return jnp.asarray(np.asarray(sd[n]).T, dtype)
+
+    def g(n):
+        return jnp.asarray(np.asarray(sd[n]), dtype)
+
+    def layer(i):
+        p = f"encoder.block.{i}."
+        out = {
+            "q": {"weight": lt(p + "layer.0.SelfAttention.q.weight")},
+            "k": {"weight": lt(p + "layer.0.SelfAttention.k.weight")},
+            "v": {"weight": lt(p + "layer.0.SelfAttention.v.weight")},
+            "o": {"weight": lt(p + "layer.0.SelfAttention.o.weight")},
+            "ln1": {"weight": g(p + "layer.0.layer_norm.weight")},
+            "ln2": {"weight": g(p + "layer.1.layer_norm.weight")},
+            "wo": {"weight": lt(p + "layer.1.DenseReluDense.wo.weight")},
+        }
+        if spec.gated_act:
+            out["wi_0"] = {"weight": lt(p + "layer.1.DenseReluDense.wi_0.weight")}
+            out["wi_1"] = {"weight": lt(p + "layer.1.DenseReluDense.wi_1.weight")}
+        else:
+            out["wi_0"] = {"weight": lt(p + "layer.1.DenseReluDense.wi.weight")}
+        return out
+
+    layers = [layer(i) for i in range(spec.num_layers)]
+    return {
+        "embed_tokens": {"weight": g("shared.weight")},
+        "rel_bias": {
+            "weight": g(
+                "encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"
+            )
+        },
+        "layers": jax.tree.map(lambda *xs: jnp.stack(xs), *layers),
+        "final_norm": {"weight": g("encoder.final_layer_norm.weight")},
+    }

@@ -1,0 +1,176 @@
+"""Flux VAE decoder (AutoencoderKL decoder half).
+
+TPU-native re-design of the reference VAE decoder application
+(reference: models/diffusers/flux/vae/modeling_vae.py:213
+``NeuronVAEDecoderApplication`` wrapping the diffusers AutoencoderKL
+decoder). Convolutions run NHWC through lax.conv_general_dilated (XLA's
+native TPU conv layout); GroupNorm/attention/resnet blocks are pure
+functions over a params pytree converted from the diffusers checkpoint.
+
+Architecture (diffusers Decoder): conv_in -> mid (resnet, single-head
+attention, resnet) -> up blocks (3 resnets each, nearest-2x upsample between
+levels) -> GroupNorm -> silu -> conv_out. Latents are unscaled by
+(z / scaling_factor + shift_factor) BEFORE decode (pipeline convention).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+GN_GROUPS = 32
+
+
+@dataclass(frozen=True)
+class VaeDecoderSpec:
+    latent_channels: int = 16  # FLUX VAE
+    out_channels: int = 3
+    block_out_channels: Tuple[int, ...] = (128, 256, 512, 512)
+    layers_per_block: int = 2  # decoder uses layers_per_block + 1 resnets
+    norm_groups: int = GN_GROUPS
+    scaling_factor: float = 0.3611
+    shift_factor: float = 0.1159
+    eps: float = 1e-6
+
+
+def group_norm(x: jax.Array, w, b, groups: int, eps: float) -> jax.Array:
+    """NHWC group norm."""
+    B, H, W, C = x.shape
+    g = min(groups, C)
+    xf = x.astype(jnp.float32).reshape(B, H, W, g, C // g)
+    mu = jnp.mean(xf, axis=(1, 2, 4), keepdims=True)
+    var = jnp.var(xf, axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mu) * jax.lax.rsqrt(var + eps)
+    xf = xf.reshape(B, H, W, C)
+    return (xf * w + b).astype(x.dtype)
+
+
+def conv2d(x: jax.Array, params: Dict, stride: int = 1, padding: int = 1) -> jax.Array:
+    """NHWC conv with HWIO weights (converted from torch OIHW)."""
+    out = jax.lax.conv_general_dilated(
+        x,
+        params["weight"].astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=[(padding, padding), (padding, padding)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return out + params["bias"].astype(x.dtype)
+
+
+def _resnet(params: Dict, x: jax.Array, spec: VaeDecoderSpec) -> jax.Array:
+    h = jax.nn.silu(group_norm(x, params["norm1"]["weight"], params["norm1"]["bias"],
+                               spec.norm_groups, spec.eps))
+    h = conv2d(h, params["conv1"])
+    h = jax.nn.silu(group_norm(h, params["norm2"]["weight"], params["norm2"]["bias"],
+                               spec.norm_groups, spec.eps))
+    h = conv2d(h, params["conv2"])
+    if "conv_shortcut" in params:
+        x = conv2d(x, params["conv_shortcut"], padding=0)
+    return x + h
+
+
+def _mid_attention(params: Dict, x: jax.Array, spec: VaeDecoderSpec) -> jax.Array:
+    """Single-head spatial self-attention (diffusers Attention in the VAE
+    mid block)."""
+    B, H, W, C = x.shape
+    h = group_norm(x, params["group_norm"]["weight"], params["group_norm"]["bias"],
+                   spec.norm_groups, spec.eps)
+    flat = h.reshape(B, H * W, C)
+    q = flat @ params["to_q"]["weight"] + params["to_q"]["bias"]
+    k = flat @ params["to_k"]["weight"] + params["to_k"]["bias"]
+    v = flat @ params["to_v"]["weight"] + params["to_v"]["bias"]
+    s = jnp.einsum("bld,bmd->blm", q, k, preferred_element_type=jnp.float32) * C**-0.5
+    p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    o = jnp.einsum("blm,bmd->bld", p, v)
+    o = o @ params["to_out"]["weight"] + params["to_out"]["bias"]
+    return x + o.reshape(B, H, W, C)
+
+
+def _upsample(params: Dict, x: jax.Array) -> jax.Array:
+    B, H, W, C = x.shape
+    x = jnp.repeat(jnp.repeat(x, 2, axis=1), 2, axis=2)  # nearest 2x
+    return conv2d(x, params["conv"])
+
+
+def vae_decode(params: Dict, latents: jax.Array, *, spec: VaeDecoderSpec) -> jax.Array:
+    """latents (B, h, w, latent_channels) NHWC -> image (B, 8h, 8w, 3) in
+    [-1, 1]. Applies the scaling/shift unnormalization first."""
+    z = latents.astype(jnp.float32) / spec.scaling_factor + spec.shift_factor
+    x = conv2d(z, params["conv_in"])
+    x = _resnet(params["mid"]["resnet_0"], x, spec)
+    x = _mid_attention(params["mid"]["attn"], x, spec)
+    x = _resnet(params["mid"]["resnet_1"], x, spec)
+    for ui in range(len(spec.block_out_channels)):
+        up = params["up"][ui]
+        for ri in range(spec.layers_per_block + 1):
+            x = _resnet(up[f"resnet_{ri}"], x, spec)
+        if "upsample" in up:
+            x = _upsample(up["upsample"], x)
+    x = jax.nn.silu(group_norm(x, params["norm_out"]["weight"], params["norm_out"]["bias"],
+                               spec.norm_groups, spec.eps))
+    return conv2d(x, params["conv_out"])
+
+
+def convert_vae_decoder_state_dict(sd: Dict, spec: VaeDecoderSpec, dtype=jnp.float32) -> Dict:
+    """Map the diffusers AutoencoderKL decoder onto the params pytree.
+    Conv weights OIHW -> HWIO; attention projections (out, in) -> (in, out)."""
+
+    def conv(n):
+        return {
+            "weight": jnp.asarray(np.asarray(sd[n + ".weight"]).transpose(2, 3, 1, 0), dtype),
+            "bias": jnp.asarray(np.asarray(sd[n + ".bias"]), dtype),
+        }
+
+    def lin(n):
+        return {
+            "weight": jnp.asarray(np.asarray(sd[n + ".weight"]).T, dtype),
+            "bias": jnp.asarray(np.asarray(sd[n + ".bias"]), dtype),
+        }
+
+    def norm(n):
+        return {
+            "weight": jnp.asarray(np.asarray(sd[n + ".weight"]), dtype),
+            "bias": jnp.asarray(np.asarray(sd[n + ".bias"]), dtype),
+        }
+
+    def resnet(p):
+        out = {
+            "norm1": norm(p + ".norm1"), "conv1": conv(p + ".conv1"),
+            "norm2": norm(p + ".norm2"), "conv2": conv(p + ".conv2"),
+        }
+        if p + ".conv_shortcut.weight" in sd:
+            out["conv_shortcut"] = conv(p + ".conv_shortcut")
+        return out
+
+    pre = "decoder."
+    params = {
+        "conv_in": conv(pre + "conv_in"),
+        "mid": {
+            "resnet_0": resnet(pre + "mid_block.resnets.0"),
+            "attn": {
+                "group_norm": norm(pre + "mid_block.attentions.0.group_norm"),
+                "to_q": lin(pre + "mid_block.attentions.0.to_q"),
+                "to_k": lin(pre + "mid_block.attentions.0.to_k"),
+                "to_v": lin(pre + "mid_block.attentions.0.to_v"),
+                "to_out": lin(pre + "mid_block.attentions.0.to_out.0"),
+            },
+            "resnet_1": resnet(pre + "mid_block.resnets.1"),
+        },
+        "up": [],
+        "norm_out": norm(pre + "conv_norm_out"),
+        "conv_out": conv(pre + "conv_out"),
+    }
+    for ui in range(len(spec.block_out_channels)):
+        p = pre + f"up_blocks.{ui}"
+        blk = {
+            f"resnet_{ri}": resnet(p + f".resnets.{ri}")
+            for ri in range(spec.layers_per_block + 1)
+        }
+        if p + ".upsamplers.0.conv.weight" in sd:
+            blk["upsample"] = {"conv": conv(p + ".upsamplers.0.conv")}
+        params["up"].append(blk)
+    return params

@@ -80,7 +80,7 @@ class TpuEncoderApplication:
             encode_fn, convert_fn, config=config, mesh=mesh, static_kwargs=static_kwargs
         )
 
-    def load(self, state_dict=None, params=None, model_path=None):
+    def load(self, state_dict=None, params=None, model_path=None, dtype=None):
         if params is None:
             if state_dict is None:
                 from neuronx_distributed_inference_tpu.utils.hf_checkpoint import (
@@ -88,7 +88,8 @@ class TpuEncoderApplication:
                 )
 
                 state_dict = load_state_dict(model_path)
-            dt = to_dtype(self.config.tpu_config.dtype) if self.config else jnp.float32
+            tc = getattr(self.config, "tpu_config", None)
+            dt = dtype if dtype is not None else (to_dtype(tc.dtype) if tc else jnp.float32)
             params = self.convert_fn(state_dict, dt)
         if self.mesh is not None and self.pspec_fn is not None:
             params = shard_pytree(params, self.pspec_fn(params), self.mesh)

@@ -1,0 +1,337 @@
+"""Flux pipeline tests (VERDICT r3 next #4): CLIP/T5 parity against the
+transformers oracles, a torch-built VAE-decoder oracle, DiT backbone
+invariants (tp parity, determinism), and the end-to-end pipeline smoke —
+the whisper/mllama tiny-random-weight strategy (diffusers itself is not in
+the image, so the DiT/VAE oracles are reconstructed with torch modules)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax
+import jax.numpy as jnp
+
+from neuronx_distributed_inference_tpu.models.flux import (
+    FluxSpec,
+    flux_forward,
+    flux_param_pspecs,
+    flux_param_shapes,
+    flux_random_params,
+    latent_image_ids,
+)
+from neuronx_distributed_inference_tpu.models.flux_text import (
+    ClipTextSpec,
+    T5EncoderSpec,
+    clip_text_encode,
+    convert_clip_text_state_dict,
+    convert_t5_state_dict,
+    t5_encode,
+)
+from neuronx_distributed_inference_tpu.models.flux_vae import (
+    VaeDecoderSpec,
+    convert_vae_decoder_state_dict,
+    vae_decode,
+)
+
+IDS = np.array([[49406, 320, 1125, 49407, 0, 0], [49406, 1125, 539, 320, 1125, 49407]])
+
+
+def test_clip_text_parity():
+    cfg = transformers.CLIPTextConfig(
+        vocab_size=49408, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, max_position_embeddings=77,
+        hidden_act="quick_gelu", eos_token_id=49407, bos_token_id=49406,
+    )
+    torch.manual_seed(0)
+    hf = transformers.CLIPTextModel(cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    spec = ClipTextSpec(
+        hidden_size=64, num_heads=4, num_layers=3, intermediate_size=128,
+        vocab_size=49408, max_positions=77, eos_token_id=49407,
+    )
+    params = convert_clip_text_state_dict(sd, spec)
+    hidden, pooled = clip_text_encode(params, jnp.asarray(IDS), spec=spec)
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(IDS))
+    np.testing.assert_allclose(
+        np.asarray(hidden), ref.last_hidden_state.numpy(), atol=2e-5, rtol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(pooled), ref.pooler_output.numpy(), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_t5_encoder_parity():
+    cfg = transformers.T5Config(
+        vocab_size=512, d_model=64, d_kv=16, d_ff=128, num_layers=3,
+        num_heads=4, relative_attention_num_buckets=32,
+        relative_attention_max_distance=128, feed_forward_proj="gated-gelu",
+        dense_act_fn="gelu_new", is_gated_act=True, tie_word_embeddings=False,
+    )
+    torch.manual_seed(1)
+    hf = transformers.T5EncoderModel(cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    spec = T5EncoderSpec(
+        d_model=64, num_heads=4, d_kv=16, num_layers=3, d_ff=128, vocab_size=512,
+    )
+    params = convert_t5_state_dict(sd, spec)
+    ids = np.array([[5, 17, 92, 41, 1, 0], [64, 3, 27, 1, 0, 0]])
+    mask = np.array([[1, 1, 1, 1, 1, 0], [1, 1, 1, 1, 0, 0]])
+    out = t5_encode(params, jnp.asarray(ids), jnp.asarray(mask), spec=spec)
+    with torch.no_grad():
+        ref = hf(
+            input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask)
+        ).last_hidden_state.numpy()
+    # compare only VALID positions (HF lets padded queries attend freely)
+    for b in range(2):
+        n = int(mask[b].sum())
+        np.testing.assert_allclose(
+            np.asarray(out)[b, :n], ref[b, :n], atol=3e-5, rtol=3e-5
+        )
+
+
+def test_clip_text_parity_legacy_eos():
+    """eos_token_id == 2 (openai/clip-vit-large-patch14, the FLUX CLIP):
+    HF pools at input_ids.argmax(-1) — id 2 never appears in real inputs."""
+    cfg = transformers.CLIPTextConfig(
+        vocab_size=49408, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, max_position_embeddings=77,
+        hidden_act="quick_gelu", eos_token_id=2, bos_token_id=49406,
+    )
+    torch.manual_seed(4)
+    hf = transformers.CLIPTextModel(cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    spec = ClipTextSpec(
+        hidden_size=32, num_heads=2, num_layers=2, intermediate_size=64,
+        vocab_size=49408, max_positions=77, eos_token_id=2,
+    )
+    params = convert_clip_text_state_dict(sd, spec)
+    ids = np.array([[49406, 320, 1125, 49407, 0, 0]])
+    _, pooled = clip_text_encode(params, jnp.asarray(ids), spec=spec)
+    with torch.no_grad():
+        ref = hf(input_ids=torch.tensor(ids)).pooler_output.numpy()
+    np.testing.assert_allclose(np.asarray(pooled), ref, atol=2e-5, rtol=2e-5)
+
+
+TINY = FluxSpec(
+    dim=64, num_heads=4, head_dim=16, num_dual=2, num_single=2,
+    in_channels=16, joint_dim=32, pooled_dim=24, guidance_embeds=True,
+    axes_dims_rope=(4, 6, 6),
+)
+
+
+def _dit_inputs(B=2, h2=4, w2=4, Lt=6, seed=0):
+    rng = np.random.RandomState(seed)
+    hidden = jnp.asarray(rng.randn(B, h2 * w2, TINY.in_channels).astype(np.float32))
+    txt = jnp.asarray(rng.randn(B, Lt, TINY.joint_dim).astype(np.float32))
+    pooled = jnp.asarray(rng.randn(B, TINY.pooled_dim).astype(np.float32))
+    t = jnp.asarray(np.full(B, 0.7, np.float32))
+    img_ids = jnp.asarray(latent_image_ids(h2, w2))
+    txt_ids = jnp.zeros((Lt, 3), jnp.float32)
+    g = jnp.full((B,), 3.5, jnp.float32)
+    return hidden, txt, pooled, t, img_ids, txt_ids, g
+
+
+def test_flux_backbone_shapes_and_determinism():
+    from neuronx_distributed_inference_tpu.parallel.mesh import single_device_mesh
+
+    params = flux_random_params(TINY, seed=3)
+    args = _dit_inputs()
+    with jax.set_mesh(single_device_mesh()):
+        out1 = flux_forward(params, *args, spec=TINY)
+        out2 = flux_forward(params, *args, spec=TINY)
+    assert out1.shape == (2, 16, TINY.in_channels)
+    assert np.isfinite(np.asarray(out1)).all()
+    np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+
+
+def test_flux_backbone_tp_parity():
+    """Head/ffn-sharded DiT over the 8-device mesh matches single-device."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from neuronx_distributed_inference_tpu.parallel.mesh import build_mesh
+    from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
+
+    from neuronx_distributed_inference_tpu.parallel.mesh import single_device_mesh
+
+    params = flux_random_params(TINY, seed=3)
+    args = _dit_inputs()
+    with jax.set_mesh(single_device_mesh()):
+        ref = np.asarray(flux_forward(params, *args, spec=TINY))
+
+    mesh = build_mesh(tp_degree=4)
+    sharded = shard_pytree(
+        params, flux_param_pspecs(flux_param_shapes(TINY)), mesh
+    )
+    from functools import partial
+
+    with jax.set_mesh(mesh):
+        out = jax.jit(partial(flux_forward, spec=TINY))(sharded, *args)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=2e-4)
+
+
+def _torch_vae_decoder(sd_seed=0):
+    """Reference decoder built from torch modules per the diffusers
+    AutoencoderKL decoder architecture (diffusers is not installed)."""
+    torch.manual_seed(sd_seed)
+    ch = [64, 32]  # reversed_block_out_channels (high -> low)
+    lat, groups = 8, 8
+
+    class Resnet(torch.nn.Module):
+        def __init__(self, i, o):
+            super().__init__()
+            self.norm1 = torch.nn.GroupNorm(groups, i, eps=1e-6)
+            self.conv1 = torch.nn.Conv2d(i, o, 3, padding=1)
+            self.norm2 = torch.nn.GroupNorm(groups, o, eps=1e-6)
+            self.conv2 = torch.nn.Conv2d(o, o, 3, padding=1)
+            self.short = torch.nn.Conv2d(i, o, 1) if i != o else None
+
+        def forward(self, x):
+            h = self.conv1(torch.nn.functional.silu(self.norm1(x)))
+            h = self.conv2(torch.nn.functional.silu(self.norm2(h)))
+            s = self.short(x) if self.short is not None else x
+            return s + h
+
+    class Attn(torch.nn.Module):
+        def __init__(self, c):
+            super().__init__()
+            self.group_norm = torch.nn.GroupNorm(groups, c, eps=1e-6)
+            self.to_q = torch.nn.Linear(c, c)
+            self.to_k = torch.nn.Linear(c, c)
+            self.to_v = torch.nn.Linear(c, c)
+            self.to_out = torch.nn.Linear(c, c)
+
+        def forward(self, x):
+            B, C, H, W = x.shape
+            h = self.group_norm(x).reshape(B, C, H * W).transpose(1, 2)
+            q, k, v = self.to_q(h), self.to_k(h), self.to_v(h)
+            p = torch.softmax(q @ k.transpose(1, 2) * C**-0.5, dim=-1)
+            o = self.to_out(p @ v)
+            return x + o.transpose(1, 2).reshape(B, C, H, W)
+
+    class Dec(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv_in = torch.nn.Conv2d(lat, ch[0], 3, padding=1)
+            self.mid_r0 = Resnet(ch[0], ch[0])
+            self.mid_attn = Attn(ch[0])
+            self.mid_r1 = Resnet(ch[0], ch[0])
+            ups = []
+            prev = ch[0]
+            for ui, c in enumerate(ch):
+                blk = torch.nn.ModuleList(
+                    [Resnet(prev if ri == 0 else c, c) for ri in range(3)]
+                )
+                ups.append(blk)
+                prev = c
+            self.ups = torch.nn.ModuleList(ups)
+            self.up_convs = torch.nn.ModuleList(
+                [torch.nn.Conv2d(ch[0], ch[0], 3, padding=1)]
+            )
+            self.norm_out = torch.nn.GroupNorm(groups, ch[-1], eps=1e-6)
+            self.conv_out = torch.nn.Conv2d(ch[-1], 3, 3, padding=1)
+
+        def forward(self, z):
+            x = self.conv_in(z)
+            x = self.mid_r1(self.mid_attn(self.mid_r0(x)))
+            for ui, blk in enumerate(self.ups):
+                for r in blk:
+                    x = r(x)
+                if ui < len(self.ups) - 1:
+                    x = torch.nn.functional.interpolate(x, scale_factor=2.0, mode="nearest")
+                    x = self.up_convs[ui](x)
+            return self.conv_out(torch.nn.functional.silu(self.norm_out(x)))
+
+    return Dec().eval()
+
+
+def test_vae_decoder_parity():
+    dec = _torch_vae_decoder()
+    spec = VaeDecoderSpec(
+        latent_channels=8, block_out_channels=(32, 64), layers_per_block=2,
+        norm_groups=8, scaling_factor=1.0, shift_factor=0.0,
+    )
+    # map the torch module's state dict onto diffusers names
+    sd = {}
+    tsd = dec.state_dict()
+    ren = {
+        "conv_in": "decoder.conv_in",
+        "mid_r0": "decoder.mid_block.resnets.0",
+        "mid_r1": "decoder.mid_block.resnets.1",
+        "mid_attn.group_norm": "decoder.mid_block.attentions.0.group_norm",
+        "mid_attn.to_q": "decoder.mid_block.attentions.0.to_q",
+        "mid_attn.to_k": "decoder.mid_block.attentions.0.to_k",
+        "mid_attn.to_v": "decoder.mid_block.attentions.0.to_v",
+        "mid_attn.to_out": "decoder.mid_block.attentions.0.to_out.0",
+        "ups.0": "decoder.up_blocks.0.resnets",
+        "ups.1": "decoder.up_blocks.1.resnets",
+        "up_convs.0": "decoder.up_blocks.0.upsamplers.0.conv",
+        "norm_out": "decoder.conv_norm_out",
+        "conv_out": "decoder.conv_out",
+    }
+    for k, v in tsd.items():
+        name = k
+        for old, new in ren.items():
+            if name.startswith(old + "."):
+                name = new + name[len(old):]
+                break
+        name = name.replace(".short.", ".conv_shortcut.")
+        # torch resnet field names already match diffusers (norm1/conv1/...)
+        sd[name] = v.numpy()
+    params = convert_vae_decoder_state_dict(sd, spec)
+
+    rng = np.random.RandomState(0)
+    z = rng.randn(2, 6, 5, 8).astype(np.float32)
+    out = vae_decode(params, jnp.asarray(z), spec=spec)
+    with torch.no_grad():
+        ref = dec(torch.tensor(z).permute(0, 3, 1, 2)).permute(0, 2, 3, 1).numpy()
+    assert np.asarray(out).shape == ref.shape == (2, 12, 10, 3)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=3e-5, rtol=3e-5)
+
+
+def test_flux_pipeline_e2e_smoke():
+    """Tiny full pipeline: ids -> encoders -> 2 denoise steps -> VAE -> image;
+    deterministic by seed, shape/range contract holds."""
+    from neuronx_distributed_inference_tpu.runtime.flux import (
+        FluxPipelineConfig,
+        TpuFluxPipeline,
+    )
+
+    cfg = FluxPipelineConfig(
+        backbone=TINY,
+        clip=ClipTextSpec(
+            hidden_size=24, num_heads=2, num_layers=2, intermediate_size=48,
+            vocab_size=64, max_positions=16, eos_token_id=2,
+        ),
+        t5=T5EncoderSpec(
+            d_model=TINY.joint_dim, num_heads=2, d_kv=16, num_layers=2,
+            d_ff=64, vocab_size=64,
+        ),
+        vae=VaeDecoderSpec(
+            latent_channels=TINY.in_channels // 4, block_out_channels=(16, 16),
+            layers_per_block=1, norm_groups=4,
+        ),
+        height=64, width=64, dtype="float32",
+    )
+    pipe = TpuFluxPipeline(cfg).load(random_weights=True)
+    clip_ids = np.array([[1, 5, 9, 2]])
+    t5_ids = np.array([[4, 7, 11, 1, 0, 0]])
+    img1 = pipe.generate(clip_ids, t5_ids, num_inference_steps=2, seed=5)
+    img2 = pipe.generate(clip_ids, t5_ids, num_inference_steps=2, seed=5)
+    assert img1.shape == (1, 64, 64, 3)
+    assert np.isfinite(img1).all() and (img1 >= 0).all() and (img1 <= 1).all()
+    np.testing.assert_array_equal(img1, img2)
+    img3 = pipe.generate(clip_ids, t5_ids, num_inference_steps=2, seed=6)
+    assert not np.array_equal(img1, img3)
+
+
+def test_image_gen_demo_smoke():
+    from neuronx_distributed_inference_tpu.inference_demo import main
+
+    rc = main([
+        "--task-type", "image-gen", "run", "--model-path", "unused",
+        "--random-weights", "--dtype", "float32", "--prompt", "x",
+    ])
+    assert rc == 0
