@@ -209,7 +209,11 @@ def _strict_kwargs(cls, d: dict) -> dict:
     if unknown:
         raise ValueError(
             f"Unknown {cls.__name__} key(s) in serialized config: {unknown}. "
-            "Refusing to silently drop them — fix or remove these keys."
+            "Refusing to silently drop them — this usually means the artifact "
+            "was saved by a DIFFERENT framework version. Migration: re-save "
+            "the compiled artifact with this version (compile() writes a "
+            "fresh tpu_config.json), or delete the stale key(s) from "
+            "tpu_config.json if their features are no longer configured."
         )
     return d
 
@@ -238,7 +242,6 @@ UNIMPLEMENTED_FLAGS: Dict[str, Tuple[Any, str]] = {
         "XLA owns cache layouts on TPU; the transposed-K layout knob is a "
         "NKI-kernel detail with no TPU equivalent",
     ),
-    "save_sharded_checkpoint": (False, "presharded checkpoint save"),
     "is_prefill_stage": (None, "disaggregated prefill/decode serving"),
     "rpl_reduce_dtype": (
         None,

@@ -295,8 +295,16 @@ class TpuModelForCausalLM:
     def compile(self, compiled_model_path: Optional[str] = None):
         """AOT-compile every (sub-model, bucket) program
         (reference application_base.py:292-315). With the persistent XLA
-        compilation cache this also serves as the on-disk artifact."""
+        compilation cache this also serves as the on-disk artifact.
+
+        With ``save_sharded_checkpoint`` a PRESHARDED weight artifact lives
+        next to the cache (utils/presharded.py; reference
+        application_base.py:240-265): later compiles restore the sharded
+        weights directly — no HF conversion, no quantize-at-load, no
+        resharding.
+        """
         tc = self.config.tpu_config
+        presharded_dir = None
         if compiled_model_path:
             os.makedirs(compiled_model_path, exist_ok=True)
             self.config.save(compiled_model_path)
@@ -309,8 +317,28 @@ class TpuModelForCausalLM:
                 compilation_cache.set_cache_dir(cache_dir)
             except Exception:
                 pass
+            presharded_dir = os.path.join(compiled_model_path, "presharded")
+        if self.params is None and presharded_dir and tc.save_sharded_checkpoint:
+            from neuronx_distributed_inference_tpu.utils.presharded import (
+                load_presharded,
+            )
+
+            restored = load_presharded(presharded_dir, self.mesh)
+            if restored is not None:
+                self.params, self._pspecs = restored
+                self.init_kv_cache()
         if self.params is None:
             self.load(random_weights=self.model_path is None, model_path=self.model_path)
+        if (
+            presharded_dir
+            and tc.save_sharded_checkpoint
+            and not os.path.exists(os.path.join(presharded_dir, "manifest.pkl"))
+        ):
+            from neuronx_distributed_inference_tpu.utils.presharded import (
+                save_presharded,
+            )
+
+            save_presharded(self.params, self._pspecs, presharded_dir)
         if not tc.skip_warmup:
             self.warmup()
         return self
