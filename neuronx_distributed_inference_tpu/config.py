@@ -242,7 +242,6 @@ UNIMPLEMENTED_FLAGS: Dict[str, Tuple[Any, str]] = {
         "XLA owns cache layouts on TPU; the transposed-K layout knob is a "
         "NKI-kernel detail with no TPU equivalent",
     ),
-    "is_prefill_stage": (None, "disaggregated prefill/decode serving"),
     "rpl_reduce_dtype": (
         None,
         "GSPMD emits collectives in the tensor dtype; a separate reduce dtype "

@@ -354,7 +354,15 @@ class TpuModelForCausalLM:
             if self.spec.bounded_window:
                 c = min(c, self.spec.bounded_window)
             chunk_q = [c]
-        for runner in self.runners:
+        # disaggregated serving (reference is_prefill_stage): a stage app
+        # compiles ONLY its stage's programs — the prefill stage serves CTE,
+        # the decode stage serves TKG (runtime/disaggregated.py hands KV over)
+        runners = self.runners
+        if tc.is_prefill_stage is True:
+            runners = [self.context_encoding_model]
+        elif tc.is_prefill_stage is False:
+            runners = [self.token_generation_model]
+        for runner in runners:
             self.kv_cache = runner.warmup(
                 self.params, self.kv_cache, self._sample_key(0),
                 chunk_q_lens=chunk_q if runner is self.token_generation_model else None,

@@ -1,0 +1,244 @@
+"""Disaggregated prefill/decode serving: a PREFILL-stage application encodes
+prompts and hands per-request KV to a DECODE-stage application.
+
+TPU-native re-design of the reference's ``is_prefill_stage`` plumbing
+(reference: models/config.py is_prefill_stage + the CP-at-prefill /
+DP-at-decode process-group split, attention_base.py:247-533; the reference
+leaves the KV transport to the serving layer — here the framework owns it).
+
+Design: each stage is an ordinary :class:`TpuModelForCausalLM` whose config
+sets ``is_prefill_stage`` (True = compile CTE programs only, False = TKG
+only). The hand-off unit is the per-request KV cache lines — a
+``(L, n_req, S, Hkv, D)`` gather on the prefill stage, scattered into the
+decode stage's lines. On one host this is a device-to-device copy; across
+hosts the same arrays ride ``jax.device_put`` to the decode mesh (DCN), the
+TPU analogue of the reference deployments' NeuronCore-to-NeuronCore KV
+transfer. Stages may use DIFFERENT meshes/shardings — e.g. CP-heavy prefill
+and DP-heavy decode, the split the reference builds process groups for.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from neuronx_distributed_inference_tpu.modules.autobucketing import get_target_bucket
+from neuronx_distributed_inference_tpu.modules.kvcache import kv_batch_size
+from neuronx_distributed_inference_tpu.modules.sampling import (
+    prepare_sampling_params,
+    validate_sampling_params,
+)
+from neuronx_distributed_inference_tpu.runtime.application import (
+    GenerationOutput,
+    TpuModelForCausalLM,
+)
+
+
+def _plain_cache(app):
+    from neuronx_distributed_inference_tpu.modules.kvcache import KVCache
+
+    cache = app.kv_cache
+    spec = app.spec
+    if (
+        type(cache) is not KVCache
+        or app.config.tpu_config.is_block_kv_layout
+        or spec.bounded_window is not None
+        or spec.ring_window is not None
+    ):
+        raise NotImplementedError(
+            "disaggregated serving supports the plain contiguous KV cache "
+            "(no ring/interleaved/paged layouts)"
+        )
+    return cache
+
+
+def _host_lines(app, cache, seq_ids: np.ndarray) -> np.ndarray:
+    """Cache line per request, honoring the attention-DP interleaved garbage
+    lines — the jnp slot mapping evaluated once and pulled to host so the
+    indices stay mesh-neutral (the two stages live on different meshes)."""
+    from neuronx_distributed_inference_tpu.modules.kvcache import (
+        slot_ids_from_seq_ids,
+    )
+
+    tc = app.config.tpu_config
+    shards = tc.attention_dp_degree * tc.data_parallel_degree
+    lines = slot_ids_from_seq_ids(
+        jnp.asarray(np.asarray(seq_ids), jnp.int32),
+        kv_batch_size(cache, shards),
+        dp=shards,
+    )
+    return np.asarray(jax.device_get(lines))
+
+
+def extract_request_kv(
+    app: TpuModelForCausalLM, seq_ids: np.ndarray, upto: Optional[int] = None
+) -> Dict:
+    """Gather the cache lines of ``seq_ids`` from the prefill stage:
+    {"k": (L, n, S, Hkv, D), "v": ...} device arrays. ``upto`` bounds the
+    position axis to the populated prefix (transfer only what exists)."""
+    cache = _plain_cache(app)
+    lines = _host_lines(app, cache, seq_ids)
+    S = upto if upto is not None else cache.k.shape[2]
+    return {
+        "k": cache.k[:, lines, :S],
+        "v": cache.v[:, lines, :S],
+        "gqa": app.builder.gqa,  # source KV-head layout for the remap
+    }
+
+
+def inject_request_kv(app: TpuModelForCausalLM, seq_ids: np.ndarray, kv: Dict) -> None:
+    """Scatter handed-over KV into the decode stage's cache lines. The
+    arrays come from the PREFILL stage's mesh; ``jax.device_put`` moves them
+    to the decode mesh (ICI/host copy same-host, DCN across hosts)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    cache = _plain_cache(app)
+    lines = _host_lines(app, cache, seq_ids)
+    S = kv["k"].shape[2]
+    if S > cache.k.shape[2]:
+        raise ValueError(
+            f"handed-over KV covers {S} positions but the decode cache holds "
+            f"{cache.k.shape[2]}"
+        )
+    # the stages may pad/replicate KV heads differently (GQASharding
+    # REPLICATE_TO_TP_DEGREE repeats each head r CONSECUTIVE times for its
+    # model-parallel degree): recover the original heads from the source
+    # layout, re-replicate for the destination's
+    src_gqa = kv.get("gqa")
+    dst_gqa = app.builder.gqa
+    k_arr, v_arr = kv["k"], kv["v"]
+    if src_gqa is not None and (
+        src_gqa.kv_repeat != dst_gqa.kv_repeat
+        or src_gqa.kv_heads != dst_gqa.kv_heads
+    ):
+        k_arr = jnp.repeat(k_arr[:, :, :, :: src_gqa.kv_repeat], dst_gqa.kv_repeat, axis=3)
+        v_arr = jnp.repeat(v_arr[:, :, :, :: src_gqa.kv_repeat], dst_gqa.kv_repeat, axis=3)
+    repl = NamedSharding(app.mesh, P())
+    k_in = jax.device_put(k_arr, repl)
+    v_in = jax.device_put(v_arr, repl)
+    k = cache.k.at[:, lines, :S].set(k_in.astype(cache.k.dtype))
+    v = cache.v.at[:, lines, :S].set(v_in.astype(cache.v.dtype))
+    app.kv_cache = type(cache)(k=k, v=v)
+
+
+class DisaggregatedPipeline:
+    """Prefill-stage + decode-stage orchestration (one process; the two apps
+    may live on different meshes). ``generate`` reproduces the monolithic
+    application's greedy/sampled semantics: CTE on the prefill stage, KV
+    hand-off, then chunked decode on the decode stage."""
+
+    def __init__(self, prefill_app: TpuModelForCausalLM, decode_app: TpuModelForCausalLM):
+        tc_p = prefill_app.config.tpu_config
+        tc_d = decode_app.config.tpu_config
+        if tc_p.is_prefill_stage is not True or tc_d.is_prefill_stage is not False:
+            raise ValueError(
+                "DisaggregatedPipeline needs a prefill-stage app "
+                "(is_prefill_stage=True) and a decode-stage app (False)"
+            )
+        self.prefill_app = prefill_app
+        self.decode_app = decode_app
+
+    def generate(
+        self,
+        input_ids: np.ndarray,
+        attention_mask: Optional[np.ndarray] = None,
+        max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None,
+        top_k=None,
+        top_p=None,
+        temperature=None,
+    ) -> GenerationOutput:
+        from neuronx_distributed_inference_tpu.runtime.application import _pick_chunk
+
+        pre, dec = self.prefill_app, self.decode_app
+        tc = dec.config.tpu_config
+        pre._advance_rng()
+        dec._advance_rng()
+        input_ids = np.asarray(input_ids)
+        B, S_in = input_ids.shape
+        if attention_mask is None:
+            attention_mask = np.ones_like(input_ids)
+        attention_mask = np.asarray(attention_mask)
+        seq_ids = np.arange(B, dtype=np.int32)
+        sp = prepare_sampling_params(B, top_k, top_p, temperature)
+        validate_sampling_params(sp, tc.max_topk)
+        ctx_lens = attention_mask.sum(axis=1).astype(np.int32)
+
+        # --- prefill stage: one CTE pass ---------------------------------
+        if pre.validate_prefill_length(S_in):
+            raise NotImplementedError(
+                "disaggregated prefill of prompts longer than one context "
+                "program is not implemented; raise max_context_length to "
+                "cover the prompt (the monolithic application handles this "
+                "via windowed prefill)"
+            )
+        position_ids = np.tile(np.arange(S_in, dtype=np.int32), (B, 1))
+        inputs, _ = pre.context_encoding_model.prepare(
+            input_ids, attention_mask, position_ids, seq_ids, sp
+        )
+        out = pre.context_encoding_model(
+            pre.params, pre.kv_cache, inputs, pre._sample_key(0)
+        )
+        pre.kv_cache = out.cache
+        first = np.asarray(jax.device_get(out.tokens))[:B, -1]
+
+        # --- KV hand-off ---------------------------------------------------
+        inject_request_kv(
+            dec, seq_ids, extract_request_kv(pre, seq_ids, upto=S_in)
+        )
+
+        # --- decode stage: the monolithic application's EOS-path loop
+        # (application.generate) so outputs match it column-for-column -------
+        eos_arr = (
+            np.atleast_1d(np.asarray(eos_token_id)).astype(np.int64)
+            if eos_token_id is not None
+            else None
+        )
+        eos_fill = int(eos_arr[0]) if eos_arr is not None else 0
+        generated = [first.astype(np.int64)]
+        done = np.zeros(B, bool)
+        if eos_arr is not None:
+            done |= np.isin(generated[-1], eos_arr)
+        pos = ctx_lens.copy()
+        last = first[:, None].astype(np.int32)
+        remaining = max_new_tokens - 1
+        step = 1
+        pos_limit = dec._pos_limit()
+        while remaining > 0 and not done.all():
+            headroom = pos_limit - int(pos.max())
+            if headroom < 1:
+                raise ValueError(
+                    f"generation needs positions past the largest TKG "
+                    f"bucket/cache window ({pos_limit}); raise "
+                    f"token_generation_buckets or seq_len"
+                )
+            chunk = _pick_chunk(remaining, eos_arr is not None, headroom)
+            take = min(chunk, remaining)
+            bucket = get_target_bucket(
+                dec.token_generation_model.buckets, int(pos.max()) + chunk
+            )
+            tokens_c, _, cache = dec.token_generation_model.decode_chunk(
+                dec.params, dec.kv_cache, last, pos[:, None], seq_ids, sp,
+                dec._sample_key(step), num_steps=chunk, bucket=bucket,
+            )
+            dec.kv_cache = cache
+            toks = np.asarray(jax.device_get(tokens_c))[:B]
+            for j in range(take):
+                col = np.where(done, eos_fill, toks[:, j])
+                if eos_arr is not None:
+                    done |= np.isin(col, eos_arr)
+                generated.append(col.astype(np.int64))
+            last = toks[:, take - 1 : take].astype(np.int32)
+            pos = pos + take
+            remaining -= take
+            step += 1
+
+        gen = np.stack(generated, axis=1)
+        return GenerationOutput(
+            sequences=np.concatenate([input_ids, gen], axis=1),
+            logits=None,
+            num_generated=gen.shape[1],
+        )

@@ -1,0 +1,78 @@
+"""Disaggregated prefill/decode serving (reference is_prefill_stage plumbing):
+a prefill-stage app encodes, KV hands over, a decode-stage app continues —
+tokens must match the monolithic application."""
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_tiny_config, make_random_hf_state_dict
+
+from neuronx_distributed_inference_tpu.runtime.application import TpuModelForCausalLM
+from neuronx_distributed_inference_tpu.runtime.disaggregated import (
+    DisaggregatedPipeline,
+)
+
+PROMPTS = np.array([[5, 17, 92, 41, 33, 88, 2, 11], [64, 3, 27, 9, 14, 0, 0, 0]])
+MASK = np.array([[1, 1, 1, 1, 1, 1, 1, 1], [1, 1, 1, 1, 1, 0, 0, 0]])
+
+
+def _apps():
+    sd = None
+    built = {}
+    for name, stage, tp in (("mono", None, 1), ("pre", True, 1), ("dec", False, 2)):
+        cfg = make_tiny_config(tpu=dict(is_prefill_stage=stage, tp_degree=tp))
+        if sd is None:
+            sd = make_random_hf_state_dict(cfg)
+        app = TpuModelForCausalLM(None, cfg)
+        app.load(state_dict=sd)
+        built[name] = app
+    return built
+
+
+def test_disaggregated_matches_monolithic():
+    apps = _apps()
+    ref = apps["mono"].generate(PROMPTS, MASK, max_new_tokens=12).sequences
+
+    pipe = DisaggregatedPipeline(apps["pre"], apps["dec"])
+    out = pipe.generate(PROMPTS, MASK, max_new_tokens=12)
+    np.testing.assert_array_equal(out.sequences, ref)
+
+
+def test_disaggregated_eos_truncation():
+    apps = _apps()
+    ref = apps["mono"].generate(PROMPTS, MASK, max_new_tokens=12, eos_token_id=7)
+    pipe = DisaggregatedPipeline(apps["pre"], apps["dec"])
+    out = pipe.generate(PROMPTS, MASK, max_new_tokens=12, eos_token_id=7)
+    np.testing.assert_array_equal(out.sequences, ref.sequences)
+
+
+def test_stage_validation():
+    apps = _apps()
+    with pytest.raises(ValueError, match="prefill-stage"):
+        DisaggregatedPipeline(apps["mono"], apps["dec"])
+
+
+def test_disaggregated_attention_dp_decode_stage():
+    """Decode stage under attention-DP: the hand-off must honor the
+    interleaved per-shard garbage lines of the DP cache layout."""
+    sd = None
+    cfgs = {
+        "mono": dict(is_prefill_stage=None, tp_degree=1),
+        "pre": dict(is_prefill_stage=True, tp_degree=1),
+        "dec": dict(
+            is_prefill_stage=False, tp_degree=4, attention_dp_degree=2,
+            is_continuous_batching=True,
+        ),
+    }
+    apps = {}
+    for name, tpu in cfgs.items():
+        cfg = make_tiny_config(tpu=tpu)
+        if sd is None:
+            sd = make_random_hf_state_dict(cfg)
+        apps[name] = TpuModelForCausalLM(None, cfg)
+        apps[name].load(state_dict=sd)
+    ref = apps["mono"].generate(PROMPTS, MASK, max_new_tokens=10).sequences
+    out = DisaggregatedPipeline(apps["pre"], apps["dec"]).generate(
+        PROMPTS, MASK, max_new_tokens=10
+    )
+    np.testing.assert_array_equal(out.sequences, ref)

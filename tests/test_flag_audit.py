@@ -92,8 +92,6 @@ def test_unimplemented_flag_raises(name):
         trigger = "float32"
     if name == "weights_to_skip_layout_optimization":
         trigger = ["lm_head"]
-    if name == "is_prefill_stage":
-        trigger = True
     kwargs = {name: trigger}
     # satisfy interaction validations that run before the unimplemented check
     if name in ("is_chunked_prefill", "is_prefix_caching"):
