@@ -37,6 +37,39 @@ from neuronx_distributed_inference_tpu.runtime.application import (
 )
 
 
+def draft_propose(draft, last, pos, seq_ids, sp, k: int, key=None):
+    """One batched draft pass proposing k-1 tokens per row. Returns
+    (proposals (B, k-1) host, draft logits or None). Shared by
+    assisted_generate and SpeculativeServingSession."""
+    bucket = get_target_bucket(
+        draft.token_generation_model.buckets, int(np.asarray(pos).max()) + k
+    )
+    d_tokens, d_logits, d_cache = draft.token_generation_model.decode_chunk(
+        draft.params, draft.kv_cache, np.asarray(last), np.asarray(pos),
+        seq_ids, sp, key, num_steps=k - 1, bucket=bucket,
+    )
+    draft.kv_cache = d_cache
+    B = np.asarray(last).shape[0]
+    return np.asarray(jax.device_get(d_tokens))[:B], d_logits
+
+
+def target_verify(target, cand, pos, seq_ids, sp, key=None):
+    """One multi-token target pass over the k candidates per row. Returns
+    the StepOutput (tokens = per-position greedy/sampled predictions)."""
+    k = cand.shape[1]
+    cand_pos = np.asarray(pos) + np.arange(k, dtype=np.int32)[None, :]
+    width = get_target_bucket(
+        target.token_generation_model.buckets, int(cand_pos.max()) + 1
+    )
+    cache_mask = (np.arange(width)[None, :] <= cand_pos[:, -1:]).astype(np.int32)
+    v_inputs, _ = target.token_generation_model.prepare(
+        cand, cache_mask, cand_pos, seq_ids, sp
+    )
+    out = target.token_generation_model(target.params, target.kv_cache, v_inputs, key)
+    target.kv_cache = out.cache
+    return out
+
+
 def assisted_generate(
     target: TpuModelForCausalLM,
     draft: TpuModelForCausalLM,
@@ -130,26 +163,15 @@ def assisted_generate(
         len(c) >= max_new_tokens for c in collected
     ):
         rnd += 1
-        # --- draft proposes k-1 tokens (k-1 single-token decodes) ---
-        bucket = get_target_bucket(
-            draft.token_generation_model.buckets, int(pos.max()) + k
-        )
+        # --- draft proposes k-1 tokens (one batched chunked pass) ---
         step_key = jax.random.fold_in(draft_key, rnd) if do_sample else None
-        d_tokens, d_logits, d_cache = draft.token_generation_model.decode_chunk(
-            draft.params, draft.kv_cache, last[:, None], pos[:, None], seq_ids, sp,
-            step_key, num_steps=k - 1, bucket=bucket,
+        proposals, d_logits = draft_propose(
+            draft, last[:, None], pos[:, None], seq_ids, sp, k, step_key
         )
-        draft.kv_cache = d_cache
-        proposals = np.asarray(jax.device_get(d_tokens))[:B]  # (B, k-1)
 
         # --- target verifies all k candidates in one pass ---
         cand = np.concatenate([last[:, None], proposals], axis=1).astype(np.int32)
-        cand_pos = pos[:, None] + np.arange(k, dtype=np.int32)[None, :]
-        width = get_target_bucket(tkg.buckets, int(pos.max()) + k)
-        cache_mask = (np.arange(width)[None, :] <= cand_pos[:, -1:]).astype(np.int32)
-        v_inputs, _ = tkg.prepare(cand, cache_mask, cand_pos, seq_ids, sp)
-        v_out = tkg(target.params, target.kv_cache, v_inputs)
-        target.kv_cache = v_out.cache
+        v_out = target_verify(target, cand, pos[:, None], seq_ids, sp)
 
         if do_sample:
             # multinomial accept/reject on the warped p/q distributions

@@ -624,3 +624,167 @@ class ServingSession:
                 if done:
                     self._finish(r)
                     break
+
+
+class SpeculativeServingSession(ServingSession):
+    """Draft-assisted continuous batching (reference: fused/EAGLE speculation
+    under vLLM continuous batching): each step() the DRAFT app proposes k-1
+    tokens for every decoding request in one batched pass, the TARGET app
+    verifies all k candidates in one multi-token pass, and each request
+    advances by its own accepted count. Greedy verification (the serving
+    sessions are greedy throughout): emitted tokens are byte-equal to the
+    target's own greedy decoding, so a weak draft only costs speed.
+
+    Cache discipline matches runtime/assisted.py: write-then-attend on both
+    apps leaves rejected candidates as masked-stale entries that the next
+    round overwrites. Contiguous caches only (speculative writes need the
+    position==slot invariant; paged serving would need k-slot block
+    reservations per step).
+    """
+
+    def __init__(self, app, draft_app, speculation_length: int = 4):
+        super().__init__(app)
+        tc_d = draft_app.config.tpu_config
+        spec = app.spec
+        if self.block_mode or self.chunked:
+            raise NotImplementedError(
+                "speculative serving runs on the contiguous cache (no "
+                "paged/chunked-prefill layouts)"
+            )
+        if spec.bounded_window or spec.ring_window or (
+            draft_app.spec.bounded_window or draft_app.spec.ring_window
+        ):
+            raise NotImplementedError(
+                "speculative serving over ring-bounded caches is not "
+                "implemented (rejected speculative writes would corrupt live "
+                "ring slots)"
+            )
+        if not tc_d.is_continuous_batching:
+            raise ValueError("the draft app needs is_continuous_batching=True")
+        if speculation_length < 2:
+            raise ValueError("speculation_length must be >= 2")
+        # fail at construction, not mid-stream: the batched rounds need the
+        # draft compiled for the same slot count and at least the target's
+        # decode reach
+        d_batch = tc_d.tkg_batch_size or tc_d.max_batch_size or tc_d.batch_size
+        if d_batch < self.num_slots:
+            raise ValueError(
+                f"draft app batch ({d_batch}) smaller than the session's "
+                f"{self.num_slots} slots"
+            )
+        if (
+            draft_app.token_generation_model.buckets[-1]
+            < app.token_generation_model.buckets[-1]
+        ):
+            raise ValueError(
+                "draft token_generation_buckets must reach at least as far "
+                "as the target's"
+            )
+        self.draft = draft_app
+        self.k = speculation_length
+        self.async_decode = False  # accept/reject is a host decision per step
+
+    def _full_prefill(self, req: Request) -> bool:
+        # fail BEFORE any state mutates: the draft prefill below is a single
+        # CTE pass, so prompts needing the windowed path are rejected here
+        if self.app.validate_prefill_length(req.prompt_len) or (
+            req.prompt_len > self.draft.context_encoding_model.buckets[-1]
+        ):
+            raise NotImplementedError(
+                "speculative serving of prompts longer than one context "
+                "program is not implemented; raise max_context_length (both "
+                "apps) to cover the prompt"
+            )
+        ok = super()._full_prefill(req)
+        if not ok or req.finished:
+            # already terminated at prefill (EOS / 1-token budget): no draft
+            # state will ever be consulted
+            return ok
+        # prefill the DRAFT's cache line for this request too (its first
+        # token is discarded — proposals chain from the target's tokens)
+        S = req.prompt_len
+        ids = req.input_ids[None, :]
+        mask = np.ones((1, S), np.int32)
+        pos = np.arange(S, dtype=np.int32)[None, :]
+        seq_ids = np.array([req.slot], np.int32)
+        inputs, _ = self.draft.context_encoding_model.prepare(
+            ids, mask, pos, seq_ids, prepare_sampling_params(1)
+        )
+        out = self.draft.context_encoding_model(
+            self.draft.params, self.draft.kv_cache, inputs, None
+        )
+        self.draft.kv_cache = out.cache
+        return True
+
+    def step(self) -> Dict[str, int]:
+        """One speculation round for every decoding request. Returns ALL
+        tokens accepted this round, {req_id: last_accepted_token} (use
+        request.generated for the full stream)."""
+        import jax
+
+        results: Dict[str, int] = {}
+        active = self.decoding
+        if not active:
+            return results
+        from neuronx_distributed_inference_tpu.runtime.assisted import (
+            draft_propose,
+            target_verify,
+        )
+
+        tc = self.app.config.tpu_config
+        k = self.k
+        B = self.num_slots
+        pos_limit = self.app._pos_limit()
+        rows = [r for r in active if r.pos + k <= pos_limit]
+        tail = [r for r in active if r not in rows]
+        if tail:
+            # rows within k-1 positions of the limit: plain single-step
+            # decode keeps emitting the same tokens the non-speculative
+            # session would (no early truncation)
+            out, snap = self._dispatch_decode([(r, r.pos) for r in tail])
+            if out is not None:
+                self._consume((out.tokens[:, -1:], snap), results)
+        if not rows:
+            return results
+
+        last = np.zeros((B, 1), np.int32)
+        pos = np.zeros((B, 1), np.int32)
+        seq_ids = np.full((B,), -1, np.int32)
+        for r in rows:
+            last[r.slot, 0] = r.last_token
+            pos[r.slot, 0] = r.pos
+            seq_ids[r.slot] = r.slot
+        sp = prepare_sampling_params(B)
+
+        # --- draft proposes k-1 tokens per row; target verifies all k -------
+        proposals, _ = draft_propose(self.draft, last, pos, seq_ids, sp, k)
+        cand = np.concatenate([last, proposals], axis=1).astype(np.int32)
+        v_out = target_verify(self.app, cand, pos, seq_ids, sp)
+        greedy = np.asarray(jax.device_get(v_out.tokens))[:B]  # (B, k)
+
+        # --- contiguous-match acceptance, per-request bookkeeping -----------
+        matches = (cand[:, 1:] == greedy[:, :-1]).astype(np.int64)
+        counts = np.cumprod(matches, axis=1).sum(axis=1) + 1  # in [1, k]
+        for r in rows:
+            s = r.slot
+            row = greedy[s, : counts[s]].tolist()
+            if r.eos_token_id is not None and r.eos_token_id in row:
+                row = row[: row.index(r.eos_token_id) + 1]
+            room = r.max_new_tokens - len(r.generated)
+            row = row[:room]
+            r.generated.extend(row)
+            r.pos += len(row)
+            if row:
+                results[r.req_id] = row[-1]
+            if (
+                (r.eos_token_id is not None and row and row[-1] == r.eos_token_id)
+                or len(r.generated) >= r.max_new_tokens
+                or r.pos + 1 >= tc.seq_len
+            ):
+                self._finish(r)
+        return results
+
+    def run_to_completion(self, decode_chunk_size: int = 16) -> Dict[str, List[int]]:
+        while self.active:
+            self.step()
+        return {rid: r.generated for rid, r in self.requests.items()}

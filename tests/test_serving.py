@@ -234,3 +234,92 @@ def test_assisted_sampled_decoding():
     bad = TpuModelForCausalLM(None, bad_cfg).load(state_dict=bad_sd)
     with pytest.raises(ValueError, match="output_logits"):
         assisted_generate(bad, dg, prompts, mask, max_new_tokens=4)
+
+
+def test_speculative_serving_matches_plain_serving():
+    """Speculation under continuous batching: greedy verification must emit
+    the same tokens as the plain session, with mid-stream request turnover
+    and a (wrong-weights) draft that forces rejections."""
+    from neuronx_distributed_inference_tpu.runtime.serving import (
+        SpeculativeServingSession,
+    )
+
+    target_cfg = make_tiny_config(
+        tpu=dict(is_continuous_batching=True, batch_size=2, ctx_batch_size=1)
+    )
+    target_sd = make_random_hf_state_dict(target_cfg, seed=0)
+    plain_app = TpuModelForCausalLM(None, target_cfg).load(state_dict=target_sd)
+    golden = {}
+    for rid, prompt in (("r1", [5, 17, 92, 41]), ("r2", [64, 3, 27, 9, 14, 33]),
+                        ("r3", [7, 8])):
+        ids = np.asarray(prompt)[None, :]
+        golden[rid] = plain_app.generate(
+            ids, np.ones_like(ids), max_new_tokens=8
+        ).sequences[0, ids.shape[1]:].tolist()
+
+    for draft_seed in (0, 7):  # identical draft (full accept) + wrong draft
+        target = TpuModelForCausalLM(
+            None, make_tiny_config(
+                tpu=dict(is_continuous_batching=True, batch_size=2, ctx_batch_size=1)
+            )
+        ).load(state_dict=target_sd)
+        draft = TpuModelForCausalLM(
+            None, make_tiny_config(
+                tpu=dict(is_continuous_batching=True, batch_size=2, ctx_batch_size=1)
+            )
+        ).load(state_dict=make_random_hf_state_dict(target_cfg, seed=draft_seed))
+        sess = SpeculativeServingSession(target, draft, speculation_length=4)
+        assert sess.add_request("r1", [5, 17, 92, 41], max_new_tokens=8)
+        assert sess.add_request("r2", [64, 3, 27, 9, 14, 33], max_new_tokens=8)
+        results = {}
+        while sess.active:
+            sess.step()
+            if "r3" not in sess.requests and sess.free_slots:
+                assert sess.add_request("r3", [7, 8], max_new_tokens=8)
+        results = {rid: r.generated for rid, r in sess.requests.items()}
+        assert results == golden, f"draft_seed={draft_seed}"
+
+
+def test_speculative_serving_gates():
+    from neuronx_distributed_inference_tpu.runtime.serving import (
+        SpeculativeServingSession,
+    )
+
+    cfg = make_tiny_config(
+        tpu=dict(
+            is_continuous_batching=True, batch_size=2, ctx_batch_size=1,
+            is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=16,
+        )
+    )
+    sd = make_random_hf_state_dict(cfg)
+    app = TpuModelForCausalLM(None, cfg).load(state_dict=sd)
+    with pytest.raises(NotImplementedError, match="contiguous"):
+        SpeculativeServingSession(app, app)
+
+
+def test_speculative_serving_near_limit_matches():
+    """Requests within k-1 positions of the limit must keep emitting the
+    plain session's tokens via single-step fallback (no early truncation)."""
+    from neuronx_distributed_inference_tpu.runtime.serving import (
+        SpeculativeServingSession,
+    )
+
+    mk = lambda: make_tiny_config(
+        tpu=dict(is_continuous_batching=True, batch_size=2, ctx_batch_size=1)
+    )
+    sd = make_random_hf_state_dict(mk(), seed=0)
+    prompt = list(range(40, 90))  # 50 tokens; seq_len 64 -> ~13 positions left
+    plain = TpuModelForCausalLM(None, mk()).load(state_dict=sd)
+    sess_p = ServingSession(plain)
+    assert sess_p.add_request("r", prompt, max_new_tokens=30)
+    golden = sess_p.run_to_completion()["r"]
+    assert len(golden) < 30  # hit the position bound, not the budget
+
+    target = TpuModelForCausalLM(None, mk()).load(state_dict=sd)
+    draft = TpuModelForCausalLM(None, mk()).load(
+        state_dict=make_random_hf_state_dict(mk(), seed=5)
+    )
+    sess = SpeculativeServingSession(target, draft, speculation_length=4)
+    assert sess.add_request("r", prompt, max_new_tokens=30)
+    out = sess.run_to_completion()["r"]
+    assert out == golden
