@@ -178,16 +178,7 @@ class ServingSession:
             )
         if (ring_w and S > ring_w) or S > cte_max:
             self.app.validate_prefill_length(S)
-            first_tok, _ = self.app._windowed_prefill(
-                req.input_ids[None, :],
-                np.ones((1, S), np.int32),
-                np.array([req.slot], np.int32),
-                prepare_sampling_params(1),
-                None,
-            )
-            req.prefill_pos = S
-            self._finish_prefill(req, int(np.asarray(jax.device_get(first_tok))[0, 0]))
-            return True
+            return self._windowed_admit(req)
         ids = req.input_ids[None, :]
         mask = np.ones((1, S), np.int32)
         pos = np.arange(S, dtype=np.int32)[None, :]
@@ -207,6 +198,75 @@ class ServingSession:
         )
         self.app.kv_cache = out.cache
         first = int(np.asarray(out.tokens)[0, -1])
+        req.prefill_pos = S
+        self._finish_prefill(req, first)
+        return True
+
+    def _windowed_admit(self, req: Request) -> bool:
+        """Admit a prompt longer than one context program (or a ring window)
+        in windows, like application._windowed_prefill — but SLOT-ALIGNED:
+        the multi-token TKG chunks run at the session's full batch with the
+        request in row == slot, because TKG programs read cache line b for
+        row b (the sorted-batch convention; a B=1 pass with seq_ids=[slot]
+        would read line 0 while writing line slot).
+
+        Deliberately mirrors application._windowed_prefill's chunk shape
+        rules (C clipped to the ring window; bounded-or-bucket width carrier;
+        sentinel positions for padding) — change them together."""
+        from neuronx_distributed_inference_tpu.modules.kvcache import (
+            PAD_POSITION_SENTINEL,
+        )
+
+        app = self.app
+        S = req.prompt_len
+        s = req.slot
+        C = app.context_encoding_model.buckets[-1]
+        ring_w = app.spec.bounded_window or app.spec.ring_window
+        if ring_w:
+            C = min(C, ring_w)  # ring slots must stay distinct within a chunk
+
+        # chunk 0 through the CTE program (writes go to line `slot` via
+        # seq_ids; CTE reads nothing from the cache, so B=1 is fine)
+        n0 = min(C, S)
+        ids0 = req.input_ids[None, :n0]
+        pos0 = np.arange(n0, dtype=np.int32)[None, :]
+        inputs, _ = app.context_encoding_model.prepare(
+            ids0, np.ones((1, n0), np.int32), pos0,
+            np.array([s], np.int32), prepare_sampling_params(1),
+        )
+        out = app.context_encoding_model(app.params, app.kv_cache, inputs, None)
+        app.kv_cache = out.cache
+        # no fetch here: this path only triggers for S > C, so the chunk loop
+        # below always runs and the final chunk's token is the one emitted
+
+        B = self.num_slots
+        start = n0
+        n = 0
+        while start < S:
+            end = min(start + C, S)
+            n = end - start
+            ids = np.zeros((B, C), np.int32)
+            ids[s, :n] = req.input_ids[start:end]
+            pos = np.full((B, C), PAD_POSITION_SENTINEL, np.int32)
+            pos[s, :n] = np.arange(start, end, dtype=np.int32)
+            # width carrier: bounded ring caches hold W slots; interleaved
+            # models keep FULL-length global layers, so the carrier is the
+            # full decode bucket (ring layers bound themselves per layer)
+            width = app.spec.bounded_window or get_target_bucket(
+                app.token_generation_model.buckets, end
+            )
+            mask = np.ones((B, width), np.int32)
+            seq_ids = np.full((B,), -1, np.int32)
+            seq_ids[s] = s
+            inputs, _ = app.token_generation_model.prepare(
+                ids, mask, pos, seq_ids, prepare_sampling_params(B)
+            )
+            out = app.token_generation_model(app.params, app.kv_cache, inputs, None)
+            app.kv_cache = out.cache
+            start = end
+        # ONE host sync for the whole admission: only the last chunk's token
+        # at the final prompt position matters
+        first = int(np.asarray(jax.device_get(out.tokens))[s, n - 1])
         req.prefill_pos = S
         self._finish_prefill(req, first)
         return True
