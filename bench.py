@@ -240,15 +240,29 @@ def run_suite(tiny=False):
 
     for name in _suite_params(False):
         # generous per-point ceiling: the int8 8B point moves ~9 GB of
-        # weights to the device, which through a tunneled chip is slow
-        proc = subprocess.run(
-            [sys.executable, __file__, "--point", name],
-            capture_output=True, text=True, timeout=7200,
-        )
-        if proc.returncode != 0:
-            print(proc.stderr[-4000:], file=sys.stderr)
-            raise RuntimeError(f"bench point {name} failed (rc={proc.returncode})")
-        points[name] = json.loads(proc.stdout.strip().splitlines()[-1])
+        # weights to the device, which through a tunneled chip is slow.
+        # A failed/timed-out point must NOT sink the suite: the headline
+        # (first) point's number is the contract — later points degrade to
+        # an "error" entry in the JSON instead.
+        try:
+            proc = subprocess.run(
+                [sys.executable, __file__, "--point", name],
+                capture_output=True, text=True, timeout=7200,
+            )
+            if proc.returncode != 0:
+                print(proc.stderr[-4000:], file=sys.stderr)
+                raise RuntimeError(f"bench point {name} failed rc={proc.returncode}")
+            points[name] = json.loads(proc.stdout.strip().splitlines()[-1])
+        except Exception as e:  # timeout / crash / bad output
+            # a timed-out child's partial stderr is the only diagnostic left
+            partial = getattr(e, "stderr", None)
+            if partial:
+                if isinstance(partial, bytes):
+                    partial = partial.decode(errors="replace")
+                print(partial[-4000:], file=sys.stderr)
+            if name == "bf16_1b_bs1":
+                raise  # no headline -> the suite IS failed
+            points[name] = {"error": str(e)[:200]}
         print(f"{name}: {points[name]}", file=sys.stderr)
     return points
 
@@ -273,15 +287,17 @@ def main():
                 "vs_baseline": round(headline / baseline, 4),
                 "ttft_ms": points["bf16_1b_bs1"]["ttft_ms"],
                 "prefill_tok_s": points["bf16_1b_bs1"].get("prefill_tok_s"),
-                "decode_bs4_tok_s": points["bf16_1b_bs4"]["decode_tok_s"],
-                "int8_1b_tok_s": points["int8_1b_bs1"]["decode_tok_s"],
-                "int8_1b_ttft_ms": points["int8_1b_bs1"]["ttft_ms"],
-                "int8_8b_tok_s": points["int8_8b_bs1"]["decode_tok_s"],
-                "int8_8b_ttft_ms": points["int8_8b_bs1"]["ttft_ms"],
+                "decode_bs4_tok_s": points["bf16_1b_bs4"].get("decode_tok_s"),
+                "int8_1b_tok_s": points["int8_1b_bs1"].get("decode_tok_s"),
+                "int8_1b_ttft_ms": points["int8_1b_bs1"].get("ttft_ms"),
+                "int8_8b_tok_s": points["int8_8b_bs1"].get("decode_tok_s"),
+                "int8_8b_ttft_ms": points["int8_8b_bs1"].get("ttft_ms"),
                 # 1332 = reference 8B bf16 trn1-32-core throughput gate
                 # (1665 * 0.8, BASELINE.md test_llama3_1_8b_4layer_dtype.py row)
-                "int8_8b_vs_8b_gate": round(
-                    points["int8_8b_bs1"]["decode_tok_s"] / 1332.0, 4
+                "int8_8b_vs_8b_gate": (
+                    round(points["int8_8b_bs1"]["decode_tok_s"] / 1332.0, 4)
+                    if "decode_tok_s" in points["int8_8b_bs1"]
+                    else None
                 ),
                 "device": points["bf16_1b_bs1"].get("device"),
             }
