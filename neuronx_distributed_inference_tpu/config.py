@@ -255,10 +255,9 @@ UNIMPLEMENTED_FLAGS: Dict[str, Tuple[Any, str]] = {
     "weights_to_skip_layout_optimization": (None, "XLA owns weight layouts on TPU"),
 }
 
-# MoETpuConfig-only parity flags, same contract
-UNIMPLEMENTED_MOE_FLAGS: Dict[str, Tuple[Any, str]] = {
-    "moe_fused_kernel_enabled": (None, "fused MoE kernel"),
-}
+# MoETpuConfig-only parity flags, same contract (empty: every MoE flag is
+# implemented as of round 4)
+UNIMPLEMENTED_MOE_FLAGS: Dict[str, Tuple[Any, str]] = {}
 
 
 # ---------------------------------------------------------------------------

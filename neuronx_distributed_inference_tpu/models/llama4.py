@@ -251,6 +251,8 @@ class Llama4TextModelBuilder(DecoderModelBuilder):
             capacity_factor=getattr(tc, "capacity_factor", None),
             ep_degree=tc.ep_degree,
             hybrid_cte_full_tp=bool(getattr(tc, "hybrid_sharding_config", None)),
+            moe_fused_kernel=getattr(tc, "moe_fused_kernel_enabled", None),
+            model_parallel=self.degree,
         )
 
     def mlp_fn(self):

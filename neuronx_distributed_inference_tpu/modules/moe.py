@@ -69,6 +69,14 @@ class MoESpec:
     # decode (S = 1..spec_len, any batch) stays dense (reference
     # moe_token_gen_all_experts)
     sparse_dispatch_threshold: int = 64
+    # fused selected-experts decode kernel (reference
+    # moe_fused_nki_kernel_enabled): None/False = native all-experts decode,
+    # True = force the Pallas kernel (ops/moe_decode.py) — structural guards
+    # still apply and fall back with a warning
+    moe_fused_kernel: Optional[bool] = None
+    # full model-parallel degree (see AttnSpec.model_parallel: pallas_call
+    # has no GSPMD rule, so the fused kernel requires one shard)
+    model_parallel: int = 1
     # hybrid CTE/TKG expert sharding (reference HybridShardingConfig,
     # models/config.py:694 + moe_v2.py:135-144): decode keeps the persistent
     # ep x tp expert layout; prefill-sized calls constrain the expert weights
@@ -406,10 +414,32 @@ def moe_layer(
         and spec.top_k < spec.num_experts
         and not _has_blockwise_scales(params["experts"])
     )
+    from neuronx_distributed_inference_tpu.ops.moe_decode import (
+        fused_moe_decode,
+        use_moe_tkg_kernel,
+    )
+
     if sparse_ok and spec.capacity_factor is not None:
         out = expert_mlps_capacity(expert_params, x, affinities, spec)
     elif sparse_ok:
         out = expert_mlps_grouped(expert_params, x, affinities, spec)
+    elif not prefill_sized and use_moe_tkg_kernel(spec, params["experts"], x.shape[0]):
+        # decode: DMA only the SELECTED experts' weights (k/E of the dense
+        # path's HBM traffic; reference fused MoE TKG kernels, §2.10)
+        from neuronx_distributed_inference_tpu.ops.kernel_mode import (
+            kernel_interpret,
+        )
+
+        w_topk, e_topk = jax.lax.top_k(affinities, spec.top_k)
+        out = fused_moe_decode(
+            x, e_topk.astype(jnp.int32), w_topk,
+            params["experts"]["gate_proj"]["weight"],
+            params["experts"]["up_proj"]["weight"],
+            params["experts"]["down_proj"]["weight"],
+            act=spec.act, act_scale=spec.act_scale, act_bias=spec.act_bias,
+            swiglu_limit=spec.swiglu_limit,
+            interpret=kernel_interpret(),
+        )
     else:
         out = expert_mlps_dense(expert_params, x, affinities, spec, selected)
     if shared_mlp_fn is not None:

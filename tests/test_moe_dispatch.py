@@ -130,3 +130,74 @@ def test_moe_layer_picks_sparse_path_at_prefill():
     np.testing.assert_allclose(
         np.asarray(out_sparse), np.asarray(out_dense), atol=2e-5, rtol=2e-5
     )
+
+
+# ---------------------------------------------------------------------------
+# fused selected-experts decode kernel (ops/moe_decode.py)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("glu", ["silu", "gptoss"])
+def test_fused_moe_decode_matches_dense(glu):
+    from neuronx_distributed_inference_tpu.modules.moe import expert_mlps_dense
+    from neuronx_distributed_inference_tpu.ops.moe_decode import fused_moe_decode
+
+    rng = np.random.RandomState(0)
+    E, k, T = 8, 2, 4
+    kwargs = (
+        dict(act_scale=1.702, act_bias=1.0, swiglu_limit=7.0)
+        if glu == "gptoss"
+        else {}
+    )
+    spec = MoESpec(num_experts=E, top_k=k, **kwargs)
+    params = _params(rng, E)
+    x = jnp.asarray(rng.randn(T, H).astype(np.float32) * 0.3)
+    aff, sel = router_top_k(jnp.asarray(rng.randn(T, E).astype(np.float32)), spec)
+    ref = expert_mlps_dense(params, x, aff, spec, sel)
+
+    w_topk, e_topk = jax.lax.top_k(aff, k)
+    out = fused_moe_decode(
+        x, e_topk.astype(jnp.int32), w_topk,
+        params["gate_proj"]["weight"], params["up_proj"]["weight"],
+        params["down_proj"]["weight"],
+        act=spec.act, act_scale=spec.act_scale, act_bias=spec.act_bias,
+        swiglu_limit=spec.swiglu_limit, interpret=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_fused_moe_decode_e2e_token_match():
+    """Mixtral generate() with the fused MoE decode kernel forced (interpret
+    on CPU) matches the native path bit-for-bit."""
+    import torch
+    import transformers
+
+    from tests.test_moe import MIXTRAL_KW, _build_app, _mixtral, PROMPTS as MP
+
+    hf, hf_config = _mixtral()
+    outs = {}
+    for fused in (False, True):
+        app = _build_app(
+            hf, hf_config, "mixtral",
+            **({"moe_fused_kernel_enabled": True} if fused else {}),
+        )
+        outs[fused] = app.generate(MP, np.ones_like(MP), max_new_tokens=6)
+    np.testing.assert_array_equal(outs[True].sequences, outs[False].sequences)
+    np.testing.assert_allclose(
+        outs[True].logits, outs[False].logits, atol=2e-4, rtol=2e-4
+    )
+
+
+def test_use_moe_tkg_kernel_gates():
+    from neuronx_distributed_inference_tpu.ops.moe_decode import use_moe_tkg_kernel
+
+    rng = np.random.RandomState(0)
+    params = _params(rng, 8)
+    on = MoESpec(num_experts=8, top_k=2, moe_fused_kernel=True)
+    assert use_moe_tkg_kernel(on, params, 4)
+    assert not use_moe_tkg_kernel(on, params, 64)  # too many tokens
+    auto = MoESpec(num_experts=8, top_k=2)
+    assert not use_moe_tkg_kernel(auto, params, 4)  # auto = off
+    q = {k2: dict(v) for k2, v in params.items()}
+    q["down_proj"] = dict(q["down_proj"], scale=jnp.ones((8, H)))
+    assert not use_moe_tkg_kernel(on, q, 4)  # quantized
