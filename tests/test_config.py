@@ -58,10 +58,11 @@ def test_attribute_map():
     assert cfg.n_positions == 99
 
 
-def test_presharded_save_load_roundtrip(tmp_path):
-    """save_sharded_checkpoint: compile() writes a presharded weight artifact
-    and a fresh app restores it WITHOUT re-running checkpoint conversion
-    (reference application_base.py:240-265)."""
+def _presharded_roundtrip(tmp_path, **tpu_kwargs):
+    """Shared harness: build + load + compile(path) an app, then restore a
+    FRESH app from the artifact (model_path=None: a restore failure would
+    fall back to random weights and break the token comparison). Returns
+    (restored_app, reference_sequences, restored_sequences)."""
     import numpy as np
 
     from tests.conftest import make_tiny_config, make_random_hf_state_dict
@@ -70,7 +71,7 @@ def test_presharded_save_load_roundtrip(tmp_path):
         load_model,
     )
 
-    cfg = make_tiny_config(tpu=dict(save_sharded_checkpoint=True, tp_degree=2))
+    cfg = make_tiny_config(tpu=dict(save_sharded_checkpoint=True, **tpu_kwargs))
     sd = make_random_hf_state_dict(cfg)
     app = TpuModelForCausalLM(None, cfg)
     app.load(state_dict=sd)
@@ -82,10 +83,30 @@ def test_presharded_save_load_roundtrip(tmp_path):
     import os
 
     assert os.path.exists(os.path.join(path, "presharded", "manifest.pkl"))
-
-    # fresh app restores presharded weights; conversion must NOT run
-    # (model_path=None and no state dict would make load() use random
-    # weights — token match proves the restored weights are the real ones)
     app2 = load_model(path)
     out = app2.generate(ids, np.ones_like(ids), max_new_tokens=6).sequences
+    return app2, ref, out
+
+
+def test_presharded_save_load_roundtrip(tmp_path):
+    """save_sharded_checkpoint: compile() writes a presharded weight artifact
+    and a fresh app restores it WITHOUT re-running checkpoint conversion
+    (reference application_base.py:240-265)."""
+    import numpy as np
+
+    _, ref, out = _presharded_roundtrip(tmp_path, tp_degree=2)
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_presharded_quantized_roundtrip(tmp_path):
+    """Quantized params (int8 weights + scale leaves) round-trip through the
+    presharded artifact — restore must skip BOTH conversion and
+    re-quantization."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    app2, ref, out = _presharded_roundtrip(tmp_path, quantized=True)
+    # int8 weights + scales restored (not re-derived)
+    w = app2.params["layers"]["self_attn"]["q_proj"]
+    assert w["weight"].dtype == jnp.int8 and "scale" in w
     np.testing.assert_array_equal(out, ref)
