@@ -1,0 +1,298 @@
+#!/usr/bin/env python
+"""One-command hardware smoke suite: the real-TPU validations the CPU test
+suite cannot perform (it runs kernels in interpret mode on a virtual mesh).
+
+Run on a host with a real TPU chip: ``python scripts/hw_smoke.py [--fast]``.
+Each check prints PASS/FAIL; exit code 0 iff all pass. Covers the round-4
+hardware findings so future rounds re-verify them in minutes instead of
+rediscovering them:
+
+1. flash prefill kernel at batch 4 (the r3 Mosaic regression shape)
+2. HF greedy-token parity end-to-end (fp32)
+3. fused decode-layer kernels vs native (bf16 logit tolerance)
+4. fused selected-experts MoE decode vs dense
+5. multimodal (llava image-to-text) exact HF tokens, fp32 + bf16
+6. disaggregated prefill/decode token parity
+7. speculative serving == plain serving tokens
+8. 8k-context prefill + decode (long-sequence kernel shapes; skipped --fast)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+import numpy as np
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)  # the repo package, wherever this is invoked from
+sys.path.insert(0, os.path.join(_ROOT, "tests"))  # test helpers
+
+RESULTS = []
+
+
+def check(name):
+    def deco(fn):
+        RESULTS.append((name, fn))
+        return fn
+
+    return deco
+
+
+def _tiny_cfg(**tpu):
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.llama import LlamaInferenceConfig
+
+    hf = dict(
+        model_type="llama", hidden_size=64, intermediate_size=128,
+        num_attention_heads=4, num_key_value_heads=2, num_hidden_layers=2,
+        vocab_size=128, rms_norm_eps=1e-5, rope_theta=10000.0,
+        max_position_embeddings=256, hidden_act="silu", tie_word_embeddings=False,
+    )
+    kw = dict(batch_size=2, seq_len=64, dtype="float32")
+    kw.update(tpu)
+    return LlamaInferenceConfig(
+        TpuConfig(**kw), load_config=lambda c: [setattr(c, k, v) for k, v in hf.items()]
+    )
+
+
+def _rand_sd(cfg, seed=0):
+    from conftest import make_random_hf_state_dict
+
+    return make_random_hf_state_dict(cfg, seed=seed)
+
+
+@check("flash prefill kernel at batch 4 (r3 regression shape)")
+def _flash_b4():
+    import jax.numpy as jnp
+
+    from neuronx_distributed_inference_tpu.ops.flash_attention import (
+        flash_attention_bhsd,
+    )
+
+    B, H, S, D = 4, 8, 256, 64
+    q = jnp.ones((B, H, S, D), jnp.bfloat16)
+    kv = jnp.ones((B, S), jnp.int32)
+    out = flash_attention_bhsd(q, q, q, kv, scale=0.125, causal=True, interpret=False)
+    assert np.isfinite(np.asarray(out[0], np.float32)).all()
+
+
+@check("HF greedy-token parity end-to-end (fp32)")
+def _hf_parity():
+    import torch
+    import transformers
+
+    from neuronx_distributed_inference_tpu.runtime.application import (
+        TpuModelForCausalLM,
+    )
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        tie_word_embeddings=False, attn_implementation="eager",
+        eos_token_id=None, bos_token_id=None,
+    )
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval().float()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    prompt = np.array([[5, 17, 92, 41, 33, 88, 2, 11]])
+    with torch.no_grad():
+        ref = hf.generate(
+            torch.tensor(prompt), max_new_tokens=12, do_sample=False, pad_token_id=0
+        ).numpy()
+    cfg = _tiny_cfg(batch_size=1)
+    for k, v in hf_cfg.to_dict().items():
+        setattr(cfg, k, v)
+    app = TpuModelForCausalLM(None, cfg).load(state_dict=sd)
+    out = app.generate(prompt, np.ones_like(prompt), max_new_tokens=12)
+    assert (out.sequences == ref).all()
+
+
+@check("fused decode-layer kernels vs native (bf16)")
+def _fused_layers():
+    from neuronx_distributed_inference_tpu.runtime.application import (
+        TpuModelForCausalLM,
+    )
+
+    sd = None
+    logits = {}
+    for fused in (False, True):
+        cfg = _tiny_cfg(
+            dtype="bfloat16", fused_qkv=True, seq_len=1024,
+            fused_attn_block_kernel_enabled=fused, fused_mlp_kernel_enabled=fused,
+            token_generation_buckets=[512], output_logits=True,
+        )
+        if sd is None:
+            sd = _rand_sd(cfg)
+        app = TpuModelForCausalLM(None, cfg).load(state_dict=sd)
+        ids = np.array([[1, 2, 3, 4], [5, 6, 7, 8]])
+        logits[fused] = app.generate(ids, np.ones_like(ids), max_new_tokens=6).logits
+    d = np.abs(logits[True] - logits[False]).max()
+    scale = np.abs(logits[False]).max()
+    assert d <= 0.05 * scale, f"fused/native logit gap {d} vs scale {scale}"
+
+
+@check("fused selected-experts MoE decode vs dense")
+def _fused_moe():
+    import jax
+    import jax.numpy as jnp
+
+    from neuronx_distributed_inference_tpu.modules.moe import (
+        MoESpec,
+        expert_mlps_dense,
+        router_top_k,
+    )
+    from neuronx_distributed_inference_tpu.ops.moe_decode import fused_moe_decode
+
+    rng = np.random.RandomState(0)
+    E, k, H, I = 8, 2, 256, 512
+    spec = MoESpec(num_experts=E, top_k=k)
+    params = {
+        n: {"weight": jnp.asarray(rng.randn(E, *s).astype(np.float32) * 0.05, jnp.bfloat16)}
+        for n, s in (("gate_proj", (H, I)), ("up_proj", (H, I)), ("down_proj", (I, H)))
+    }
+    x = jnp.asarray(rng.randn(1, H).astype(np.float32), jnp.bfloat16)
+    aff, sel = router_top_k(jnp.asarray(rng.randn(1, E).astype(np.float32)), spec)
+    ref = expert_mlps_dense(params, x, aff, spec, sel)
+    w_topk, e_topk = jax.lax.top_k(aff, k)
+    out = fused_moe_decode(
+        x, e_topk.astype(jnp.int32), w_topk,
+        params["gate_proj"]["weight"], params["up_proj"]["weight"],
+        params["down_proj"]["weight"], act="silu", interpret=False,
+    )
+    d = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+    assert d < 0.05, f"moe kernel divergence {d}"
+
+
+@check("multimodal (llava) exact HF tokens, fp32 + bf16")
+def _multimodal():
+    import torch
+
+    from test_multimodal import _tiny_hf_llava
+    from neuronx_distributed_inference_tpu.config import InferenceConfig, TpuConfig
+    from neuronx_distributed_inference_tpu.runtime.image_to_text import (
+        TpuImageToTextModel,
+    )
+
+    hf = _tiny_hf_llava()
+    sd = {k: v.float().numpy() for k, v in hf.state_dict().items()}
+
+    def load_config(cfg):
+        for k, v in hf.config.to_dict().items():
+            setattr(cfg, k, v)
+
+    ids = np.array([[1] + [99] * 16 + [5, 17, 9]])
+    mask = np.ones_like(ids)
+    px = np.random.RandomState(1).randn(1, 3, 64, 64).astype(np.float32)
+    with torch.no_grad():
+        ref = hf.generate(
+            input_ids=torch.tensor(ids), attention_mask=torch.tensor(mask),
+            pixel_values=torch.tensor(px), max_new_tokens=8, do_sample=False,
+            pad_token_id=0,
+        ).numpy()
+    for dt in ("float32", "bfloat16"):
+        cfg = InferenceConfig(
+            TpuConfig(batch_size=1, seq_len=64, dtype=dt), load_config=load_config
+        )
+        app = TpuImageToTextModel(None, cfg)
+        app.load(state_dict=sd)
+        out = app.generate(ids, mask, pixel_values=px, max_new_tokens=8)
+        assert (out.sequences == ref).all(), dt
+
+
+@check("disaggregated prefill/decode token parity")
+def _disagg():
+    from neuronx_distributed_inference_tpu.runtime.application import (
+        TpuModelForCausalLM,
+    )
+    from neuronx_distributed_inference_tpu.runtime.disaggregated import (
+        DisaggregatedPipeline,
+    )
+
+    sd = None
+    apps = {}
+    for name, stage in (("mono", None), ("pre", True), ("dec", False)):
+        cfg = _tiny_cfg(is_prefill_stage=stage)
+        if sd is None:
+            sd = _rand_sd(cfg)
+        apps[name] = TpuModelForCausalLM(None, cfg).load(state_dict=sd)
+    ids = np.array([[5, 17, 92, 41], [64, 3, 27, 9]])
+    mask = np.ones_like(ids)
+    ref = apps["mono"].generate(ids, mask, max_new_tokens=10).sequences
+    out = DisaggregatedPipeline(apps["pre"], apps["dec"]).generate(
+        ids, mask, max_new_tokens=10
+    ).sequences
+    assert (out == ref).all()
+
+
+@check("speculative serving == plain serving tokens")
+def _spec_serving():
+    from neuronx_distributed_inference_tpu.runtime.application import (
+        TpuModelForCausalLM,
+    )
+    from neuronx_distributed_inference_tpu.runtime.serving import (
+        ServingSession,
+        SpeculativeServingSession,
+    )
+
+    mk = lambda: _tiny_cfg(is_continuous_batching=True, ctx_batch_size=1)
+    sd = _rand_sd(mk())
+    plain = TpuModelForCausalLM(None, mk()).load(state_dict=sd)
+    sess_p = ServingSession(plain)
+    sess_p.add_request("r", [5, 17, 92, 41], max_new_tokens=10)
+    golden = sess_p.run_to_completion()["r"]
+    target = TpuModelForCausalLM(None, mk()).load(state_dict=sd)
+    draft = TpuModelForCausalLM(None, mk()).load(state_dict=_rand_sd(mk(), seed=3))
+    sess = SpeculativeServingSession(target, draft, speculation_length=4)
+    sess.add_request("r", [5, 17, 92, 41], max_new_tokens=10)
+    assert sess.run_to_completion()["r"] == golden
+
+
+@check("8k-context prefill + decode (long-sequence shapes)")
+def _long_ctx():
+    if "--fast" in sys.argv:
+        return
+    import bench as B
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.llama import LlamaInferenceConfig
+    from neuronx_distributed_inference_tpu.runtime.application import (
+        TpuModelForCausalLM,
+    )
+
+    attrs = dict(B.LLAMA_1B, max_position_embeddings=16384)
+    tc = TpuConfig(
+        batch_size=1, seq_len=8704, dtype="bfloat16", fused_qkv=True,
+        enable_bucketing=True, context_encoding_buckets=[8192],
+        token_generation_buckets=[8704],
+    )
+    app = TpuModelForCausalLM(
+        None,
+        LlamaInferenceConfig(tc, load_config=lambda c: [setattr(c, k, v) for k, v in attrs.items()]),
+    )
+    app.load(random_weights=True)
+    ids = np.random.RandomState(0).randint(0, 120000, size=(1, 8192))
+    out = app.generate(ids, np.ones_like(ids), max_new_tokens=16)
+    assert out.sequences.shape == (1, 8208)
+
+
+def main():
+    import jax
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    failed = 0
+    for name, fn in RESULTS:
+        try:
+            fn()
+            print(f"PASS  {name}")
+        except Exception:
+            failed += 1
+            print(f"FAIL  {name}")
+            traceback.print_exc()
+    print(f"\n{len(RESULTS) - failed}/{len(RESULTS)} hardware checks passed")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -105,7 +105,8 @@ def test_presharded_quantized_roundtrip(tmp_path):
     import jax.numpy as jnp
     import numpy as np
 
-    app2, ref, out = _presharded_roundtrip(tmp_path, quantized=True)
+    # tp_degree=2: also exercises the sharded quantized-SCALE restore path
+    app2, ref, out = _presharded_roundtrip(tmp_path, quantized=True, tp_degree=2)
     # int8 weights + scales restored (not re-derived)
     w = app2.params["layers"]["self_attn"]["q_proj"]
     assert w["weight"].dtype == jnp.int8 and "scale" in w
