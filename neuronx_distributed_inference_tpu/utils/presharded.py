@@ -40,11 +40,13 @@ def save_presharded(params, pspecs, path: str) -> None:
     os.makedirs(path, exist_ok=True)
     shapes = jax.tree.map(lambda x: tuple(x.shape), params)
     dtypes = jax.tree.map(lambda x: str(x.dtype), params)
-    with open(os.path.join(path, MANIFEST), "wb") as f:
-        pickle.dump({"shapes": shapes, "dtypes": dtypes, "pspecs": pspecs}, f)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(os.path.join(os.path.abspath(path), WEIGHTS), params, force=True)
     ckptr.wait_until_finished()
+    # the manifest is the commit marker: written LAST so a kill mid-save
+    # leaves no manifest and readers treat the artifact as absent
+    with open(os.path.join(path, MANIFEST), "wb") as f:
+        pickle.dump({"shapes": shapes, "dtypes": dtypes, "pspecs": pspecs}, f)
 
 
 def load_presharded(path: str, mesh) -> Optional[Tuple[dict, dict]]:

@@ -3,22 +3,98 @@ warmup → measure, every point) with a tiny model on the CPU mesh.
 
 Two of the first three rounds shipped a crash only bench.py could hit
 (VERDICT r3 weak #2: r1 ``_pick_chunk`` NameError, r3 the flash B>1
-BlockSpec). The suite must execute bench's code path, not a parallel copy —
-hence bench.run_suite(tiny=True) runs the same functions main() runs.
+BlockSpec), and round 4's official artifact was voided by a driver timeout
+landing mid-suite (VERDICT r4 weak #1). The suite must execute bench's code
+path, not a parallel copy — hence bench.run_suite(tiny=True) runs the same
+functions main() runs — and must prove the output contract survives a kill
+at ANY point boundary: the summary line is printed after the headline and
+re-printed after every later point, and a wall-clock budget skips remaining
+points instead of letting a driver timeout void the artifact.
 """
 
+import json
 import os
+import signal
+import subprocess
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+ALL_POINTS = {
+    "bf16_1b_bs1", "bf16_1b_bs4", "int8_1b_bs1", "serving_1b_int8",
+    "int8_8b_bs1",
+}
 
 
 def test_bench_suite_tiny():
     import bench
 
-    points = bench.run_suite(tiny=True)
-    assert set(points) == {"bf16_1b_bs1", "bf16_1b_bs4", "int8_1b_bs1", "int8_8b_bs1"}
+    emitted = []
+    points = bench.run_suite(tiny=True, emit=lambda p: emitted.append(dict(p)))
+    assert set(points) == ALL_POINTS
     for name, p in points.items():
         assert p["decode_tok_s"] > 0, (name, p)
-        assert p["ttft_ms"] > 0, (name, p)
+        if name != "serving_1b_int8":
+            assert p["ttft_ms"] > 0, (name, p)
     assert points["bf16_1b_bs1"]["prefill_tok_s"] > 0
+    assert points["serving_1b_int8"]["ttft_p99_ms"] >= points["serving_1b_int8"]["ttft_ms"]
+    # emit fired after EVERY point (the incremental-summary contract) and
+    # every snapshot produces a valid summary line
+    assert len(emitted) == len(ALL_POINTS)
+    for snap in emitted:
+        line = json.dumps(bench.summary_line(snap))
+        assert json.loads(line)["metric"]
+    # final snapshot has the headline populated
+    final = bench.summary_line(points)
+    assert final["value"] > 0 and final["vs_baseline"] > 0
+    assert final["serving_tok_s"] > 0
+    assert all(v == "ok" for v in final["points"].values())
+
+
+def test_bench_budget_skips_but_parses(monkeypatch):
+    """BENCH_BUDGET_S=0: only the headline point runs; every later point is
+    marked skipped_budget; the summary line still parses with a real
+    headline value — the exact shape the driver must be able to record."""
+    import bench
+
+    monkeypatch.setenv("BENCH_BUDGET_S", "0")
+    emitted = []
+    points = bench.run_suite(tiny=True, emit=lambda p: emitted.append(dict(p)))
+    assert "decode_tok_s" in points["bf16_1b_bs1"]
+    for name in ALL_POINTS - {"bf16_1b_bs1"}:
+        assert points[name] == {"skipped_budget": True}, points[name]
+    final = bench.summary_line(points)
+    assert final["value"] > 0
+    assert final["points"]["int8_8b_bs1"] == "skipped_budget"
+    assert final["int8_8b_tok_s"] is None
+
+
+def test_bench_killed_mid_suite_leaves_parseable_line(tmp_path):
+    """Simulate the r4 failure: the driver kills bench mid-suite. The last
+    fully-written stdout line must be a parseable summary with the headline
+    metric (the driver records tail + last-line parse)."""
+    bench_path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    env = dict(os.environ)
+    env.pop("BENCH_BUDGET_S", None)
+    proc = subprocess.Popen(
+        [sys.executable, bench_path, "--tiny", "--cpu"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env,
+    )
+    try:
+        # the first summary line appears right after the headline point
+        line = proc.stdout.readline()
+        deadline = time.time() + 300
+        while not line.strip() and time.time() < deadline:
+            if line == "" and proc.poll() is not None:
+                raise AssertionError(
+                    f"bench exited rc={proc.returncode} before any summary line"
+                )
+            line = proc.stdout.readline()
+    finally:
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+    parsed = json.loads(line)
+    assert parsed["value"] > 0
+    assert parsed["unit"] == "tokens/sec"
+    assert parsed["points"]["bf16_1b_bs1"] == "ok"
