@@ -160,6 +160,14 @@ def build_app(
         for k, v in hf_attrs.items():
             setattr(c, k, v)
 
+    # persistent XLA compilation cache: bench points re-run across processes
+    # and rounds; compiles (up to ~8 min for int8 8B) must be paid once
+    try:
+        from jax.experimental.compilation_cache import compilation_cache
+
+        compilation_cache.set_cache_dir(os.path.join(_cache_dir(), "xla"))
+    except Exception:
+        pass
     kw = {}
     if block_kv:
         from neuronx_distributed_inference_tpu.config import ChunkedPrefillConfig

@@ -504,11 +504,6 @@ class TpuConfig:
                     "token_tree_config requires enable_eagle_speculation "
                     "(trees expand the EAGLE draft; reference eagle/token_tree.py)"
                 )
-            ods = self.on_device_sampling_config
-            if ods is not None and ods.do_sample:
-                raise NotImplementedError(
-                    "token-tree speculation is greedy-only; disable do_sample"
-                )
         if self.medusa_speculation_length and self.num_medusa_heads <= 0:
             raise ValueError("medusa requires num_medusa_heads > 0")
         if self.padding_side not in ("right", "left"):

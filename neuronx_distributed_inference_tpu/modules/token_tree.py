@@ -287,36 +287,60 @@ def sampled_tree_accept(
     Returns (tokens (B, depth+1) zero-padded, counts (B,), best_nodes
     (B, depth+1) accepted node sequence starting at the root).
     """
+    ctab = jnp.broadcast_to(
+        jnp.asarray(tree.children_table)[None],
+        (tlogits.shape[0],) + tree.children_table.shape,
+    )
+    return sampled_accept_walk(
+        ctab, tree.depth, cand, tlogits, q_nodes, sampling_params, key, max_topk
+    )
+
+
+def sampled_accept_walk(
+    ctab: jax.Array,  # (B, N, mc) child node id per (node, rank); -1 absent
+    depth: int,
+    cand: jax.Array,  # (B, N)
+    tlogits: jax.Array,  # (B, N, V)
+    q_nodes: jax.Array,  # (B, N, V)
+    sampling_params: jax.Array,  # (B, 3)
+    key: jax.Array,
+    max_topk: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The recursive-rejection walk of :func:`sampled_tree_accept` over a
+    PER-BATCH children table — the connectivity may be data-dependent
+    (dynamic trees build it in-graph; static trees broadcast theirs). The
+    exact-marginal guarantee needs only that each reached node's children
+    were drawn i.i.d. from that node's q, which holds whatever (data-
+    dependent) rule decided WHICH nodes got children."""
     from neuronx_distributed_inference_tpu.modules.sampling import warped_probs
 
     # q distributions live on the TRUE target vocab; drop any padded-vocab
     # tail from the target logits so p and q share one width
     tlogits = tlogits[..., : q_nodes.shape[-1]]
     B, N, V = tlogits.shape
-    mc = tree.children_table.shape[1]
+    mc = ctab.shape[2]
     p_warp = warped_probs(
         tlogits.reshape(B * N, V),
         jnp.repeat(sampling_params, N, axis=0),
         max_topk,
     ).reshape(B, N, V)
-    ctab = jnp.asarray(tree.children_table)  # (N, mc)
 
     cur = jnp.zeros((B,), jnp.int32)
     p_res = p_warp[:, 0]  # (B, V)
     stopped = jnp.zeros((B,), bool)
     counts = jnp.ones((B,), jnp.int32)
-    tok_out = jnp.zeros((B, tree.depth + 1), jnp.int32)
-    node_out = jnp.zeros((B, tree.depth + 1), jnp.int32)
+    tok_out = jnp.zeros((B, depth + 1), jnp.int32)
+    node_out = jnp.zeros((B, depth + 1), jnp.int32)
     bi = jnp.arange(B)
 
-    for d in range(tree.depth):
+    for d in range(depth):
         accepted = jnp.zeros((B,), bool)
         next_cur = cur
         tok_d = jnp.zeros((B,), jnp.int32)
         q_cur = q_nodes[bi, cur]  # (B, V) draft dist at the current node
         for r in range(mc):
             key, ku = jax.random.split(key)
-            child = ctab[cur, r]  # (B,) -1 when absent
+            child = ctab[bi, cur, r]  # (B,) -1 when absent
             has = (child >= 0) & ~stopped & ~accepted
             x = cand[bi, jnp.maximum(child, 0)]  # (B,)
             px = p_res[bi, x]
@@ -349,7 +373,7 @@ def sampled_tree_accept(
         kf, jnp.log(jnp.maximum(p_res, 1e-30)), axis=-1
     ).astype(jnp.int32)
     tok_out = tok_out.at[bi, counts - 1].set(final)
-    idx = jnp.arange(tree.depth + 1, dtype=jnp.int32)[None, :]
+    idx = jnp.arange(depth + 1, dtype=jnp.int32)[None, :]
     tok_out = jnp.where(idx < counts[:, None], tok_out, 0)
     # node_out beyond counts holds zeros (the root) — fixup_cache_paths
     # tolerates junk past the accepted count
@@ -451,10 +475,23 @@ def dynamic_tree_token_gen(
     target_mlp_fn: Callable,
     target_capture_layers: Optional[Tuple[int, ...]] = None,
     draft_lm_hidden_fn: Optional[Callable] = None,
+    do_sample: bool = False,
+    max_topk: int = 256,
 ):
-    """One fused dynamic-tree decode round (greedy). The tree connectivity
-    (parent of each node) is decided in-graph from cumulative draft
-    log-probs; everything else mirrors :func:`tree_token_gen`."""
+    """One fused dynamic-tree decode round. The tree connectivity (parent of
+    each node) is decided in-graph from cumulative draft log-probs;
+    everything else mirrors :func:`tree_token_gen`.
+
+    Greedy mode expands each frontier node's top-bf draft tokens and verifies
+    by deepest contiguous argmax match. Sampled mode (``do_sample``) draws
+    each frontier node's bf children i.i.d. from the node's WARPED draft
+    distribution and verifies by recursive rejection sampling over the
+    in-graph connectivity (:func:`sampled_accept_walk`) — the emitted
+    marginal equals sampling from the target: frontier selection decides
+    only WHICH nodes get children, never the distribution the children were
+    drawn from, which is all the multi-candidate theorem needs.
+    (Reference ships its dynamic tree unwired and greedy-only,
+    modules/eagle/dynamic_token_tree.py:4-153 — this is parity-plus.)"""
     from neuronx_distributed_inference_tpu.modules.eagle import EagleOutput
 
     N = dyn.num_nodes
@@ -473,6 +510,9 @@ def dynamic_tree_token_gen(
     cumlp = jnp.full((B, N), -1e30, jnp.float32).at[:, 0].set(0.0)
     anc = jnp.zeros((B, N, N), bool).at[:, 0, 0].set(True)
     node_hidden = None  # (B, N, Hd) draft hiddens, filled level by level
+    q_nodes = (
+        jnp.zeros((B, N, target_spec.vocab_size), jnp.float32) if do_sample else None
+    )
 
     def draft_level(off, w, prev_h, cache):
         node_ids = off + jnp.arange(w, dtype=jnp.int32)[None, :]  # (1, w)
@@ -517,11 +557,42 @@ def dynamic_tree_token_gen(
             draft_params, d_hidden
         )
         dlogits = lm_head(draft_params, lm_h, draft_spec)[..., : draft_spec.vocab_size]
-        logp = jax.nn.log_softmax(dlogits.astype(jnp.float32), axis=-1)  # (B, w, V)
-        topv, topt = jax.lax.top_k(logp, dyn.bf)  # (B, w, bf)
-        topt = topt.astype(jnp.int32)
-        if d2t is not None:
-            topt = topt + d2t[topt]  # draft vocab -> target vocab (EAGLE3)
+        if do_sample:
+            # children drawn i.i.d. from this node's WARPED draft dist — the
+            # q the recursive-rejection accept ratio assumes; the frontier
+            # heuristic ranks by cumulative log q of the drawn tokens
+            from neuronx_distributed_inference_tpu.modules.sampling import (
+                warped_probs,
+            )
+
+            Vd = dlogits.shape[-1]
+            q_l = warped_probs(
+                dlogits.reshape(B * w, Vd), jnp.repeat(sp, w, axis=0), max_topk
+            ).reshape(B, w, Vd)
+            key, kl = jax.random.split(key)
+            draws = jax.random.categorical(
+                kl, jnp.log(jnp.maximum(q_l, 1e-30)), shape=(dyn.bf, B, w)
+            ).astype(jnp.int32)
+            draws = jnp.transpose(draws, (1, 2, 0))  # (B, w, bf)
+            topv = jnp.log(
+                jnp.maximum(jnp.take_along_axis(q_l, draws, axis=-1), 1e-30)
+            )
+            if d2t is not None:
+                q_t = q_to_target_vocab(q_l, d2t, target_spec.vocab_size)
+                topt = draws + d2t[draws]  # draft vocab -> target vocab
+            else:
+                q_t = q_l
+                topt = draws
+            Vp = q_nodes.shape[-1]
+            if q_t.shape[-1] < Vp:
+                q_t = jnp.pad(q_t, ((0, 0), (0, 0), (0, Vp - q_t.shape[-1])))
+            q_nodes = q_nodes.at[:, ids].set(q_t)
+        else:
+            logp = jax.nn.log_softmax(dlogits.astype(jnp.float32), axis=-1)
+            topv, topt = jax.lax.top_k(logp, dyn.bf)  # (B, w, bf)
+            topt = topt.astype(jnp.int32)
+            if d2t is not None:
+                topt = topt + d2t[topt]  # draft vocab -> target vocab (EAGLE3)
 
         # pick the expansion frontier: top-ni of this level by cumulative lp
         ni = min(dyn.ni, w) if s > 0 else 1
@@ -565,30 +636,52 @@ def dynamic_tree_token_gen(
         spec=target_spec, phase=PHASE_TOKEN_GENERATION, mlp_fn=target_mlp_fn,
         return_hidden=True, capture_layers=target_capture_layers,
     )
-    greedy = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (B, N)
+    if do_sample:
+        # in-graph children table from the data-dependent connectivity: a
+        # child's rank among its siblings is STATIC (its local index mod bf);
+        # only its parent is data-dependent — one scatter builds (B, N, bf)
+        import numpy as onp
 
-    # ---- greedy walk through the dynamic connectivity ---------------------
-    node_ids = jnp.arange(N, dtype=jnp.int32)[None, :]
-    cur = jnp.zeros((B,), jnp.int32)
-    alive = jnp.ones((B,), bool)
-    acc = jnp.zeros((B,), jnp.int32)
-    best_nodes = [cur]
-    for _ in range(dyn.steps):
-        pred = jnp.take_along_axis(greedy, cur[:, None], axis=1)[:, 0]  # (B,)
-        # the child of cur whose token equals the target's prediction
-        is_child = (parent == cur[:, None]) & (node_ids > 0) & (depth > 0)
-        match = is_child & (tokens == pred[:, None])
-        found = jnp.any(match, axis=1) & alive
-        nxt = jnp.argmax(match, axis=1).astype(jnp.int32)
-        cur = jnp.where(found, nxt, cur)
-        acc = acc + found.astype(jnp.int32)
-        alive = found
-        best_nodes.append(cur)
-    best_nodes = jnp.stack(best_nodes, axis=1)  # (B, steps+1)
-    counts = acc + 1
-    toks = jnp.take_along_axis(greedy, best_nodes, axis=1)
-    idx = jnp.arange(dyn.steps + 1, dtype=jnp.int32)[None, :]
-    out_tokens = jnp.where(idx < counts[:, None], toks, 0)
+        ranks_np = onp.zeros(N, onp.int32)
+        for s in range(1, dyn.steps + 1):
+            o, w = dyn.level_offsets[s], dyn.level_widths[s]
+            ranks_np[o:o + w] = onp.arange(w) % dyn.bf
+        ranks = jnp.asarray(ranks_np)
+        ids_all = jnp.arange(N, dtype=jnp.int32)
+        bi = jnp.arange(B)
+        ctab = jnp.full((B, N, dyn.bf), -1, jnp.int32)
+        ctab = ctab.at[bi[:, None], parent[:, 1:], ranks[None, 1:]].set(
+            jnp.broadcast_to(ids_all[None, 1:], (B, N - 1))
+        )
+        key, ka = jax.random.split(key)
+        out_tokens, counts, best_nodes = sampled_accept_walk(
+            ctab, dyn.steps, tokens, tlogits, q_nodes, sp, ka, max_topk
+        )
+    else:
+        greedy = jnp.argmax(tlogits, axis=-1).astype(jnp.int32)  # (B, N)
+
+        # ---- greedy walk through the dynamic connectivity -----------------
+        node_ids = jnp.arange(N, dtype=jnp.int32)[None, :]
+        cur = jnp.zeros((B,), jnp.int32)
+        alive = jnp.ones((B,), bool)
+        acc = jnp.zeros((B,), jnp.int32)
+        best_nodes = [cur]
+        for _ in range(dyn.steps):
+            pred = jnp.take_along_axis(greedy, cur[:, None], axis=1)[:, 0]  # (B,)
+            # the child of cur whose token equals the target's prediction
+            is_child = (parent == cur[:, None]) & (node_ids > 0) & (depth > 0)
+            match = is_child & (tokens == pred[:, None])
+            found = jnp.any(match, axis=1) & alive
+            nxt = jnp.argmax(match, axis=1).astype(jnp.int32)
+            cur = jnp.where(found, nxt, cur)
+            acc = acc + found.astype(jnp.int32)
+            alive = found
+            best_nodes.append(cur)
+        best_nodes = jnp.stack(best_nodes, axis=1)  # (B, steps+1)
+        counts = acc + 1
+        toks = jnp.take_along_axis(greedy, best_nodes, axis=1)
+        idx = jnp.arange(dyn.steps + 1, dtype=jnp.int32)[None, :]
+        out_tokens = jnp.where(idx < counts[:, None], toks, 0)
 
     # ---- accepted-path KV to contiguous slots + buffer update -------------
     kv_lines = slot_ids_from_seq_ids(seq_ids, target_cache.k.shape[1] - 1)

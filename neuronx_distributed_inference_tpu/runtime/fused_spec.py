@@ -301,7 +301,10 @@ class TpuEagleSpecModelForCausalLM(_SpecAppBase):
     candidate TREE instead of a chain (modules/token_tree.py; reference
     eagle/token_tree.py + tree decode forward model_base.py:2143). Static
     trees support greedy AND sampled verification (recursive rejection
-    sampling); dynamic trees are greedy-only.
+    sampling); dynamic trees support both too — sampled expansion draws
+    children i.i.d. from each frontier node's warped draft distribution and
+    verifies over the in-graph connectivity (the reference's dynamic tree,
+    modules/eagle/dynamic_token_tree.py, ships unwired and greedy-only).
     """
 
     def __init__(self, model_path, config, draft_model_path=None, mesh=None):
@@ -352,15 +355,13 @@ class TpuEagleSpecModelForCausalLM(_SpecAppBase):
                 draft_lm_hidden_fn=self._draft_lm_hidden_fn(),
             )
             if dynamic:
-                if self.do_sample:
-                    raise NotImplementedError(
-                        "dynamic-tree speculation is greedy-only (the "
-                        "cumulative-log-prob expansion selects by argmax); "
-                        "use a static token tree for sampled tree decoding"
-                    )
                 self.tree = DynamicTokenTree(tc.token_tree_config)
                 self._tkg_fn = jax.jit(
-                    partial(dynamic_tree_token_gen, dyn=self.tree, **common),
+                    partial(
+                        dynamic_tree_token_gen, dyn=self.tree,
+                        do_sample=self.do_sample, max_topk=tc.max_topk,
+                        **common,
+                    ),
                     donate_argnums=(2, 3, 4),
                 )
             else:

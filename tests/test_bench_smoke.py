@@ -27,9 +27,10 @@ ALL_POINTS = {
 }
 
 
-def test_bench_suite_tiny():
+def test_bench_suite_tiny(monkeypatch):
     import bench
 
+    monkeypatch.delenv("BENCH_BUDGET_S", raising=False)
     emitted = []
     points = bench.run_suite(tiny=True, emit=lambda p: emitted.append(dict(p)))
     assert set(points) == ALL_POINTS
