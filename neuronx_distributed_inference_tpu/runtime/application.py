@@ -572,6 +572,82 @@ class TpuModelForCausalLM:
             )
         return windowed
 
+    def forward(
+        self,
+        input_ids: np.ndarray,
+        position_ids: np.ndarray,
+        seq_ids: np.ndarray,
+        *,
+        attention_mask: Optional[np.ndarray] = None,
+        sampling_params: Optional[np.ndarray] = None,
+        slot_mapping: Optional[np.ndarray] = None,
+        block_table: Optional[np.ndarray] = None,
+        phase: Optional[str] = None,
+        key=None,
+    ):
+        """External-scheduler forward: ONE model pass with caller-provided
+        cache placement — the entry point a vLLM-style continuous-batching
+        engine drives when IT owns slot tables and block tables instead of
+        :class:`~..runtime.serving.ServingSession` (VERDICT r4 next #10;
+        reference public forward with slot_mapping/block_table,
+        model_base.py:3392-3396).
+
+        ``input_ids``/``position_ids``: (B, S). ``seq_ids``: (B,) cache-line
+        ids; -1 marks an inactive row (writes land in the garbage line).
+        ``slot_mapping``: (B, S) flat block-cache write slots for prefill on
+        the paged cache (-1 drops the write); decode on the paged cache
+        derives slots in-graph from ``block_table`` (B, max_blocks), exactly
+        like the serving path. ``attention_mask``: (B, width) cache
+        occupancy; defaults to "everything up to the max position".
+        ``phase``: "cte"/"tkg"; inferred from S when omitted (S > 1 →
+        context encoding). Chunked/prior-KV prefill passes multi-token
+        inputs through the TKG program — pass ``phase="tkg"`` explicitly.
+
+        Returns (tokens (B, K) np.ndarray, logits (B, K, V) np.ndarray or
+        None). Updates the app's KV cache in place; all scheduling state
+        stays with the caller.
+        """
+        input_ids = np.asarray(input_ids)
+        position_ids = np.asarray(position_ids)
+        seq_ids = np.asarray(seq_ids, np.int32)
+        B, S = input_ids.shape
+        if phase is None:
+            phase = "cte" if S > 1 else "tkg"
+        if phase not in ("cte", "tkg"):
+            raise ValueError("phase must be 'cte' or 'tkg'")
+        runner = (
+            self.context_encoding_model if phase == "cte"
+            else self.token_generation_model
+        )
+        if sampling_params is None:
+            sampling_params = prepare_sampling_params(B)
+        if attention_mask is None:
+            if phase == "cte":
+                attention_mask = np.ones_like(input_ids)
+            else:
+                width = self._decode_bucket(int(position_ids.max()) + 1)
+                attention_mask = (
+                    np.arange(width)[None, :] <= position_ids.max(axis=1)[:, None]
+                ).astype(np.int32)
+        inputs, _ = runner.prepare(
+            input_ids,
+            np.asarray(attention_mask),
+            position_ids,
+            seq_ids,
+            np.asarray(sampling_params, np.float32),
+            slot_mapping=slot_mapping,
+            block_table=block_table,
+        )
+        out = runner(self.params, self.kv_cache, inputs, key)
+        self.kv_cache = out.cache
+        tokens = np.asarray(jax.device_get(out.tokens))[:B]
+        logits = (
+            np.asarray(jax.device_get(out.logits))[:B]
+            if out.logits is not None
+            else None
+        )
+        return tokens, logits
+
     def _pos_limit(self) -> int:
         """Largest writable position: a ring cache bounds SLOTS, not
         positions; otherwise the largest compiled TKG bucket bounds it."""

@@ -24,7 +24,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from neuronx_distributed_inference_tpu.modules.autobucketing import get_target_bucket
 from neuronx_distributed_inference_tpu.modules.sampling import (
     prepare_sampling_params,
     validate_sampling_params,
@@ -121,10 +120,8 @@ def draft_propose(draft, last, pos, seq_ids, sp, k: int, key=None):
     (proposals (B, k-1) host, draft logits or None). Shared by
     assisted_generate and SpeculativeServingSession."""
     # ring-bounded caches hold exactly W slots whatever the position; the
-    # in-graph mask derives from positions (model_runner.prepare's TKG rule)
-    bucket = draft.spec.bounded_window or get_target_bucket(
-        draft.token_generation_model.buckets, int(np.asarray(pos).max()) + k
-    )
+    # bounded-vs-bucket rule lives in ONE place (application._decode_bucket)
+    bucket = draft._decode_bucket(int(np.asarray(pos).max()) + k)
     d_tokens, d_logits, d_cache = draft.token_generation_model.decode_chunk(
         draft.params, draft.kv_cache, np.asarray(last), np.asarray(pos),
         seq_ids, sp, key, num_steps=k - 1, bucket=bucket,
@@ -139,9 +136,7 @@ def target_verify(target, cand, pos, seq_ids, sp, key=None):
     the StepOutput (tokens = per-position greedy/sampled predictions)."""
     k = cand.shape[1]
     cand_pos = np.asarray(pos) + np.arange(k, dtype=np.int32)[None, :]
-    width = target.spec.bounded_window or get_target_bucket(
-        target.token_generation_model.buckets, int(cand_pos.max()) + 1
-    )
+    width = target._decode_bucket(int(cand_pos.max()) + 1)
     cache_mask = (np.arange(width)[None, :] <= cand_pos[:, -1:]).astype(np.int32)
     v_inputs, _ = target.token_generation_model.prepare(
         cand, cache_mask, cand_pos, seq_ids, sp
@@ -162,6 +157,7 @@ def assisted_generate(
     top_k=None,
     top_p=None,
     temperature=None,
+    draft_logit_sink: Optional[list] = None,
 ) -> GenerationOutput:
     """Draft-assisted generation (reference hf_adapter.py:427).
 
@@ -248,6 +244,16 @@ def assisted_generate(
         proposals, d_logits = draft_propose(
             draft, last[:, None], pos[:, None], seq_ids, sp, k, step_key
         )
+        if draft_logit_sink is not None:
+            # per-round draft logits for the draft-logit accuracy harness
+            # (utils/accuracy.check_draft_logit_match; reference
+            # capture_draft_logits, hf_adapter.py + accuracy.py:1200-1265)
+            if d_logits is None:
+                raise ValueError(
+                    "draft_logit_sink requires the draft app loaded with "
+                    "output_logits=True"
+                )
+            draft_logit_sink.append(np.asarray(jax.device_get(d_logits))[:B])
 
         # --- target verifies all k candidates in one pass ---
         cand = np.concatenate([last[:, None], proposals], axis=1).astype(np.int32)

@@ -212,3 +212,86 @@ def _capture_divergence(
         ),
     )
     report.message += f" [captured {len(hook.saved)} dispatch inputs -> {capture_dir}]"
+
+
+def check_draft_logit_match(
+    actual_rounds,
+    golden_rounds,
+    top_k: int = 2,
+    divergence_tol: float = DEFAULT_DIVERGENCE_TOL,
+    num_rounds: Optional[int] = None,
+    raise_on_fail: bool = True,
+) -> AccuracyReport:
+    """Draft-model logit matching for speculative decoding: per speculation
+    ROUND, per draft ITERATION, gate the actual draft logits at the golden's
+    top-``top_k`` token positions (reference check_accuracy_draft_logit +
+    check_logits_per_draft_loop, accuracy.py:1200-1265 — same contract:
+    validation of a round STOPS at the first draft-token argmax divergence,
+    since later iterations are conditioned on the diverged token, and
+    resumes at the next round).
+
+    ``actual_rounds``/``golden_rounds``: sequences of per-round draft logits,
+    each (k-1, V) or (B, k-1, V) — e.g. the ``draft_logit_sink`` of
+    :func:`~..runtime.assisted.assisted_generate` vs a golden capture.
+    Speculation-quality regressions (a draft drifting from its golden) fail
+    HERE with (round, iteration) coordinates instead of surfacing as an
+    opaque end-to-end throughput drop.
+    """
+    if num_rounds is None and len(actual_rounds) != len(golden_rounds):
+        # a changed round count IS a speculation-quality regression (e.g. an
+        # acceptance-rate collapse changes how many rounds a fixed token
+        # budget takes) — comparing only the common prefix would swallow it
+        msg = (
+            f"speculation round count changed: actual {len(actual_rounds)} "
+            f"vs golden {len(golden_rounds)} rounds (pass num_rounds to "
+            "compare a prefix deliberately)"
+        )
+        if raise_on_fail:
+            raise LogitMatchingValidationError(msg)
+        return AccuracyReport(passed=False, message=msg)
+    n = min(len(actual_rounds), len(golden_rounds))
+    if num_rounds is not None:
+        n = min(n, num_rounds)
+    if n == 0:
+        raise ValueError("no draft rounds to compare")
+    errors: List[float] = []
+    for r in range(n):
+        a = np.asarray(actual_rounds[r], np.float32)
+        g = np.asarray(golden_rounds[r], np.float32)
+        if a.ndim == 2:
+            a, g = a[None], g[None]
+        if a.shape != g.shape:
+            raise ValueError(
+                f"round {r}: actual shape {a.shape} != golden shape {g.shape}"
+            )
+        B, iters, V = a.shape
+        for i in range(iters):
+            idx = np.argsort(g[:, i], axis=-1)[:, -top_k:]  # (B, top_k)
+            a_top = np.take_along_axis(a[:, i], idx, axis=-1)
+            g_top = np.take_along_axis(g[:, i], idx, axis=-1)
+            err = float(np.max(np.abs(a_top - g_top)))
+            errors.append(err)
+            if err > divergence_tol:
+                report = AccuracyReport(
+                    passed=False,
+                    first_divergence_index=r,
+                    max_error_per_position=errors,
+                    message=(
+                        f"draft logit divergence at round {r} iteration {i}: "
+                        f"max top-{top_k} err {err:.5f} > {divergence_tol}"
+                    ),
+                )
+                if raise_on_fail:
+                    raise LogitMatchingValidationError(
+                        report.message, divergence_index=r,
+                        details={"round": r, "iteration": i, "errors": errors},
+                    )
+                return report
+            if (np.argmax(a[:, i], axis=-1) != np.argmax(g[:, i], axis=-1)).any():
+                # conditioned divergence: later iterations of THIS round ran
+                # on a different token path — stop validating the round
+                break
+    return AccuracyReport(
+        passed=True, max_error_per_position=errors,
+        message=f"draft logits match over {n} rounds",
+    )

@@ -1,0 +1,134 @@
+"""Public module micro-test harness (VERDICT r4 next #9): the suite itself
+uses utils/testing.py so the user-facing API cannot drift from what the
+tests exercise (reference utils/testing.py:55-253 build_function /
+build_module / validate_accuracy)."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from neuronx_distributed_inference_tpu.parallel.mesh import build_mesh
+from neuronx_distributed_inference_tpu.utils.testing import (
+    build_function,
+    build_module,
+    validate_accuracy,
+)
+
+
+def test_build_function_runs_and_validates():
+    """A plain function: compiled on the mesh, validated against a numpy CPU
+    oracle over multiple inputs."""
+    def fn(x, w):
+        return jnp.tanh(x @ w)
+
+    rng = np.random.RandomState(0)
+    ex = (rng.randn(4, 16).astype(np.float32), rng.randn(16, 8).astype(np.float32))
+    built = build_function(fn, [ex], tpu_lower=False)
+    inputs = [
+        ex,
+        (rng.randn(4, 16).astype(np.float32), rng.randn(16, 8).astype(np.float32)),
+    ]
+    validate_accuracy(
+        built, inputs, cpu_callable=lambda x, w: np.tanh(x @ w),
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_build_function_tpu_lowers_pallas_kernel():
+    """The harness AOT-lowers for the TPU target from the CPU host — the
+    exact check that caught the r3 flash B>1 Mosaic bug (this is the ported
+    tests/test_tpu_lowering.py mechanism as a public API)."""
+    from neuronx_distributed_inference_tpu.ops.flash_attention import (
+        flash_attention_bhsd,
+    )
+
+    B, H, S, D = 2, 8, 128, 64
+    q = jax.ShapeDtypeStruct((B, H, S, D), jnp.bfloat16)
+    kv = jax.ShapeDtypeStruct((B, S), jnp.int32)
+    fn = functools.partial(
+        flash_attention_bhsd, scale=D**-0.5, causal=True, interpret=False
+    )
+    built = build_function(fn, [(q, q, q, kv)], tpu_lower=True)
+    assert built.exported is not None
+    assert "tpu" in built.exported.platforms
+
+
+def test_build_module_sharded_params_validate():
+    """A parameterized module (matmul + bias) with its weight TP-sharded over
+    the 8-device mesh must match the CPU oracle: the harness drives the real
+    GSPMD path, not a single-device special case."""
+    mesh = build_mesh(tp_degree=8)
+
+    def apply_fn(params, x):
+        return x @ params["w"] + params["b"]
+
+    rng = np.random.RandomState(1)
+    params = {
+        "w": rng.randn(32, 64).astype(np.float32),
+        "b": rng.randn(64).astype(np.float32),
+    }
+    pspecs = {"w": P(None, "tp"), "b": P("tp")}
+    x = rng.randn(4, 32).astype(np.float32)
+    built = build_module(
+        apply_fn, params, [(x,)], param_pspecs=pspecs, mesh=mesh,
+        in_pspecs=[P()],  # input replicated; params tree-mapped to shardings
+        tpu_lower=False,
+    )
+    validate_accuracy(
+        built, [(x,)],
+        cpu_callable=lambda x: x @ params["w"] + params["b"],
+        rtol=1e-5, atol=1e-5,
+    )
+
+
+def test_validate_accuracy_contract():
+    def fn(x):
+        return x + 1
+
+    built = build_function(fn, [(np.zeros(3, np.float32),)], tpu_lower=False)
+    with pytest.raises(ValueError, match="expected_outputs or a cpu_callable"):
+        validate_accuracy(built, [(np.zeros(3, np.float32),)])
+    # expected and cpu oracle disagreeing must fail the expected-vs-cpu check
+    with pytest.raises(AssertionError):
+        validate_accuracy(
+            built, [(np.zeros(3, np.float32),)],
+            expected_outputs=[np.full(3, 9.0, np.float32)],
+            cpu_callable=lambda x: x + 1,
+        )
+    # wrong expectation fails against the built output
+    with pytest.raises(AssertionError):
+        validate_accuracy(
+            built, [(np.zeros(3, np.float32),)],
+            expected_outputs=[np.full(3, 9.0, np.float32)],
+        )
+    # correct expectation passes
+    validate_accuracy(
+        built, [(np.zeros(3, np.float32),)],
+        expected_outputs=[np.ones(3, np.float32)],
+    )
+
+
+def test_build_module_real_op_rms_norm():
+    """Port of an existing ad-hoc check onto the harness: the rms_norm module
+    vs a numpy oracle (reference validate_accuracy usage pattern)."""
+    from neuronx_distributed_inference_tpu.modules.norm import rms_norm
+
+    H = 64
+    rng = np.random.RandomState(2)
+    params = {"weight": (1 + 0.1 * rng.randn(H)).astype(np.float32)}
+    x = rng.randn(2, 5, H).astype(np.float32)
+
+    def oracle(x):
+        var = (x.astype(np.float64) ** 2).mean(-1, keepdims=True)
+        return (x / np.sqrt(var + 1e-5) * params["weight"]).astype(np.float32)
+
+    built = build_module(
+        lambda p, x: rms_norm(x, p["weight"], 1e-5), params, [(x,)],
+        tpu_lower=True,  # pytree (dict) params must abstractify for export
+    )
+    assert built.exported is not None
+    validate_accuracy(built, [(x,)], cpu_callable=oracle, rtol=2e-3, atol=2e-3)
