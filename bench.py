@@ -204,12 +204,15 @@ def build_app(
     loaded = False
     if artifact and os.path.exists(os.path.join(artifact, "manifest.pkl")):
         from neuronx_distributed_inference_tpu.utils.presharded import (
+            config_fingerprint,
             load_presharded,
         )
 
         t0 = time.time()
         try:
-            restored = load_presharded(artifact, app.mesh)
+            restored = load_presharded(
+                artifact, app.mesh, fingerprint=config_fingerprint(app.config)
+            )
         except Exception as e:
             # corrupt/stale artifact (killed mid-write, recipe change):
             # degrade to a cold load + rewrite rather than failing the point
@@ -232,11 +235,15 @@ def build_app(
         print(f"load (cold) {time.time() - t0:.1f}s", file=sys.stderr)
         if artifact:
             from neuronx_distributed_inference_tpu.utils.presharded import (
+                config_fingerprint,
                 save_presharded,
             )
 
             t0 = time.time()
-            save_presharded(app.params, app._pspecs, artifact)
+            save_presharded(
+                app.params, app._pspecs, artifact,
+                fingerprint=config_fingerprint(app.config),
+            )
             print(
                 f"presharded cache write {artifact} ({time.time() - t0:.1f}s)",
                 file=sys.stderr,

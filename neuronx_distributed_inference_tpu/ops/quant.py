@@ -13,6 +13,7 @@ per-channel(out) scales, and XLA fuses the cast+scale into the matmul.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence
 
 import jax
@@ -58,14 +59,21 @@ def quantize_tensor(
     absmax = xp.maximum(absmax, 1e-8)
     qmax = 127.0 if dt == jnp.int8 else float(jnp.finfo(dt).max)
     scale = absmax / qmax
-    q = wf / scale[..., None, :]
-    if dt == jnp.int8:
-        q = xp.clip(xp.round(q), -127, 127)
     if xp is np:
+        # host path: mutate the fp32 upcast IN PLACE so peak transient per
+        # leaf is (source) + (one fp32 copy) + (quantized result) — not two
+        # fp32 copies; the near-RAM-limit 8B quantize-at-load depends on it
         import ml_dtypes  # numpy fp8/bf16 dtype support
 
+        wf /= scale[..., None, :]
+        if dt == jnp.int8:
+            np.rint(wf, out=wf)
+            np.clip(wf, -127, 127, out=wf)
         np_dt = np.int8 if dt == jnp.int8 else np.dtype(ml_dtypes.float8_e4m3fn if dt == jnp.float8_e4m3fn else ml_dtypes.float8_e5m2)
-        return {"weight": q.astype(np_dt), "scale": scale.astype(np.float32)}
+        return {"weight": wf.astype(np_dt), "scale": scale.astype(np.float32)}
+    q = wf / scale[..., None, :]
+    if dt == jnp.int8:
+        q = jnp.clip(jnp.round(q), -127, 127)
     return {"weight": q.astype(dt), "scale": scale.astype(jnp.float32)}
 
 
@@ -154,10 +162,11 @@ def quantize_params(
     """Walk the param pytree quantizing every eligible 'weight' leaf.
 
     DONATING: the tree is mutated in place and each source weight's reference
-    is dropped as soon as its quantized replacement exists, so peak memory is
-    (quantized model) + (up to ``max_workers`` = 4 full-precision leaves in
-    flight on the parallel host path; exactly one on the serial/device path)
-    — not two full models. An int8 8B quantize-at-load on a 16G chip depends
+    is dropped as soon as its quantized replacement exists. Peak transient
+    memory per in-flight leaf is (source leaf) + (one fp32 upcast, mutated in
+    place) + (quantized result); the host path runs ``TPU_QUANT_WORKERS``
+    (default 2) leaves concurrently, the serial/device path exactly one —
+    never two full models. An int8 8B quantize-at-load on a 16G chip depends
     on this bound.
 
     Reference: save_quantized_state_dict / convert()
@@ -193,14 +202,15 @@ def quantize_params(
         node.update(q)  # drops the source weight's last reference
 
     host = bool(eligible) and isinstance(eligible[0]["weight"], np.ndarray)
-    if host and len(eligible) > 1:
+    workers = int(os.environ.get("TPU_QUANT_WORKERS", "2"))
+    if host and len(eligible) > 1 and workers > 1:
         # host quantize-at-load: leaves are independent and numpy releases
-        # the GIL — a small pool cuts an 8B walk severalfold. Peak memory is
-        # (quantized model) + (max_workers full-precision leaves); 4 keeps
-        # an 8B walk well inside host RAM (VERDICT r4 weak #2).
+        # the GIL — a small pool cuts a multi-core 8B walk severalfold
+        # (VERDICT r4 weak #2); see the docstring for the per-worker
+        # transient-memory bound that sizes the default
         from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=4) as ex:
+        with ThreadPoolExecutor(max_workers=workers) as ex:
             list(ex.map(quantize_one, eligible))
     else:
         for node in eligible:

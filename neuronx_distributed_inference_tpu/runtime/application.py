@@ -320,10 +320,14 @@ class TpuModelForCausalLM:
             presharded_dir = os.path.join(compiled_model_path, "presharded")
         if self.params is None and presharded_dir and tc.save_sharded_checkpoint:
             from neuronx_distributed_inference_tpu.utils.presharded import (
+                config_fingerprint,
                 load_presharded,
             )
 
-            restored = load_presharded(presharded_dir, self.mesh)
+            restored = load_presharded(
+                presharded_dir, self.mesh,
+                fingerprint=config_fingerprint(self.config),
+            )
             if restored is not None:
                 self.params, self._pspecs = restored
                 self.init_kv_cache()
@@ -335,10 +339,14 @@ class TpuModelForCausalLM:
             and not os.path.exists(os.path.join(presharded_dir, "manifest.pkl"))
         ):
             from neuronx_distributed_inference_tpu.utils.presharded import (
+                config_fingerprint,
                 save_presharded,
             )
 
-            save_presharded(self.params, self._pspecs, presharded_dir)
+            save_presharded(
+                self.params, self._pspecs, presharded_dir,
+                fingerprint=config_fingerprint(self.config),
+            )
         if not tc.skip_warmup:
             self.warmup()
         return self

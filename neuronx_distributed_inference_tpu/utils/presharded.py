@@ -27,13 +27,41 @@ MANIFEST = "manifest.pkl"
 WEIGHTS = "weights"
 
 
+def config_fingerprint(config) -> str:
+    """Stable identity of the weights an artifact holds: model shape + dtype
+    + quantization recipe. A stale artifact (different model/recipe under
+    the same compiled dir) must NOT silently override the requested config —
+    the sibling quantized-checkpoint path validates its recipe the same way
+    (ops/quant.has_quantized_checkpoint; reference recipe check,
+    application_base.py:636)."""
+    tc = config.tpu_config
+    fields = {
+        "model_type": getattr(config, "model_type", None),
+        "hidden_size": getattr(config, "hidden_size", None),
+        "intermediate_size": getattr(config, "intermediate_size", None),
+        "num_hidden_layers": getattr(config, "num_hidden_layers", None),
+        "num_attention_heads": getattr(config, "num_attention_heads", None),
+        "num_key_value_heads": getattr(config, "num_key_value_heads", None),
+        "vocab_size": getattr(config, "vocab_size", None),
+        "tie_word_embeddings": getattr(config, "tie_word_embeddings", None),
+        "dtype": tc.dtype,
+        "quantized": tc.quantized,
+        "quantization_type": tc.quantization_type if tc.quantized else None,
+        "quantization_dtype": tc.quantization_dtype if tc.quantized else None,
+        "block": tc.blockwise_matmul_block_size if tc.quantized else None,
+        "skip": tuple(tc.modules_to_not_convert or ()) if tc.quantized else None,
+        "tp": tc.tp_degree,
+    }
+    return repr(sorted(fields.items()))
+
+
 def _is_leaf_spec(x):
     from jax.sharding import PartitionSpec
 
     return isinstance(x, PartitionSpec)
 
 
-def save_presharded(params, pspecs, path: str) -> None:
+def save_presharded(params, pspecs, path: str, fingerprint: Optional[str] = None) -> None:
     """Write the (already sharded) params + a restore manifest."""
     import orbax.checkpoint as ocp
 
@@ -46,12 +74,25 @@ def save_presharded(params, pspecs, path: str) -> None:
     # the manifest is the commit marker: written LAST so a kill mid-save
     # leaves no manifest and readers treat the artifact as absent
     with open(os.path.join(path, MANIFEST), "wb") as f:
-        pickle.dump({"shapes": shapes, "dtypes": dtypes, "pspecs": pspecs}, f)
+        pickle.dump(
+            {
+                "shapes": shapes,
+                "dtypes": dtypes,
+                "pspecs": pspecs,
+                "fingerprint": fingerprint,
+            },
+            f,
+        )
 
 
-def load_presharded(path: str, mesh) -> Optional[Tuple[dict, dict]]:
+def load_presharded(
+    path: str, mesh, fingerprint: Optional[str] = None
+) -> Optional[Tuple[dict, dict]]:
     """Restore (params, pspecs) from a presharded artifact, sharded onto
-    ``mesh``; None when no artifact exists."""
+    ``mesh``; None when no artifact exists OR when ``fingerprint`` is given
+    and disagrees with the one stored at save time (stale artifact — the
+    caller falls back to a real load instead of silently serving the wrong
+    weights/recipe)."""
     import orbax.checkpoint as ocp
     from jax.sharding import NamedSharding
 
@@ -60,6 +101,14 @@ def load_presharded(path: str, mesh) -> Optional[Tuple[dict, dict]]:
         return None
     with open(manifest_path, "rb") as f:
         manifest = pickle.load(f)
+    if fingerprint is not None and manifest.get("fingerprint") != fingerprint:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "presharded artifact at %s was saved for a different "
+            "model/quantization recipe; ignoring it", path,
+        )
+        return None
     pspecs = manifest["pspecs"]
 
     def abstract(shape, dtype, spec):

@@ -117,3 +117,41 @@ def test_cli_input_capture_and_profile(tiny_checkpoint, tmp_path):
     assert rc == 0
     assert len(glob.glob(os.path.join(cap, "*.npz"))) == 2
     assert glob.glob(os.path.join(prof, "**", "*.xplane.pb"), recursive=True)
+
+
+def test_cli_presharded_quantized_roundtrip(tiny_checkpoint, tmp_path, capsys):
+    """--save-sharded-checkpoint + --quantized: the first run quantizes once
+    and writes the presharded artifact; the second run restores sharded int8
+    arrays directly (no HF conversion, no re-quantization) and generates the
+    same tokens (VERDICT r4 next #2; reference save_sharded_checkpoint,
+    application_base.py:240-265 + quantize-at-prep :744-797)."""
+    from neuronx_distributed_inference_tpu.inference_demo import main
+
+    compiled = str(tmp_path / "compiled_q")
+    args = [
+        "--model-type", "llama", "run",
+        "--model-path", tiny_checkpoint,
+        "--compiled-model-path", compiled,
+        "--batch-size", "1", "--seq-len", "64", "--dtype", "float32",
+        "--quantized", "--save-sharded-checkpoint",
+        "--prompt", "2 7 1 8",
+        "--max-new-tokens", "6", "--skip-warmup",
+    ]
+    assert main(args) == 0
+    first = capsys.readouterr().out
+    assert os.path.exists(os.path.join(compiled, "presharded", "manifest.pkl"))
+
+    # the second run must come FROM the artifact: remove the HF weights so
+    # any conversion/re-quantization attempt would fail loudly
+    wf = os.path.join(tiny_checkpoint, "model.safetensors")
+    os.rename(wf, wf + ".bak")
+    try:
+        assert main(args) == 0
+    finally:
+        os.rename(wf + ".bak", wf)
+    second = capsys.readouterr().out
+
+    def toks(out):
+        return [l for l in out.splitlines() if l.strip().startswith("[")]
+
+    assert toks(first) == toks(second) and toks(first)
