@@ -333,8 +333,16 @@ def measure_serving(app, *, n_requests, prompt_len, gen_len):
                                     max_new_tokens=gen_len)
                 submit_t[next_idx] = now
                 next_idx += 1
-            if next_idx >= n_requests and not session.active:
-                break
+            if next_idx >= n_requests:
+                if not session.active:
+                    break
+                if len(first_t) >= n_requests:
+                    # every request admitted + producing: drain the decode
+                    # tail in multi-step chunks (one host sync per chunk —
+                    # vLLM-style multi-step scheduling; per-step scheduling
+                    # through a TUNNELED chip is pure host-RTT)
+                    session.run_to_completion(decode_chunk_size=16)
+                    break
         total_s = time.time() - t_start
         counts = {rid: len(r.generated) for rid, r in session.requests.items()}
         return submit_t, first_t, counts, total_s

@@ -459,31 +459,19 @@ def run_inference(args) -> int:
         # restores the sharded (possibly quantized) arrays directly — no HF
         # conversion, no quantize-at-load (VERDICT r4 next #2; reference
         # save_sharded_checkpoint reload, application_base.py:240-265)
-        has_presharded = False
-        if (
-            config.tpu_config.save_sharded_checkpoint
-            and args.compiled_model_path
-            and not args.random_weights
-            # LoRA attaches to loaded base params before compile
-            and not args.lora_ckpt_paths
-        ):
-            import pickle
+        # only skip the eager load for an artifact saved under THIS model +
+        # quantization recipe — a stale or corrupt artifact must not
+        # silently override the CLI flags (and must not crash: a kill
+        # mid-write degrades to a normal load). One shared gate with
+        # compile() so the checks cannot drift (utils/presharded.py).
+        from neuronx_distributed_inference_tpu.utils.presharded import (
+            artifact_ready,
+        )
 
-            manifest = os.path.join(
-                args.compiled_model_path, "presharded", "manifest.pkl"
-            )
-            if os.path.exists(manifest):
-                from neuronx_distributed_inference_tpu.utils.presharded import (
-                    config_fingerprint,
-                )
-
-                # only skip the eager load for an artifact saved under THIS
-                # model/quantization recipe — a stale artifact must not
-                # silently override the CLI flags
-                with open(manifest, "rb") as f:
-                    stored = pickle.load(f).get("fingerprint")
-                has_presharded = stored == config_fingerprint(config)
-        if not has_presharded:
+        artifact_ok = not args.random_weights and artifact_ready(
+            config, args.compiled_model_path, args.model_path
+        )
+        if not artifact_ok:
             app.load(random_weights=args.random_weights)
         if args.lora_ckpt_paths:
             from neuronx_distributed_inference_tpu.utils.hf_checkpoint import (

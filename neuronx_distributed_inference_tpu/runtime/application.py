@@ -318,35 +318,50 @@ class TpuModelForCausalLM:
             except Exception:
                 pass
             presharded_dir = os.path.join(compiled_model_path, "presharded")
-        if self.params is None and presharded_dir and tc.save_sharded_checkpoint:
+        # LoRA-attached trees never round-trip through the artifact: adapter
+        # identity isn't part of the fingerprint, and serving adapter weights
+        # a later run's flags never requested would be silently wrong
+        use_artifact = (
+            presharded_dir and tc.save_sharded_checkpoint and tc.lora_config is None
+        )
+        if use_artifact:
             from neuronx_distributed_inference_tpu.utils.presharded import (
                 config_fingerprint,
+                has_presharded,
                 load_presharded,
+                save_presharded,
             )
 
-            restored = load_presharded(
-                presharded_dir, self.mesh,
-                fingerprint=config_fingerprint(self.config),
+            fp = config_fingerprint(
+                self.config,
+                model_path=(
+                    os.path.abspath(self.model_path) if self.model_path else None
+                ),
             )
+        if self.params is None and use_artifact and has_presharded(presharded_dir, fp):
+            try:
+                restored = load_presharded(presharded_dir, self.mesh, fingerprint=fp)
+            except Exception as e:
+                # manifest intact but weights damaged (partial delete,
+                # killed rewrite): degrade to the normal load + rewrite
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "presharded restore failed (%s); falling back to a full load", e
+                )
+                import shutil
+
+                shutil.rmtree(presharded_dir, ignore_errors=True)
+                restored = None
             if restored is not None:
                 self.params, self._pspecs = restored
                 self.init_kv_cache()
         if self.params is None:
             self.load(random_weights=self.model_path is None, model_path=self.model_path)
-        if (
-            presharded_dir
-            and tc.save_sharded_checkpoint
-            and not os.path.exists(os.path.join(presharded_dir, "manifest.pkl"))
-        ):
-            from neuronx_distributed_inference_tpu.utils.presharded import (
-                config_fingerprint,
-                save_presharded,
-            )
-
-            save_presharded(
-                self.params, self._pspecs, presharded_dir,
-                fingerprint=config_fingerprint(self.config),
-            )
+        if use_artifact and not has_presharded(presharded_dir, fp):
+            # absent OR stale (recipe changed): (re)write so the next run
+            # restores instead of paying the cold load forever
+            save_presharded(self.params, self._pspecs, presharded_dir, fingerprint=fp)
         if not tc.skip_warmup:
             self.warmup()
         return self

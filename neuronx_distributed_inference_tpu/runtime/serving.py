@@ -526,18 +526,20 @@ class ServingSession:
 
     def run_to_completion(self, decode_chunk_size: int = 16) -> Dict[str, List[int]]:
         """Drain the session. When every active request is decoding (no
-        prefill pending) and the cache is contiguous, decode runs in
-        MULTI-STEP device chunks (models/base.decode_steps) — one host sync
-        per ``decode_chunk_size`` tokens instead of per token. Requests that
-        hit EOS mid-chunk overshoot by up to a chunk of discarded tokens
-        (causality makes them independent; they are truncated on consume).
-        Per-step semantics (step()) are unchanged for interactive callers."""
+        prefill pending), decode runs in MULTI-STEP device chunks
+        (models/base.decode_steps) — one host sync per ``decode_chunk_size``
+        tokens instead of per token — on the contiguous AND the paged cache
+        (paged chunks derive per-step write slots in-graph from the block
+        table; blocks are pre-allocated per chunk, vLLM-style multi-step
+        scheduling). Requests that hit EOS mid-chunk overshoot by up to a
+        chunk of discarded tokens (causality makes them independent; they
+        are truncated on consume). Per-step semantics (step()) are unchanged
+        for interactive callers."""
         spec = self.app.spec
         ring_cache = bool(spec.bounded_window or spec.ring_window)
         while self.active:
             if (
                 self.prefilling
-                or self.block_mode
                 # ring caches: pow2 surplus steps would overwrite live ring
                 # slots MID-stream (slot = pos mod W); generate()'s surplus
                 # is safe only because it is terminal — stay per-step
@@ -555,6 +557,28 @@ class ServingSession:
             else:
                 self._decode_chunk_pass(decode_chunk_size)
         return {rid: r.generated for rid, r in self.requests.items()}
+
+    def _chunk_block_table(self, rows, chunk: int, bucket: int):
+        """Paged-cache chunk prep: allocate blocks covering every row's next
+        NEEDED positions (min(chunk, remaining) — lockstep surplus steps for
+        rows that finish early write to table-zero entries, i.e. the
+        reserved garbage block, so they need no real blocks) and build the
+        (B, bucket//bs) table the in-graph slot mapping reads. Returns None
+        when the chunk can't run paged (bucket not block-aligned, or pool
+        exhausted — the per-step path preempts) —
+        ``rows`` = [(slot, pos, remaining_tokens), ...]."""
+        bs = self.allocator.block_size
+        if bucket % bs:
+            return None
+        mb = bucket // bs
+        table = np.zeros((self.num_slots, mb), np.int32)
+        for slot, pos, remaining in rows:
+            try:
+                self.allocator.alloc_seq(slot, pos + max(0, min(chunk, remaining)))
+            except RuntimeError:
+                return None
+            table[slot] = self.allocator.block_table(slot, mb)
+        return table
 
     def _decode_drain(self):
         """Drain all decoding requests (no EOS) in chained multi-step chunks
@@ -603,9 +627,27 @@ class ServingSession:
             if chunk < 1:
                 break
             bucket = self.app._decode_bucket(int(pos.max()) + chunk)
+            block_table = None
+            if self.block_mode:
+                block_table = self._chunk_block_table(
+                    [
+                        (r.slot, int(pos[r.slot, 0]), need[r.slot] - done)
+                        for r in active
+                    ],
+                    chunk, bucket,
+                )
+                if block_table is None:
+                    # pool exhausted mid-drain: consume what ran; with no
+                    # progress at all, one per-step pass preempts a request
+                    # so the next drain attempt can make headway
+                    if not chunks:
+                        self.step()
+                        return
+                    break
             tokens_c, _, cache = self.app.token_generation_model.decode_chunk(
                 self.app.params, self.app.kv_cache, last_dev, pos, seq_ids,
                 prepare_sampling_params(B), None, num_steps=chunk, bucket=bucket,
+                block_table=block_table,
             )
             self.app.kv_cache = cache
             take = min(chunk, total - done)
@@ -665,9 +707,22 @@ class ServingSession:
             pos[r.slot, 0] = r.pos
             seq_ids[r.slot] = r.slot
         bucket = self.app._decode_bucket(int(pos.max()) + chunk)
+        block_table = None
+        if self.block_mode:
+            block_table = self._chunk_block_table(
+                [
+                    (r.slot, r.pos, r.max_new_tokens - len(r.generated))
+                    for r in active
+                ],
+                chunk, bucket,
+            )
+            if block_table is None:
+                self.step()  # pool exhausted: the per-step path preempts
+                return
         tokens_c, _, cache = self.app.token_generation_model.decode_chunk(
             self.app.params, self.app.kv_cache, last, pos, seq_ids,
             prepare_sampling_params(B), None, num_steps=chunk, bucket=bucket,
+            block_table=block_table,
         )
         self.app.kv_cache = cache
         toks = np.asarray(tokens_c)  # ONE sync per chunk tokens

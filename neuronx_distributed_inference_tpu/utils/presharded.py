@@ -27,7 +27,7 @@ MANIFEST = "manifest.pkl"
 WEIGHTS = "weights"
 
 
-def config_fingerprint(config) -> str:
+def config_fingerprint(config, model_path: Optional[str] = None) -> str:
     """Stable identity of the weights an artifact holds: model shape + dtype
     + quantization recipe. A stale artifact (different model/recipe under
     the same compiled dir) must NOT silently override the requested config —
@@ -51,8 +51,52 @@ def config_fingerprint(config) -> str:
         "block": tc.blockwise_matmul_block_size if tc.quantized else None,
         "skip": tuple(tc.modules_to_not_convert or ()) if tc.quantized else None,
         "tp": tc.tp_degree,
+        # changes the param-TREE layout, not just values
+        "fused_qkv": tc.fused_qkv,
+        # two same-architecture checkpoints (base vs instruct) must not
+        # serve each other's weights from a shared compiled dir
+        "model_path": model_path,
     }
     return repr(sorted(fields.items()))
+
+
+def artifact_ready(config, compiled_model_path: Optional[str], model_path: Optional[str]) -> bool:
+    """ONE gate for "can this run restore from the presharded artifact
+    instead of loading": save_sharded_checkpoint on, no LoRA (adapter
+    identity is not fingerprinted), and a manifest matching this config +
+    checkpoint identity. Shared by inference_demo's eager-load skip and any
+    other caller so the gates cannot drift from what
+    :meth:`~..runtime.application.TpuModelForCausalLM.compile` will accept."""
+    tc = config.tpu_config
+    if not (tc.save_sharded_checkpoint and compiled_model_path):
+        return False
+    if tc.lora_config is not None:
+        return False
+    return has_presharded(
+        os.path.join(compiled_model_path, "presharded"),
+        config_fingerprint(
+            config,
+            model_path=os.path.abspath(model_path) if model_path else None,
+        ),
+    )
+
+
+def has_presharded(path: str, fingerprint: Optional[str] = None) -> bool:
+    """True when a usable artifact exists at ``path`` (manifest present,
+    readable, and — when ``fingerprint`` is given — saved under the same
+    model/recipe). Safe on truncated/corrupt manifests: a kill mid-write
+    must degrade to a normal load, never crash the caller (the sibling
+    probe for quantized checkpoints, ops/quant.has_quantized_checkpoint,
+    has the same contract)."""
+    manifest_path = os.path.join(path, MANIFEST)
+    try:
+        with open(manifest_path, "rb") as f:
+            manifest = pickle.load(f)
+    except Exception:
+        return False
+    if fingerprint is not None and manifest.get("fingerprint") != fingerprint:
+        return False
+    return True
 
 
 def _is_leaf_spec(x):
@@ -66,6 +110,14 @@ def save_presharded(params, pspecs, path: str, fingerprint: Optional[str] = None
     import orbax.checkpoint as ocp
 
     os.makedirs(path, exist_ok=True)
+    # invalidate FIRST: the manifest is the commit marker, so it must never
+    # sit over weights that are being replaced (a reader or a kill during
+    # the multi-minute rewrite would otherwise restore foreign weights
+    # under a stale identity)
+    try:
+        os.remove(os.path.join(path, MANIFEST))
+    except FileNotFoundError:
+        pass
     shapes = jax.tree.map(lambda x: tuple(x.shape), params)
     dtypes = jax.tree.map(lambda x: str(x.dtype), params)
     ckptr = ocp.StandardCheckpointer()

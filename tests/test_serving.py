@@ -387,3 +387,48 @@ def test_gpt_oss_class_serving_session():
     results = sess.run_to_completion()
     assert results["short"] == golden["short"]
     assert results["long"] == golden["long"]
+
+
+def test_paged_chunked_drain_matches_per_step():
+    """Multi-step decode on the PAGED cache (vLLM-style multi-step
+    scheduling, r5): run_to_completion's chunked drains must emit exactly
+    the per-step path's tokens, with and without EOS observation."""
+    def _mk():
+        return make_tiny_config(
+            tpu=dict(
+                is_continuous_batching=True, batch_size=2, ctx_batch_size=1,
+                is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=16,
+                seq_len=64,
+            )
+        )
+
+    sd = make_random_hf_state_dict(_mk())
+    prompts = {"r1": [5, 17, 92, 41], "r2": [64, 3, 27, 9, 14, 33]}
+
+    # per-step oracle
+    app1 = TpuModelForCausalLM(None, _mk()).load(state_dict=sd)
+    s1 = ServingSession(app1)
+    for rid, p in prompts.items():
+        assert s1.add_request(rid, p, max_new_tokens=20)
+    while s1.active:
+        s1.step()
+    golden = {rid: r.generated for rid, r in s1.requests.items()}
+    assert all(len(v) == 20 for v in golden.values())
+
+    # chunked drain (no EOS -> _decode_drain chained chunks)
+    app2 = TpuModelForCausalLM(None, _mk()).load(state_dict=sd)
+    s2 = ServingSession(app2)
+    for rid, p in prompts.items():
+        assert s2.add_request(rid, p, max_new_tokens=20)
+    assert s2.run_to_completion(decode_chunk_size=8) == golden
+
+    # EOS mid-stream -> _decode_chunk_pass with truncation on consume
+    eos = golden["r1"][9]
+    stop = golden["r1"].index(eos)  # first occurrence is where EOS stops
+    app3 = TpuModelForCausalLM(None, _mk()).load(state_dict=sd)
+    s3 = ServingSession(app3)
+    assert s3.add_request("r1", prompts["r1"], max_new_tokens=20, eos_token_id=eos)
+    assert s3.add_request("r2", prompts["r2"], max_new_tokens=20)
+    out = s3.run_to_completion(decode_chunk_size=8)
+    assert out["r1"] == golden["r1"][: stop + 1]
+    assert out["r2"] == golden["r2"]
