@@ -199,7 +199,7 @@ def build_app(
     )
     app = TpuModelForCausalLM(None, LlamaInferenceConfig(tc, load_config=load_cfg))
     artifact = None
-    if quantized and cache_key:
+    if cache_key:
         artifact = os.path.join(_cache_dir(), cache_key)
     loaded = False
     if artifact and os.path.exists(os.path.join(artifact, "manifest.pkl")):
@@ -389,10 +389,12 @@ def _suite_params(tiny):
         "bf16_1b_bs1": dict(
             attrs=attrs_1b, batch=1, seq=seq, ce=ce, tkg=tkg,
             prompt=prompt, gen=gen, long_prompt=long_prompt, quantized=False,
+            cache_key="bf16_1b" if not tiny else None,
         ),
         "bf16_1b_bs4": dict(
             attrs=attrs_1b, batch=4, seq=seq, ce=ce4, tkg=tkg4,
             prompt=prompt, gen=gen, long_prompt=None, quantized=False,
+            cache_key="bf16_1b" if not tiny else None,
         ),
         "int8_1b_bs1": dict(
             attrs=attrs_1b, batch=1, seq=seq, ce=ce[:1], tkg=tkg[:1],
