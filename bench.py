@@ -143,6 +143,7 @@ def build_app(
     quantized=False,
     cache_key=None,
     block_kv=False,
+    extra_tpu=None,
 ):
     """Build + load a random-weight app — the exact production code path.
 
@@ -196,6 +197,7 @@ def build_app(
         # they auto-enable on TPU (quantized configs fall back structurally)
         fused_qkv=not quantized,
         **kw,
+        **(extra_tpu or {}),
     )
     app = TpuModelForCausalLM(None, LlamaInferenceConfig(tc, load_config=load_cfg))
     artifact = None
