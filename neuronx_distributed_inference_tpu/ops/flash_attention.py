@@ -147,12 +147,26 @@ def flash_attention_bhsd(
     causal: bool = True,
     window: Optional[int] = None,
     chunk: Optional[int] = None,
-    bq: int = 128,
-    bkv: int = 128,
+    bq: Optional[int] = None,
+    bkv: Optional[int] = None,
     interpret: bool = False,
 ):
-    """Returns (out (B,H,S,D), m (B,H,S,1), l (B,H,S,1))."""
+    """Returns (out (B,H,S,D), m (B,H,S,1), l (B,H,S,1)).
+
+    Default tiles are picked by a measured rule (v5e tile sweep, PERF.md
+    "Prefill efficiency" round-5 section): plain-causal attention runs 3.1x
+    faster at 512x512 than the old 128x128 default at S=8192 (fewer grid
+    steps => less per-step pipeline overhead; VMEM comfortably fits the f32
+    accumulator at D<=128). Windowed/chunked flavors keep 128x128: live
+    kernel work scales as S*(window + bq), so a 512-row q tile would do up
+    to (window+512)/(window+128) more masked-flavor work than the skip
+    granularity saves."""
     B, H, S, D = q.shape
+    masked = window is not None or chunk is not None
+    if bq is None:
+        bq = 128 if masked else 512
+    if bkv is None:
+        bkv = 128 if masked else 512
     bq = min(bq, S)
     bkv = min(bkv, S)
     nq = pl.cdiv(S, bq)
