@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from neuronx_distributed_inference_tpu.config import InferenceConfig, to_dtype
+from neuronx_distributed_inference_tpu.ops.quant import linear as quant_linear
 from neuronx_distributed_inference_tpu.models.base import (
     PHASE_CONTEXT_ENCODING,
     ModelSpec,
@@ -393,7 +394,7 @@ def mllama_text_forward(
     hidden = rms_norm(hidden, params["norm"]["weight"], spec.rms_eps)
     if phase == PHASE_CONTEXT_ENCODING:
         hidden = gather_last_token(hidden, inputs.attention_mask)
-    logits = (hidden @ params["lm_head"]["weight"]).astype(jnp.float32)
+    logits = quant_linear(params["lm_head"], hidden).astype(jnp.float32)
     return logits[..., : spec.vocab_size], cache
 
 

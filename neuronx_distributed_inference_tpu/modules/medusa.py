@@ -53,7 +53,7 @@ def medusa_head_logits(head_params, hidden: jax.Array) -> jax.Array:
     h = hidden + jax.nn.silu(
         linear(head_params["res"], hidden) + head_params["res"]["bias"]
     )
-    return h @ head_params["lm_head"]["weight"]
+    return linear(head_params["lm_head"], h)
 
 
 def medusa_context_encoding(

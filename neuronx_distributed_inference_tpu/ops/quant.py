@@ -28,9 +28,13 @@ QUANT_DTYPES = {
 }
 
 # param-tree keys never quantized (reference modules_to_not_convert defaults)
+# Reference posture: modules_to_not_convert defaults to None there, i.e.
+# EVERY Linear converts — including lm_head (config.py:219). Measured here
+# (PERF.md r5): the bf16 lm_head was 30% of the int8-1B decode step's
+# device time; quantizing it is +11% decode throughput. Norm/router/sink/
+# embed stay excluded (not weight-streamed matmuls / accuracy-critical).
 DEFAULT_SKIP = ("embed_tokens", "rope", "norm", "input_layernorm",
-                "post_attention_layernorm", "q_norm", "k_norm", "router", "sink",
-                "lm_head")
+                "post_attention_layernorm", "q_norm", "k_norm", "router", "sink")
 
 
 def quantize_tensor(
@@ -319,6 +323,14 @@ def _quant_meta(tpu_config) -> dict:
         "quantization_type": tpu_config.quantization_type,
         "quantization_dtype": tpu_config.quantization_dtype,
         "blockwise_matmul_block_size": tpu_config.blockwise_matmul_block_size,
+        # WHICH modules were converted is part of the recipe: an artifact
+        # saved under an old skip set (e.g. bf16 lm_head) must re-quantize,
+        # not silently serve the old tree
+        "modules_to_not_convert": sorted(
+            tpu_config.modules_to_not_convert
+            if tpu_config.modules_to_not_convert
+            else DEFAULT_SKIP
+        ),
     }
 
 

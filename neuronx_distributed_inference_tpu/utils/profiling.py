@@ -112,8 +112,9 @@ def _fields(buf: bytes):
 
 def _parse_xplane_minimal(path: str, top: int) -> Dict:
     """Minimal xplane reader: XSpace{planes:1}.XPlane{name:2, lines:3,
-    event_metadata:5}.XLine{events:6}.XEvent{metadata_id:1, duration_ps:3}.
-    Aggregates device-plane op durations by event metadata name."""
+    event_metadata:4, stat_metadata:5}.XLine{events:4 (older traces: 6)}.
+    XEvent{metadata_id:1, offset_ps:2, duration_ps:3}. Aggregates
+    device-plane op durations by event metadata name."""
     data = open(path, "rb").read()
     if path.endswith(".gz"):
         data = gzip.decompress(data)
@@ -130,8 +131,12 @@ def _parse_xplane_minimal(path: str, top: int) -> Dict:
                 name = pv
             elif pf == 3:
                 lines.append(pv)
-            elif pf == 5:
-                # map<int64, XEventMetadata>: entry {key:1, value:2}
+            elif pf in (4, 5):
+                # event_metadata map<int64, XEventMetadata>: entry {key:1,
+                # value:2 = XEventMetadata{id:1, name:2}}. Current traces
+                # put it at plane field 4; 5 is stat_metadata, whose ids
+                # live in a SEPARATE space — only use it as a fallback and
+                # let event_metadata (4) always win on id collisions
                 k = None
                 m = b""
                 for ef, _, ev in _fields(pv):
@@ -144,12 +149,15 @@ def _parse_xplane_minimal(path: str, top: int) -> Dict:
                     for mf, _, mv in _fields(m):
                         if mf == 2 and isinstance(mv, bytes):
                             mname = mv.decode("utf-8", "replace")
-                    meta[k] = mname
+                    if mname and (pf == 4 or k not in meta):
+                        meta[k] = mname
         if b"TPU" not in name and b"/device" not in name and b"Device" not in name:
             continue
         for line in lines:
             for lf, _, lv in _fields(line):
-                if lf != 6:
+                # XLine events have appeared at field 4 (current jax/xprof)
+                # and field 6 (older traces) — accept both
+                if lf not in (4, 6):
                     continue
                 mid, dur = None, 0
                 for ef, wt, ev in _fields(lv):
