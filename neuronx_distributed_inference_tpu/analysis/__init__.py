@@ -1,0 +1,32 @@
+"""Static-analysis subsystem: graph-contract auditing for the AOT stack.
+
+Three cooperating passes, one finding/baseline format, one CLI
+(``python -m neuronx_distributed_inference_tpu.analysis``):
+
+- :mod:`.graph_audit` — jaxpr/HLO contract auditor: per sub-model tag ×
+  bucket, collective census, dtype discipline, KV-cache donation, and
+  bucket skeleton invariance (rules GRAPH2xx).
+- :mod:`.retrace_guard` — trace-time hooks + a context manager that fail
+  steady-state recompiles after ``warmup()``.
+- :mod:`.tpulint` — AST rules for host-sync/print/time under trace, Pallas
+  ``interpret`` plumbing, and mutable defaults (rules TPU1xx).
+- :mod:`.flag_audit` — no silently-ignored config flags (rule FLAG301).
+
+This module stays import-light (no jax) so the retrace-guard hooks can be
+wired into the runtime without pulling the analyzers in.
+"""
+
+from neuronx_distributed_inference_tpu.analysis.findings import (  # noqa: F401
+    Baseline,
+    Finding,
+    SEV_ERROR,
+    SEV_WARNING,
+    render_report,
+)
+from neuronx_distributed_inference_tpu.analysis.retrace_guard import (  # noqa: F401
+    RetraceError,
+    RetraceGuard,
+    guard_enabled,
+    note_trace,
+    trace_marker,
+)

@@ -1,0 +1,136 @@
+"""Shared finding / baseline machinery for the static-analysis passes.
+
+Every analysis pass (tpulint AST rules, the config flag audit, the jaxpr/HLO
+graph audit) emits :class:`Finding` records — rule id, severity, location
+(``file:line`` for source rules, ``tag/bucket`` for graph rules), message —
+so one CLI renders them as text or JSON and ONE baseline mechanism decides
+what is allowed to exist.
+
+Baseline model: a committed JSON file maps ``rule -> location-key -> count``.
+A finding is *baselined* (allowed) while its (rule, key) bucket still has
+budget; anything beyond the recorded count is NEW and fails the run. Counts —
+not line numbers — are pinned so unrelated edits don't churn the baseline,
+while a new ``jax.device_get`` in a file immediately trips the gate (the
+"pins the count" contract of the host-sync rule).
+
+In-code escape hatch: a ``# tpulint: ignore[RULE]`` comment on the offending
+line (or its enclosing ``def`` line) suppresses a source finding with a
+written-down justification right at the site.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analysis finding.
+
+    ``location`` is ``path/to/file.py:LINE`` for source rules and
+    ``tag/bucket`` (e.g. ``token_generation/128``) for graph rules.
+    ``key`` is the baseline bucket the finding counts against — file path for
+    source rules, tag for graph rules — deliberately coarser than
+    ``location`` so baselines survive unrelated line churn.
+    """
+
+    rule: str
+    severity: str
+    location: str
+    message: str
+    key: str = ""
+
+    def baseline_key(self) -> Tuple[str, str]:
+        return (self.rule, self.key or self.location)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def render(self) -> str:
+        return f"{self.location}: {self.severity} [{self.rule}] {self.message}"
+
+
+@dataclass
+class Baseline:
+    """Committed allowance: ``rule -> key -> count``."""
+
+    counts: Dict[str, Dict[str, int]] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path) -> "Baseline":
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except FileNotFoundError:
+            return cls()
+        return cls(counts={r: dict(v) for r, v in data.get("counts", {}).items()})
+
+    def save(self, path):
+        with open(path, "w") as f:
+            json.dump(
+                {"counts": {r: dict(sorted(v.items())) for r, v in sorted(self.counts.items())}},
+                f, indent=2, sort_keys=True,
+            )
+            f.write("\n")
+
+    @classmethod
+    def from_findings(cls, findings: List[Finding]) -> "Baseline":
+        c = Counter(f.baseline_key() for f in findings)
+        counts: Dict[str, Dict[str, int]] = {}
+        for (rule, key), n in c.items():
+            counts.setdefault(rule, {})[key] = n
+        return cls(counts=counts)
+
+    def filter_new(self, findings: List[Finding]) -> List[Finding]:
+        """Findings beyond the recorded per-(rule, key) budget, i.e. the ones
+        that must fail the run. Within a bucket the EXCESS findings are
+        reported (ordering inside a bucket is by location, so reports are
+        stable)."""
+        budget = Counter()
+        for rule, keys in self.counts.items():
+            for key, n in keys.items():
+                budget[(rule, key)] = n
+        new: List[Finding] = []
+        for f in sorted(findings, key=lambda f: (f.rule, f.key, f.location)):
+            k = f.baseline_key()
+            if budget[k] > 0:
+                budget[k] -= 1
+            else:
+                new.append(f)
+        return new
+
+
+def render_report(
+    findings: List[Finding],
+    new_findings: List[Finding],
+    as_json: bool = False,
+    suites: Optional[List[str]] = None,
+) -> str:
+    """Text or JSON report. JSON carries every finding plus the subset that
+    is new (non-baselined); text shows new findings and a summary line."""
+    if as_json:
+        return json.dumps(
+            {
+                "suites": suites or [],
+                "total": len(findings),
+                "new": len(new_findings),
+                "findings": [f.to_dict() for f in findings],
+                "new_findings": [f.to_dict() for f in new_findings],
+            },
+            indent=2,
+        )
+    lines = []
+    for f in new_findings:
+        lines.append(f.render())
+    lines.append(
+        f"{len(findings)} finding(s), {len(new_findings)} new (non-baselined)"
+        + (f" [suites: {', '.join(suites)}]" if suites else "")
+    )
+    return "\n".join(lines)

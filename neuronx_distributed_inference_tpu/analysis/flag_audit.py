@@ -1,0 +1,96 @@
+"""Config-flag audit: no silently-ignored feature flags (VERDICT r1 weak #4).
+
+Every :class:`~..config.TpuConfig` / :class:`~..config.MoETpuConfig` field
+must be (a) consumed outside ``config.py``, (b) raise when set to a non-inert
+value (the ``UNIMPLEMENTED_FLAGS`` contract), or (c) sit on the explicit
+allowlist below with a written justification. A field in none of the three
+buckets is config-surface padding and yields a **FLAG301** finding.
+
+This is the generalized form of the original private scan in
+``tests/test_flag_audit.py``; the test now consumes these findings so the
+flag audit, tpulint, and the graph audit share one finding/baseline format
+and one CLI (``python -m neuronx_distributed_inference_tpu.analysis``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import pathlib
+import re
+from typing import Dict, List, Optional
+
+from neuronx_distributed_inference_tpu.analysis.findings import Finding, SEV_ERROR
+
+# Documented pass-through fields: justification required.
+ALLOWLIST: Dict[str, str] = {
+    # reference parity: the reference also only plumbs pp_degree (SURVEY §2.9)
+    "pp_degree": "reference parity; only plumbed, like the reference",
+    # multi-host rank bookkeeping, consumed by launch scripts not the graph
+    "start_rank_id": "multi-host rank bookkeeping for launch scripts",
+    "local_ranks_size": "multi-host rank bookkeeping for launch scripts",
+    # inert data containers gated by their feature flag (is_chunked_prefill)
+    "chunked_prefill_config": "inert container gated by is_chunked_prefill",
+    # consumed by blockwise quantization (gated by quantization_type)
+    "blockwise_matmul_block_size": "consumed by blockwise quantization",
+    # hardware knobs with no TPU meaning, kept for config-file compatibility;
+    # documented as no-ops at their definition
+    "logical_nc_config": "NKI hardware knob; documented no-op on TPU",
+    "scratchpad_page_size": "NKI hardware knob; documented no-op on TPU",
+    # validated against derived values in validate() (must match tp/ep)
+    "moe_tp_degree": "validated against tp/ep in validate()",
+    "moe_ep_degree": "validated against tp/ep in validate()",
+    # validated (non-GLU raises) in MoETpuConfig.validate
+    "glu_mlp": "validated in MoETpuConfig.validate",
+    "glu_type": "validated in MoETpuConfig.validate",
+    # declarative aliases for the cp-axis flash-decode path: validate()
+    # requires cp_degree>1 / num_cores_per_group==cp_degree; the S-sharded KV
+    # decode itself is implemented off cp_degree (modules/kvcache.py)
+    "flash_decoding_enabled": "declarative alias validated against cp_degree",
+    "num_cores_per_group": "declarative alias validated against cp_degree",
+}
+
+
+def _package_source_without_config(root: Optional[pathlib.Path] = None) -> str:
+    pkg = (
+        root
+        if root is not None
+        else pathlib.Path(__file__).resolve().parents[1]
+    )
+    srcs = []
+    for p in pkg.rglob("*.py"):
+        if p.name != "config.py":
+            srcs.append(p.read_text())
+    return "\n".join(srcs)
+
+
+def run(root: Optional[pathlib.Path] = None) -> List[Finding]:
+    """Audit every config field; return FLAG301 findings for orphans."""
+    from neuronx_distributed_inference_tpu.config import (
+        MoETpuConfig,
+        UNIMPLEMENTED_FLAGS,
+        UNIMPLEMENTED_MOE_FLAGS,
+    )
+
+    src = _package_source_without_config(root)
+    raising = set(UNIMPLEMENTED_FLAGS) | set(UNIMPLEMENTED_MOE_FLAGS)
+    findings: List[Finding] = []
+    # MoETpuConfig subclasses TpuConfig, so its fields() cover both
+    for f in dataclasses.fields(MoETpuConfig):
+        name = f.name
+        if name in raising or name in ALLOWLIST:
+            continue
+        if not re.search(r"\b" + re.escape(name) + r"\b", src):
+            findings.append(
+                Finding(
+                    rule="FLAG301",
+                    severity=SEV_ERROR,
+                    location=f"config.py:{name}",
+                    message=(
+                        f"TpuConfig field `{name}` is neither consumed "
+                        f"outside config.py, raising (UNIMPLEMENTED_FLAGS), "
+                        f"nor allowlisted — a silently-ignored feature flag"
+                    ),
+                    key=name,
+                )
+            )
+    return findings

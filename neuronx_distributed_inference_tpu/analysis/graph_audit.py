@@ -1,0 +1,434 @@
+"""Jaxpr/HLO contract auditor for the compiled sub-model programs.
+
+For each registered sub-model tag × bucket, trace a TINY tp-sharded model on
+the CPU mesh (no accelerator needed; 8 virtual devices, same GSPMD path as
+hardware) and assert the graph invariants the AOT latency model relies on:
+
+- **GRAPH201 collective-census** — per-phase counts of the partitioner's
+  collectives (all-reduce / all-gather / reduce-scatter / collective-permute
+  / all-to-all in the compiled HLO) must match the committed baseline
+  (``analysis/graph_baseline.json``). A new collective in the decode graph is
+  a silent latency regression even when numerics are identical; a missing
+  one usually means a sharding constraint stopped propagating.
+- **GRAPH202 census-bucket-variance** — the census must be IDENTICAL across
+  buckets of one tag: buckets only change constants, never the communication
+  pattern.
+- **GRAPH203 f32-upcast-in-decode** — in a bf16 config, no
+  ``convert_element_type`` bf16→f32 inside the decode layer scan except from
+  the allowlisted files (norm/softmax/rope/sampling compute in f32 by
+  design; ``cast_logits_fp32`` is outside the scan).
+- **GRAPH204 missing-donation** — KV-cache donation must survive to lowering
+  (``tf.aliasing_output`` / ``jax.buffer_donor`` attrs on the cache leaves);
+  otherwise every decode step double-buffers the whole cache.
+- **GRAPH205 bucket-skeleton-drift** — the jaxpr equation skeleton (the
+  recursive sequence of primitive names) must be identical across buckets of
+  one tag: same program, different constants, exactly the frozen-executable
+  contract.
+
+Everything runs from ``jax.make_jaxpr``-level tracing plus a CPU compile of
+tiny (2-layer, 64-hidden) models — a few seconds per tag, no device state.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+from typing import Dict, List, Optional, Tuple
+
+from neuronx_distributed_inference_tpu.analysis.findings import (
+    Finding,
+    SEV_ERROR,
+)
+
+BASELINE_PATH = pathlib.Path(__file__).resolve().parent / "graph_baseline.json"
+
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+# Files allowed to upcast bf16 -> f32 inside the decode scan: numerically
+# deliberate (fp32 softmax/norm/rope/sampling), mirrored by config flags
+# (attention_softmax_fp32) or reference parity.
+F32_UPCAST_ALLOWLIST = (
+    "norm.py",
+    "attention.py",
+    "rope.py",
+    "sampling.py",
+    "decode_attention.py",
+    "masks.py",
+    "quant.py",
+)
+
+TAG_CONTEXT_ENCODING = "context_encoding"
+TAG_TOKEN_GENERATION = "token_generation"
+TAG_FUSED_SPECULATION = "fused_speculation"
+
+AUDIT_TAGS = (TAG_CONTEXT_ENCODING, TAG_TOKEN_GENERATION, TAG_FUSED_SPECULATION)
+
+
+# ---------------------------------------------------------------------------
+# tiny audit model
+# ---------------------------------------------------------------------------
+
+
+def _tiny_hf_attrs(vocab: int = 128) -> dict:
+    return dict(
+        model_type="llama",
+        hidden_size=64,
+        intermediate_size=128,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        num_hidden_layers=2,
+        vocab_size=vocab,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        max_position_embeddings=256,
+        hidden_act="silu",
+        tie_word_embeddings=False,
+    )
+
+
+def _tiny_config(**tpu_overrides):
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.llama import LlamaInferenceConfig
+
+    attrs = _tiny_hf_attrs()
+
+    def load_config(cfg):
+        for k, v in attrs.items():
+            setattr(cfg, k, v)
+
+    tc_kwargs = dict(
+        batch_size=2,
+        seq_len=128,
+        dtype="bfloat16",
+        tp_degree=2,
+        context_encoding_buckets=[64, 128],
+        token_generation_buckets=[64, 128],
+    )
+    tc_kwargs.update(tpu_overrides)
+    return LlamaInferenceConfig(TpuConfig(**tc_kwargs), load_config=load_config)
+
+
+def _census(hlo_text: str) -> Dict[str, int]:
+    counts = {}
+    for op in COLLECTIVE_OPS:
+        # ops appear as `%all-reduce.12 = ...` / `all-gather-start`; count
+        # result definitions so fused start/done pairs count once
+        counts[op] = len(re.findall(r"%?" + op + r"(?:-start)?(?:\.\d+)? = ", hlo_text))
+    return counts
+
+
+def _skeleton(jaxpr) -> Tuple:
+    """Recursive primitive-name skeleton of a (closed) jaxpr."""
+    out = []
+    for eqn in jaxpr.eqns:
+        sub = []
+        for v in eqn.params.values():
+            inner = getattr(v, "jaxpr", None)
+            if inner is not None:
+                sub.append(_skeleton(inner))
+        out.append((eqn.primitive.name, tuple(sub)))
+    return tuple(out)
+
+
+def _eqn_source_file(eqn) -> Optional[str]:
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is not None:
+            return frame.file_name
+    except Exception:
+        pass
+    return None
+
+
+def _walk_scan_upcasts(jaxpr, hits: List[Tuple[str, Optional[str]]], in_scan: bool = False):
+    """Collect bf16->f32 convert_element_type eqns inside scan bodies."""
+    import jax.numpy as jnp
+
+    for eqn in jaxpr.eqns:
+        if in_scan and eqn.primitive.name == "convert_element_type":
+            src_dtype = eqn.invars[0].aval.dtype
+            dst_dtype = eqn.params.get("new_dtype")
+            if src_dtype == jnp.bfloat16 and dst_dtype == jnp.float32:
+                hits.append((str(eqn), _eqn_source_file(eqn)))
+        for v in eqn.params.values():
+            inner = getattr(v, "jaxpr", None)
+            if inner is not None:
+                _walk_scan_upcasts(
+                    inner, hits, in_scan=in_scan or eqn.primitive.name == "scan"
+                )
+
+
+def _donation_count(lowered_text: str) -> int:
+    return lowered_text.count("tf.aliasing_output") + lowered_text.count(
+        "jax.buffer_donor"
+    )
+
+
+# ---------------------------------------------------------------------------
+# per-tag tracing
+# ---------------------------------------------------------------------------
+
+
+def _audit_causal_lm():
+    """Trace/lower/compile the CTE and TKG programs across buckets.
+
+    Returns {tag: {bucket: (jaxpr, lowered_text, census, donation_count,
+    n_cache_leaves)}}.
+    """
+    import jax
+
+    from neuronx_distributed_inference_tpu.runtime.application import (
+        TpuModelForCausalLM,
+    )
+
+    cfg = _tiny_config()
+    app = TpuModelForCausalLM(None, cfg)
+    app.load(random_weights=True)
+    results = {}
+    for tag, runner in (
+        (TAG_CONTEXT_ENCODING, app.context_encoding_model),
+        (TAG_TOKEN_GENERATION, app.token_generation_model),
+    ):
+        per_bucket = {}
+        n_cache_leaves = len(jax.tree.leaves(app.kv_cache))
+        for bucket in runner.buckets:
+            inputs = runner.example_inputs(bucket)
+            with jax.set_mesh(app.mesh):
+                traced = runner._fn.trace(app.params, app.kv_cache, inputs, None)
+                lowered = traced.lower()
+                compiled = lowered.compile()
+            lowered_text = lowered.as_text()
+            per_bucket[bucket] = (
+                traced.jaxpr,
+                lowered_text,
+                _census(compiled.as_text()),
+                _donation_count(lowered_text),
+                n_cache_leaves,
+            )
+        results[tag] = per_bucket
+    return results
+
+
+def _audit_fused_spec():
+    """Trace/lower/compile the fused-speculation decode program across ≥2
+    TKG bucket widths (draft chain + target verify in ONE graph)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from neuronx_distributed_inference_tpu.config import (
+        FusedSpecConfig,
+        OnDeviceSamplingConfig,
+    )
+    from neuronx_distributed_inference_tpu.models.base import StepInputs
+    from neuronx_distributed_inference_tpu.modules.sampling import (
+        prepare_sampling_params,
+    )
+    from neuronx_distributed_inference_tpu.runtime.fused_spec import (
+        TpuFusedSpecModelForCausalLM,
+    )
+
+    cfg = _tiny_config(
+        speculation_length=3,
+        enable_fused_speculation=True,
+        on_device_sampling_config=OnDeviceSamplingConfig(do_sample=False),
+    )
+    cfg.fused_spec_config = FusedSpecConfig(
+        draft_model_name="tiny-draft", draft_config=_tiny_config()
+    )
+    app = TpuFusedSpecModelForCausalLM(None, cfg)
+    app.load(random_weights=True)
+
+    B = cfg.tpu_config.batch_size
+    sp = prepare_sampling_params(B)
+    per_bucket = {}
+    n_cache_leaves = len(jax.tree.leaves(app.draft_cache)) + len(
+        jax.tree.leaves(app.target_cache)
+    )
+    for bucket in app.tkg_buckets:
+        inputs = StepInputs(
+            input_ids=jnp.zeros((B, 1), jnp.int32),
+            attention_mask=jnp.zeros((B, bucket), jnp.int32),
+            position_ids=jnp.full((B, 1), 7, jnp.int32),
+            seq_ids=jnp.asarray(np.arange(B, dtype=np.int32)),
+            sampling_params=jnp.asarray(sp, jnp.float32),
+        )
+        with jax.set_mesh(app.mesh):
+            traced = app._tkg_fn.trace(
+                app.draft_params, app.target_params, app.draft_cache,
+                app.target_cache, inputs, None,
+            )
+            lowered = traced.lower()
+            compiled = lowered.compile()
+        lowered_text = lowered.as_text()
+        per_bucket[bucket] = (
+            traced.jaxpr,
+            lowered_text,
+            _census(compiled.as_text()),
+            _donation_count(lowered_text),
+            n_cache_leaves,
+        )
+    return {TAG_FUSED_SPECULATION: per_bucket}
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def load_census_baseline(path: Optional[pathlib.Path] = None) -> Dict[str, Dict[str, int]]:
+    p = path or BASELINE_PATH
+    try:
+        with open(p) as f:
+            return json.load(f).get("census", {})
+    except FileNotFoundError:
+        return {}
+
+
+def save_census_baseline(census: Dict[str, Dict[str, int]], path: Optional[pathlib.Path] = None):
+    p = path or BASELINE_PATH
+    with open(p, "w") as f:
+        json.dump({"census": census}, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def run(
+    write_baseline: bool = False,
+    baseline_path: Optional[pathlib.Path] = None,
+    tags: Tuple[str, ...] = AUDIT_TAGS,
+) -> List[Finding]:
+    """Run the graph audit over the requested tags; return findings."""
+    findings: List[Finding] = []
+    results = {}
+    if TAG_CONTEXT_ENCODING in tags or TAG_TOKEN_GENERATION in tags:
+        results.update(_audit_causal_lm())
+    if TAG_FUSED_SPECULATION in tags:
+        results.update(_audit_fused_spec())
+    results = {t: results[t] for t in tags if t in results}
+
+    baseline = load_census_baseline(baseline_path)
+    observed_census: Dict[str, Dict[str, int]] = {}
+
+    for tag, per_bucket in results.items():
+        buckets = sorted(per_bucket)
+        # -- GRAPH204 donation ---------------------------------------------
+        for bucket in buckets:
+            _, _, _, donated, n_cache = per_bucket[bucket]
+            if donated < n_cache:
+                findings.append(
+                    Finding(
+                        rule="GRAPH204",
+                        severity=SEV_ERROR,
+                        location=f"{tag}/{bucket}",
+                        message=(
+                            f"KV-cache donation missing: {donated} aliased/"
+                            f"donor buffers in the lowering, expected ≥ "
+                            f"{n_cache} cache leaves — decode would "
+                            f"double-buffer the cache"
+                        ),
+                        key=tag,
+                    )
+                )
+        # -- GRAPH202/201 census -------------------------------------------
+        censuses = {b: per_bucket[b][2] for b in buckets}
+        ref_bucket = buckets[0]
+        for b in buckets[1:]:
+            if censuses[b] != censuses[ref_bucket]:
+                findings.append(
+                    Finding(
+                        rule="GRAPH202",
+                        severity=SEV_ERROR,
+                        location=f"{tag}/{b}",
+                        message=(
+                            f"collective census differs across buckets: "
+                            f"{censuses[ref_bucket]} (bucket {ref_bucket}) vs "
+                            f"{censuses[b]} (bucket {b}) — buckets must only "
+                            f"change constants, never the communication "
+                            f"pattern"
+                        ),
+                        key=tag,
+                    )
+                )
+        observed_census[tag] = censuses[ref_bucket]
+        # under --write-baseline the observed census IS the new contract:
+        # drift vs the old file is being accepted, not reported
+        expected = None if write_baseline else baseline.get(tag)
+        if expected is not None and expected != censuses[ref_bucket]:
+            regressed = {
+                op: (expected.get(op, 0), censuses[ref_bucket].get(op, 0))
+                for op in set(expected) | set(censuses[ref_bucket])
+                if expected.get(op, 0) != censuses[ref_bucket].get(op, 0)
+            }
+            findings.append(
+                Finding(
+                    rule="GRAPH201",
+                    severity=SEV_ERROR,
+                    location=f"{tag}/{ref_bucket}",
+                    message=(
+                        f"collective census drifted from baseline "
+                        f"(op: expected -> got): {regressed} — regenerate "
+                        f"with --write-baseline only if the change is "
+                        f"intentional"
+                    ),
+                    key=tag,
+                )
+            )
+        # -- GRAPH205 skeleton ---------------------------------------------
+        skels = {b: _skeleton(per_bucket[b][0].jaxpr) for b in buckets}
+        for b in buckets[1:]:
+            if skels[b] != skels[ref_bucket]:
+                findings.append(
+                    Finding(
+                        rule="GRAPH205",
+                        severity=SEV_ERROR,
+                        location=f"{tag}/{b}",
+                        message=(
+                            f"jaxpr equation skeleton differs between "
+                            f"buckets {ref_bucket} and {b} — the per-bucket "
+                            f"programs must share one structure (only "
+                            f"constants may differ)"
+                        ),
+                        key=tag,
+                    )
+                )
+        # -- GRAPH203 f32 upcasts in decode scan ---------------------------
+        if tag in (TAG_TOKEN_GENERATION, TAG_FUSED_SPECULATION):
+            hits: List[Tuple[str, Optional[str]]] = []
+            _walk_scan_upcasts(per_bucket[ref_bucket][0].jaxpr, hits)
+            for eqn_str, src in hits:
+                base = pathlib.Path(src).name if src else "<unknown>"
+                if src is not None and base in F32_UPCAST_ALLOWLIST:
+                    continue
+                if src is None:
+                    # no user frame (jax-internal rewrite): not actionable
+                    continue
+                findings.append(
+                    Finding(
+                        rule="GRAPH203",
+                        severity=SEV_ERROR,
+                        location=f"{tag}/{ref_bucket}",
+                        message=(
+                            f"bf16→f32 upcast inside the decode layer scan "
+                            f"from {base} (not in the logits/norm allowlist): "
+                            f"{eqn_str[:120]}"
+                        ),
+                        key=tag,
+                    )
+                )
+
+    if write_baseline:
+        # merge over the existing file so auditing a tags SUBSET never
+        # deletes the other tags' committed censuses
+        merged = dict(baseline)
+        merged.update(observed_census)
+        save_census_baseline(merged, baseline_path)
+    return findings

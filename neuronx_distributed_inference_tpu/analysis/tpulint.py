@@ -1,0 +1,534 @@
+"""tpulint: AST rules specific to this codebase.
+
+The rules encode contracts the runtime relies on but Python cannot enforce:
+
+- **TPU101 host-sync-under-trace** (error): ``jax.device_get``,
+  ``block_until_ready`` or ``.item()`` inside a jit-traced function body. At
+  trace time these force the tracer to a concrete value (ConcretizationError
+  at best, a silent constant-fold at worst); they belong in host loops only.
+- **TPU102 host-sync-census** (warning, baselined): EVERY host-sync call in
+  the package, counted per file. The committed baseline pins the count — the
+  batched ``jax.device_get((tokens, logits))`` work in runtime/ stays pinned
+  so a new per-field fetch in a hot loop fails the lint.
+- **TPU103 host-time-under-trace** (error): ``time.time()`` /
+  ``time.perf_counter()`` / ``print`` under trace — they execute ONCE at
+  trace time and then lie forever.
+- **TPU104 pallas-missing-interpret** (error): a ``pallas_call`` site
+  without the ``interpret=`` kwarg, i.e. a kernel outside the
+  ``ops/kernel_mode.py`` plumbing. Such a kernel cannot run on the CPU test
+  mesh and cannot be forced to compile for the AOT Mosaic-lowering tests
+  (the r1/r3 bench-only crash class).
+- **TPU105 mutable-default-arg** (error): a list/dict/set literal default
+  argument anywhere in the package.
+- **TPU106 np-under-trace** (warning, baselined): ``np.asarray``/``np.array``
+  inside a traced body. Legitimate on trace-time-static values (bucket
+  tables, permutations) — those sites carry a pragma or a baseline entry —
+  but on a traced value it synchronizes or crashes.
+
+Traced-body detection: a function is *traced* when it is (a) decorated with
+``jax.jit`` (possibly through ``partial``), (b) referenced anywhere inside a
+``jax.jit(...)`` call's arguments (covers ``jax.jit(partial(forward, ...))``
+and the retrace-guard ``trace_marker`` wrappers, resolved across modules
+through the import graph), (c) defined inside a traced function, or (d)
+reachable from a traced function through package-internal calls/references
+(fixpoint propagation — ``forward -> model_logits -> decoder_layer`` all
+count). This overapproximates (a function used both host-side and in-graph
+counts as traced), which is the correct direction for a contract check.
+
+Suppression: ``# tpulint: ignore[TPU101]`` (or a bare ``# tpulint: ignore``)
+on the offending line or its enclosing ``def`` line.
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from neuronx_distributed_inference_tpu.analysis.findings import (
+    Finding,
+    SEV_ERROR,
+    SEV_WARNING,
+)
+
+PACKAGE = "neuronx_distributed_inference_tpu"
+
+HOST_SYNC_ATTRS = {"device_get", "block_until_ready", "item"}
+HOST_TIME_FUNCS = {"time", "perf_counter", "monotonic"}
+NP_SYNC_FUNCS = {"asarray", "array"}
+
+_PRAGMA_RE = re.compile(r"#\s*tpulint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
+
+
+@dataclass
+class _FuncInfo:
+    module: str  # module path relative to repo root
+    name: str  # bare name
+    node: ast.AST  # FunctionDef / AsyncFunctionDef / Lambda
+    refs: Set[Tuple[str, str]] = field(default_factory=set)  # resolved (module, name)
+    traced: bool = False
+
+
+class _ModuleIndex:
+    """Per-module: source, pragma lines, import map, function table."""
+
+    def __init__(self, path: pathlib.Path, relpath: str, root: pathlib.Path):
+        self.path = path
+        self.relpath = relpath
+        self.source = path.read_text()
+        self.tree = ast.parse(self.source, filename=str(path))
+        self.pragmas = self._collect_pragmas()
+        # local name -> fully-resolved in-package module relpath (aliases for
+        # `import pkg.x as y` and symbols for `from pkg.x import f`)
+        self.import_modules: Dict[str, str] = {}
+        self.import_symbols: Dict[str, Tuple[str, str]] = {}
+        self._collect_imports(root)
+        self.functions: Dict[str, List[_FuncInfo]] = {}
+        # simple name -> assigned RHS expressions, so the two-step pattern
+        # `step = partial(forward, ...); jax.jit(step)` still seeds `forward`
+        self.assignments: Dict[str, List[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.assignments.setdefault(t.id, []).append(node.value)
+
+    def _collect_pragmas(self) -> Dict[int, Set[str]]:
+        pragmas: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.source.splitlines(), start=1):
+            m = _PRAGMA_RE.search(line)
+            if m:
+                rules = m.group(1)
+                pragmas[i] = (
+                    {r.strip() for r in rules.split(",")} if rules else {"*"}
+                )
+        return pragmas
+
+    def _mod_to_relpath(self, dotted: str, root: pathlib.Path) -> Optional[str]:
+        if not dotted.startswith(PACKAGE):
+            return None
+        p = root / (dotted.replace(".", "/") + ".py")
+        if p.is_file():
+            return str(p.relative_to(root))
+        p = root / dotted.replace(".", "/") / "__init__.py"
+        if p.is_file():
+            return str(p.relative_to(root))
+        return None
+
+    def _collect_imports(self, root: pathlib.Path):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    rp = self._mod_to_relpath(a.name, root)
+                    if rp:
+                        self.import_modules[a.asname or a.name.split(".")[-1]] = rp
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mod = self._mod_to_relpath(node.module, root)
+                for a in node.names:
+                    if mod:
+                        sub = self._mod_to_relpath(f"{node.module}.{a.name}", root)
+                        if sub:
+                            # `from pkg.x import submodule`
+                            self.import_modules[a.asname or a.name] = sub
+                        else:
+                            self.import_symbols[a.asname or a.name] = (mod, a.name)
+
+    def suppressed(self, line: int, rule: str, def_line: Optional[int] = None) -> bool:
+        for ln in (line, def_line):
+            if ln is None:
+                continue
+            rules = self.pragmas.get(ln)
+            if rules and ("*" in rules or rule in rules):
+                return True
+        return False
+
+
+def _names_in(expr: ast.AST) -> List[ast.AST]:
+    """Every Name / module-attribute reference inside an expression tree."""
+    out = []
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name):
+            out.append(n)
+        elif isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name):
+            out.append(n)
+    return out
+
+
+def _is_jit_expr(expr: ast.AST) -> bool:
+    """Does this expression mention jax.jit (directly or through partial)?"""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute) and n.attr == "jit":
+            return True
+        if isinstance(n, ast.Name) and n.id == "jit":
+            return True
+    return False
+
+
+def _is_jit_call(call: ast.Call) -> bool:
+    """A DIRECT ``jax.jit(...)`` / ``jit(...)`` call — not a chained
+    ``jax.jit(fn).lower(...)`` whose args are abstract values, not traced
+    functions."""
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "jit") or (
+        isinstance(f, ast.Name) and f.id == "jit"
+    )
+
+
+def _local_bindings(fn_node: ast.AST) -> Set[str]:
+    """Names bound inside a function (params + assignments + comprehension
+    targets): references to these are data flow, not module-function refs."""
+    out: Set[str] = set()
+    args = fn_node.args
+    for a in (
+        list(args.posonlyargs)
+        + list(args.args)
+        + list(args.kwonlyargs)
+        + ([args.vararg] if args.vararg else [])
+        + ([args.kwarg] if args.kwarg else [])
+    ):
+        out.add(a.arg)
+    for n in ast.walk(fn_node):
+        if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.For, ast.comprehension)):
+            targets = (
+                n.targets
+                if isinstance(n, ast.Assign)
+                else [getattr(n, "target", None)]
+            )
+            for t in targets:
+                if t is None:
+                    continue
+                for x in ast.walk(t):
+                    if isinstance(x, ast.Name):
+                        out.add(x.id)
+        elif isinstance(n, ast.withitem) and n.optional_vars is not None:
+            for x in ast.walk(n.optional_vars):
+                if isinstance(x, ast.Name):
+                    out.add(x.id)
+        elif (
+            isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef))
+            and n is not fn_node
+        ):
+            # nested defs bind their name locally; references to them are
+            # covered by the nested-def propagation rule, and resolving the
+            # bare name module-wide would drag in unrelated same-name defs
+            out.add(n.name)
+    return out
+
+
+class _Linter:
+    def __init__(self, root: pathlib.Path, files: List[pathlib.Path]):
+        self.root = root
+        self.modules: Dict[str, _ModuleIndex] = {}
+        for f in files:
+            rel = str(f.relative_to(root))
+            try:
+                self.modules[rel] = _ModuleIndex(f, rel, root)
+            except SyntaxError as e:  # pragma: no cover - repo code parses
+                raise RuntimeError(f"tpulint: cannot parse {rel}: {e}") from e
+        self.findings: List[Finding] = []
+
+    # ---- pass 1: function tables + traced roots --------------------------
+
+    def index_functions(self):
+        for rel, mod in self.modules.items():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info = _FuncInfo(module=rel, name=node.name, node=node)
+                    mod.functions.setdefault(node.name, []).append(info)
+
+    def resolve(self, mod: _ModuleIndex, node: ast.AST) -> List[_FuncInfo]:
+        """Resolve a Name / module-attr reference to package functions."""
+        if isinstance(node, ast.Name):
+            # imported symbols win over same-named local defs: a function-
+            # local `from models.base import forward` shadows a module-level
+            # method named `forward` at its use sites
+            if node.id in mod.import_symbols:
+                target_mod, name = mod.import_symbols[node.id]
+                target = self.modules.get(target_mod)
+                if target:
+                    return target.functions.get(name, [])
+            if node.id in mod.functions:
+                return mod.functions[node.id]
+        elif isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            target_rel = mod.import_modules.get(node.value.id)
+            target = self.modules.get(target_rel) if target_rel else None
+            if target:
+                return target.functions.get(node.attr, [])
+        return []
+
+    def seed_traced(self):
+        for rel, mod in self.modules.items():
+            for infos in mod.functions.values():
+                for info in infos:
+                    for dec in getattr(info.node, "decorator_list", []):
+                        if _is_jit_expr(dec):
+                            info.traced = True
+            def mark_expr(expr, seen):
+                for ref in _names_in(expr):
+                    for target in self.resolve(mod, ref):
+                        target.traced = True
+                    # chase `name = <expr>` one assignment at a time so
+                    # `step = partial(forward, ...); jax.jit(step)` seeds
+                    # `forward` (cycle-guarded via `seen`)
+                    if isinstance(ref, ast.Name) and ref.id not in seen:
+                        seen.add(ref.id)
+                        for rhs in mod.assignments.get(ref.id, []):
+                            mark_expr(rhs, seen)
+
+            for node in ast.walk(mod.tree):
+                if not (isinstance(node, ast.Call) and _is_jit_call(node)):
+                    continue
+                # every function referenced anywhere in the jit call's args
+                # is (transitively) a traced root
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    mark_expr(arg, set())
+
+    def collect_refs(self):
+        for rel, mod in self.modules.items():
+            for infos in mod.functions.values():
+                for info in infos:
+                    local = _local_bindings(info.node)
+                    for n in ast.walk(info.node):
+                        if isinstance(n, ast.Call):
+                            for ref in _names_in(n.func) + [
+                                r
+                                for a in list(n.args) + [k.value for k in n.keywords]
+                                for r in _names_in(a)
+                            ]:
+                                if isinstance(ref, ast.Name) and ref.id in local:
+                                    continue
+                                for t in self.resolve(mod, ref):
+                                    info.refs.add((t.module, t.name))
+
+    def propagate_traced(self):
+        changed = True
+        while changed:
+            changed = False
+            for mod in self.modules.values():
+                for infos in mod.functions.values():
+                    for info in infos:
+                        if not info.traced:
+                            continue
+                        # nested defs of a traced function are traced
+                        for n in ast.walk(info.node):
+                            if isinstance(
+                                n, (ast.FunctionDef, ast.AsyncFunctionDef)
+                            ) and n is not info.node:
+                                for cand in mod.functions.get(n.name, []):
+                                    if cand.node is n and not cand.traced:
+                                        cand.traced = True
+                                        changed = True
+                        for tm, tn in info.refs:
+                            target = self.modules.get(tm)
+                            if not target:
+                                continue
+                            for cand in target.functions.get(tn, []):
+                                if not cand.traced:
+                                    cand.traced = True
+                                    changed = True
+
+    def traced_functions(self) -> List[Tuple[_ModuleIndex, _FuncInfo]]:
+        out = []
+        for mod in self.modules.values():
+            for infos in mod.functions.values():
+                for info in infos:
+                    if info.traced:
+                        out.append((mod, info))
+        return out
+
+    # ---- pass 2: rules ---------------------------------------------------
+
+    def _emit(self, mod, node, rule, severity, message, def_line=None):
+        line = getattr(node, "lineno", 0)
+        if mod.suppressed(line, rule, def_line):
+            return
+        self.findings.append(
+            Finding(
+                rule=rule,
+                severity=severity,
+                location=f"{mod.relpath}:{line}",
+                message=message,
+                key=mod.relpath,
+            )
+        )
+
+    def rule_host_sync_census(self):
+        for mod in self.modules.values():
+            for n in ast.walk(mod.tree):
+                if not isinstance(n, ast.Call):
+                    continue
+                f = n.func
+                name = None
+                if isinstance(f, ast.Attribute) and f.attr in (
+                    "device_get",
+                    "block_until_ready",
+                ):
+                    name = f.attr
+                elif isinstance(f, ast.Name) and f.id in (
+                    "device_get",
+                    "block_until_ready",
+                ):
+                    # `from jax import device_get; device_get(x)` must not
+                    # slip past the pinned census
+                    name = f.id
+                if name:
+                    self._emit(
+                        mod, n, "TPU102", SEV_WARNING,
+                        f"host-sync call `{name}` (census; the baseline pins "
+                        f"this file's count — batch fetches into one "
+                        f"device_get per step)",
+                    )
+
+    def _body_nodes(self, info: _FuncInfo):
+        """Nodes of this function body, excluding nested defs (they are
+        linted as their own traced functions)."""
+        nested = [
+            n
+            for n in ast.walk(info.node)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n is not info.node
+        ]
+        skip = set()
+        for nd in nested:
+            skip.update(id(x) for x in ast.walk(nd))
+            skip.discard(id(nd))
+        for n in ast.walk(info.node):
+            if id(n) not in skip:
+                yield n
+
+    def rule_under_trace(self):
+        for mod, info in self.traced_functions():
+            def_line = info.node.lineno
+            for n in self._body_nodes(info):
+                if not isinstance(n, ast.Call):
+                    continue
+                f = n.func
+                if isinstance(f, ast.Attribute):
+                    if f.attr in HOST_SYNC_ATTRS:
+                        # dict.items() etc. have different names; `.item()` on
+                        # anything inside a traced body is the bug
+                        self._emit(
+                            mod, n, "TPU101", SEV_ERROR,
+                            f"host-sync `.{f.attr}(...)` inside jit-traced "
+                            f"`{info.name}` — forces a device round-trip/"
+                            f"concretization at trace time; move it to the "
+                            f"host loop",
+                            def_line=def_line,
+                        )
+                    elif (
+                        isinstance(f.value, ast.Name)
+                        and f.value.id in ("time",)
+                        and f.attr in HOST_TIME_FUNCS
+                    ):
+                        self._emit(
+                            mod, n, "TPU103", SEV_ERROR,
+                            f"`time.{f.attr}()` inside jit-traced "
+                            f"`{info.name}` — executes once at trace time; "
+                            f"use utils/profiling.py host-side",
+                            def_line=def_line,
+                        )
+                    elif (
+                        isinstance(f.value, ast.Name)
+                        and f.value.id in ("np", "numpy")
+                        and f.attr in NP_SYNC_FUNCS
+                    ):
+                        self._emit(
+                            mod, n, "TPU106", SEV_WARNING,
+                            f"`np.{f.attr}` inside jit-traced `{info.name}` — "
+                            f"fine on trace-time constants (baseline/pragma "
+                            f"it), a sync or crash on traced values",
+                            def_line=def_line,
+                        )
+                elif isinstance(f, ast.Name) and f.id == "print":
+                    self._emit(
+                        mod, n, "TPU103", SEV_ERROR,
+                        f"`print` inside jit-traced `{info.name}` — runs once "
+                        f"at trace time; use jax.debug.print",
+                        def_line=def_line,
+                    )
+                elif isinstance(f, ast.Name) and f.id in (
+                    "device_get",
+                    "block_until_ready",
+                ):
+                    # bare-imported forms of the host-sync calls
+                    self._emit(
+                        mod, n, "TPU101", SEV_ERROR,
+                        f"host-sync `{f.id}(...)` inside jit-traced "
+                        f"`{info.name}` — forces a device round-trip/"
+                        f"concretization at trace time; move it to the "
+                        f"host loop",
+                        def_line=def_line,
+                    )
+
+    def rule_pallas_interpret(self):
+        for mod in self.modules.values():
+            for n in ast.walk(mod.tree):
+                if not isinstance(n, ast.Call):
+                    continue
+                f = n.func
+                is_pallas = (isinstance(f, ast.Name) and f.id == "pallas_call") or (
+                    isinstance(f, ast.Attribute) and f.attr == "pallas_call"
+                )
+                if not is_pallas:
+                    continue
+                if not any(k.arg == "interpret" for k in n.keywords):
+                    self._emit(
+                        mod, n, "TPU104", SEV_ERROR,
+                        "`pallas_call` without `interpret=` — every kernel "
+                        "must plumb ops/kernel_mode.kernel_interpret() so the "
+                        "CPU mesh can run it and the AOT lowering tests can "
+                        "force-compile it",
+                    )
+
+    def rule_mutable_defaults(self):
+        for mod in self.modules.values():
+            for infos in mod.functions.values():
+                for info in infos:
+                    args = info.node.args
+                    for default in list(args.defaults) + [
+                        d for d in args.kw_defaults if d is not None
+                    ]:
+                        if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                            self._emit(
+                                mod, default, "TPU105", SEV_ERROR,
+                                f"mutable default argument in `{info.name}` — "
+                                f"shared across calls; use None + in-body "
+                                f"default",
+                                def_line=info.node.lineno,
+                            )
+
+    def run(self) -> List[Finding]:
+        self.index_functions()
+        self.seed_traced()
+        self.collect_refs()
+        self.propagate_traced()
+        self.rule_under_trace()
+        self.rule_host_sync_census()
+        self.rule_pallas_interpret()
+        self.rule_mutable_defaults()
+        self.findings.sort(key=lambda f: (f.location, f.rule))
+        return self.findings
+
+
+def package_files(root: Optional[pathlib.Path] = None) -> Tuple[pathlib.Path, List[pathlib.Path]]:
+    """(repo root, package .py files). The analysis package itself is linted
+    too — it must obey its own rules."""
+    if root is None:
+        root = pathlib.Path(__file__).resolve().parents[2]
+    pkg = root / PACKAGE
+    return root, sorted(pkg.rglob("*.py"))
+
+
+def run(root: Optional[pathlib.Path] = None, files: Optional[List[pathlib.Path]] = None) -> List[Finding]:
+    """Lint the package (or an explicit file list, for fixture tests)."""
+    resolved_root, pkg_files = package_files(root)
+    if files is not None:
+        pkg_files = files
+    return _Linter(resolved_root, pkg_files).run()
+
+
+def lint_paths(paths: List[pathlib.Path], root: pathlib.Path) -> List[Finding]:
+    """Lint arbitrary snippet files (test fixtures) relative to ``root``."""
+    return _Linter(root, [p.resolve() for p in paths]).run()

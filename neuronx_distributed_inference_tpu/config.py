@@ -396,6 +396,10 @@ class TpuConfig:
     # device-resident tokens, one sync per call (runtime/application.py).
     # False: block at every chunk boundary (step-accurate debugging).
     async_mode: bool = True
+    # seal the jit caches after warmup(): any steady-state retrace/recompile
+    # raises instead of silently blowing the latency model (analysis/
+    # retrace_guard.py). Env override: NXDI_TPU_RETRACE_GUARD=1.
+    retrace_guard: bool = False
     weights_to_skip_layout_optimization: Optional[List[str]] = None
     logical_nc_config: int = 1  # kept for config-surface parity; no-op on TPU
     skip_warmup: bool = False

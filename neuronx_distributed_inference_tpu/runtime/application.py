@@ -390,6 +390,21 @@ class TpuModelForCausalLM:
                 self.params, self.kv_cache, self._sample_key(0),
                 chunk_q_lens=chunk_q if runner is self.token_generation_model else None,
             )
+        from neuronx_distributed_inference_tpu.analysis.retrace_guard import (
+            guard_enabled,
+        )
+
+        if guard_enabled(tc) and chunk_q is None:
+            # seal the warmed step programs: a steady-state retrace now
+            # raises (analysis/retrace_guard.py) instead of silently
+            # recompiling mid-serve. Any multi-token chunk config
+            # (chunked/prefix prefill, windowed prefill, bounded windows —
+            # exactly the cases that set chunk_q) stays unsealed: their
+            # smaller-kv chunk programs compile lazily at first use by
+            # design (model_runner.warmup docstring) and sealing would turn
+            # that designed lazy compile into a serve-time RetraceError.
+            for runner in runners:
+                runner.seal()
 
     def capture_forward(
         self,
@@ -520,11 +535,14 @@ class TpuModelForCausalLM:
         self.kv_cache = out.cache
         rows = ctx_lens <= n0
         if rows.any():
-            t0 = np.asarray(jax.device_get(out.tokens))[:B]
-            first_tok[rows] = t0[rows, -1]
+            # ONE host round-trip for the step: tokens + logits batched into
+            # a single device_get (tpulint TPU102 pins this count)
+            t0, l0 = jax.device_get(
+                (out.tokens, out.logits if first_logits is not None else None)
+            )
+            first_tok[rows] = np.asarray(t0)[:B][rows, -1]
             if first_logits is not None:
-                l0 = np.asarray(jax.device_get(out.logits))[:B]
-                first_logits[rows, 0] = l0[rows, -1]
+                first_logits[rows, 0] = np.asarray(l0)[:B][rows, -1]
 
         # --- later chunks: multi-token prior-KV passes ---
         start = n0
@@ -559,12 +577,14 @@ class TpuModelForCausalLM:
             self.kv_cache = out.cache
             rows = (ctx_lens > start) & (ctx_lens <= end)
             if rows.any():
-                toks = np.asarray(jax.device_get(out.tokens))[:B]
+                toks, lg = jax.device_get(
+                    (out.tokens, out.logits if first_logits is not None else None)
+                )
+                toks = np.asarray(toks)[:B]
                 idx = np.clip(ctx_lens - 1 - start, 0, n - 1)
                 first_tok[rows] = toks[rows, idx[rows]]
                 if first_logits is not None:
-                    lg = np.asarray(jax.device_get(out.logits))[:B]
-                    first_logits[rows, 0] = lg[rows, idx[rows]]
+                    first_logits[rows, 0] = np.asarray(lg)[:B][rows, idx[rows]]
             start = end
             step += 1
         fl = jnp.asarray(first_logits) if first_logits is not None else None
@@ -663,12 +683,11 @@ class TpuModelForCausalLM:
         )
         out = runner(self.params, self.kv_cache, inputs, key)
         self.kv_cache = out.cache
-        tokens = np.asarray(jax.device_get(out.tokens))[:B]
-        logits = (
-            np.asarray(jax.device_get(out.logits))[:B]
-            if out.logits is not None
-            else None
-        )
+        # one host round-trip per step: tokens + logits in a single fetch
+        tokens, logits = jax.device_get((out.tokens, out.logits))
+        tokens = np.asarray(tokens)[:B]
+        if logits is not None:
+            logits = np.asarray(logits)[:B]
         return tokens, logits
 
     def _pos_limit(self) -> int:
@@ -814,23 +833,33 @@ class TpuModelForCausalLM:
                     # sync at every chunk boundary (debugging; reference
                     # async_mode=False per-step dispatch semantics)
                     jax.block_until_ready(tokens_c)
-            gen = np.asarray(jax.device_get(jnp.concatenate(token_chunks, axis=1)))
-            sequences = np.concatenate([input_ids, gen.astype(np.int64)], axis=1)
-            logits = (
-                np.asarray(jax.device_get(jnp.concatenate(logit_chunks, axis=1)))
-                if logit_chunks
-                else None
+            # everything the loop produced comes back in ONE fetch
+            gen, logits = jax.device_get(
+                (
+                    jnp.concatenate(token_chunks, axis=1),
+                    jnp.concatenate(logit_chunks, axis=1) if logit_chunks else None,
+                )
             )
+            gen = np.asarray(gen)
+            sequences = np.concatenate([input_ids, gen.astype(np.int64)], axis=1)
+            if logits is not None:
+                logits = np.asarray(logits)
             return GenerationOutput(
                 sequences=sequences, logits=logits, num_generated=gen.shape[1]
             )
 
         eos_arr = np.atleast_1d(np.asarray(eos_token_id)).astype(np.int64)
         eos_fill = int(eos_arr[0])
-        tokens = np.asarray(jax.device_get(first_tokens))  # (B, 1)
+        # tokens + logits in ONE device_get per step (tpulint TPU102 pins
+        # the count); logits land on host each chunk so device memory stays
+        # bounded regardless of generation length
+        tokens, first_l = jax.device_get(
+            (first_tokens, first_logits if self.spec.output_logits else None)
+        )
+        tokens = np.asarray(tokens)  # (B, 1)
         logits_acc: List[np.ndarray] = []
-        if self.spec.output_logits:
-            logits_acc.append(np.asarray(jax.device_get(first_logits)))
+        if first_l is not None:
+            logits_acc.append(np.asarray(first_l))
         generated = [tokens[:, -1]]
         done = np.zeros(B, bool)
         done |= np.isin(generated[-1], eos_arr)
@@ -860,9 +889,17 @@ class TpuModelForCausalLM:
                 adapter_ids=adapter_ids,
             )
             self.kv_cache = cache
-            tokens_c = np.asarray(jax.device_get(tokens_c))[:B]  # (B, chunk)
-            if self.spec.output_logits:
-                logits_acc.append(np.asarray(jax.device_get(logits_c))[:B, :take])
+            # the chunk boundary must sync anyway to test EOS; riding the
+            # logits on the SAME fetch keeps it one round-trip per chunk
+            tokens_c, logits_h = jax.device_get(
+                (
+                    tokens_c,
+                    logits_c[:B, :take] if self.spec.output_logits else None,
+                )
+            )
+            tokens_c = np.asarray(tokens_c)[:B]  # (B, chunk)
+            if logits_h is not None:
+                logits_acc.append(np.asarray(logits_h))
             for j in range(take):
                 step_tokens = tokens_c[:, j]
                 step_tokens = np.where(done, eos_fill, step_tokens)

@@ -25,6 +25,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from neuronx_distributed_inference_tpu.analysis.retrace_guard import trace_marker
 from neuronx_distributed_inference_tpu.config import InferenceConfig, to_dtype
 from neuronx_distributed_inference_tpu.models.base import StepInputs
 from neuronx_distributed_inference_tpu.models.registry import get_model_builder
@@ -97,11 +98,19 @@ class _SpecAppBase:
             draft_mlp_fn=self.draft_builder.mlp_fn(),
             target_mlp_fn=self.target_builder.mlp_fn(),
         )
+        # retrace guard: the jitted CTE/TKG programs note every trace; after
+        # the first decode round a caller (or test) may seal() the app so a
+        # steady-state retrace raises (analysis/retrace_guard.py)
+        self._sealed = False
         self._make_fns()
         self.draft_params = None
         self.target_params = None
         self.draft_cache = None
         self.target_cache = None
+
+    def seal(self):
+        """Arm the retrace guard for the fused CTE/TKG programs."""
+        self._sealed = True
 
     # subclasses define _make_fns / _call_cte / _call_tkg
 
@@ -220,8 +229,11 @@ class _SpecAppBase:
                 sampling_params=jnp.asarray(sp, jnp.float32),
             )
             out = self._call_tkg(inputs, self._step_key(step))
-            tokens = np.asarray(jax.device_get(out.tokens))
-            counts = np.asarray(jax.device_get(out.counts))
+            # one host round-trip per speculation round: tokens + counts in a
+            # single batched fetch (tpulint TPU102 pins this count)
+            tokens, counts = jax.device_get((out.tokens, out.counts))
+            tokens = np.asarray(tokens)
+            counts = np.asarray(counts)
             for b in range(B):
                 if done[b]:
                     continue
@@ -252,21 +264,29 @@ class TpuFusedSpecModelForCausalLM(_SpecAppBase):
     def _make_fns(self):
         tc = self.config.tpu_config
         self._cte_fn = jax.jit(
-            partial(
-                fused_spec_context_encoding,
-                do_sample=self.do_sample,
-                max_topk=tc.max_topk,
-                **self._common,
+            trace_marker(
+                "fused_speculation:cte",
+                partial(
+                    fused_spec_context_encoding,
+                    do_sample=self.do_sample,
+                    max_topk=tc.max_topk,
+                    **self._common,
+                ),
+                owner=self,
             ),
             donate_argnums=(2, 3),
         )
         self._tkg_fn = jax.jit(
-            partial(
-                fused_spec_token_gen,
-                spec_len=self.k,
-                do_sample=self.do_sample,
-                max_topk=tc.max_topk,
-                **self._common,
+            trace_marker(
+                "fused_speculation:tkg",
+                partial(
+                    fused_spec_token_gen,
+                    spec_len=self.k,
+                    do_sample=self.do_sample,
+                    max_topk=tc.max_topk,
+                    **self._common,
+                ),
+                owner=self,
             ),
             donate_argnums=(2, 3),
         )
@@ -357,48 +377,64 @@ class TpuEagleSpecModelForCausalLM(_SpecAppBase):
             if dynamic:
                 self.tree = DynamicTokenTree(tc.token_tree_config)
                 self._tkg_fn = jax.jit(
-                    partial(
-                        dynamic_tree_token_gen, dyn=self.tree,
-                        do_sample=self.do_sample, max_topk=tc.max_topk,
-                        **common,
+                    trace_marker(
+                        "eagle:tkg",
+                        partial(
+                            dynamic_tree_token_gen, dyn=self.tree,
+                            do_sample=self.do_sample, max_topk=tc.max_topk,
+                            **common,
+                        ),
+                        owner=self,
                     ),
                     donate_argnums=(2, 3, 4),
                 )
             else:
                 self.tree = TokenTree(tc.token_tree_config)
                 self._tkg_fn = jax.jit(
-                    partial(
-                        tree_token_gen, tree=self.tree,
-                        do_sample=self.do_sample, max_topk=tc.max_topk,
-                        **common,
+                    trace_marker(
+                        "eagle:tkg",
+                        partial(
+                            tree_token_gen, tree=self.tree,
+                            do_sample=self.do_sample, max_topk=tc.max_topk,
+                            **common,
+                        ),
+                        owner=self,
                     ),
                     donate_argnums=(2, 3, 4),
                 )
             self.reserve_slots = self.tree.num_nodes
         else:
             self._tkg_fn = jax.jit(
-                partial(
-                    eagle_token_gen,
-                    spec_len=self.k,
-                    draft_input_norm=norm,
-                    do_sample=self.do_sample,
-                    max_topk=tc.max_topk,
-                    draft_fn=self._draft_fn(),
-                    draft_lm_hidden_fn=self._draft_lm_hidden_fn(),
-                    capture_layers=self._capture_layers(),
-                    **self._common,
+                trace_marker(
+                    "eagle:tkg",
+                    partial(
+                        eagle_token_gen,
+                        spec_len=self.k,
+                        draft_input_norm=norm,
+                        do_sample=self.do_sample,
+                        max_topk=tc.max_topk,
+                        draft_fn=self._draft_fn(),
+                        draft_lm_hidden_fn=self._draft_lm_hidden_fn(),
+                        capture_layers=self._capture_layers(),
+                        **self._common,
+                    ),
+                    owner=self,
                 ),
                 donate_argnums=(2, 3, 4),
             )
         self._cte_fn = jax.jit(
-            partial(
-                eagle_context_encoding,
-                draft_input_norm=norm,
-                do_sample=self.do_sample,
-                max_topk=tc.max_topk,
-                draft_fn=self._draft_fn(),
-                capture_layers=self._capture_layers(),
-                **self._common,
+            trace_marker(
+                "eagle:cte",
+                partial(
+                    eagle_context_encoding,
+                    draft_input_norm=norm,
+                    do_sample=self.do_sample,
+                    max_topk=tc.max_topk,
+                    draft_fn=self._draft_fn(),
+                    capture_layers=self._capture_layers(),
+                    **self._common,
+                ),
+                owner=self,
             ),
             donate_argnums=(2, 3, 4),
         )

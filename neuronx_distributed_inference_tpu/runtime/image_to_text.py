@@ -232,20 +232,23 @@ class TpuImageToTextModel:
         request must not pay a serve-time compile)."""
         self.text.warmup()
         cte = self.text.context_encoding_model
-        H = self.text.spec.hidden_size
-        dt = to_dtype(self.config.tpu_config.dtype)
-        B = cte.batch_size
-        for bucket in cte.buckets:
-            ids = np.zeros((B, bucket), np.int64)
-            mask = np.ones((B, bucket), np.int64)
-            pos = np.tile(np.arange(bucket, dtype=np.int32), (B, 1))
-            inputs, _ = cte.prepare(
-                ids, mask, pos, np.arange(B, dtype=np.int32),
-                inputs_embeds=np.zeros((B, bucket, H), jnp.dtype(dt)),
-            )
-            out = cte(self.text.params, self.text.kv_cache, inputs, None)
-            jax.block_until_ready(out.tokens)
-            self.text.kv_cache = out.cache
+        # the embeds variant is part of THIS app's warmed set: lift the
+        # retrace seal (if armed) while its programs compile, re-arm after
+        with cte.seal_suspended():
+            H = self.text.spec.hidden_size
+            dt = to_dtype(self.config.tpu_config.dtype)
+            B = cte.batch_size
+            for bucket in cte.buckets:
+                ids = np.zeros((B, bucket), np.int64)
+                mask = np.ones((B, bucket), np.int64)
+                pos = np.tile(np.arange(bucket, dtype=np.int32), (B, 1))
+                inputs, _ = cte.prepare(
+                    ids, mask, pos, np.arange(B, dtype=np.int32),
+                    inputs_embeds=np.zeros((B, bucket, H), jnp.dtype(dt)),
+                )
+                out = cte(self.text.params, self.text.kv_cache, inputs, None)
+                jax.block_until_ready(out.tokens)
+                self.text.kv_cache = out.cache
         return self
 
     def encode_images(self, pixel_values: np.ndarray) -> jax.Array:

@@ -20,12 +20,14 @@ token_generation, ...; reference model_wrapper.py:32-37). Responsibilities:
 from __future__ import annotations
 
 import os
+from contextlib import contextmanager
 from functools import partial
 from typing import Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from neuronx_distributed_inference_tpu.analysis.retrace_guard import trace_marker
 from neuronx_distributed_inference_tpu.models.base import (
     PHASE_CONTEXT_ENCODING,
     PHASE_TOKEN_GENERATION,
@@ -71,6 +73,10 @@ class SubModelRunner:
         self.mlp_fn = mlp_fn
         self.layer_fn = layer_fn
         self._decode_fns = {}  # (num_steps, bucket) -> jitted multi-step program
+        # retrace guard (analysis/retrace_guard.py): the step fn notes every
+        # jit trace; after warmup() the application may seal() the runner so a
+        # steady-state retrace raises instead of silently recompiling
+        self._sealed = False
 
         # params/cache arrive as committed GSPMD-sharded arrays (device_put in
         # load()); jit follows their shardings, so no in_shardings needed —
@@ -78,9 +84,30 @@ class SubModelRunner:
         # leaves) without invalidating the runner
         step = partial(forward, spec=spec, phase=phase, mlp_fn=mlp_fn, layer_fn=layer_fn)
         self._fn = jax.jit(
-            step,
+            trace_marker(tag, step, owner=self),
             donate_argnums=(1,),  # cache in-place (reference KV aliasing)
         )
+
+    def seal(self):
+        """Arm the retrace guard: any later trace of this runner's step
+        program raises RetraceError. Multi-step decode programs (pow2-keyed,
+        built lazily in :meth:`decode_chunk`) are each allowed ONE compile —
+        their first trace per (num_steps, bucket) key — and raise on any
+        re-trace after that: steady state must reuse, first use may build.
+        Call only after warmup() has compiled every bucket this runner will
+        serve."""
+        self._sealed = True
+
+    @contextmanager
+    def seal_suspended(self):
+        """Temporarily lift the seal while a composite app (image-to-text,
+        encoder-decoder) compiles additional program variants post-warmup;
+        the previous seal state is restored even on failure."""
+        was_sealed, self._sealed = self._sealed, False
+        try:
+            yield self
+        finally:
+            self._sealed = was_sealed
 
     # ---- host-side padding (reference model_wrapper.py:582-1013) ---------
 
@@ -234,18 +261,36 @@ class SubModelRunner:
         key = (num_steps, bucket, adapter_ids is not None, block_table is not None)
         fn = self._decode_fns.get(key)
         if fn is None:
-            fn = jax.jit(
-                partial(
-                    decode_steps,
-                    spec=self.spec,
-                    num_steps=num_steps,
-                    bucket=bucket,
-                    mlp_fn=self.mlp_fn,
-                    layer_fn=self.layer_fn,
-                    unroll=int(os.environ.get("NXDI_TPU_DECODE_UNROLL", "1")),
-                ),
-                donate_argnums=(1,),
+            from neuronx_distributed_inference_tpu.analysis import retrace_guard
+
+            inner = partial(
+                decode_steps,
+                spec=self.spec,
+                num_steps=num_steps,
+                bucket=bucket,
+                mlp_fn=self.mlp_fn,
+                layer_fn=self.layer_fn,
+                unroll=int(os.environ.get("NXDI_TPU_DECODE_UNROLL", "1")),
             )
+            tag = f"{self.tag}:decode[{num_steps},{bucket}]"
+            state = {"traced": False}
+            runner = self
+
+            def decode_step_fn(*args, **kwargs):
+                # decode programs build lazily (this very call may be the
+                # first): the first trace per key is legitimate even when
+                # sealed; a RE-trace of an existing program in a sealed
+                # runner is the steady-state recompile the guard forbids
+                retrace_guard.note_trace(
+                    tag, sealed=state["traced"] and runner._sealed
+                )
+                out = inner(*args, **kwargs)
+                # only a COMPLETED first trace counts: a failed compile must
+                # not make the retry look like a steady-state recompile
+                state["traced"] = True
+                return out
+
+            fn = jax.jit(decode_step_fn, donate_argnums=(1,))
             self._decode_fns[key] = fn
         kwargs = {}
         if adapter_ids is not None:

@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""CI gate: run the static-analysis suites, exit non-zero on NEW findings.
+
+Thin wrapper over ``python -m neuronx_distributed_inference_tpu.analysis``
+so CI configs and humans share one entry point:
+
+    JAX_PLATFORMS=cpu python scripts/run_static_analysis.py [--json]
+    python scripts/run_static_analysis.py --suites lint,flags   # no tracing
+
+The graph audit traces tiny tp-sharded models on a CPU mesh — no accelerator
+required; the whole gate fits inside the tier-1 timeout. After an
+INTENTIONAL contract change (a new collective, a new host-sync site),
+regenerate the committed baselines with ``--write-baseline`` and review the
+diff like code.
+"""
+
+import os
+import sys
+
+# force a CPU backend with virtual devices before jax initializes: the gate
+# must give identical answers on a TPU host and in CPU-only CI
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from neuronx_distributed_inference_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -106,6 +106,7 @@ def test_ring_guard_unit_interleaved_cache():
     np.testing.assert_array_equal(np.asarray(app.kv_cache.k_full), full)
 
 
+@pytest.mark.slow
 def test_assisted_sliding_window_greedy_matches_generate():
     """Greedy assisted decoding on a ring-bounded sliding-window model must
     equal the target's own generate() byte-for-byte across several ring
@@ -139,6 +140,7 @@ def test_assisted_sliding_window_greedy_matches_generate():
         )
 
 
+@pytest.mark.slow
 def test_assisted_sampled_sliding_window_runs():
     """Sampled assisted decoding over the ring cache: valid tokens and
     seed-reproducible (the sampled accept path shares the same guard)."""

@@ -33,6 +33,7 @@ def test_dp_slot_mapping_interleaved():
     )
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("cp", [1, 2])
 def test_attention_dp_logit_parity(cp):
     """tp=4 with attention_dp=2 (and optionally cp=2... no: dp*cp must divide

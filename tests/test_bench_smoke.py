@@ -13,6 +13,8 @@ points instead of letting a driver timeout void the artifact.
 """
 
 import json
+
+import pytest
 import os
 import signal
 import subprocess
@@ -27,6 +29,7 @@ ALL_POINTS = {
 }
 
 
+@pytest.mark.slow
 def test_bench_suite_tiny(monkeypatch):
     import bench
 
@@ -71,6 +74,7 @@ def test_bench_budget_skips_but_parses(monkeypatch):
     assert final["int8_8b_tok_s"] is None
 
 
+@pytest.mark.slow
 def test_bench_killed_mid_suite_leaves_parseable_line(tmp_path):
     """Simulate the r4 failure: the driver kills bench mid-suite. The last
     fully-written stdout line must be a parseable summary with the headline

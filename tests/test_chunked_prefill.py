@@ -128,6 +128,7 @@ def test_prefix_allocator_lifecycle():
     assert len(a.hash_of_block) == 0
 
 
+@pytest.mark.slow
 def test_prefix_prefill_matches_full_cte():
     """A prefix-cache hit (suffix-only prior-KV prefill) must generate the
     same tokens as a fresh full prefill."""
@@ -182,6 +183,7 @@ def test_prefix_cache_actually_reuses_blocks():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_chunked_serving_matches_unchunked():
     app1, sd = _block_app()
     plain = ServingSession(app1)
@@ -206,6 +208,7 @@ def test_chunked_serving_matches_unchunked():
     assert out["s"] == ref["s"]
 
 
+@pytest.mark.slow
 def test_chunked_prefill_overlaps_decode():
     """A decoding request keeps producing tokens while another's long prompt
     is still being chunk-prefilled."""
@@ -253,6 +256,7 @@ def test_in_graph_slot_mapping_matches_host():
     np.testing.assert_array_equal(np.asarray(slots), [[9 * 8 + 1], [2 * 8 + 4]])
 
 
+@pytest.mark.slow
 def test_paged_kernel_integrated_serving_parity():
     """Chunked serving with the paged flash kernel force-enabled must match
     the native gathered-block path token-for-token (head_dim 64 model)."""
@@ -311,6 +315,7 @@ def test_step_reports_prefill_completion_token_once():
     assert streamed == sess.requests["r"].generated
 
 
+@pytest.mark.slow
 def test_warmup_covers_chunk_prefill_programs():
     """warmup() must compile the 2-D chunk-prefill programs so the first long
     prompt doesn't pay a serving-time JIT (r2 review finding)."""

@@ -119,6 +119,7 @@ def test_cli_input_capture_and_profile(tiny_checkpoint, tmp_path):
     assert glob.glob(os.path.join(prof, "**", "*.xplane.pb"), recursive=True)
 
 
+@pytest.mark.slow
 def test_cli_presharded_quantized_roundtrip(tiny_checkpoint, tmp_path, capsys):
     """--save-sharded-checkpoint + --quantized: the first run quantizes once
     and writes the presharded artifact; the second run restores sharded int8

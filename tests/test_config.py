@@ -88,6 +88,7 @@ def _presharded_roundtrip(tmp_path, **tpu_kwargs):
     return app2, ref, out
 
 
+@pytest.mark.slow
 def test_presharded_save_load_roundtrip(tmp_path):
     """save_sharded_checkpoint: compile() writes a presharded weight artifact
     and a fresh app restores it WITHOUT re-running checkpoint conversion
@@ -98,6 +99,7 @@ def test_presharded_save_load_roundtrip(tmp_path):
     np.testing.assert_array_equal(out, ref)
 
 
+@pytest.mark.slow
 def test_presharded_quantized_roundtrip(tmp_path):
     """Quantized params (int8 weights + scale leaves) round-trip through the
     presharded artifact — restore must skip BOTH conversion and

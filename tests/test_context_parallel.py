@@ -22,6 +22,7 @@ def _app(tp, cp, sd, sp=False):
     return app
 
 
+@pytest.mark.slow
 def test_cp_matches_tp_logits():
     """tp=4 cp=2 must match tp=1 logits within collective-reassociation tol
     (reference CP integration gate, test_llama3_2_1b_4layer_context_parallel)."""

@@ -54,6 +54,7 @@ def _native_attn_block(x, gamma, wqkv, wout, cos, sin, k_cache, v_cache,
     return x + o_project(params, attn, spec), k_cache, v_cache
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("K", [1, 4])
 def test_fused_attn_block_parity(K):
     rng = np.random.RandomState(7 + K)
@@ -163,6 +164,7 @@ def test_use_fused_attn_block_gates():
     assert use_fused_attn_block(auto, 1, 512) == (jax.default_backend() == "tpu")
 
 
+@pytest.mark.slow
 def test_fused_block_e2e_token_match():
     """generate() with the fused decode-layer kernels forced (interpret mode
     on CPU) matches the native path bit-for-bit on tokens."""

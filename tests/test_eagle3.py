@@ -77,6 +77,7 @@ def _run_eagle3(cfg, target_sd):
     return app.generate(PROMPTS, MASK, max_new_tokens=12)
 
 
+@pytest.mark.slow
 def test_eagle3_chain_greedy_parity():
     target_cfg = make_tiny_config(num_hidden_layers=4)
     target_sd = make_random_hf_state_dict(target_cfg, seed=3)
@@ -117,6 +118,7 @@ def test_is_eagle3_validation():
         TpuConfig(is_eagle3=True)
 
 
+@pytest.mark.slow
 def test_eagle3_reduced_vocab_d2t_parity():
     """Reduced draft vocab + d2t mapping: greedy parity still holds (the
     verification is target-exact; d2t just maps candidate ids)."""

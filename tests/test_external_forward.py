@@ -117,6 +117,7 @@ def test_external_forward_paged_matches_serving_session():
     assert out == golden
 
 
+@pytest.mark.slow
 def test_check_draft_logit_match():
     """Draft-logit harness: identical runs pass; a perturbed golden fails
     with (round, iteration) coordinates; argmax divergence stops a round's

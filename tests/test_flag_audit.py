@@ -4,14 +4,17 @@ Every TpuConfig field must be (a) consumed outside config.py, (b) raise when
 set to a non-inert value (UNIMPLEMENTED_FLAGS contract), or (c) sit on an
 explicit allowlist with a written justification. A field in none of the three
 buckets is config-surface padding and fails this test.
-"""
 
-import dataclasses
-import pathlib
-import re
+The scan itself lives in ``analysis/flag_audit.py`` (rule FLAG301) and shares
+the finding/allowlist format of the static-analysis subsystem; this test
+consumes its findings so there is exactly one baseline mechanism
+(``python -m neuronx_distributed_inference_tpu.analysis`` runs the same
+audit as a CLI gate).
+"""
 
 import pytest
 
+from neuronx_distributed_inference_tpu.analysis import flag_audit
 from neuronx_distributed_inference_tpu.config import (
     MoETpuConfig,
     TpuConfig,
@@ -19,62 +22,27 @@ from neuronx_distributed_inference_tpu.config import (
     UNIMPLEMENTED_MOE_FLAGS,
 )
 
-PKG = pathlib.Path(__file__).resolve().parent.parent / "neuronx_distributed_inference_tpu"
-
-# Documented pass-through fields: justification required.
-ALLOWLIST = {
-    # reference parity: the reference also only plumbs pp_degree (SURVEY §2.9)
-    "pp_degree",
-    # multi-host rank bookkeeping, consumed by launch scripts not the graph
-    "start_rank_id",
-    "local_ranks_size",
-    # inert data containers gated by their feature flag (is_chunked_prefill)
-    "chunked_prefill_config",
-    # consumed by blockwise quantization (gated by quantization_type)
-    "blockwise_matmul_block_size",
-    # hardware knobs with no TPU meaning, kept for config-file compatibility;
-    # documented as no-ops at their definition
-    "logical_nc_config",
-    "scratchpad_page_size",
-    # validated against derived values in validate() (must match tp/ep)
-    "moe_tp_degree",
-    "moe_ep_degree",
-    # validated (non-GLU raises) in MoETpuConfig.validate
-    "glu_mlp",
-    "glu_type",
-    # declarative aliases for the cp-axis flash-decode path: validate()
-    # requires cp_degree>1 / num_cores_per_group==cp_degree; the S-sharded KV
-    # decode itself is implemented off cp_degree (modules/kvcache.py)
-    "flash_decoding_enabled",
-    "num_cores_per_group",
-}
-
-
-def _all_fields():
-    return [f.name for f in dataclasses.fields(MoETpuConfig)]
-
-
-def _package_source_without_config():
-    srcs = []
-    for p in PKG.rglob("*.py"):
-        if p.name != "config.py":
-            srcs.append(p.read_text())
-    return "\n".join(srcs)
-
 
 def test_every_flag_used_raising_or_allowlisted():
-    src = _package_source_without_config()
-    raising = set(UNIMPLEMENTED_FLAGS) | set(UNIMPLEMENTED_MOE_FLAGS)
-    orphans = []
-    for name in _all_fields():
-        if name in raising or name in ALLOWLIST:
-            continue
-        if not re.search(r"\b" + re.escape(name) + r"\b", src):
-            orphans.append(name)
-    assert not orphans, (
-        f"TpuConfig fields neither consumed outside config.py, raising, nor "
-        f"allowlisted (silently ignored): {orphans}"
+    findings = flag_audit.run()
+    assert findings == [], (
+        "TpuConfig fields neither consumed outside config.py, raising, nor "
+        "allowlisted (silently ignored):\n"
+        + "\n".join(f.render() for f in findings)
     )
+
+
+def test_flag_audit_detects_orphans(tmp_path):
+    """The audit must actually fire: scanning a tree that consumes nothing
+    reports every non-raising, non-allowlisted field."""
+    (tmp_path / "empty.py").write_text("# consumes no flags\n")
+    findings = flag_audit.run(root=tmp_path)
+    names = {f.key for f in findings}
+    assert "async_mode" in names  # a real consumed-elsewhere field
+    assert all(f.rule == "FLAG301" for f in findings)
+    # allowlisted / raising fields stay exempt even in the empty tree
+    assert "pp_degree" not in names
+    assert not (set(UNIMPLEMENTED_FLAGS) & names)
 
 
 @pytest.mark.parametrize("name", sorted(UNIMPLEMENTED_FLAGS))
@@ -143,6 +111,7 @@ def test_fused_qkv_rejects_lora():
         TpuConfig(fused_qkv=True, lora_config=LoraServingConfig())
 
 
+@pytest.mark.slow
 def test_fused_qkv_logit_parity():
     """fused_qkv must be numerically identical to the unfused path."""
     import numpy as np

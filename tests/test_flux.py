@@ -291,6 +291,7 @@ def test_vae_decoder_parity():
     np.testing.assert_allclose(np.asarray(out), ref, atol=3e-5, rtol=3e-5)
 
 
+@pytest.mark.slow
 def test_flux_pipeline_e2e_smoke():
     """Tiny full pipeline: ids -> encoders -> 2 denoise steps -> VAE -> image;
     deterministic by seed, shape/range contract holds."""
@@ -327,6 +328,7 @@ def test_flux_pipeline_e2e_smoke():
     assert not np.array_equal(img1, img3)
 
 
+@pytest.mark.slow
 def test_image_gen_demo_smoke():
     from neuronx_distributed_inference_tpu.inference_demo import main
 

@@ -31,6 +31,7 @@ def _target_and_draft(k=4, draft_seed=7):
     return target_cfg, target_sd, spec_cfg, draft_sd
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("draft_seed", [7, 0])  # 0 = draft IS the target
 def test_fused_spec_matches_greedy(draft_seed):
     target_cfg, target_sd, spec_cfg, draft_sd = _target_and_draft(k=4, draft_seed=draft_seed)

@@ -175,6 +175,7 @@ def _dynamic_app(max_loras=2, max_cpu=4):
     return app, cfg
 
 
+@pytest.mark.slow
 def test_dynamic_lora_swap_matches_static():
     """Adapters served through the dynamic cache (2 device slots, 3 adapters)
     produce exactly the logits of a static app with the adapter loaded

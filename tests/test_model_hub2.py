@@ -89,6 +89,7 @@ def test_deepseek_v3_hf_parity(rope_interleave):
     np.testing.assert_allclose(out.logits, ref_logits, atol=2e-3, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_deepseek_v3_tp_parity():
     """MLA under tp=4 (q-head padding 6 -> 8) matches tp=1."""
     from transformers.models.deepseek_v3 import (
@@ -153,6 +154,7 @@ def test_gpt_oss_hf_parity():
     np.testing.assert_allclose(out.logits, ref_logits, atol=2e-3, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_gpt_oss_tp_parity():
     """Sinks + GQA replication under tp=4 matches tp=1."""
     from transformers import GptOssConfig, GptOssForCausalLM

@@ -109,6 +109,7 @@ def test_mixtral_parity():
     _check_parity(app, hf)
 
 
+@pytest.mark.slow
 def test_mixtral_expert_parallel():
     """tp=2 × ep=2 over the virtual mesh must match single-device logits
     (reference: expert-parallel feature tests, test_expert_mlp_ep.py)."""

@@ -166,6 +166,7 @@ def test_fused_moe_decode_matches_dense(glu):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_fused_moe_decode_e2e_token_match():
     """Mixtral generate() with the fused MoE decode kernel forced (interpret
     on CPU) matches the native path bit-for-bit."""

@@ -63,10 +63,22 @@ def test_ddp_with_attention_dp():
 
 _WORKER = textwrap.dedent(
     """
+    import os
     import sys
+
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    # 2 virtual CPU devices per process: the config knob on new jax; on
+    # jax < 0.5 fall back to the XLA flag, which the backend reads at first
+    # device use (still ahead of us here). Never set both — new jax rejects
+    # the combination at backend init.
+    try:
+        jax.config.update("jax_num_cpu_devices", 2)
+    except AttributeError:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2"
+        )
     import numpy as np
 
     port, pid = sys.argv[1], int(sys.argv[2])
@@ -85,10 +97,12 @@ _WORKER = textwrap.dedent(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     # a ddp-sharded batch reduced across the "DCN" axis: every process must
-    # agree on the global sum
-    x = jax.device_put(
-        np.arange(8.0).reshape(4, 2),
-        NamedSharding(mesh, P(("ddp",), None)),
+    # agree on the global sum. make_array_from_callback is the portable
+    # multi-process construction (device_put of a global host array onto a
+    # cross-process sharding is new-jax only)
+    data = np.arange(8.0).reshape(4, 2)
+    x = jax.make_array_from_callback(
+        data.shape, NamedSharding(mesh, P(("ddp",), None)), lambda idx: data[idx]
     )
 
     @jax.jit

@@ -216,6 +216,7 @@ def _tiny_hf_llama4():
     return Llama4ForConditionalGeneration(cfg).eval().float()
 
 
+@pytest.mark.slow
 def test_llama4_vision_e2e_hf_parity():
     """Llama4 vision tower (unfold patch embed, 2-D rope, pixel-shuffle
     adapter) + text decoder: greedy tokens match HF

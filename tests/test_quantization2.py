@@ -188,6 +188,7 @@ def test_mxfp4_dequant_matches_transformers():
     np.testing.assert_allclose(got, ref, atol=0, rtol=0)
 
 
+@pytest.mark.slow
 def test_gpt_oss_loads_mxfp4_packed_checkpoint():
     """A packed-expert GPT-OSS state dict loads through the MXFP4 dequant
     path and matches a model whose experts were dequantized by transformers'

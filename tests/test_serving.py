@@ -180,6 +180,7 @@ def test_serving_over_window_prompt_matches_generate():
     assert results["long"] == golden
 
 
+@pytest.mark.slow
 def test_assisted_sampled_decoding():
     """Sampled assisted decoding: multinomial accept/reject path runs, is
     seed-deterministic, stays in-vocab, and raises a guided error when the
@@ -239,6 +240,7 @@ def test_assisted_sampled_decoding():
         assisted_generate(bad, dg, prompts, mask, max_new_tokens=4)
 
 
+@pytest.mark.slow
 def test_speculative_serving_matches_plain_serving():
     """Speculation under continuous batching: greedy verification must emit
     the same tokens as the plain session, with mid-stream request turnover
@@ -328,6 +330,7 @@ def test_speculative_serving_near_limit_matches():
     assert out == golden
 
 
+@pytest.mark.slow
 def test_gpt_oss_class_serving_session():
     """ServingSession end-to-end on a GPT-OSS-class model (interleaved
     sliding/global ring caches, sinks, MoE): per-request tokens must match
@@ -389,6 +392,7 @@ def test_gpt_oss_class_serving_session():
     assert results["long"] == golden["long"]
 
 
+@pytest.mark.slow
 def test_paged_chunked_drain_matches_per_step():
     """Multi-step decode on the PAGED cache (vLLM-style multi-step
     scheduling, r5): run_to_completion's chunked drains must emit exactly

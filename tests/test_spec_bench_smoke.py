@@ -4,11 +4,14 @@ crash classes must be impossible; r4 next #6 — the speculation machinery
 measurement harness)."""
 
 import os
+
+import pytest
 import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "scripts"))
 
 
+@pytest.mark.slow
 def test_spec_bench_tiny():
     import spec_bench
 
@@ -38,6 +41,7 @@ def test_prefill_profile_tiny():
         assert r["wall_tok_s"] > 0
 
 
+@pytest.mark.slow
 def test_decode_scaling_tiny():
     """scripts/decode_scaling.py runs every (bs, variant) cell at tiny size
     on CPU (VERDICT r4 next #5 harness)."""

@@ -84,6 +84,7 @@ def test_speculative_selection_greedy_limit():
     np.testing.assert_array_equal(np.asarray(tokens)[0, :3], [1, 2, 3])
 
 
+@pytest.mark.slow
 def test_fused_spec_sampling_runs_and_differs_by_seed():
     from neuronx_distributed_inference_tpu.runtime.fused_spec import (
         TpuFusedSpecModelForCausalLM,

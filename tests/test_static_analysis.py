@@ -1,0 +1,445 @@
+"""The static-analysis subsystem analyzing itself and the tree.
+
+Three layers:
+1. fixture snippets with KNOWN violations — every tpulint rule must fire
+   (host-sync under jit, print/time under trace, pallas without interpret,
+   mutable defaults, np.asarray under trace) and pragmas must suppress;
+2. the REAL package must be clean: zero non-baselined tpulint findings,
+   zero flag-audit findings, zero graph-audit findings (collective census,
+   dtype discipline, KV donation, bucket skeleton invariance across
+   context-encoding / token-generation / fused-speculation × 2 buckets);
+3. the retrace guard must prove steady-state decode performs ZERO recompiles
+   after warmup — and must catch an induced retrace.
+"""
+
+import pathlib
+import textwrap
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_random_hf_state_dict, make_tiny_config
+
+from neuronx_distributed_inference_tpu.analysis import (
+    Baseline,
+    Finding,
+    RetraceError,
+    RetraceGuard,
+)
+from neuronx_distributed_inference_tpu.analysis import tpulint
+from neuronx_distributed_inference_tpu.analysis.tpulint import lint_paths
+
+pytestmark = pytest.mark.static_analysis
+
+
+# ---------------------------------------------------------------------------
+# 1. fixture snippets: every rule fires
+# ---------------------------------------------------------------------------
+
+
+def _lint_snippet(tmp_path, source: str):
+    pkg = tmp_path / "neuronx_distributed_inference_tpu"
+    pkg.mkdir(exist_ok=True)
+    f = pkg / "snippet.py"
+    f.write_text(textwrap.dedent(source))
+    return lint_paths([f], tmp_path)
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def test_rule_host_sync_under_jit(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def step(params, x):
+            y = params["w"] @ x
+            host = jax.device_get(y)      # BUG: sync under trace
+            return y + host.shape[0]
+
+        fn = jax.jit(step)
+        """,
+    )
+    assert "TPU101" in _rules(findings)
+    assert any("device_get" in f.message for f in findings if f.rule == "TPU101")
+
+
+def test_rule_bare_imported_device_get(tmp_path):
+    """`from jax import device_get` must not slip past TPU101 or the
+    TPU102 census."""
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        from jax import device_get
+
+        @jax.jit
+        def step(x):
+            return device_get(x)          # BUG: bare-name host sync
+        """,
+    )
+    assert "TPU101" in _rules(findings)
+    assert "TPU102" in _rules(findings)
+
+
+def test_rule_item_and_block_until_ready_under_jit(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):
+            x.block_until_ready()         # BUG
+            return x.sum().item()         # BUG
+        """,
+    )
+    assert sum(1 for f in findings if f.rule == "TPU101") == 2
+
+
+def test_rule_traced_through_partial_and_call_graph(tmp_path):
+    """jax.jit(partial(outer)) -> outer -> helper: the violation in the
+    helper two hops away must still be found."""
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        from functools import partial
+
+        def helper(y):
+            return jax.device_get(y)      # BUG: traced transitively
+
+        def outer(x, flag):
+            return helper(x) + 1
+
+        fn = jax.jit(partial(outer, flag=True))
+        """,
+    )
+    assert "TPU101" in _rules(findings)
+
+
+def test_rule_traced_through_assigned_step_variable(tmp_path):
+    """The runtime's own idiom — `step = partial(forward, ...);
+    jax.jit(step)` — must seed `forward` as a traced root."""
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        from functools import partial
+
+        def forward(params, x):
+            return jax.device_get(x)      # BUG: traced via the step variable
+
+        step = partial(forward, spec=1)
+        fn = jax.jit(step, donate_argnums=(1,))
+        """,
+    )
+    assert "TPU101" in _rules(findings)
+
+
+def test_rule_time_and_print_under_trace(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import time
+        import jax
+
+        @jax.jit
+        def step(x):
+            t0 = time.time()              # BUG: trace-time constant
+            print("step", x)              # BUG: prints once, at trace
+            return x * t0
+        """,
+    )
+    assert sum(1 for f in findings if f.rule == "TPU103") == 2
+
+
+def test_rule_pallas_missing_interpret(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        from jax.experimental import pallas as pl
+
+        def kernel_call(x):
+            return pl.pallas_call(lambda r: r, out_shape=x)(x)  # BUG: no interpret=
+
+        def good_call(x, interp):
+            return pl.pallas_call(lambda r: r, out_shape=x, interpret=interp)(x)
+        """,
+    )
+    assert sum(1 for f in findings if f.rule == "TPU104") == 1
+
+
+def test_rule_mutable_default(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        class Module:
+            def __init__(self, layers=[]):   # BUG
+                self.layers = layers
+
+        def fn(cfg={}):                      # BUG
+            return cfg
+        """,
+    )
+    assert sum(1 for f in findings if f.rule == "TPU105") == 2
+
+
+def test_rule_np_asarray_under_trace_and_pragma(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def step(x):
+            bad = np.asarray(x)                              # BUG (warning)
+            ok = np.asarray([1, 2, 3])  # tpulint: ignore[TPU106]
+            return x + bad.shape[0] + ok[0]
+        """,
+    )
+    assert sum(1 for f in findings if f.rule == "TPU106") == 1
+
+
+def test_pragma_suppresses_on_def_line(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        @jax.jit
+        def step(x):  # tpulint: ignore
+            return jax.device_get(x)
+        """,
+    )
+    assert "TPU101" not in _rules(findings)
+
+
+def test_host_sync_census_counts_per_file(tmp_path):
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+
+        def host_loop(out):
+            a = jax.device_get(out.tokens)
+            b = jax.device_get(out.logits)
+            out.cache.block_until_ready()
+            return a, b
+        """,
+    )
+    census = [f for f in findings if f.rule == "TPU102"]
+    assert len(census) == 3
+    # the baseline pins the count: 3 allowed, a 4th is new
+    base = Baseline.from_findings(census)
+    assert base.filter_new(census) == []
+    extra = census + [
+        Finding(rule="TPU102", severity="warning", key=census[0].key,
+                location=census[0].key + ":999", message="one more")
+    ]
+    assert len(base.filter_new(extra)) == 1
+
+
+# ---------------------------------------------------------------------------
+# 2. the real tree is clean
+# ---------------------------------------------------------------------------
+
+
+def test_package_tpulint_clean_vs_baseline():
+    findings = tpulint.run()
+    baseline = Baseline.load(
+        pathlib.Path(tpulint.__file__).parent / "tpulint_baseline.json"
+    )
+    new = baseline.filter_new(findings)
+    assert new == [], "non-baselined tpulint findings:\n" + "\n".join(
+        f.render() for f in new
+    )
+    # no hard errors may exist at all, baselined or not
+    errors = [f for f in findings if f.severity == "error"]
+    assert errors == [], "\n".join(f.render() for f in errors)
+
+
+def test_flag_audit_clean():
+    from neuronx_distributed_inference_tpu.analysis import flag_audit
+
+    findings = flag_audit.run()
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_graph_audit_clean_and_covers_tags():
+    """The jaxpr/HLO auditor over the real programs: context-encoding,
+    token-generation, and fused-speculation tags, ≥2 buckets each, zero
+    findings (census matches baseline, donation present, no stray f32
+    upcasts, one skeleton per tag)."""
+    from neuronx_distributed_inference_tpu.analysis import graph_audit
+
+    findings = graph_audit.run()
+    assert findings == [], "\n".join(f.render() for f in findings)
+    # coverage floor: the audited tag set is the acceptance-criteria set
+    assert set(graph_audit.AUDIT_TAGS) == {
+        "context_encoding",
+        "token_generation",
+        "fused_speculation",
+    }
+    baseline = graph_audit.load_census_baseline()
+    assert set(baseline) == set(graph_audit.AUDIT_TAGS)
+    # a tp=2 decode graph must actually communicate: vacuous censuses (all
+    # zeros) would mean the auditor is looking at the wrong HLO
+    assert baseline["token_generation"]["all-reduce"] > 0
+
+
+def test_graph_audit_flags_census_drift(tmp_path):
+    """A doctored baseline must produce GRAPH201 findings."""
+    from neuronx_distributed_inference_tpu.analysis import graph_audit
+
+    good = graph_audit.load_census_baseline()
+    doctored = {t: dict(c) for t, c in good.items()}
+    doctored["token_generation"]["all-reduce"] += 1
+    p = tmp_path / "graph_baseline.json"
+    graph_audit.save_census_baseline(doctored, p)
+    findings = graph_audit.run(baseline_path=p, tags=("token_generation",))
+    assert any(f.rule == "GRAPH201" for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# 3. retrace guard
+# ---------------------------------------------------------------------------
+
+
+def test_retrace_guard_records_and_raises():
+    import jax
+    import jax.numpy as jnp
+
+    from neuronx_distributed_inference_tpu.analysis.retrace_guard import (
+        trace_marker,
+    )
+
+    fn = jax.jit(trace_marker("toy", lambda x: x * 2))
+    fn(jnp.ones((2,)))  # first compile
+    with RetraceGuard(fail=False) as g:
+        fn(jnp.ones((2,)))  # cache hit: no trace
+    assert g.traces == []
+    with pytest.raises(RetraceError):
+        with RetraceGuard():
+            fn(jnp.ones((3,)))  # new shape: retrace inside the guard
+    with RetraceGuard(allowed=1):
+        fn(jnp.ones((4,)))  # tolerated when explicitly allowed
+
+
+def test_steady_state_decode_zero_recompiles_after_warmup():
+    """The acceptance contract: after warmup() + one generate() (which
+    compiles the decode-chunk programs), further steady-state decode performs
+    ZERO recompiles."""
+    cfg = make_tiny_config(tpu=dict(skip_warmup=False))
+    sd = make_random_hf_state_dict(cfg)
+    from neuronx_distributed_inference_tpu.runtime.application import (
+        TpuModelForCausalLM,
+    )
+
+    app = TpuModelForCausalLM(None, cfg)
+    app.load(state_dict=sd)
+    app.warmup()
+    prompt = np.array([[5, 17, 92, 41], [64, 3, 27, 9]])
+    mask = np.ones_like(prompt)
+    app.generate(prompt, mask, max_new_tokens=8)  # decode-chunk compile
+    with RetraceGuard() as g:  # raises on ANY trace in scope
+        out = app.generate(prompt, mask, max_new_tokens=8)
+    assert g.traces == []
+    assert out.num_generated == 8
+
+
+def test_sealed_runner_raises_on_post_warmup_retrace():
+    """TpuConfig.retrace_guard: after warmup the step programs are sealed —
+    a new shape reaching them raises instead of silently recompiling."""
+    cfg = make_tiny_config(tpu=dict(retrace_guard=True))
+    sd = make_random_hf_state_dict(cfg)
+    from neuronx_distributed_inference_tpu.runtime.application import (
+        TpuModelForCausalLM,
+    )
+
+    app = TpuModelForCausalLM(None, cfg)
+    app.load(state_dict=sd)
+    app.warmup()
+    assert app.token_generation_model._sealed
+    # every warmed bucket still serves fine
+    prompt = np.array([[5, 17, 92, 41], [64, 3, 27, 9]])
+    app.generate(prompt, np.ones_like(prompt), max_new_tokens=4)
+    # an unwarmed multi-token TKG shape (q_len=3 was never compiled) must
+    # refuse to silently recompile
+    runner = app.token_generation_model
+    bad_inputs = runner.example_inputs(runner.buckets[-1], q_len=3)
+    with pytest.raises(RetraceError):
+        runner(app.params, app.kv_cache, bad_inputs, None)
+    # decode programs: a NEW (num_steps, bucket) key may still lazily build
+    # its first program while sealed...
+    last = np.array([[3], [4]], np.int32)
+    pos = np.array([[4], [4]], np.int32)
+    seq_ids = np.arange(2, dtype=np.int32)
+    sp = np.tile(np.array([1, 1.0, 1.0], np.float32), (2, 1))
+    _, _, cache2 = runner.decode_chunk(
+        app.params, app.kv_cache, last, pos, seq_ids, sp, None,
+        num_steps=2, bucket=runner.buckets[-1],
+    )
+    # ...but RE-tracing that same keyed program (here: rng None -> PRNGKey
+    # changes the arg pytree) is the steady-state recompile the seal forbids
+    import jax
+
+    with pytest.raises(RetraceError):
+        runner.decode_chunk(
+            app.params, cache2, last, pos, seq_ids, sp,
+            jax.random.PRNGKey(0), num_steps=2, bucket=runner.buckets[-1],
+        )
+
+
+def test_fused_spec_steady_state_zero_recompiles():
+    """The fused-speculation decode loop must reuse ONE compiled program
+    across rounds (each round: same bucket, same shapes)."""
+    from neuronx_distributed_inference_tpu.config import FusedSpecConfig
+    from neuronx_distributed_inference_tpu.runtime.fused_spec import (
+        TpuFusedSpecModelForCausalLM,
+    )
+
+    target_cfg = make_tiny_config()
+    target_sd = make_random_hf_state_dict(target_cfg, seed=0)
+    draft_cfg = make_tiny_config()
+    draft_sd = make_random_hf_state_dict(draft_cfg, seed=7)
+    spec_cfg = make_tiny_config()
+    spec_cfg.tpu_config.speculation_length = 4
+    spec_cfg.tpu_config.enable_fused_speculation = True
+    spec_cfg.fused_spec_config = FusedSpecConfig(
+        draft_model_name="tiny-draft", draft_config=draft_cfg
+    )
+    app = TpuFusedSpecModelForCausalLM(None, spec_cfg)
+    app.load(target_state_dict=target_sd, draft_state_dict=draft_sd)
+
+    prompt = np.array([[5, 17, 92, 41, 33, 88, 2, 11], [64, 3, 27, 9, 14, 1, 7, 2]])
+    # first call compiles CTE + the TKG program(s) for the visited buckets
+    app.generate(prompt, np.ones_like(prompt), max_new_tokens=8)
+    app.seal()
+    with RetraceGuard() as g:
+        app.generate(prompt, np.ones_like(prompt), max_new_tokens=8)
+    assert g.traces == []
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def test_cli_main_clean_tree_exits_zero(capsys):
+    """The in-process CLI path over the fast suites (lint + flags): a clean
+    tree exits 0 and reports zero new findings."""
+    from neuronx_distributed_inference_tpu.analysis.__main__ import main
+
+    rc = main(["--suites", "lint,flags", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    import json
+
+    report = json.loads(out)
+    assert report["new"] == 0
+    assert report["total"] >= 1  # the pinned host-sync census is visible

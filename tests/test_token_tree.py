@@ -135,6 +135,7 @@ def _tree_app(tree_config, target_sd, k=4):
     return app
 
 
+@pytest.mark.slow
 def test_chain_tree_equals_chain_eagle_and_plain_greedy():
     """A chain-shaped tree must reproduce chain EAGLE (and plain greedy)
     bit-for-bit — the greedy-tree == greedy-chain invariant."""
@@ -194,6 +195,7 @@ def test_tree_config_validation():
     assert tc.on_device_sampling_config.do_sample
 
 
+@pytest.mark.slow
 def test_tree_acceptance_beats_chain():
     """Measured acceptance: with a draft correlated to the target (shared
     embed/lm-head/layer-0, pass-through fc), a branching tree finishes the
@@ -336,6 +338,7 @@ def test_sampled_tree_accept_marginal_matches_target():
     assert tv < 0.05, f"TV(emp, p_root) = {tv:.3f}; marginal deviates from target"
 
 
+@pytest.mark.slow
 def test_sampled_tree_topk1_equals_greedy_tree():
     """top_k=1 sampling collapses every distribution to the argmax: the
     sampled tree must emit exactly the greedy tree's tokens."""
@@ -363,6 +366,7 @@ def test_sampled_tree_topk1_equals_greedy_tree():
     np.testing.assert_array_equal(out.sequences, greedy_out.sequences)
 
 
+@pytest.mark.slow
 def test_sampled_tree_runs_and_differs_by_seed():
     """Sampled tree decoding with temperature produces valid, seed-varying,
     seed-reproducible output."""
@@ -457,6 +461,7 @@ def test_sampled_dynamic_walk_marginal_matches_target():
     assert tv < 0.05, f"TV(emp, p_root) = {tv:.3f}; marginal deviates from target"
 
 
+@pytest.mark.slow
 def test_sampled_dynamic_tree_topk1_equals_greedy():
     """top_k=1 collapses every distribution to its argmax: the sampled
     dynamic tree must emit exactly the greedy dynamic tree's tokens."""
@@ -486,6 +491,7 @@ def test_sampled_dynamic_tree_topk1_equals_greedy():
     np.testing.assert_array_equal(out.sequences, greedy_out.sequences)
 
 
+@pytest.mark.slow
 def test_sampled_dynamic_tree_runs_and_reproduces():
     """Sampled dynamic-tree decoding with temperature: valid tokens,
     seed-reproducible, seed-varying."""

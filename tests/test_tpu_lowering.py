@@ -277,6 +277,7 @@ def _kernel_model(batch):
     return LlamaModelBuilder(cfg)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("B", [1, 4])
 def test_lower_model_cte_with_kernels(B):
     from neuronx_distributed_inference_tpu.models.base import (
@@ -312,6 +313,7 @@ def test_lower_model_cte_with_kernels(B):
         lower_tpu(fn, params, cache, inputs, None)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("B", [1, 4])
 def test_lower_model_tkg_with_kernels(B):
     from neuronx_distributed_inference_tpu.models.base import (
