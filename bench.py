@@ -13,6 +13,10 @@ Points (VERDICT r3 #1/#3, r4 #1/#2/#3):
   (chunked prefill + paged cache): aggregate decode tok/s + p50/p99 TTFT
 - llama-3.1-8B int8: bs=1 decode + TTFT (the closest single-chip proxy for the
   BASELINE.json 8B north star; int8 8B fits one 16G v5e chip)
+- llama-3.2-1B bf16 16k long-context (VERDICT r5 weak #5): 16384-token
+  prefill TTFT + decode at 16k context (~1 GB KV) — the budgeted, skippable
+  last point that validates the retuned + head-packed prefill tiles where
+  attention dominates
 
 vs_baseline anchors against the reference's Llama3.2-1B-class integration
 throughput gate (~1057 tok/s on 32 trainium cores,
@@ -377,6 +381,7 @@ def _suite_params(tiny):
         ce4, tkg4 = [16], [32]
         serving = dict(n_requests=3, prompt=12, gen=6, seq=64,
                        blocks=24, block_size=16, max_seqs=4, q_tile=16)
+        lc = dict(prompt=48, gen=8, seq=64, ce=[48], tkg=[64])
     else:
         attrs_1b, attrs_8b = LLAMA_1B, LLAMA_8B
         prompt, gen, long_prompt = 128, 256, 512
@@ -384,6 +389,11 @@ def _suite_params(tiny):
         ce4, tkg4 = [128], [512]
         serving = dict(n_requests=8, prompt=128, gen=128, seq=1024,
                        blocks=512, block_size=32, max_seqs=8)
+        # 16k long-context point (VERDICT r5 weak #5): 1B shape, ~1 GB KV
+        # ((B+1)=2 cache rows x 16448 x 8 kv heads x 64 x k+v x 16 layers
+        # x bf16) — validates the retuned + head-packed prefill tiles at the
+        # length where attention dominates
+        lc = dict(prompt=16384, gen=32, seq=16448, ce=[16384], tkg=[16448])
     return {
         # ORDER = budget priority: the headline first (its number is the
         # contract), then cheap points, the serving point, and the expensive
@@ -414,6 +424,14 @@ def _suite_params(tiny):
             attrs=attrs_8b, batch=1, seq=seq, ce=ce[:1], tkg=tkg[:1],
             prompt=prompt, gen=gen, long_prompt=None, quantized=True,
             cache_key="int8_8b" if not tiny else None,
+        ),
+        # LAST in budget priority: the expensive long-context point is the
+        # first casualty of a tight BENCH_BUDGET_S (skippable by design)
+        "bf16_1b_16k": dict(
+            attrs=attrs_1b, batch=1, seq=lc["seq"], ce=lc["ce"],
+            tkg=lc["tkg"], prompt=lc["prompt"], gen=lc["gen"],
+            long_prompt=None, quantized=False,
+            cache_key="bf16_1b" if not tiny else None,
         ),
     }
 
@@ -475,6 +493,9 @@ def summary_line(points):
         "serving_ttft_p99_ms": g("serving_1b_int8", "ttft_p99_ms"),
         "int8_8b_tok_s": g("int8_8b_bs1", "decode_tok_s"),
         "int8_8b_ttft_ms": g("int8_8b_bs1", "ttft_ms"),
+        # 16k long-context row: TTFT ~= the 16k prefill wall time
+        "long_ctx_ttft_ms": g("bf16_1b_16k", "ttft_ms"),
+        "long_ctx_tok_s": g("bf16_1b_16k", "decode_tok_s"),
         "int8_8b_vs_8b_gate": (
             round(g("int8_8b_bs1", "decode_tok_s") / BASELINE_8B_GATE, 4)
             if g("int8_8b_bs1", "decode_tok_s")

@@ -323,6 +323,15 @@ class TpuConfig:
     flash_decoding_enabled: bool = False
     num_cores_per_group: int = 1
     attn_kernel_enabled: Optional[bool] = None  # None = auto (pallas flash attn on TPU)
+    # head-pair packed flash prefill (ops/flash_attention.py packed path):
+    # D<=64 models run attention with two heads per 128-lane tile at full
+    # MXU contraction depth. None = auto-on for causal D<=64 shapes
+    # whenever the flash kernel runs, True = force (still honors shape
+    # guards), False = keep the unpacked kernel. The packed softmax
+    # intermediates follow attention_softmax_fp32: the default (True) keeps
+    # fp32 exp/PV like the unpacked kernel; set it False to add the bf16
+    # VPU/MXU win on top of the packing.
+    attn_packed_kernel_enabled: Optional[bool] = None
     # decode (TKG) attention kernel, contiguous + paged (ops/decode_attention.py):
     # None = auto on TPU, True = force, False = native gather path.
     # NOTE: artifacts saved before this feature landed serialized the then-

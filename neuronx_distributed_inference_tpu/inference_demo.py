@@ -103,6 +103,10 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--attn-block-tkg-kernel-enabled", default=None,
                      type=lambda s: s.lower() in ("1", "true", "yes"),
                      help="decode (TKG) attention kernel: true/false (default: auto)")
+    run.add_argument("--attn-packed-kernel-enabled", default=None,
+                     type=lambda s: s.lower() in ("1", "true", "yes"),
+                     help="head-pair packed flash prefill for head_dim<=64: "
+                          "true/false (default: auto-on on the flash path)")
 
     # bucketing
     onoff("enable-bucketing", True)
@@ -314,6 +318,7 @@ def create_tpu_config(args) -> TpuConfig:
         sliding_window=args.sliding_window,
         attention_chunk_size=args.attention_chunk_size,
         attn_kernel_enabled=args.attn_kernel_enabled,
+        attn_packed_kernel_enabled=args.attn_packed_kernel_enabled,
         attn_block_tkg_kernel_enabled=args.attn_block_tkg_kernel_enabled,
         enable_bucketing=args.enable_bucketing,
         context_encoding_buckets=args.context_encoding_buckets,
@@ -399,9 +404,13 @@ def run_inference(args) -> int:
             "--enable-fused-speculation/--enable-eagle-speculation"
         )
     if args.assisted_decoding and args.do_sample:
+        # sampled assisted decoding exists (runtime.assisted requires BOTH
+        # apps loaded with do_sample on-device sampling + output_logits);
+        # the demo doesn't build the draft app that way, so keep the gate
         raise NotImplementedError(
-            "assisted decoding is greedy-only; sampled speculation runs "
-            "through --enable-fused-speculation (multinomial accept/reject)"
+            "assisted decoding is greedy-only in inference_demo; sampled "
+            "speculation runs through --enable-fused-speculation "
+            "(multinomial accept/reject) or runtime.assisted directly"
         )
     fused_spec = args.enable_fused_speculation or args.enable_eagle_speculation or (
         args.draft_model_path and args.speculation_length >= 2

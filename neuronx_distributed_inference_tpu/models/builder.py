@@ -89,6 +89,7 @@ class DecoderModelBuilder:
             has_sink=bool(getattr(self.config, "attention_sink", False)),
             rms_norm_eps=getattr(self.config, "rms_norm_eps", 1e-6),
             use_flash_kernel=tc.attn_kernel_enabled,
+            use_packed_heads=tc.attn_packed_kernel_enabled,
             use_tkg_kernel=tc.attn_block_tkg_kernel_enabled,
             use_fused_block=tc.fused_attn_block_kernel_enabled,
             qkv_shards=self.degree if tc.fused_qkv else 1,

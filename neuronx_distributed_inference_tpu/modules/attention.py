@@ -48,6 +48,10 @@ class AttnSpec:
     has_sink: bool = False
     rms_norm_eps: float = 1e-6
     use_flash_kernel: Optional[bool] = None  # None = auto by platform
+    # head-pair packed flash prefill (config attn_packed_kernel_enabled):
+    # D<=64 heads ride 128-lane tiles in pairs — None = auto-on for causal
+    # D<=64 shapes on the flash path, True = force, False = unpacked kernel
+    use_packed_heads: Optional[bool] = None
     # decode (TKG) attention kernel (config attn_block_tkg_kernel_enabled):
     # None = auto on TPU, True = force, False = native path
     use_tkg_kernel: Optional[bool] = None
@@ -181,8 +185,10 @@ def _masked_softmax_attention(
 
 def _flash_shape_ok(spec: AttnSpec, seq_len: int) -> bool:
     # q/k tiles are (128, D): seq must tile evenly; D must be a lane-aligned
-    # multiple of 64 (64 is padded to a full lane by Mosaic — slight waste,
-    # but it keeps head_dim-64 models like Llama-3.2-1B on the kernel)
+    # multiple of 64. D=64 models (Llama-3.2-1B class) normally ride the
+    # head-pair PACKED kernel (two heads fill the 128 lanes, _use_packed);
+    # with packing off they fall back to half-lane tiles — slight waste,
+    # but still kernel-eligible.
     return seq_len >= 128 and seq_len % 128 == 0 and spec.head_dim % 64 == 0
 
 
@@ -202,6 +208,31 @@ def _use_flash(spec: AttnSpec, seq_len: int) -> bool:
             )
         return ok
     return ok and spec.model_parallel == 1 and jax.default_backend() == "tpu"
+
+
+def _use_packed(spec: AttnSpec) -> bool:
+    """Head-pair packing decision, taken AFTER :func:`_use_flash` says yes
+    (seq-length eligibility is already settled there).
+
+    Auto-on for head_dim <= 64 (the packing exists exactly because D=64
+    half-fills the 128-wide MXU contraction; D=128 tiles are already full).
+    Needs >= 2 heads to pair (H odd pads inside the kernel wrapper, H=1
+    would only add waste). Tri-state ``use_packed_heads`` overrides like the
+    other kernel switches — force-enable still honors the shape guards."""
+    if spec.use_packed_heads is False:
+        return False
+    ok = spec.head_dim <= 64 and spec.num_heads >= 2
+    if spec.use_packed_heads and not ok:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "attn_packed_kernel_enabled=True but shape (heads=%d, "
+            "head_dim=%d) is unsupported by the packed kernel; using the "
+            "unpacked flash path",
+            spec.num_heads,
+            spec.head_dim,
+        )
+    return ok
 
 
 def attention_prefill(
@@ -231,6 +262,7 @@ def attention_prefill(
         return flash_attention(
             q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), key_valid, spec,
             window=window, chunk=chunk, sink=sink,
+            packed=_use_packed(spec),
         )
     return _masked_softmax_attention(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep), mask, spec, sink)
 

@@ -31,8 +31,11 @@ reproduces chain-EAGLE and plain greedy decoding bit-for-bit, the invariant
 the tests pin) or SAMPLED (children drawn i.i.d. from the warped draft
 distribution; recursive rejection sampling walks the tree —
 :func:`sampled_tree_accept` — with an exact target-marginal guarantee).
-Dynamic trees remain greedy-only (their expansion selects by cumulative
-argmax log-prob).
+Dynamic trees support both modes too (:func:`dynamic_tree_token_gen`):
+greedy expansion selects frontier nodes by cumulative log-prob; sampled
+mode draws each frontier node's children i.i.d. from its warped draft
+distribution and verifies by recursive rejection sampling over the
+in-graph connectivity with the same target-marginal guarantee.
 """
 
 from __future__ import annotations

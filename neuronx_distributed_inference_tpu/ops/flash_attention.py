@@ -19,6 +19,22 @@ per-row (m, l) softmax stats and the wrapper rescales the output by
 ``l / (l + exp(sink - m))`` — exactly the sink-in-denominator semantics
 (reference attention_base.py:879-889) with no extra kernel passes.
 
+Head-packed variant (``packed=True``, head_dim 64): pairs of heads are laid
+out side by side in one 128-lane tile — (B, H, S, 64) -> (B, H/2, S, 128) —
+so the Q·Kᵀ contraction runs at the MXU's full 128 depth instead of
+half-filling it. Cross-head partial products are suppressed by a
+BLOCK-DIAGONAL K/V layout (two independent 64-deep accumulations side by
+side in the 128-wide tile): K is stacked [[K₀|0], [0|K₁]] (2·bkv, 128), so
+Q_packed @ K_bdᵀ yields (bq, 2·bkv) = [S₀ | S₁] with zero cross terms.
+Online-softmax stats (m, l) stay per-head inside the tile; the softmax
+exp/rescale intermediates run in bf16 (VPU bf16 is 2x fp32 on v5e) while
+m/l/accumulator stay fp32. The PV product uses the same block-diagonal V:
+P (bq, 2·bkv) @ V_bd (2·bkv, 128) = [P₀V₀ | P₁V₁] — full contraction depth
+AND full 128-lane output width. Odd head counts pad with a duplicate of the
+last head (one wasted head-pair slot) and slice after. PERF.md round 6 has
+the arithmetic; the packing halves grid steps, fully packs every 128-lane
+register the VPU touches, and moves the PV matmul off the fp32 MXU path.
+
 Falls back to an XLA masked-softmax path off-TPU or for shapes the kernel
 doesn't support (the reference similarly keeps a native softmax path,
 attention_base.py:720-891).
@@ -42,6 +58,40 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 NEG_INF = -1e30
+
+
+def _tile_live(q_start, q_last, kv_start, bkv, *, causal, window, chunk):
+    """Dead-tile skip predicate shared by the packed and unpacked kernels:
+    True unless the (bq, bkv) tile lies entirely outside the mask — above
+    the causal diagonal, fully below the sliding window, or in a
+    non-overlapping attention chunk (the reference sliding-window kernel's
+    fully-masked-tile skip, sliding_window/attention.py:61-233)."""
+    run = jnp.bool_(True) if not causal else (kv_start <= q_last)
+    if window is not None:
+        # rows attend (row - window, row]: a tile is dead when its LAST kv
+        # column is <= the FIRST row - window
+        run = jnp.logical_and(run, kv_start + bkv - 1 > q_start - window)
+    if chunk is not None:
+        # same-chunk attention only: tile chunk ranges must overlap
+        run = jnp.logical_and(run, (kv_start // chunk) <= (q_last // chunk))
+        run = jnp.logical_and(run, ((kv_start + bkv - 1) // chunk) >= (q_start // chunk))
+    return run
+
+
+def _tile_mask(valid, q_start, kv_start, bq, bkv, *, causal, window, chunk):
+    """(bq, bkv) boolean mask for one tile — key validity fused with the
+    causal/window/chunk flavors. Shared by both kernels so the semantics
+    cannot drift between them."""
+    mask = jnp.broadcast_to(valid[None, :], (bq, bkv))
+    rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
+    cols = kv_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
+    if causal:
+        mask = mask & (cols <= rows)
+    if window is not None:
+        mask = mask & (cols > rows - window)
+    if chunk is not None:
+        mask = mask & ((cols // chunk) == (rows // chunk))
+    return mask
 
 
 def _flash_kernel(
@@ -76,18 +126,9 @@ def _flash_kernel(
     q_start = iq * bq
     kv_start = ik * bkv
     q_last = q_start + bq - 1
-
-    # skip tiles entirely outside the mask: above the causal diagonal,
-    # fully below the sliding window, or in a non-overlapping chunk
-    run = jnp.bool_(True) if not causal else (kv_start <= q_last)
-    if window is not None:
-        # rows attend (row - window, row]: a tile is dead when its LAST kv
-        # column is <= the FIRST row - window
-        run = jnp.logical_and(run, kv_start + bkv - 1 > q_start - window)
-    if chunk is not None:
-        # same-chunk attention only: tile chunk ranges must overlap
-        run = jnp.logical_and(run, (kv_start // chunk) <= (q_last // chunk))
-        run = jnp.logical_and(run, ((kv_start + bkv - 1) // chunk) >= (q_start // chunk))
+    run = _tile_live(
+        q_start, q_last, kv_start, bkv, causal=causal, window=window, chunk=chunk
+    )
 
     @pl.when(run)
     def _compute():
@@ -99,15 +140,10 @@ def _flash_kernel(
         s = s * scale  # (bq, bkv)
 
         valid = valid_ref[0, 0, :] > 0  # (bkv,)
-        mask = jnp.broadcast_to(valid[None, :], (bq, bkv))
-        rows = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 0)
-        cols = kv_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bkv), 1)
-        if causal:
-            mask = mask & (cols <= rows)
-        if window is not None:
-            mask = mask & (cols > rows - window)
-        if chunk is not None:
-            mask = mask & ((cols // chunk) == (rows // chunk))
+        mask = _tile_mask(
+            valid, q_start, kv_start, bq, bkv, causal=causal, window=window,
+            chunk=chunk,
+        )
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:]  # (bq, 1)
@@ -133,9 +169,223 @@ def _flash_kernel(
         l_ref[0, 0, :, :] = l_scr[:]
 
 
+def _flash_kernel_packed(
+    q_ref,  # (1, 1, bq, 2d) — [head 2p | head 2p+1] side by side
+    k_ref,  # (1, 1, bkv, 2d)
+    v_ref,  # (1, 1, bkv, 2d)
+    valid_ref,  # (1, 1, bkv) int32 key-validity
+    o_ref,  # (1, 1, bq, 2d)
+    m_ref,  # (1, 1, 2, bq, 1) f32 per-head row max
+    l_ref,  # (1, 1, 2, bq, 1) f32 per-head row denom
+    m0_scr,  # (bq, 1) f32 running max, even head
+    m1_scr,  # (bq, 1) f32 running max, odd head
+    l0_scr,  # (bq, 1) f32 running denom, even head
+    l1_scr,  # (bq, 1) f32 running denom, odd head
+    acc_scr,  # (bq, 2d) f32 packed accumulator
+    *,
+    scale: float,
+    bq: int,
+    bkv: int,
+    nkv: int,
+    causal: bool,
+    window: Optional[int],
+    chunk: Optional[int],
+    d: int,
+    softmax_bf16: bool,
+):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m0_scr[:] = jnp.full_like(m0_scr, NEG_INF)
+        m1_scr[:] = jnp.full_like(m1_scr, NEG_INF)
+        l0_scr[:] = jnp.zeros_like(l0_scr)
+        l1_scr[:] = jnp.zeros_like(l1_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q_start = iq * bq
+    kv_start = ik * bkv
+    q_last = q_start + bq - 1
+    # both packed heads see the same positions, so the shared skip
+    # predicate and mask builder apply unchanged
+    run = _tile_live(
+        q_start, q_last, kv_start, bkv, causal=causal, window=window, chunk=chunk
+    )
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0]  # (bq, 2d)
+        k = k_ref[0, 0]  # (bkv, 2d)
+        if not softmax_bf16:
+            # parity mode: fp32 operands reproduce the unpacked kernel's
+            # numerics (bf16 MXU inputs accumulate identically in f32, but
+            # the exp/PV below also stay f32)
+            q = q.astype(jnp.float32)
+            k = k.astype(jnp.float32)
+        # block-diagonal K (2bkv, 2d): row r keeps lanes of ITS head only —
+        # (r >= bkv) == (lane >= d). Zeros kill the cross-head partials, so
+        # one full-128-deep contraction emits both heads' score tiles.
+        k2 = jnp.concatenate([k, k], axis=0)
+        rhalf = jax.lax.broadcasted_iota(jnp.int32, (2 * bkv, 2 * d), 0) >= bkv
+        chalf = jax.lax.broadcasted_iota(jnp.int32, (2 * bkv, 2 * d), 1) >= d
+        bd = rhalf == chalf
+        k_bd = jnp.where(bd, k2, jnp.zeros_like(k2))
+        s = jax.lax.dot_general(
+            q, k_bd, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        s = s * scale  # (bq, 2bkv) = [S_even | S_odd]
+
+        valid = valid_ref[0, 0, :] > 0  # (bkv,)
+        mask = _tile_mask(
+            valid, q_start, kv_start, bq, bkv, causal=causal, window=window,
+            chunk=chunk,
+        )
+        mask2 = jnp.concatenate([mask, mask], axis=1)  # (bq, 2bkv)
+        s = jnp.where(mask2, s, NEG_INF)
+
+        s0 = s[:, :bkv]
+        s1 = s[:, bkv:]
+        m0 = jnp.maximum(m0_scr[:], jnp.max(s0, axis=1, keepdims=True))
+        m1 = jnp.maximum(m1_scr[:], jnp.max(s1, axis=1, keepdims=True))
+        # exp in bf16 (stats stay f32): the O(bq*bkv) VPU exp is the
+        # softmax floor at D=64 and bf16 doubles VPU throughput on v5e
+        pdt = jnp.bfloat16 if softmax_bf16 else jnp.float32
+        t = jnp.concatenate([s0 - m0, s1 - m1], axis=1)  # f32, <= 0
+        p = jnp.exp(t.astype(pdt))  # (bq, 2bkv)
+        p = jnp.where(mask2, p, jnp.zeros_like(p))
+        a0 = jnp.exp(m0_scr[:] - m0)  # (bq, 1) f32
+        a1 = jnp.exp(m1_scr[:] - m1)
+        l0_scr[:] = l0_scr[:] * a0 + jnp.sum(
+            p[:, :bkv].astype(jnp.float32), axis=1, keepdims=True
+        )
+        l1_scr[:] = l1_scr[:] * a1 + jnp.sum(
+            p[:, bkv:].astype(jnp.float32), axis=1, keepdims=True
+        )
+
+        v = v_ref[0, 0]
+        if not softmax_bf16:
+            v = v.astype(jnp.float32)
+        v2 = jnp.concatenate([v, v], axis=0)
+        v_bd = jnp.where(bd, v2, jnp.zeros_like(v2))
+        pv = jax.lax.dot_general(
+            p, v_bd.astype(p.dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bq, 2d) = [P0@V0 | P1@V1]
+        lane = jax.lax.broadcasted_iota(jnp.int32, (bq, 2 * d), 1)
+        alpha = jnp.where(
+            lane < d,
+            jnp.broadcast_to(a0, (bq, 2 * d)),
+            jnp.broadcast_to(a1, (bq, 2 * d)),
+        )
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m0_scr[:] = m0
+        m1_scr[:] = m1
+
+    @pl.when(ik == nkv - 1)
+    def _finalize():
+        d0 = jnp.maximum(l0_scr[:], 1e-30)
+        d1 = jnp.maximum(l1_scr[:], 1e-30)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (bq, 2 * d), 1)
+        denom = jnp.where(
+            lane < d,
+            jnp.broadcast_to(d0, (bq, 2 * d)),
+            jnp.broadcast_to(d1, (bq, 2 * d)),
+        )
+        o_ref[0, 0, :, :] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        m_ref[0, 0, 0, :, :] = m0_scr[:]
+        m_ref[0, 0, 1, :, :] = m1_scr[:]
+        l_ref[0, 0, 0, :, :] = l0_scr[:]
+        l_ref[0, 0, 1, :, :] = l1_scr[:]
+
+
+def _packed_flash_call(
+    q, k, v, key_valid, *, scale, causal, window, chunk, bq, bkv, interpret,
+    softmax_bf16,
+):
+    """Head-pair packed kernel launch: (B, H, S, 64) -> (B, ceil(H/2), S, 128)
+    pairs, block-diagonal contraction, per-head (m, l). Returns the UNPACKED
+    (out, m, l) triple with the same shapes as the plain kernel."""
+    B, H, S, D = q.shape
+    if D > 64:
+        raise ValueError(f"head packing needs head_dim <= 64, got {D}")
+    Hp = H + (H % 2)
+    if Hp != H:
+        # odd head count: pad with a duplicate of the last head (one wasted
+        # 64-lane half in the final pair) and slice it off after
+        q, k, v = (jnp.concatenate([x, x[:, -1:]], axis=1) for x in (q, k, v))
+    P = Hp // 2
+
+    def pack(x):
+        return (
+            x.reshape(B, P, 2, S, D)
+            .transpose(0, 1, 3, 2, 4)
+            .reshape(B, P, S, 2 * D)
+        )
+
+    nq = pl.cdiv(S, bq)
+    nkv = pl.cdiv(S, bkv)
+    kernel = functools.partial(
+        _flash_kernel_packed, scale=scale, bq=bq, bkv=bkv, nkv=nkv,
+        causal=causal, window=window, chunk=chunk, d=D,
+        softmax_bf16=softmax_bf16,
+    )
+    grid = (B, P, nq, nkv)
+    out, m, l = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, 2 * D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            pl.BlockSpec((1, 1, bkv, 2 * D), lambda b, h, iq, ik: (b, h, ik, 0)),
+            pl.BlockSpec((1, 1, bkv, 2 * D), lambda b, h, iq, ik: (b, h, ik, 0)),
+            # dummy middle axis — same Mosaic block-divisibility workaround
+            # as the unpacked kernel (see flash_attention_bhsd)
+            pl.BlockSpec((1, 1, bkv), lambda b, h, iq, ik: (b, 0, ik)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, 2 * D), lambda b, h, iq, ik: (b, h, iq, 0)),
+            # per-head stats ride a SIZE-2 head-half axis (not 2 lanes): the
+            # (bq, 1) trailing block keeps the layout the unpacked kernel
+            # already lowers
+            pl.BlockSpec((1, 1, 2, bq, 1), lambda b, h, iq, ik: (b, h, 0, iq, 0)),
+            pl.BlockSpec((1, 1, 2, bq, 1), lambda b, h, iq, ik: (b, h, 0, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, P, S, 2 * D), q.dtype),
+            jax.ShapeDtypeStruct((B, P, 2, S, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, P, 2, S, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 1), jnp.float32),
+            pltpu.VMEM((bq, 2 * D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(pack(q), pack(k), pack(v), key_valid[:, None, :])
+
+    out = (
+        out.reshape(B, P, S, 2, D)
+        .transpose(0, 1, 3, 2, 4)
+        .reshape(B, Hp, S, D)
+    )
+    m = m.reshape(B, Hp, S, 1)  # (B, P, 2, S, 1): (pair, half) == head order
+    l = l.reshape(B, Hp, S, 1)
+    if Hp != H:
+        out, m, l = out[:, :H], m[:, :H], l[:, :H]
+    return out, m, l
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "window", "chunk", "bq", "bkv", "interpret"),
+    static_argnames=(
+        "causal", "scale", "window", "chunk", "bq", "bkv", "interpret",
+        "packed", "softmax_bf16",
+    ),
 )
 def flash_attention_bhsd(
     q: jax.Array,  # (B, H, S, D)
@@ -150,6 +400,8 @@ def flash_attention_bhsd(
     bq: Optional[int] = None,
     bkv: Optional[int] = None,
     interpret: bool = False,
+    packed: bool = False,
+    softmax_bf16: Optional[bool] = None,
 ):
     """Returns (out (B,H,S,D), m (B,H,S,1), l (B,H,S,1)).
 
@@ -160,7 +412,17 @@ def flash_attention_bhsd(
     accumulator at D<=128). Windowed/chunked flavors keep 128x128: live
     kernel work scales as S*(window + bq), so a 512-row q tile would do up
     to (window+512)/(window+128) more masked-flavor work than the skip
-    granularity saves."""
+    granularity saves. The packed path keeps both rules — packing halves
+    the head-grid axis and doubles per-tile lanes without changing the
+    (bq, bkv) trade-off (PERF.md round 6).
+
+    ``packed``: head-pair packing for head_dim <= 64 (module docstring) —
+    all mask flavors supported. ``softmax_bf16`` (packed path only): run the
+    softmax exp/PV intermediates in bf16 with fp32 stats/accumulators;
+    default (None) = bf16 exactly when the inputs are bf16 — a KERNEL-LEVEL
+    default for direct callers (tile sweeps). The model path
+    (:func:`flash_attention`) always passes the ``attention_softmax_fp32``
+    config decision explicitly instead."""
     B, H, S, D = q.shape
     masked = window is not None or chunk is not None
     if bq is None:
@@ -169,6 +431,14 @@ def flash_attention_bhsd(
         bkv = 128 if masked else 512
     bq = min(bq, S)
     bkv = min(bkv, S)
+    if packed:
+        if softmax_bf16 is None:
+            softmax_bf16 = q.dtype == jnp.bfloat16
+        return _packed_flash_call(
+            q, k, v, key_valid.astype(jnp.int32), scale=scale, causal=causal,
+            window=window, chunk=chunk, bq=bq, bkv=bkv, interpret=interpret,
+            softmax_bf16=softmax_bf16,
+        )
     nq = pl.cdiv(S, bq)
     nkv = pl.cdiv(S, bkv)
 
@@ -216,12 +486,18 @@ def flash_attention_bhsd(
 def flash_attention(
     q, k, v, key_valid, spec, causal: bool = True,
     window: Optional[int] = None, chunk: Optional[int] = None, sink=None,
+    packed: bool = False,
 ):
     """Flash attention entry. q/k/v: (B, S, H, D) with H already GQA-repeated;
     key_valid: (B, S). ``window``/``chunk`` select the sliding-window /
     chunked-attention prefill masks; ``sink`` (Hq,) folds learned sink logits
-    into the softmax denominator via the emitted (m, l) stats. Returns
-    (B, S, H, D)."""
+    into the softmax denominator via the emitted (m, l) stats; ``packed``
+    selects the head-pair packed kernel (decided by the dispatch layer,
+    modules/attention._use_packed). The packed kernel's bf16 softmax
+    intermediates honor ``spec.softmax_fp32`` (config
+    attention_softmax_fp32, default True -> fp32 exp/PV exactly like the
+    unpacked kernel; set it False to opt into the bf16 VPU/MXU win).
+    Returns (B, S, H, D)."""
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
@@ -235,6 +511,8 @@ def flash_attention(
         window=window,
         chunk=chunk,
         interpret=kernel_interpret(),
+        packed=packed,
+        softmax_bf16=not spec.softmax_fp32 if packed else None,
     )
     if sink is not None:
         # softmax-with-sink = softmax * l / (l + exp(sink - m))

@@ -110,6 +110,10 @@ class TpuModelForCausalLM:
         self.spec = self.builder.model_spec()
         self.mesh = mesh if mesh is not None else mesh_from_config(tc)
         self.params = None
+        # weight provenance: True once load(random_weights=True) ran —
+        # compile() keys the presharded artifact on it so a --random-weights
+        # demo run can never poison the real checkpoint's artifact
+        self._random_weights = False
         self.kv_cache: Optional[KVCache] = None
         self._rng_key = jax.random.PRNGKey(tc.seed)
         self._call_key = self._rng_key
@@ -200,6 +204,7 @@ class TpuModelForCausalLM:
                     save_quantized_checkpoint(params, tc.quantized_checkpoints_path, tc)
         self._pspecs = pspecs
         self.params = shard_pytree(params, pspecs, self.mesh)
+        self._random_weights = bool(random_weights)
         self.init_kv_cache()
         return self
 
@@ -332,11 +337,21 @@ class TpuModelForCausalLM:
                 save_presharded,
             )
 
+            # weight provenance keys the artifact (ADVICE r5): a
+            # --random-weights run (params already loaded as random, or no
+            # model_path to load from) must never save/restore under the
+            # real checkpoint's fingerprint
+            random_prov = (
+                self._random_weights
+                if self.params is not None
+                else self.model_path is None
+            )
             fp = config_fingerprint(
                 self.config,
                 model_path=(
                     os.path.abspath(self.model_path) if self.model_path else None
                 ),
+                random_weights=random_prov,
             )
         if self.params is None and use_artifact and has_presharded(presharded_dir, fp):
             try:
@@ -355,12 +370,22 @@ class TpuModelForCausalLM:
                 restored = None
             if restored is not None:
                 self.params, self._pspecs = restored
+                # the artifact was keyed by random_prov above — keep the
+                # in-object provenance consistent so a second compile() on
+                # this app recomputes the SAME fingerprint
+                self._random_weights = random_prov
                 self.init_kv_cache()
         if self.params is None:
             self.load(random_weights=self.model_path is None, model_path=self.model_path)
-        if use_artifact and not has_presharded(presharded_dir, fp):
+        if (
+            use_artifact
+            and not has_presharded(presharded_dir, fp)
+            and not (random_prov and self.model_path)
+        ):
             # absent OR stale (recipe changed): (re)write so the next run
-            # restores instead of paying the cold load forever
+            # restores instead of paying the cold load forever. Random-init
+            # params over a REAL model_path never write: they would clobber
+            # (or stand in for) the real checkpoint's artifact for no gain
             save_presharded(self.params, self._pspecs, presharded_dir, fingerprint=fp)
         if not tc.skip_warmup:
             self.warmup()

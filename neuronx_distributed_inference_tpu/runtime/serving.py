@@ -574,7 +574,12 @@ class ServingSession:
         table = np.zeros((self.num_slots, mb), np.int32)
         for slot, pos, remaining in rows:
             try:
-                self.allocator.alloc_seq(slot, pos + max(0, min(chunk, remaining)))
+                # clamp to the row's COMMITTED end: drain passes advance
+                # `pos` in lockstep, so a finished row (remaining <= 0)
+                # arrives with pos past its last real token by -remaining —
+                # flooring the delta at 0 would allocate real blocks for its
+                # pure-garbage surplus positions (ADVICE r5)
+                self.allocator.alloc_seq(slot, pos + min(chunk, remaining))
             except RuntimeError:
                 return None
             table[slot] = self.allocator.block_table(slot, mb)
@@ -670,9 +675,11 @@ class ServingSession:
                 self._finish(r)
 
     def _decode_chunk_pass(self, chunk: int):
-        """One multi-step decode dispatch for all decoding requests
-        (contiguous cache only). The 1-ahead pending step is flushed first so
-        chunk inputs start from consistent host state."""
+        """One multi-step decode dispatch for all decoding requests — on the
+        contiguous AND the paged cache (paged chunks allocate per-row block
+        coverage via :meth:`_chunk_block_table` and fall back to the per-step
+        path when the pool is exhausted). The 1-ahead pending step is flushed
+        first so chunk inputs start from consistent host state."""
         if self._pending is not None:
             self._consume(self._pending, {})
             self._pending = None

@@ -176,9 +176,14 @@ class HuggingFaceGenerationAdapter:
             )
 
             if do_sample:
+                # sampled assisted decoding EXISTS (runtime.assisted with
+                # both apps loaded for do_sample on-device sampling +
+                # output_logits) but the adapter builds neither; keep the
+                # adapter path greedy
                 raise NotImplementedError(
-                    "assisted decoding is greedy-only; fused speculation "
-                    "supports multinomial sampling"
+                    "assisted decoding through the HF adapter is greedy-only; "
+                    "use runtime.assisted.assisted_generate with do_sample-"
+                    "loaded apps, or fused speculation, for sampling"
                 )
             out = assisted_generate(
                 self.app, assistant_model, run_ids, run_mask,

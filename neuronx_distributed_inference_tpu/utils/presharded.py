@@ -27,13 +27,21 @@ MANIFEST = "manifest.pkl"
 WEIGHTS = "weights"
 
 
-def config_fingerprint(config, model_path: Optional[str] = None) -> str:
+def config_fingerprint(
+    config, model_path: Optional[str] = None, random_weights: bool = False
+) -> str:
     """Stable identity of the weights an artifact holds: model shape + dtype
     + quantization recipe. A stale artifact (different model/recipe under
     the same compiled dir) must NOT silently override the requested config —
     the sibling quantized-checkpoint path validates its recipe the same way
     (ops/quant.has_quantized_checkpoint; reference recipe check,
-    application_base.py:636)."""
+    application_base.py:636).
+
+    ``random_weights`` records WEIGHT PROVENANCE (ADVICE r5): params that
+    were randomly initialized get a distinct fingerprint even under the same
+    ``model_path``, so a --random-weights --save-sharded-checkpoint run can
+    never poison the artifact a later real run restores. The field is only
+    added when True so existing real-weight artifacts stay valid."""
     tc = config.tpu_config
     fields = {
         "model_type": getattr(config, "model_type", None),
@@ -57,6 +65,8 @@ def config_fingerprint(config, model_path: Optional[str] = None) -> str:
         # serve each other's weights from a shared compiled dir
         "model_path": model_path,
     }
+    if random_weights:
+        fields["random_weights"] = True
     return repr(sorted(fields.items()))
 
 

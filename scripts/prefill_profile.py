@@ -114,9 +114,16 @@ def measure_cte(app, S, hf, n=5, profile_dir=None):
     return res
 
 
-def sweep_flash_blocks(S, D=64, H=32, dtype="bfloat16", n=10):
+def sweep_flash_blocks(S, D=64, H=32, dtype="bfloat16", n=10, packed=False,
+                       softmax_bf16=None):
     """Standalone flash-kernel timing across tile sizes at the 1B attention
-    shape — the actual tuning surface."""
+    shape — the actual tuning surface. ``packed`` sweeps the head-pair
+    packed kernel (round 6): the same (bq, bkv) grid at the new arithmetic
+    intensity — packing halves head-grid steps and doubles per-tile lanes,
+    so the winning tile must be re-measured, not assumed. ``softmax_bf16``
+    pins the packed softmax mode: sweep BOTH, because the shipping default
+    (attention_softmax_fp32=True) runs fp32 exp/PV and its winning tile can
+    differ from the bf16 mix."""
     import jax
     import jax.numpy as jnp
 
@@ -136,7 +143,7 @@ def sweep_flash_blocks(S, D=64, H=32, dtype="bfloat16", n=10):
             try:
                 out, _, _ = flash_attention_bhsd(
                     q, q, q, kv_valid, scale=D**-0.5, causal=True,
-                    bq=bq, bkv=bkv,
+                    bq=bq, bkv=bkv, packed=packed, softmax_bf16=softmax_bf16,
                 )
                 jax.device_get(out[0, 0, 0])
                 # burst: dispatch n, fetch once — a per-iteration fetch pays
@@ -145,7 +152,7 @@ def sweep_flash_blocks(S, D=64, H=32, dtype="bfloat16", n=10):
                 for _ in range(n):
                     out, _, _ = flash_attention_bhsd(
                         out, q, q, kv_valid, scale=D**-0.5, causal=True,
-                        bq=bq, bkv=bkv,
+                        bq=bq, bkv=bkv, packed=packed, softmax_bf16=softmax_bf16,
                     )
                 jax.device_get(out[0, 0, 0])
                 dt = (time.time() - t0) / n
@@ -181,7 +188,18 @@ def run(tiny=False, profile=False):
         out["cte"].append(measure_cte(app, S, hf, profile_dir=pdir))
     del app
     if not tiny:
+        # unpacked vs head-packed at every tile: the packed winner becomes
+        # the default, the unpacked column quantifies the packing win itself
         out["flash_sweep_8k"] = sweep_flash_blocks(8192)
+        # packed in BOTH softmax modes: fp32 is the shipping default
+        # (attention_softmax_fp32=True); bf16 is the opt-in fast mix — each
+        # gets its own winning tile
+        out["flash_sweep_8k_packed_fp32"] = sweep_flash_blocks(
+            8192, packed=True, softmax_bf16=False
+        )
+        out["flash_sweep_8k_packed_bf16"] = sweep_flash_blocks(
+            8192, packed=True, softmax_bf16=True
+        )
     return out
 
 

@@ -25,7 +25,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 ALL_POINTS = {
     "bf16_1b_bs1", "bf16_1b_bs4", "int8_1b_bs1", "serving_1b_int8",
-    "int8_8b_bs1",
+    "int8_8b_bs1", "bf16_1b_16k",
 }
 
 
@@ -53,6 +53,8 @@ def test_bench_suite_tiny(monkeypatch):
     final = bench.summary_line(points)
     assert final["value"] > 0 and final["vs_baseline"] > 0
     assert final["serving_tok_s"] > 0
+    # the 16k long-context row (tiny-scaled) reports prefill TTFT + decode
+    assert final["long_ctx_ttft_ms"] > 0 and final["long_ctx_tok_s"] > 0
     assert all(v == "ok" for v in final["points"].values())
 
 

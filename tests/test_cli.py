@@ -119,6 +119,71 @@ def test_cli_input_capture_and_profile(tiny_checkpoint, tmp_path):
     assert glob.glob(os.path.join(prof, "**", "*.xplane.pb"), recursive=True)
 
 
+def test_presharded_random_weights_cannot_poison_artifact(tiny_checkpoint, tmp_path):
+    """ADVICE r5 (medium): --random-weights --save-sharded-checkpoint with a
+    REAL model_path must not leave an artifact a later real run would
+    restore — weight provenance is part of the fingerprint and random-over-
+    real runs skip the save entirely."""
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+    from neuronx_distributed_inference_tpu.models.llama import LlamaInferenceConfig
+    from neuronx_distributed_inference_tpu.runtime.application import (
+        TpuModelForCausalLM,
+    )
+    from neuronx_distributed_inference_tpu.utils.presharded import (
+        artifact_ready,
+        config_fingerprint,
+    )
+
+    compiled = str(tmp_path / "compiled_rw")
+
+    from neuronx_distributed_inference_tpu.utils.hf_adapter import (
+        load_pretrained_config,
+    )
+
+    def make_app():
+        tc = TpuConfig(
+            batch_size=1, seq_len=64, dtype="float32",
+            save_sharded_checkpoint=True, skip_warmup=True,
+        )
+        cfg = LlamaInferenceConfig(
+            tc, load_config=load_pretrained_config(tiny_checkpoint)
+        )
+        return TpuModelForCausalLM(tiny_checkpoint, cfg)
+
+    # the poisoning run: random weights pre-loaded over a real model_path
+    app = make_app()
+    app.load(random_weights=True)
+    random_param = np.asarray(
+        jax_tree_leaf(app.params), np.float32
+    ).copy()
+    app.compile(compiled)
+    # no artifact a REAL run would accept may exist now
+    assert not artifact_ready(app.config, compiled, tiny_checkpoint)
+
+    # a later real run through the same compiled dir loads the checkpoint
+    app2 = make_app()
+    app2.compile(compiled)
+    real_param = np.asarray(jax_tree_leaf(app2.params), np.float32)
+    assert not np.array_equal(random_param, real_param), (
+        "real run restored random-init weights from the presharded artifact"
+    )
+    # and the real run's (re)written artifact IS keyed for real loads
+    assert artifact_ready(app2.config, compiled, tiny_checkpoint)
+    # provenance is part of the fingerprint: random vs real never collide
+    fp_real = config_fingerprint(app2.config, model_path=tiny_checkpoint)
+    fp_rand = config_fingerprint(
+        app2.config, model_path=tiny_checkpoint, random_weights=True
+    )
+    assert fp_real != fp_rand
+
+
+def jax_tree_leaf(tree):
+    """First array leaf of a param tree (stable order via tree flatten)."""
+    import jax
+
+    return jax.tree_util.tree_flatten(tree)[0][0]
+
+
 @pytest.mark.slow
 def test_cli_presharded_quantized_roundtrip(tiny_checkpoint, tmp_path, capsys):
     """--save-sharded-checkpoint + --quantized: the first run quantizes once

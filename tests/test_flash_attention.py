@@ -158,6 +158,197 @@ def test_flash_sink_folding():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
 
+# ---------------------------------------------------------------------------
+# head-packed kernel (pairs of D<=64 heads per 128-lane tile, ISSUE 2)
+# ---------------------------------------------------------------------------
+
+
+def test_packed_matches_unpacked_bit_parity():
+    """fp32 packed path vs the unpacked kernel: the block-diagonal zeros
+    contribute exact +0.0 terms, so the ONLY admissible difference is f32
+    reassociation inside the dot (XLA blocks the (bq,128)x(128,2bkv)
+    contraction differently) — pin (out, m, l) to ~1 ulp across ragged
+    batches."""
+    rng = np.random.RandomState(5)
+    B, H, S, D = 2, 4, 256, 64
+    q = rng.randn(B, H, S, D).astype(np.float32) * 0.3
+    k = rng.randn(B, H, S, D).astype(np.float32) * 0.3
+    v = rng.randn(B, H, S, D).astype(np.float32) * 0.3
+    key_valid = np.zeros((B, S), np.int32)
+    key_valid[0, :256] = 1
+    key_valid[1, :130] = 1
+    args = (jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(key_valid))
+    kw = dict(scale=D**-0.5, causal=True, interpret=True)
+    un = flash_attention_bhsd(*args, **kw)
+    pk = flash_attention_bhsd(*args, packed=True, **kw)
+    for b, n in ((0, 256), (1, 130)):
+        for u, p in zip(un, pk):
+            np.testing.assert_allclose(
+                np.asarray(u)[b, :, :n], np.asarray(p)[b, :, :n],
+                atol=1e-6, rtol=1e-6,
+            )
+
+
+def test_packed_odd_head_count():
+    """H=7: three pairs + one padded pair; the duplicate pad head must be
+    sliced off and every real head must match the native reference."""
+    rng = np.random.RandomState(6)
+    B, H, S, D = 2, 7, 256, 64
+    q = rng.randn(B, H, S, D).astype(np.float32) * 0.3
+    k = rng.randn(B, H, S, D).astype(np.float32) * 0.3
+    v = rng.randn(B, H, S, D).astype(np.float32) * 0.3
+    key_valid = np.zeros((B, S), np.int32)
+    key_valid[0, :200] = 1
+    key_valid[1, :77] = 1
+    out, m, l = flash_attention_bhsd(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(key_valid),
+        scale=D**-0.5, causal=True, interpret=True, packed=True,
+    )
+    assert out.shape == (B, H, S, D) and m.shape == (B, H, S, 1)
+    ref = _ref(q, k, v, key_valid, D**-0.5)
+    for b in range(B):
+        n = key_valid[b].sum()
+        np.testing.assert_allclose(
+            np.asarray(out)[b, :, :n], ref[b, :, :n], atol=2e-5, rtol=2e-5
+        )
+
+
+def test_packed_mask_flavors():
+    """Windowed and chunked prefill flavors gain the packing (same fused
+    masks + dead-tile skip) — parity vs the native masked softmax."""
+    rng = np.random.RandomState(7)
+    B, H, S, D = 1, 6, 256, 64
+    q = rng.randn(B, H, S, D).astype(np.float32) * 0.3
+    k = rng.randn(B, H, S, D).astype(np.float32) * 0.3
+    v = rng.randn(B, H, S, D).astype(np.float32) * 0.3
+    key_valid = np.ones((B, S), np.int32)
+    key_valid[0, 200:] = 0
+    scale = D**-0.5
+    rows = np.arange(S)[:, None]
+    cols = np.arange(S)[None, :]
+    for kw, extra in [
+        ({"window": 64}, cols > rows - 64),
+        ({"chunk": 64}, (cols // 64) == (rows // 64)),
+    ]:
+        out, _m, _l = flash_attention_bhsd(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(key_valid),
+            scale=scale, causal=True, interpret=True, packed=True, **kw,
+        )
+        spec = AttnSpec(num_heads=H, num_kv_heads=H, head_dim=D, scale=scale)
+        mask = (np.tril(np.ones((S, S), bool)) & extra)[None, None] & (
+            key_valid[:, None, None, :] > 0
+        )
+        ref = _masked_softmax_attention(
+            jnp.asarray(np.swapaxes(q, 1, 2)), jnp.asarray(np.swapaxes(k, 1, 2)),
+            jnp.asarray(np.swapaxes(v, 1, 2)), jnp.asarray(mask), spec,
+        )
+        ref = np.swapaxes(np.asarray(ref), 1, 2)
+        np.testing.assert_allclose(
+            np.asarray(out)[0, :, :200], ref[0, :, :200], atol=2e-5, rtol=2e-5
+        )
+
+
+def test_packed_bf16_softmax_intermediates():
+    """bf16 inputs auto-select bf16 exp/PV intermediates (fp32 stats and
+    accumulators): parity vs the fp32 native path within bf16 tolerance."""
+    rng = np.random.RandomState(8)
+    B, H, S, D = 1, 4, 256, 64
+    q = (rng.randn(B, H, S, D) * 0.3).astype(np.float32)
+    k = (rng.randn(B, H, S, D) * 0.3).astype(np.float32)
+    v = (rng.randn(B, H, S, D) * 0.3).astype(np.float32)
+    valid = np.ones((B, S), np.int32)
+    out, _m, _l = flash_attention_bhsd(
+        jnp.asarray(q, jnp.bfloat16), jnp.asarray(k, jnp.bfloat16),
+        jnp.asarray(v, jnp.bfloat16), jnp.asarray(valid),
+        scale=D**-0.5, causal=True, interpret=True, packed=True,
+    )
+    ref = _ref(q, k, v, valid, D**-0.5)
+    np.testing.assert_allclose(np.asarray(out, np.float32), ref, atol=2e-2, rtol=2e-2)
+
+
+def test_packed_sink_folding():
+    """Sink folding consumes the packed kernel's per-head (m, l) stats."""
+    from neuronx_distributed_inference_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(9)
+    B, S, H, D = 1, 128, 4, 64
+    q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.3)
+    k = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.3)
+    v = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.3)
+    sink = jnp.asarray(rng.randn(H).astype(np.float32))
+    key_valid = np.ones((B, S), np.int32)
+    spec = AttnSpec(num_heads=H, num_kv_heads=H, head_dim=D, has_sink=True)
+    out = flash_attention(q, k, v, jnp.asarray(key_valid), spec, sink=sink, packed=True)
+    mask = np.tril(np.ones((S, S), bool))[None, None]
+    ref = _masked_softmax_attention(q, k, v, jnp.asarray(mask), spec, sink=sink)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+
+def test_packed_honors_attention_softmax_fp32():
+    """The MODEL path must not silently downgrade softmax precision: with
+    the default spec (softmax_fp32=True) the packed kernel on bf16 inputs
+    keeps fp32 exp/PV — byte-equal to the unpacked kernel — and only
+    softmax_fp32=False opts into bf16 intermediates."""
+    from neuronx_distributed_inference_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.RandomState(11)
+    B, S, H, D = 1, 128, 4, 64
+    q = jnp.asarray((rng.randn(B, S, H, D) * 0.3), jnp.bfloat16)
+    k = jnp.asarray((rng.randn(B, S, H, D) * 0.3), jnp.bfloat16)
+    v = jnp.asarray((rng.randn(B, S, H, D) * 0.3), jnp.bfloat16)
+    key_valid = jnp.asarray(np.ones((B, S), np.int32))
+
+    spec_fp32 = AttnSpec(num_heads=H, num_kv_heads=H, head_dim=D)
+    packed = flash_attention(q, k, v, key_valid, spec_fp32, packed=True)
+    unpacked = flash_attention(q, k, v, key_valid, spec_fp32, packed=False)
+    np.testing.assert_array_equal(
+        np.asarray(packed, np.float32), np.asarray(unpacked, np.float32)
+    )
+
+    # opting out of fp32 softmax engages bf16 intermediates: close, not equal
+    spec_bf16 = AttnSpec(num_heads=H, num_kv_heads=H, head_dim=D, softmax_fp32=False)
+    packed_bf = flash_attention(q, k, v, key_valid, spec_bf16, packed=True)
+    np.testing.assert_allclose(
+        np.asarray(packed_bf, np.float32), np.asarray(unpacked, np.float32),
+        atol=2e-2, rtol=2e-2,
+    )
+
+
+def test_packed_gate():
+    """_use_packed: auto-on for D<=64 with >=2 heads; D=128 stays unpacked
+    (its tiles already fill the MXU); tri-state override honors shape
+    guards like the other kernel switches."""
+    from neuronx_distributed_inference_tpu.modules.attention import _use_packed
+
+    d64 = AttnSpec(num_heads=4, num_kv_heads=4, head_dim=64)
+    assert _use_packed(d64)
+    d128 = AttnSpec(num_heads=4, num_kv_heads=4, head_dim=128)
+    assert not _use_packed(d128)
+    forced_bad = AttnSpec(
+        num_heads=4, num_kv_heads=4, head_dim=128, use_packed_heads=True
+    )
+    assert not _use_packed(forced_bad)  # force still honors shape guard
+    single_head = AttnSpec(num_heads=1, num_kv_heads=1, head_dim=64)
+    assert not _use_packed(single_head)  # nothing to pair
+    off = AttnSpec(num_heads=4, num_kv_heads=4, head_dim=64, use_packed_heads=False)
+    assert not _use_packed(off)
+
+
+def test_packed_rejects_wide_heads():
+    """The kernel wrapper itself refuses head_dim > 64 (the gate should
+    never let it through, but a direct caller must get a clear error)."""
+    import pytest
+
+    rng = np.random.RandomState(10)
+    q = jnp.asarray(rng.randn(1, 2, 128, 128).astype(np.float32))
+    valid = jnp.asarray(np.ones((1, 128), np.int32))
+    with pytest.raises(ValueError, match="head_dim"):
+        flash_attention_bhsd(
+            q, q, q, valid, scale=128**-0.5, causal=True, interpret=True,
+            packed=True,
+        )
+
+
 def test_windowed_prefill_takes_kernel_path():
     """Mistral-style windowed CTE and GPT-OSS interleaved CTE route through
     the flash kernel (asserted via tap on the kernel entry), with tokens

@@ -436,3 +436,48 @@ def test_paged_chunked_drain_matches_per_step():
     out = s3.run_to_completion(decode_chunk_size=8)
     assert out["r1"] == golden["r1"][: stop + 1]
     assert out["r2"] == golden["r2"]
+
+
+def test_chunk_block_table_no_alloc_for_finished_rows():
+    """ADVICE r5 (low): a drain chunk must not allocate real blocks for the
+    pure-garbage surplus positions of rows that already finished — the
+    allocation target is clamped to each row's committed end, so finished
+    rows ride the reserved garbage block and the pool stays flat."""
+    from types import SimpleNamespace
+
+    from neuronx_distributed_inference_tpu.modules.block_kvcache import (
+        BlockAllocator,
+    )
+
+    bs = 16
+    alloc = BlockAllocator(num_blocks=32, block_size=bs)
+    stub = SimpleNamespace(allocator=alloc, num_slots=4)
+    table_fn = ServingSession._chunk_block_table
+
+    # two live rows at pos 32, one row that finished 24 steps ago (its
+    # lockstep pos has advanced to 56 but its committed end is 56-24=32)
+    alloc.alloc_seq(0, 32)
+    alloc.alloc_seq(1, 32)
+    alloc.alloc_seq(2, 32)
+    free_before = len(alloc.free)
+    blocks_finished_before = len(alloc.seq_blocks[2])
+
+    chunk = 16
+    rows = [(0, 32, 100), (1, 32, 8), (2, 56, -24)]
+    table = table_fn(stub, rows, chunk, bucket=128)
+    assert table is not None
+
+    # live rows got exactly the blocks their NEEDED positions cover
+    assert len(alloc.seq_blocks[0]) == -(-(32 + chunk) // bs)  # full chunk
+    assert len(alloc.seq_blocks[1]) == -(-(32 + 8) // bs)  # remaining < chunk
+    # the finished row allocated NOTHING
+    assert len(alloc.seq_blocks[2]) == blocks_finished_before
+    used = (
+        len(alloc.seq_blocks[0]) + len(alloc.seq_blocks[1])
+        + len(alloc.seq_blocks[2])
+    )
+    assert len(alloc.free) == free_before - (used - 3 * blocks_finished_before)
+
+    # its surplus positions resolve to table-zero entries (garbage block 0)
+    committed_blocks = -(-32 // bs)
+    assert (table[2][committed_blocks:] == 0).all()

@@ -84,6 +84,63 @@ def test_lower_flash_attention_long_seq():
 
 
 # ---------------------------------------------------------------------------
+# head-packed flash attention (D<=64 pairs per 128-lane tile, ISSUE 2)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("B", [1, 4])
+@pytest.mark.parametrize("S", [512, 8192])
+def test_lower_packed_flash(B, S):
+    """Packed kernel lowers for the TPU target at the prefill-profile shapes
+    (S=512 short bucket, S=8192 long-context)."""
+    H, D = 8, 64
+    q = sds((B, H, S, D), jnp.bfloat16)
+    kv = sds((B, S), jnp.int32)
+    fn = functools.partial(
+        flash_attention_bhsd, scale=D**-0.5, causal=True, interpret=False,
+        packed=True,
+    )
+    lower_tpu(fn, q, q, q, kv)
+
+
+def test_lower_packed_flash_odd_heads():
+    # H=7: the pad-and-slice wrapper path must also survive Mosaic lowering
+    B, H, S, D = 2, 7, 512, 64
+    q = sds((B, H, S, D), jnp.bfloat16)
+    kv = sds((B, S), jnp.int32)
+    fn = functools.partial(
+        flash_attention_bhsd, scale=D**-0.5, causal=True, interpret=False,
+        packed=True,
+    )
+    lower_tpu(fn, q, q, q, kv)
+
+
+@pytest.mark.parametrize("window,chunk", [(256, None), (None, 256)])
+def test_lower_packed_flash_masked_flavors(window, chunk):
+    B, H, S, D = 4, 8, 1024, 64
+    q = sds((B, H, S, D), jnp.bfloat16)
+    kv = sds((B, S), jnp.int32)
+    fn = functools.partial(
+        flash_attention_bhsd, scale=D**-0.5, causal=True, window=window,
+        chunk=chunk, interpret=False, packed=True,
+    )
+    lower_tpu(fn, q, q, q, kv)
+
+
+def test_lower_packed_flash_bench_shape_8k():
+    # the 1B bench attention shape (H=32 post-repeat, D=64) at 8k — the
+    # exact shape the PERF.md round-6 MFU claim is about
+    B, H, S, D = 1, 32, 8192, 64
+    q = sds((B, H, S, D), jnp.bfloat16)
+    kv = sds((B, S), jnp.int32)
+    fn = functools.partial(
+        flash_attention_bhsd, scale=D**-0.5, causal=True, interpret=False,
+        packed=True,
+    )
+    lower_tpu(fn, q, q, q, kv)
+
+
+# ---------------------------------------------------------------------------
 # TKG decode kernels (contiguous + paged)
 # ---------------------------------------------------------------------------
 
