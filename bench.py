@@ -259,8 +259,12 @@ def build_app(
 
 def measure_point(app, *, batch, prompt_len, gen_len, long_prompt=None):
     """Warmup-compile then measure TTFT / decode throughput (+ optional
-    long-prompt prefill throughput). Returns a dict of metrics."""
+    long-prompt prefill throughput). Returns a dict of metrics including
+    ``kv_bytes``, the cache's true HBM cost (codes + scales for quantized
+    caches) — the quantity the kv-quant rows halve."""
     import numpy as np
+
+    from neuronx_distributed_inference_tpu.modules.kvcache import cache_nbytes
 
     rng = np.random.RandomState(0)
     vocab = app.config.vocab_size - 10
@@ -286,6 +290,7 @@ def measure_point(app, *, batch, prompt_len, gen_len, long_prompt=None):
         "ttft_ms": round(ttft_ms, 1),
         "decode_tok_s": round(decode_tok_s, 2),
         "compile_s": round(compile_s, 1),
+        "kv_bytes": cache_nbytes(app.kv_cache),
     }
     if long_prompt:
         ids_l = rng.randint(0, vocab, size=(batch, long_prompt))
@@ -382,6 +387,7 @@ def _suite_params(tiny):
         serving = dict(n_requests=3, prompt=12, gen=6, seq=64,
                        blocks=24, block_size=16, max_seqs=4, q_tile=16)
         lc = dict(prompt=48, gen=8, seq=64, ce=[48], tkg=[64])
+        mc = dict(prompt=32, gen=8, seq=64, ce=[32], tkg=[64])
     else:
         attrs_1b, attrs_8b = LLAMA_1B, LLAMA_8B
         prompt, gen, long_prompt = 128, 256, 512
@@ -390,10 +396,16 @@ def _suite_params(tiny):
         serving = dict(n_requests=8, prompt=128, gen=128, seq=1024,
                        blocks=512, block_size=32, max_seqs=8)
         # 16k long-context point (VERDICT r5 weak #5): 1B shape, ~1 GB KV
-        # ((B+1)=2 cache rows x 16448 x 8 kv heads x 64 x k+v x 16 layers
+        # ((B+1)=2 cache rows x 16896 x 8 kv heads x 64 x k+v x 16 layers
         # x bf16) — validates the retuned + head-packed prefill tiles at the
-        # length where attention dominates
-        lc = dict(prompt=16384, gen=32, seq=16448, ce=[16384], tkg=[16448])
+        # length where attention dominates. The 8k point pairs with it so the
+        # bf16 vs *_kvq8 rows isolate the KV DMA term at both depths.
+        # TKG buckets are 512-ALIGNED (8704 = 17*512, 16896 = 33*512) so the
+        # TKG decode kernel is shape-eligible (use_tkg_kernel requires
+        # kv_width % 512 == 0 — the old 16448 bucket silently pinned the
+        # native gather path for long-context decode).
+        lc = dict(prompt=16384, gen=32, seq=16896, ce=[16384], tkg=[16896])
+        mc = dict(prompt=8192, gen=32, seq=8704, ce=[8192], tkg=[8704])
     return {
         # ORDER = budget priority: the headline first (its number is the
         # contract), then cheap points, the serving point, and the expensive
@@ -425,12 +437,34 @@ def _suite_params(tiny):
             prompt=prompt, gen=gen, long_prompt=None, quantized=True,
             cache_key="int8_8b" if not tiny else None,
         ),
-        # LAST in budget priority: the expensive long-context point is the
-        # first casualty of a tight BENCH_BUDGET_S (skippable by design)
+        # LAST in budget priority: the expensive long-context points are the
+        # first casualties of a tight BENCH_BUDGET_S (skippable by design).
+        # The 8k/16k bf16 vs *_kvq8 pairs report kv_bytes + decode tok/s so
+        # the KV-quant bandwidth win is measured where KV DMA dominates.
+        "bf16_1b_8k": dict(
+            attrs=attrs_1b, batch=1, seq=mc["seq"], ce=mc["ce"],
+            tkg=mc["tkg"], prompt=mc["prompt"], gen=mc["gen"],
+            long_prompt=None, quantized=False,
+            cache_key="bf16_1b" if not tiny else None,
+        ),
+        "bf16_1b_8k_kvq8": dict(
+            attrs=attrs_1b, batch=1, seq=mc["seq"], ce=mc["ce"],
+            tkg=mc["tkg"], prompt=mc["prompt"], gen=mc["gen"],
+            long_prompt=None, quantized=False,
+            extra_tpu=dict(kv_cache_dtype="int8"),
+            cache_key="bf16_1b" if not tiny else None,
+        ),
         "bf16_1b_16k": dict(
             attrs=attrs_1b, batch=1, seq=lc["seq"], ce=lc["ce"],
             tkg=lc["tkg"], prompt=lc["prompt"], gen=lc["gen"],
             long_prompt=None, quantized=False,
+            cache_key="bf16_1b" if not tiny else None,
+        ),
+        "bf16_1b_16k_kvq8": dict(
+            attrs=attrs_1b, batch=1, seq=lc["seq"], ce=lc["ce"],
+            tkg=lc["tkg"], prompt=lc["prompt"], gen=lc["gen"],
+            long_prompt=None, quantized=False,
+            extra_tpu=dict(kv_cache_dtype="int8"),
             cache_key="bf16_1b" if not tiny else None,
         ),
     }
@@ -458,7 +492,7 @@ def run_point(name, tiny=False):
         app = build_app(
             p["attrs"], batch=p["batch"], seq_len=p["seq"], ce_buckets=p["ce"],
             tkg_buckets=p["tkg"], quantized=p["quantized"],
-            cache_key=p.get("cache_key"),
+            cache_key=p.get("cache_key"), extra_tpu=p.get("extra_tpu"),
         )
         res = measure_point(
             app, batch=p["batch"], prompt_len=p["prompt"], gen_len=p["gen"],
@@ -496,6 +530,16 @@ def summary_line(points):
         # 16k long-context row: TTFT ~= the 16k prefill wall time
         "long_ctx_ttft_ms": g("bf16_1b_16k", "ttft_ms"),
         "long_ctx_tok_s": g("bf16_1b_16k", "decode_tok_s"),
+        # 8k/16k bf16 vs kv-int8 pairs: decode tok/s + true cache bytes
+        # (codes + scales) — the *_kvq8 rows must show kv_bytes ~halved
+        "ctx8k_tok_s": g("bf16_1b_8k", "decode_tok_s"),
+        "ctx8k_kv_bytes": g("bf16_1b_8k", "kv_bytes"),
+        "kvq8_8k_tok_s": g("bf16_1b_8k_kvq8", "decode_tok_s"),
+        "kvq8_8k_kv_bytes": g("bf16_1b_8k_kvq8", "kv_bytes"),
+        "long_ctx_kv_bytes": g("bf16_1b_16k", "kv_bytes"),
+        "kvq8_16k_tok_s": g("bf16_1b_16k_kvq8", "decode_tok_s"),
+        "kvq8_16k_ttft_ms": g("bf16_1b_16k_kvq8", "ttft_ms"),
+        "kvq8_16k_kv_bytes": g("bf16_1b_16k_kvq8", "kv_bytes"),
         "int8_8b_vs_8b_gate": (
             round(g("int8_8b_bs1", "decode_tok_s") / BASELINE_8B_GATE, 4)
             if g("int8_8b_bs1", "decode_tok_s")

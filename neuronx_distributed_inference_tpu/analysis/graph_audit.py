@@ -53,7 +53,10 @@ COLLECTIVE_OPS = (
 
 # Files allowed to upcast bf16 -> f32 inside the decode scan: numerically
 # deliberate (fp32 softmax/norm/rope/sampling), mirrored by config flags
-# (attention_softmax_fp32) or reference parity.
+# (attention_softmax_fp32) or reference parity. kvcache/block_kvcache are the
+# int8/fp8 cache write path: the running-absmax + quantize math runs in f32
+# by design (the CACHE itself stays in codes — GRAPH203 would catch a
+# dequantized-cache materialization coming from any other file).
 F32_UPCAST_ALLOWLIST = (
     "norm.py",
     "attention.py",
@@ -62,13 +65,25 @@ F32_UPCAST_ALLOWLIST = (
     "decode_attention.py",
     "masks.py",
     "quant.py",
+    "kvcache.py",
+    "block_kvcache.py",
 )
 
 TAG_CONTEXT_ENCODING = "context_encoding"
 TAG_TOKEN_GENERATION = "token_generation"
 TAG_FUSED_SPECULATION = "fused_speculation"
+# the same CTE/TKG programs compiled with kv_cache_dtype="int8" — the
+# quantized-cache program set gets its own census/skeleton/dtype contract
+TAG_CONTEXT_ENCODING_KVQ8 = "context_encoding_kvq8"
+TAG_TOKEN_GENERATION_KVQ8 = "token_generation_kvq8"
 
-AUDIT_TAGS = (TAG_CONTEXT_ENCODING, TAG_TOKEN_GENERATION, TAG_FUSED_SPECULATION)
+AUDIT_TAGS = (
+    TAG_CONTEXT_ENCODING,
+    TAG_TOKEN_GENERATION,
+    TAG_FUSED_SPECULATION,
+    TAG_CONTEXT_ENCODING_KVQ8,
+    TAG_TOKEN_GENERATION_KVQ8,
+)
 
 
 # ---------------------------------------------------------------------------
@@ -178,8 +193,11 @@ def _donation_count(lowered_text: str) -> int:
 # ---------------------------------------------------------------------------
 
 
-def _audit_causal_lm():
+def _audit_causal_lm(kv_quant: bool = False):
     """Trace/lower/compile the CTE and TKG programs across buckets.
+
+    ``kv_quant``: compile the same programs with kv_cache_dtype="int8"
+    (codes + scale cache leaves; fused quantize/dequantize in the graph).
 
     Returns {tag: {bucket: (jaxpr, lowered_text, census, donation_count,
     n_cache_leaves)}}.
@@ -190,13 +208,19 @@ def _audit_causal_lm():
         TpuModelForCausalLM,
     )
 
-    cfg = _tiny_config()
+    cfg = _tiny_config(**(dict(kv_cache_dtype="int8") if kv_quant else {}))
     app = TpuModelForCausalLM(None, cfg)
     app.load(random_weights=True)
     results = {}
     for tag, runner in (
-        (TAG_CONTEXT_ENCODING, app.context_encoding_model),
-        (TAG_TOKEN_GENERATION, app.token_generation_model),
+        (
+            TAG_CONTEXT_ENCODING_KVQ8 if kv_quant else TAG_CONTEXT_ENCODING,
+            app.context_encoding_model,
+        ),
+        (
+            TAG_TOKEN_GENERATION_KVQ8 if kv_quant else TAG_TOKEN_GENERATION,
+            app.token_generation_model,
+        ),
     ):
         per_bucket = {}
         n_cache_leaves = len(jax.tree.leaves(app.kv_cache))
@@ -313,6 +337,8 @@ def run(
         results.update(_audit_causal_lm())
     if TAG_FUSED_SPECULATION in tags:
         results.update(_audit_fused_spec())
+    if TAG_CONTEXT_ENCODING_KVQ8 in tags or TAG_TOKEN_GENERATION_KVQ8 in tags:
+        results.update(_audit_causal_lm(kv_quant=True))
     results = {t: results[t] for t in tags if t in results}
 
     baseline = load_census_baseline(baseline_path)
@@ -401,7 +427,11 @@ def run(
                     )
                 )
         # -- GRAPH203 f32 upcasts in decode scan ---------------------------
-        if tag in (TAG_TOKEN_GENERATION, TAG_FUSED_SPECULATION):
+        if tag in (
+            TAG_TOKEN_GENERATION,
+            TAG_FUSED_SPECULATION,
+            TAG_TOKEN_GENERATION_KVQ8,
+        ):
             hits: List[Tuple[str, Optional[str]]] = []
             _walk_scan_upcasts(per_bucket[ref_bucket][0].jaxpr, hits)
             for eqn_str, src in hits:

@@ -62,6 +62,16 @@ def dtype_name(dtype) -> str:
     return jnp.dtype(dtype).name
 
 
+#: accepted kv_cache_dtype names: plain storage dtypes plus the quantized
+#: (codes + per-(layer, head) scales) cache formats. Anything else fails
+#: validation loudly — an unknown string must not silently serve bf16.
+KV_CACHE_DTYPES = (
+    "bfloat16", "bf16", "float16", "fp16", "float32", "fp32",
+    "int8", "fp8", "float8_e4m3", "float8_e5m2",
+)
+KV_QUANT_DTYPE_NAMES = ("int8", "fp8", "float8_e4m3", "float8_e5m2")
+
+
 # ---------------------------------------------------------------------------
 # Sub-configs
 # ---------------------------------------------------------------------------
@@ -306,10 +316,19 @@ class TpuConfig:
     output_logits: bool = False
 
     # --- KV cache --------------------------------------------------------
-    kv_cache_dtype: Optional[str] = None  # e.g. "fp8" for quantized KV
+    # None = store in `dtype`; "int8"/"fp8" build the quantized cache
+    # (codes + per-(layer, head) running-absmax scales, modules/kvcache.py)
+    # with fused in-kernel dequant on the decode/paged kernels. Validated
+    # against KV_CACHE_DTYPES — unknown names fail loudly.
+    kv_cache_dtype: Optional[str] = None
     is_block_kv_layout: bool = False  # paged KV cache
     pa_num_blocks: Optional[int] = None
     pa_block_size: int = 16
+    # size the paged block pool by HBM BYTES instead of a block count: the
+    # application derives pa_num_blocks = pa_pool_bytes // true-per-block
+    # byte cost in the CACHE dtype (block_kvcache.kv_block_bytes) — a
+    # quantized cache admits ~2x the blocks for the same budget
+    pa_pool_bytes: Optional[int] = None
     is_prefix_caching: bool = False
     is_chunked_prefill: bool = False
     chunked_prefill_config: Optional[ChunkedPrefillConfig] = None
@@ -454,8 +473,26 @@ class TpuConfig:
     def kv_dtype(self):
         return to_dtype(self.kv_cache_dtype) if self.kv_cache_dtype else to_dtype(self.dtype)
 
+    @property
+    def kv_quantized(self) -> bool:
+        """True when the KV cache stores int8/fp8 codes + scales."""
+        return self.kv_cache_dtype in KV_QUANT_DTYPE_NAMES
+
     def validate(self):
         """Feature-interaction validation (reference config.py:567-594)."""
+        if self.kv_cache_dtype is not None and self.kv_cache_dtype not in KV_CACHE_DTYPES:
+            raise ValueError(
+                f"unknown kv_cache_dtype {self.kv_cache_dtype!r}; supported: "
+                f"{KV_CACHE_DTYPES} (int8/fp8 build the quantized cache)"
+            )
+        if self.pa_pool_bytes is not None:
+            if not self.is_block_kv_layout:
+                raise ValueError("pa_pool_bytes requires is_block_kv_layout")
+            if self.pa_num_blocks is not None:
+                raise ValueError(
+                    "set pa_num_blocks OR pa_pool_bytes, not both (the pool "
+                    "byte budget derives the block count from the cache dtype)"
+                )
         if self.attention_dp_degree > 1 and not self.is_continuous_batching:
             raise ValueError("attention_dp_degree > 1 requires is_continuous_batching")
         if self.attention_dp_degree > 1 and self.max_batch_size % self.attention_dp_degree != 0:
@@ -503,7 +540,11 @@ class TpuConfig:
                              "set is_continuous_batching=True")
         if self.is_prefix_caching and not self.is_block_kv_layout:
             raise ValueError("prefix caching requires block KV layout")
-        if self.is_block_kv_layout and self.pa_num_blocks is None:
+        if (
+            self.is_block_kv_layout
+            and self.pa_num_blocks is None
+            and self.pa_pool_bytes is None
+        ):
             self.pa_num_blocks = max(
                 1, (self.max_batch_size * self.seq_len + self.pa_block_size - 1) // self.pa_block_size
             )

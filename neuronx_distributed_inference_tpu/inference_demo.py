@@ -114,12 +114,23 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--token-generation-buckets", type=int, nargs="+", default=None)
 
     # KV cache / paged / serving (reference block-KV + chunked-prefill flags)
-    run.add_argument("--kv-cache-dtype", default=None)
+    from neuronx_distributed_inference_tpu.config import KV_CACHE_DTYPES
+
+    run.add_argument(
+        "--kv-cache-dtype", default=None, choices=list(KV_CACHE_DTYPES),
+        help="KV cache storage dtype; int8/fp8 build the quantized cache "
+        "(codes + per-(layer, head) scales, fused in-kernel dequant)",
+    )
     run.add_argument("--kv-cache-batch-size", type=int, default=None)
     run.add_argument("--is-continuous-batching", action="store_true")
     run.add_argument("--is-block-kv-layout", action="store_true")
     run.add_argument("--pa-num-blocks", type=int, default=None)
     run.add_argument("--pa-block-size", type=int, default=16)
+    run.add_argument(
+        "--pa-pool-bytes", type=int, default=None,
+        help="size the paged block pool by HBM bytes (block count derived "
+        "from the cache dtype's true per-block cost; excludes pa-num-blocks)",
+    )
     run.add_argument("--is-prefix-caching", action="store_true")
     run.add_argument("--is-chunked-prefill", action="store_true")
     run.add_argument("--cp-max-num-seqs", type=int, default=8,
@@ -329,6 +340,7 @@ def create_tpu_config(args) -> TpuConfig:
         is_block_kv_layout=args.is_block_kv_layout,
         pa_num_blocks=args.pa_num_blocks,
         pa_block_size=args.pa_block_size,
+        pa_pool_bytes=args.pa_pool_bytes,
         is_prefix_caching=args.is_prefix_caching,
         is_chunked_prefill=args.is_chunked_prefill,
         chunked_prefill_config=cpc,

@@ -42,7 +42,9 @@ from neuronx_distributed_inference_tpu.ops.kernel_mode import kernel_interpret
 from neuronx_distributed_inference_tpu.ops.quant import linear as quant_linear
 from neuronx_distributed_inference_tpu.modules.kvcache import (
     KVCache,
+    QuantizedKV,
     kv_batch_size,
+    layer_dequant_factors,
     read_cache_at_layer,
     slot_ids_from_seq_ids,
     update_cache_at_layer,
@@ -308,6 +310,9 @@ def _fused_attn_eligible(
     return (
         (plain_flavor or K == 1)
         and not isinstance(k_cache, tuple)  # contiguous cache only
+        # quantized caches ride the TKG kernel's fused dequant instead (the
+        # fused block kernel streams the cache in its storage dtype)
+        and not isinstance(k_cache, QuantizedKV)
         and spec.bounded_window is None
         and spec.norm_type == "rmsnorm"
         and "qkv_proj" in sa
@@ -494,7 +499,8 @@ def decoder_layer(
         else:
             write_positions = positions
         k_cache, v_cache = update_cache_at_layer(
-            k_cache, v_cache, k, v, layer_idx, slot_ids, write_positions
+            k_cache, v_cache, k, v, layer_idx, slot_ids, write_positions,
+            dp=spec.attention_dp * spec.data_parallel,
         )
 
     sink = layer_params["self_attn"].get("sink", {}).get("weight") if aspec.has_sink else None
@@ -550,13 +556,23 @@ def decoder_layer(
         if sink is None and plain_model and _use_paged_flash(aspec, Sq):
             # chunked/prefix prefill rides the paged flash kernel: blocks are
             # DMA'd straight from the cache via the block table — no gather
-            # materialization (reference flash_pa_with_schedule.py:157)
-            k_l = jax.lax.dynamic_index_in_dim(k_cache, layer_idx, axis=0, keepdims=False)
-            v_l = jax.lax.dynamic_index_in_dim(v_cache, layer_idx, axis=0, keepdims=False)
+            # materialization (reference flash_pa_with_schedule.py:157). A
+            # quantized cache hands the kernel this layer's code blocks plus
+            # per-head dequant factors — the prior-KV path reads narrow tiles
+            ks = vs = None
+            if isinstance(k_cache, QuantizedKV):
+                ks = layer_dequant_factors(k_cache, layer_idx)
+                vs = layer_dequant_factors(v_cache, layer_idx)
+                k_arr, v_arr = k_cache.data, v_cache.data
+            else:
+                k_arr, v_arr = k_cache, v_cache
+            k_l = jax.lax.dynamic_index_in_dim(k_arr, layer_idx, axis=0, keepdims=False)
+            v_l = jax.lax.dynamic_index_in_dim(v_arr, layer_idx, axis=0, keepdims=False)
             attn_out = paged_flash_attention(
                 q, k_l, v_l, block_table, positions, kv_limit,
                 scale=aspec.softmax_scale,
                 n_rep=aspec.num_heads // aspec.num_kv_heads,
+                k_scale=ks, v_scale=vs,
                 interpret=kernel_interpret(),
             )
         else:

@@ -490,6 +490,6 @@ class DecoderModelBuilder:
         )
         return shard_pytree(
             cache,
-            cache_spec(tc.cp_degree > 1, batch_shards > 1),
+            cache_spec(tc.cp_degree > 1, batch_shards > 1, quantized=tc.kv_quantized),
             mesh,
         )

@@ -296,7 +296,14 @@ class DeepseekV3ModelBuilder(DecoderModelBuilder):
             v_heads=1, v_head_dim=cfg.qk_rope_head_dim,  # V stream: rope keys
         )
         # single-"head" latent streams replicate over the model axes
-        spec = KVCache(k=P(), v=P())
+        # (quantized caches carry an extra scale leaf per stream)
+        if tc.kv_quantized:
+            from neuronx_distributed_inference_tpu.modules.kvcache import QuantizedKV
+
+            stream = QuantizedKV(data=P(), scale=P())
+            spec = KVCache(k=stream, v=stream)
+        else:
+            spec = KVCache(k=P(), v=P())
         return shard_pytree(cache, spec, mesh)
 
     # ---- params ----------------------------------------------------------

@@ -124,6 +124,15 @@ class GptOssModelBuilder(DecoderModelBuilder):
         from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
 
         tc = self.config.tpu_config
+        if tc.kv_quantized:
+            # fail fast (config validation cannot see the model's cache
+            # variant): the interleaved full+ring stacks carry no scale
+            # streams yet — int8 codes without scales would be silent garbage
+            raise NotImplementedError(
+                "kv_cache_dtype int8/fp8 is not implemented for the "
+                "interleaved (GPT-OSS sliding/global) cache; supported cache "
+                "variants: contiguous, ring-bounded, and paged"
+            )
         num_sliding = sum(t == "sliding_attention" for t in self.layer_types)
         cache = init_interleaved_cache(
             len(self.layer_types) - num_sliding,

@@ -160,8 +160,9 @@ def _masked_softmax_attention(
     sink: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Native attention: q (B,Sq,Hq,D), k/v (B,Sk,Hq,D), mask (B,1,Sq,Sk)."""
-    # fp8-quantized KV caches arrive in their storage dtype; compute in q's
-    # dtype (reference fp8 KV dequant, kv_cache_manager.py:137-160)
+    # int8/fp8-quantized caches are dequantized at the read (kvcache.read_*
+    # return fp32 — the reference's post-gather fp8 dequant,
+    # kv_cache_manager.py:137-160); align to q's compute dtype here
     k = k.astype(q.dtype)
     v = v.astype(q.dtype)
     dtype = jnp.float32 if spec.softmax_fp32 else q.dtype

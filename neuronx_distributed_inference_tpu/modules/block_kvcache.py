@@ -35,13 +35,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from neuronx_distributed_inference_tpu.modules.kvcache import (
+    QuantizedKV,
+    _quantized_update,
+    is_kv_quant_dtype,
+    layer_dequant_factors,
+)
+
 GARBAGE_BLOCK = 0  # block id 0 reserved for invalid-slot writes
 
 
 @jax.tree_util.register_dataclass
 @dataclass
 class BlockKVCache:
-    """k/v: (L, num_blocks+1, H_kv, block_size, D) — head-major blocks."""
+    """k/v: (L, num_blocks+1, H_kv, block_size, D) — head-major blocks
+    (arrays, or :class:`~.kvcache.QuantizedKV` streams of the same layout)."""
 
     k: jax.Array
     v: jax.Array
@@ -68,17 +76,40 @@ def init_block_cache(
     dtype=jnp.bfloat16,
 ) -> BlockKVCache:
     shape = (num_layers, num_blocks + 1, num_kv_heads, block_size, head_dim)
+    if is_kv_quant_dtype(dtype):
+        def stream():
+            return QuantizedKV(
+                data=jnp.zeros(shape, dtype),
+                scale=jnp.zeros((num_layers, num_kv_heads), jnp.float32),
+            )
+
+        return BlockKVCache(k=stream(), v=stream())
     return BlockKVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
-def block_cache_spec():
+def kv_block_bytes(
+    num_layers: int, block_size: int, num_kv_heads: int, head_dim: int, dtype
+) -> int:
+    """True per-block HBM cost of K+V for ONE block, in the CACHE dtype —
+    what sizes the serving block pool (a quantized cache fits ~2x the blocks
+    of bf16 in the same budget; the (L, H) scales are amortized over the
+    whole pool and excluded here)."""
+    return int(
+        2 * num_layers * num_kv_heads * block_size * head_dim
+        * jnp.dtype(dtype).itemsize
+    )
+
+
+def block_cache_spec(quantized: bool = False):
     from jax.sharding import PartitionSpec as P
 
     from neuronx_distributed_inference_tpu.parallel.mesh import MODEL_AXES
 
-    return BlockKVCache(
-        k=P(None, None, MODEL_AXES, None, None), v=P(None, None, MODEL_AXES, None, None)
-    )
+    spec = P(None, None, MODEL_AXES, None, None)
+    if quantized:
+        stream = QuantizedKV(data=spec, scale=P(None, MODEL_AXES))
+        return BlockKVCache(k=stream, v=stream)
+    return BlockKVCache(k=spec, v=spec)
 
 
 def update_block_cache_at_layer(
@@ -95,12 +126,31 @@ def update_block_cache_at_layer(
     kvcache.update_cache_at_layer for why). Negative slots are DROPPED by
     mapping them PAST the last block (scatter mode="drop" discards
     out-of-range indices; -1 would WRAP to the last real block and corrupt
-    it) — same net effect as the reference's garbage-block writes."""
+    it) — same net effect as the reference's garbage-block writes.
+
+    Quantized caches quantize fused into this scatter with the running
+    per-(layer, head) absmax (see kvcache.update_cache_at_layer); invalid
+    (garbage) slots are excluded from the scale update."""
     L, NB1, H, bs, D = k_cache.shape
     B, S = slot_mapping.shape
     slots = slot_mapping.reshape(B * S)
     blocks = jnp.where(slots >= 0, slots // bs, NB1)
     offs = jnp.where(slots >= 0, slots % bs, 0)
+    if isinstance(k_cache, QuantizedKV):
+        # scale-update mask: negative (dropped) slots AND garbage-block
+        # writes are excluded — idle serving rows carry all-zero block
+        # tables whose slots map INTO block 0 with slot >= 0, and the
+        # monotone pool-wide scale could never un-learn their junk
+        valid = (slot_mapping >= 0) & (slot_mapping // bs != GARBAGE_BLOCK)
+        k_codes, k_scale = _quantized_update(k_cache, k_new, layer_idx, valid)
+        v_codes, v_scale = _quantized_update(v_cache, v_new, layer_idx, valid)
+        k_data = k_cache.data.at[layer_idx, blocks, :, offs].set(
+            k_codes.reshape(B * S, H, D), mode="drop"
+        )
+        v_data = v_cache.data.at[layer_idx, blocks, :, offs].set(
+            v_codes.reshape(B * S, H, D), mode="drop"
+        )
+        return QuantizedKV(k_data, k_scale), QuantizedKV(v_data, v_scale)
     k_cache = k_cache.at[layer_idx, blocks, :, offs].set(
         k_new.reshape(B * S, H, D).astype(k_cache.dtype), mode="drop"
     )
@@ -135,7 +185,19 @@ def read_block_cache_at_layer(
     block_table: jax.Array,  # (B, MB) block ids; 0 for unused tail entries
 ) -> Tuple[jax.Array, jax.Array]:
     """Gather one layer's active blocks into a contiguous per-sequence view
-    (reference gather-by-active-block-table reads)."""
+    (reference gather-by-active-block-table reads). Quantized caches
+    dequantize AFTER the gather to fp32 — the native fallback path only;
+    the paged kernels DMA the codes straight from the cache instead."""
+    if isinstance(k_cache, QuantizedKV):
+        k_s = layer_dequant_factors(k_cache, layer_idx)
+        v_s = layer_dequant_factors(v_cache, layer_idx)
+        k_r, v_r = read_block_cache_at_layer(
+            k_cache.data, v_cache.data, layer_idx, block_table
+        )
+        return (
+            k_r.astype(jnp.float32) * k_s[:, None],
+            v_r.astype(jnp.float32) * v_s[:, None],
+        )
     B, MB = block_table.shape
     _, _, H, bs, D = k_cache.shape
     k_l = jax.lax.dynamic_index_in_dim(k_cache, layer_idx, axis=0, keepdims=False)

@@ -15,8 +15,17 @@ Design differences (deliberate, TPU-first):
   are therefore direct slices (no gather); writes scatter through ``slot_ids``
   so padded/invalid rows land in ``G`` garbage lines instead of corrupting
   live state (reference KV_CACHE_PAD_FOR_SEQ_IDS_MASKING, kv_cache_manager.py:26).
-- fp8 KV quantization stores quantized K/V plus per-head scales
-  (reference kv_cache_manager.py:137-160) — see quantized variant below.
+- int8/fp8 KV quantization stores quantized K/V codes plus per-(layer, head)
+  symmetric scales (reference kv_cache_manager.py:137-160): each cache stream
+  becomes a :class:`QuantizedKV` pytree ``{data: int8/fp8 codes, scale:
+  (L, H) fp32 running absmax}``. Quantization is FUSED into the existing
+  update ops (prefill scatter, decode append, paged writes, speculation
+  commit all ride the same scatters) with the scale updated as a running
+  absmax — steady-state decode never re-reads the cache to rescale. Reads
+  either dequantize after the gather (native fallback paths) or hand the raw
+  codes to the Pallas decode kernels, which dequantize in-register (the
+  per-head scale folds into q for the QKᵀ product and into the output for
+  the PV accumulation — exact for symmetric per-head scales).
 """
 
 from __future__ import annotations
@@ -34,11 +43,115 @@ GARBAGE_LINES = 1  # padding-zone lines for invalid seq_id writes
 #: (update_cache_at_layer uses mode="drop")
 PAD_POSITION_SENTINEL = -(1 << 30)
 
+def is_kv_quant_dtype(dtype) -> bool:
+    """True for cache storage dtypes that need codes + scales."""
+    dt = jnp.dtype(dtype)
+    return dt in (
+        jnp.dtype(jnp.int8),
+        jnp.dtype(jnp.float8_e4m3fn),
+        jnp.dtype(jnp.float8_e5m2),
+    )
+
+
+def kv_qmax(dtype) -> float:
+    """Largest representable magnitude of the code dtype: codes span
+    [-qmax, qmax] and dequantize as ``codes * scale / qmax``."""
+    dt = jnp.dtype(dtype)
+    if dt == jnp.dtype(jnp.int8):
+        return 127.0
+    return float(jnp.finfo(dt).max)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class QuantizedKV:
+    """One quantized cache stream: ``data`` holds int8/fp8 codes in the SAME
+    layout the bf16 cache would use; ``scale`` is the (L, H) fp32 running
+    per-(layer, head) absmax (symmetric: x ≈ codes * scale / qmax).
+
+    Shape/dtype probes proxy to ``data`` so cache-layout code (batch rows,
+    bucket lengths, kernel shape guards) works unchanged on either variant.
+    """
+
+    data: jax.Array
+    scale: jax.Array
+
+    @property
+    def shape(self):
+        return self.data.shape
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    @property
+    def ndim(self) -> int:
+        return self.data.ndim
+
+
+def quantize_kv_codes(x: jax.Array, scale: jax.Array, code_dtype) -> jax.Array:
+    """Quantize ``x`` (..., H, D) with per-head absmax ``scale`` (H,) to the
+    code dtype. Symmetric: codes = round/clip(x * qmax / max(scale, eps))."""
+    qmax = kv_qmax(code_dtype)
+    s = jnp.maximum(scale, 1e-8).astype(jnp.float32)
+    y = x.astype(jnp.float32) * (qmax / s)[..., :, None]
+    if jnp.dtype(code_dtype) == jnp.dtype(jnp.int8):
+        return jnp.clip(jnp.round(y), -qmax, qmax).astype(code_dtype)
+    return jnp.clip(y, -qmax, qmax).astype(code_dtype)
+
+
+def dequantize_kv(codes: jax.Array, scale: jax.Array) -> jax.Array:
+    """Dequantize codes (..., H, D) with per-head absmax ``scale`` (H,) to
+    fp32 (callers cast to their compute dtype)."""
+    factor = scale.astype(jnp.float32) / kv_qmax(codes.dtype)
+    return codes.astype(jnp.float32) * factor[..., :, None]
+
+
+def layer_dequant_factors(stream: QuantizedKV, layer_idx) -> jax.Array:
+    """Per-head dequant factors scale/qmax (H,) for one layer — what the
+    kernel paths fold into q (K stream) / the output (V stream)."""
+    s = jax.lax.dynamic_index_in_dim(
+        stream.scale, jnp.asarray(layer_idx, jnp.int32), 0, keepdims=False
+    )
+    return s / kv_qmax(stream.data.dtype)
+
+
+def _quantized_update(
+    stream: QuantizedKV, new: jax.Array, layer_idx, valid: jax.Array
+) -> Tuple[jax.Array, jax.Array]:
+    """Running-absmax scale update + quantize for one layer's write.
+
+    ``new``: (B, S, H, D) values about to be scattered; ``valid``: (B, S)
+    mask of tokens that actually land in the cache (padded/sentinel writes
+    must not inflate the scale). Returns (codes, updated (L, H) scale). The
+    write quantizes with the UPDATED scale, so a steady-state decode step
+    never re-reads the cache to rescale — earlier entries keep their codes
+    and dequantize with the (monotonically grown) running scale.
+    """
+    li = jnp.asarray(layer_idx, jnp.int32)
+    xf = new.astype(jnp.float32)
+    amax_new = jnp.max(
+        jnp.where(valid[:, :, None, None], jnp.abs(xf), 0.0), axis=(0, 1, 3)
+    )  # (H,)
+    cur = jax.lax.dynamic_index_in_dim(stream.scale, li, 0, keepdims=False)
+    s = jnp.maximum(cur, amax_new)
+    codes = quantize_kv_codes(xf, s, stream.data.dtype)
+    scale = jax.lax.dynamic_update_slice(stream.scale, s[None], (li, 0))
+    return codes, scale
+
+
+def cache_nbytes(cache) -> int:
+    """Total bytes of a cache pytree (codes + scales for quantized caches) —
+    the honest HBM cost the bench/serving accounting reports."""
+    return int(sum(x.size * jnp.dtype(x.dtype).itemsize for x in jax.tree.leaves(cache)))
+
 
 @jax.tree_util.register_dataclass
 @dataclass
 class KVCache:
-    """Stacked per-layer KV buffers. k/v: (L, B_kv+G, S_max, H_kv, D)."""
+    """Stacked per-layer KV buffers. k/v: (L, B_kv+G, S_max, H_kv, D) arrays,
+    or :class:`QuantizedKV` streams of the same data layout when the cache
+    dtype is int8/fp8."""
 
     k: jax.Array
     v: jax.Array
@@ -77,13 +190,29 @@ def init_cache(
 
     ``v_heads``/``v_head_dim`` let the V stream differ from K (MLA caches the
     compressed latent in K and the rope keys in V; reference
-    modeling_deepseek.py weight-absorption cache)."""
+    modeling_deepseek.py weight-absorption cache).
+
+    A quantized ``dtype`` (int8/fp8) builds :class:`QuantizedKV` streams:
+    codes in the same layout plus zero-initialized (L, H) running-absmax
+    scales (reference quantized K/V + per-head scales,
+    kv_cache_manager.py:137-160)."""
     garbage = dp if dp > 1 else GARBAGE_LINES
     rows = batch_size + garbage
     k_shape = (num_layers, rows, max_len, num_kv_heads, head_dim)
     v_shape = (
         num_layers, rows, max_len, v_heads or num_kv_heads, v_head_dim or head_dim
     )
+    if is_kv_quant_dtype(dtype):
+        return KVCache(
+            k=QuantizedKV(
+                data=jnp.zeros(k_shape, dtype),
+                scale=jnp.zeros((num_layers, k_shape[3]), jnp.float32),
+            ),
+            v=QuantizedKV(
+                data=jnp.zeros(v_shape, dtype),
+                scale=jnp.zeros((num_layers, v_shape[3]), jnp.float32),
+            ),
+        )
     return KVCache(k=jnp.zeros(k_shape, dtype), v=jnp.zeros(v_shape, dtype))
 
 
@@ -159,7 +288,7 @@ def interleaved_cache_spec():
     return InterleavedKVCache(k_full=spec, v_full=spec, k_ring=spec, v_ring=spec)
 
 
-def cache_spec(cp_enabled: bool = False, dp_enabled: bool = False):
+def cache_spec(cp_enabled: bool = False, dp_enabled: bool = False, quantized: bool = False):
     """PartitionSpec for the cache — identical for the CTE and TKG programs so
     the cache never reshards between phases (SURVEY §7 hard-part 5).
 
@@ -185,8 +314,16 @@ def cache_spec(cp_enabled: bool = False, dp_enabled: bool = False):
     batch = (AXIS_DDP, AXIS_DP) if dp_enabled else None
     if cp_enabled:
         spec = P(None, batch, AXIS_CP, (AXIS_EP, AXIS_TP), None)
+        head_axes = (AXIS_EP, AXIS_TP)
     else:
         spec = P(None, batch, None, MODEL_AXES, None)
+        head_axes = MODEL_AXES
+    if quantized:
+        # (L, H) scales shard their head dim exactly like the cache heads so
+        # the per-head scale math stays shard-local
+        scale_spec = P(None, head_axes)
+        stream = QuantizedKV(data=spec, scale=scale_spec)
+        return KVCache(k=stream, v=stream)
     return KVCache(k=spec, v=spec)
 
 
@@ -218,6 +355,7 @@ def update_cache_at_layer(
     layer_idx: jax.Array,
     slot_ids: jax.Array,
     positions: jax.Array,
+    dp: int = 1,
 ) -> Tuple[jax.Array, jax.Array]:
     """Scatter new K/V into the FULL stacked cache at one layer.
 
@@ -233,8 +371,39 @@ def update_cache_at_layer(
 
     Reference: KVCacheManager.update_cache (kv_cache_manager.py:356) —
     scatter / dynamic-update-slice with seq_id indexing.
+
+    Quantized caches quantize FUSED into this scatter (reference quantized
+    write, kv_cache_manager.py:137-160): the per-(layer, head) running
+    absmax is bumped by the valid new tokens, the new values are quantized
+    with the updated scale, and only the codes are scattered — the prefill
+    scatter, decode append, and speculation commit/rollback overwrites all
+    ride this one path. Tokens whose position lands outside the cache
+    (padding sentinel, ring drop-slot) are excluded from the absmax.
     """
     idx_b = slot_ids[:, None]  # (B, 1) broadcasts over S_new
+    if isinstance(k_cache, QuantizedKV):
+        # scale-update mask: in-cache positions AND non-garbage rows — the
+        # monotone scale can never un-learn junk, so both terms gate it
+        # (idle serving rows can carry in-range position 0 with a garbage
+        # slot). ``dp`` selects the garbage layout: dp=1 has one trailing
+        # garbage line; the interleaved attention-DP layout one PER SHARD
+        # at slot % (sr+1) == sr (see slot_ids_from_seq_ids).
+        rows = k_cache.data.shape[1]
+        if dp > 1:
+            sr = (rows - dp) // dp
+            garbage = slot_ids % (sr + 1) == sr
+        else:
+            garbage = slot_ids == rows - 1
+        valid = (
+            (positions >= 0)
+            & (positions < k_cache.data.shape[2])
+            & ~garbage[:, None]
+        )
+        k_codes, k_scale = _quantized_update(k_cache, k_new, layer_idx, valid)
+        v_codes, v_scale = _quantized_update(v_cache, v_new, layer_idx, valid)
+        k_data = k_cache.data.at[layer_idx, idx_b, positions].set(k_codes, mode="drop")
+        v_data = v_cache.data.at[layer_idx, idx_b, positions].set(v_codes, mode="drop")
+        return QuantizedKV(k_data, k_scale), QuantizedKV(v_data, v_scale)
     k_cache = k_cache.at[layer_idx, idx_b, positions].set(
         k_new.astype(k_cache.dtype), mode="drop"
     )
@@ -256,8 +425,22 @@ def read_cache_at_layer(
     row b owns cache line b (sorted-batch convention). Reference: get_cache
     slices to bucket length (kv_cache_manager.py:331).
 
+    Quantized caches dequantize AFTER the slice with the layer's per-head
+    scales and return fp32 (this is the native fallback path — the Pallas
+    decode kernels never come through here; they DMA the codes directly).
+
     dp > 1: drop each shard's interleaved garbage line first (a shard-local
     reshape/slice — the row dim splits exactly at dp shard boundaries)."""
+    if isinstance(k_cache, QuantizedKV):
+        k_s = layer_dequant_factors(k_cache, layer_idx)
+        v_s = layer_dequant_factors(v_cache, layer_idx)
+        k_r, v_r = read_cache_at_layer(
+            k_cache.data, v_cache.data, layer_idx, batch_size, bucket_len, dp
+        )
+        return (
+            k_r.astype(jnp.float32) * k_s[:, None],
+            v_r.astype(jnp.float32) * v_s[:, None],
+        )
     if dp > 1:
         sr = batch_size // dp
         L, R, S = k_cache.shape[:3]

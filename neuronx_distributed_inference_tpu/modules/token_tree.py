@@ -219,14 +219,26 @@ def fixup_cache_paths(
     the accepted count receive junk from padded path tails — harmless: they
     are past the next round's valid mask and are overwritten (write-then-
     attend) before any query can reach them."""
+    from neuronx_distributed_inference_tpu.modules.kvcache import QuantizedKV
+
     d1 = best_nodes.shape[1]
     src = p + best_nodes  # (B, d1)
     dst = p + jnp.arange(d1, dtype=jnp.int32)[None, :]
     lines = slot_ids[:, None]  # (B, 1)
-    k_vals = cache.k[:, lines, src]  # (L, B, d1, H, D)
-    v_vals = cache.v[:, lines, src]
-    k = cache.k.at[:, lines, dst].set(k_vals, mode="drop")
-    v = cache.v.at[:, lines, dst].set(v_vals, mode="drop")
+    # quantized caches move the raw CODES between slots — exact (the
+    # per-(layer, head) scale is shared by source and destination slots)
+    quant = isinstance(cache.k, QuantizedKV)
+    k_arr = cache.k.data if quant else cache.k
+    v_arr = cache.v.data if quant else cache.v
+    k_vals = k_arr[:, lines, src]  # (L, B, d1, H, D)
+    v_vals = v_arr[:, lines, src]
+    k = k_arr.at[:, lines, dst].set(k_vals, mode="drop")
+    v = v_arr.at[:, lines, dst].set(v_vals, mode="drop")
+    if quant:
+        return type(cache)(
+            k=QuantizedKV(data=k, scale=cache.k.scale),
+            v=QuantizedKV(data=v, scale=cache.v.scale),
+        )
     return type(cache)(k=k, v=v)
 
 

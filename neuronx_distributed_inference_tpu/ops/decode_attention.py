@@ -27,6 +27,16 @@ kernels).
 
 Learned attention sinks (GPT-OSS) join the softmax denominator at finalize
 (reference attention_base.py:1964-1980).
+
+Quantized (int8/fp8) caches: both kernels take the :class:`QuantizedKV`
+streams directly and DMA the NARROW code tiles — half (or a quarter of) the
+bf16 bytes, which is the entire win on the bandwidth-bound decode step. The
+per-(layer, head) symmetric scale is applied exactly, without materializing
+a dequantized cache anywhere: the K scale folds into q before the kernel
+(scaling the QKᵀ product — the online-softmax stats then run on true
+scores), and the V scale multiplies the per-head output after finalize
+(linear in the PV accumulation). In-kernel the codes convert to fp32
+in-register (``.astype`` in ``_body``); stats/accumulators stay fp32.
 """
 
 from __future__ import annotations
@@ -36,6 +46,11 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+
+from neuronx_distributed_inference_tpu.modules.kvcache import (
+    QuantizedKV,
+    layer_dequant_factors,
+)
 
 try:  # pallas TPU backend
     from jax.experimental.pallas import tpu as pltpu
@@ -158,6 +173,20 @@ def _prep_q(q: jax.Array):
     return q.transpose(0, 2, 1, 3).reshape(B, Hq * K, D)
 
 
+def _fold_k_dequant(q: jax.Array, k_cache: QuantizedKV, layer_idx, n_rep: int):
+    """Fold the K stream's per-head dequant factor into q (fp32): the QKᵀ
+    product then equals q·k̂ exactly, so mask/max/exp see true scores."""
+    ks = layer_dequant_factors(k_cache, layer_idx)  # (Hkv,)
+    return q.astype(jnp.float32) * jnp.repeat(ks, n_rep)[None, None, :, None]
+
+
+def _apply_v_dequant(out: jax.Array, v_cache: QuantizedKV, layer_idx, n_rep: int):
+    """Scale the per-head output by the V dequant factor: the accumulated
+    Σ p·v_codes times scale/qmax equals Σ p·v̂ (scale constant per head)."""
+    vs = layer_dequant_factors(v_cache, layer_idx)  # (Hkv,)
+    return out * jnp.repeat(vs, n_rep)[None, None, :, None]
+
+
 def _unprep_out(out: jax.Array, B: int, K: int, Hq: int, D: int):
     return out.reshape(B, Hq, K, D).transpose(0, 2, 1, 3)
 
@@ -207,13 +236,20 @@ def tkg_decode_attention(
 ) -> jax.Array:
     """Decode attention straight off the stacked contiguous cache (batch row b
     owns cache line b — the sorted-batch convention of read_cache_at_layer).
-    Returns (B, K, Hq, D)."""
+    Quantized caches (QuantizedKV streams) DMA the int8/fp8 code tiles and
+    dequantize in-register (see module docstring). Returns (B, K, Hq, D)."""
     B, K, Hq, D = q.shape
     S_kv = mask.shape[-1]
     bs = min(bs, S_kv)
     nkv = S_kv // bs
     n_rep = Hq // n_kv
     rk = n_rep * K
+    out_dtype = q.dtype
+    quantized = isinstance(k_cache, QuantizedKV)
+    if quantized:
+        q = _fold_k_dequant(q, k_cache, layer_idx, n_rep)
+        k_cache, v_quant = k_cache.data, v_cache
+        v_cache = v_cache.data
     qr = _prep_q(q)
     m, tile_any = _mask_tiles(mask, nkv, bs)
     li = jnp.reshape(layer_idx, (1,)).astype(jnp.int32)
@@ -250,7 +286,10 @@ def tkg_decode_attention(
         ],
         interpret=interpret,
     )
-    return _unprep_out(out, B, K, Hq, D)
+    out = _unprep_out(out, B, K, Hq, D)
+    if quantized:
+        out = _apply_v_dequant(out, v_quant, layer_idx, n_rep).astype(out_dtype)
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("scale", "n_kv", "interpret"))
@@ -271,6 +310,7 @@ def paged_tkg_decode_attention(
     table (scalar prefetch) — kills the materializing
     read_block_cache_at_layer gather on the serving decode path
     (reference attention_block_tokengen kernel, attention_base.py:1609).
+    Quantized caches DMA the code blocks and dequantize in-register.
     Returns (B, K, Hq, D)."""
     B, K, Hq, D = q.shape
     _, _, Hkv, bs, _ = k_cache.shape
@@ -278,6 +318,12 @@ def paged_tkg_decode_attention(
     assert mask.shape[-1] == MB * bs, (mask.shape, MB, bs)
     n_rep = Hq // n_kv
     rk = n_rep * K
+    out_dtype = q.dtype
+    quantized = isinstance(k_cache, QuantizedKV)
+    if quantized:
+        q = _fold_k_dequant(q, k_cache, layer_idx, n_rep)
+        k_cache, v_quant = k_cache.data, v_cache
+        v_cache = v_cache.data
     qr = _prep_q(q)
     m, tile_any = _mask_tiles(mask, MB, bs)
     li = jnp.reshape(layer_idx, (1,)).astype(jnp.int32)
@@ -318,4 +364,7 @@ def paged_tkg_decode_attention(
         ],
         interpret=interpret,
     )
-    return _unprep_out(out, B, K, Hq, D)
+    out = _unprep_out(out, B, K, Hq, D)
+    if quantized:
+        out = _apply_v_dequant(out, v_quant, layer_idx, n_rep).astype(out_dtype)
+    return out

@@ -137,6 +137,8 @@ def paged_flash_attention(
     scale: float,
     n_rep: int,
     tq: int = 128,
+    k_scale: jax.Array = None,  # (Hkv,) per-head dequant factor (scale/qmax)
+    v_scale: jax.Array = None,  # for int8/fp8 caches; None = plain cache
     interpret: bool = False,
 ) -> jax.Array:
     """Prefix/chunked-prefill attention straight off the paged cache.
@@ -145,6 +147,12 @@ def paged_flash_attention(
     p <= positions[b, t] with p < kv_limit[b] — prior context plus causal
     among the new tokens (KV for the new tokens must already be written;
     write-then-attend as everywhere else).
+
+    Quantized caches pass the raw int8/fp8 code blocks plus this layer's
+    per-head dequant factors: the K factor folds into q (scaling the QKᵀ
+    product), the V factor scales the per-head output after the online
+    softmax — the kernel DMAs narrow code tiles, converts to fp32
+    in-register, and never materializes a dequantized cache.
     """
     B, Sq, Hq, D = q.shape
     _, Hkv, bs, _ = k_cache.shape
@@ -152,6 +160,9 @@ def paged_flash_attention(
     tq = min(tq, Sq)
     nq = pl.cdiv(Sq, tq)
 
+    out_dtype = q.dtype
+    if k_scale is not None:
+        q = q.astype(jnp.float32) * jnp.repeat(k_scale, n_rep)[None, None, :, None]
     qt = jnp.swapaxes(q, 1, 2)  # (B, Hq, Sq, D)
     # per-(row, q-tile) causal frontier for tile skipping
     pos_pad = jnp.pad(positions, ((0, 0), (0, nq * tq - Sq)))
@@ -205,4 +216,7 @@ def paged_flash_attention(
         k_cache,
         v_cache,
     )
-    return jnp.swapaxes(out, 1, 2)[:, :Sq]
+    out = jnp.swapaxes(out, 1, 2)[:, :Sq]
+    if v_scale is not None:
+        out = (out * jnp.repeat(v_scale, n_rep)[None, None, :, None]).astype(out_dtype)
+    return out

@@ -215,8 +215,24 @@ class TpuModelForCausalLM:
             from neuronx_distributed_inference_tpu.modules.block_kvcache import (
                 block_cache_spec,
                 init_block_cache,
+                kv_block_bytes,
             )
 
+            if tc.pa_num_blocks is None and tc.pa_pool_bytes is not None:
+                # byte-budgeted pool: the block count follows the TRUE
+                # per-block cost in the cache dtype — a quantized cache
+                # admits ~2x the blocks for the same HBM budget
+                tc.pa_num_blocks = max(
+                    1,
+                    tc.pa_pool_bytes
+                    // kv_block_bytes(
+                        self.spec.num_layers,
+                        tc.pa_block_size,
+                        self.spec.attn.num_kv_heads,
+                        self.spec.attn.head_dim,
+                        dt,
+                    ),
+                )
             cache = init_block_cache(
                 self.spec.num_layers,
                 tc.pa_num_blocks,
@@ -225,7 +241,9 @@ class TpuModelForCausalLM:
                 self.spec.attn.head_dim,
                 dtype=dt,
             )
-            self.kv_cache = shard_pytree(cache, block_cache_spec(), self.mesh)
+            self.kv_cache = shard_pytree(
+                cache, block_cache_spec(quantized=tc.kv_quantized), self.mesh
+            )
             return
         self.kv_cache = self.builder.init_kv_cache(self.mesh)
 

@@ -67,9 +67,16 @@ class RingSnapshotGuard:
         self._slots = None
 
     def _ring_arrays(self):
+        from neuronx_distributed_inference_tpu.modules.kvcache import QuantizedKV
+
         cache = self.app.kv_cache
         if hasattr(cache, "k_ring"):
             return ("k_ring", "v_ring"), (cache.k_ring, cache.v_ring)
+        if isinstance(cache.k, QuantizedKV):
+            # snapshot/restore moves the raw CODES (scale-invariant within a
+            # layer/head up to the running absmax's monotone growth — the
+            # same approximation every overwrite path accepts)
+            return ("k", "v"), (cache.k.data, cache.v.data)
         return ("k", "v"), (cache.k, cache.v)
 
     def snapshot(self, pos: np.ndarray) -> None:
@@ -103,6 +110,8 @@ class RingSnapshotGuard:
         names, arrays = self._ring_arrays()
         import dataclasses
 
+        from neuronx_distributed_inference_tpu.modules.kvcache import QuantizedKV
+
         updates = {}
         for name, a, snap in zip(names, arrays, snaps):
             cur = jnp.take_along_axis(a[:, :B], idx, axis=2)
@@ -111,7 +120,11 @@ class RingSnapshotGuard:
                 a[:, :B], jnp.broadcast_to(idx, merged.shape), merged,
                 axis=2, inplace=False,
             )
-            updates[name] = jnp.concatenate([upd, a[:, B:]], axis=1)
+            new_arr = jnp.concatenate([upd, a[:, B:]], axis=1)
+            stream = getattr(self.app.kv_cache, name)
+            if isinstance(stream, QuantizedKV):
+                new_arr = QuantizedKV(data=new_arr, scale=stream.scale)
+            updates[name] = new_arr
         self.app.kv_cache = dataclasses.replace(self.app.kv_cache, **updates)
 
 

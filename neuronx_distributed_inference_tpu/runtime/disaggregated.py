@@ -52,6 +52,14 @@ def _plain_cache(app):
             "disaggregated serving supports the plain contiguous KV cache "
             "(no ring/interleaved/paged layouts)"
         )
+    if app.config.tpu_config.kv_quantized:
+        # codes are only meaningful together with the per-(layer, head)
+        # running scales, and the two stages' scales evolve independently —
+        # a code handover under a different scale silently rescales history
+        raise NotImplementedError(
+            "disaggregated KV handover is not implemented for quantized "
+            "(int8/fp8) caches; use a plain kv_cache_dtype"
+        )
     return cache
 
 

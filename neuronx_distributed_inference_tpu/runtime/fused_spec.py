@@ -145,7 +145,8 @@ class _SpecAppBase:
 
         kv_batch = tc.kv_cache_batch_size or tc.max_batch_size
         dt = to_dtype(tc.kv_cache_dtype or tc.dtype)
-        cspec = cache_spec(tc.cp_degree > 1)  # same layout as the model graph's
+        # same layout as the model graph's (quantized caches add scale leaves)
+        cspec = cache_spec(tc.cp_degree > 1, quantized=tc.kv_quantized)
         self.target_cache = shard_pytree(
             init_cache(
                 self.target_spec.num_layers, kv_batch, tc.seq_len,

@@ -147,7 +147,7 @@ class TpuMedusaModelForCausalLM(_SpecAppBase):
                 self.spec.attn.num_kv_heads, self.spec.attn.head_dim,
                 to_dtype(tc.kv_cache_dtype or tc.dtype),
             ),
-            cache_spec(tc.cp_degree > 1), self.mesh,
+            cache_spec(tc.cp_degree > 1, quantized=tc.kv_quantized), self.mesh,
         )
         self.hidden_buffer = init_hidden_buffer(kv_batch, H, dt)
         return self

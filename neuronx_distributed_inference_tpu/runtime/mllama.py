@@ -286,6 +286,12 @@ class MllamaForConditionalGeneration:
 
     def load(self, model_path=None, state_dict=None, random_weights: bool = False):
         tc = self.config.tpu_config
+        if tc.kv_quantized:
+            # fail BEFORE the multi-GB checkpoint load/convert/shard
+            raise NotImplementedError(
+                "kv_cache_dtype int8/fp8 is not implemented for the mllama "
+                "self+cross cache (no scale streams); use a plain kv dtype"
+            )
         if state_dict is None and not random_weights:
             from neuronx_distributed_inference_tpu.utils.hf_checkpoint import (
                 load_state_dict,

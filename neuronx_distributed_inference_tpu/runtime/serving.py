@@ -88,14 +88,33 @@ class ServingSession:
         self.chunk_size = cpc.kernel_q_tile_size if cpc else 128
         self.max_prefill_seqs = cpc.max_num_seqs if cpc else 8
         self.allocator = None
+        self.block_bytes = 0
         if self.block_mode:
             from neuronx_distributed_inference_tpu.modules.block_kvcache import (
                 BlockAllocator,
                 PrefixCachingAllocator,
+                kv_block_bytes,
             )
 
+            if tc.pa_num_blocks is None:
+                # pa_pool_bytes configs resolve the count at cache init
+                raise RuntimeError(
+                    "pa_num_blocks is unresolved — load the application "
+                    "(init_kv_cache sizes the pool from pa_pool_bytes and "
+                    "the cache dtype) before creating a ServingSession"
+                )
             cls = PrefixCachingAllocator if self.prefix_caching else BlockAllocator
             self.allocator = cls(tc.pa_num_blocks, tc.pa_block_size)
+            # true per-block HBM cost in the CACHE dtype (NOT a hardcoded
+            # bf16 itemsize): quantized caches admit ~2x the blocks for the
+            # same pool budget, and this is what capacity reporting uses
+            self.block_bytes = kv_block_bytes(
+                app.spec.num_layers,
+                tc.pa_block_size,
+                app.spec.attn.num_kv_heads,
+                app.spec.attn.head_dim,
+                tc.kv_dtype,
+            )
         # async 1-ahead decode (reference modules/async_execution.py:190):
         # the decode step dispatched last step(), not yet fetched —
         # (device tokens (B, 1), [(req, pos_dispatched), ...])
@@ -105,6 +124,25 @@ class ServingSession:
     @property
     def free_slots(self) -> List[int]:
         return [i for i, r in enumerate(self.slots) if r is None]
+
+    @property
+    def kv_pool_bytes(self) -> int:
+        """Total block-pool HBM cost in the cache dtype (0 off block mode)."""
+        if not self.block_mode:
+            return 0
+        return self.allocator.num_blocks * self.block_bytes
+
+    @property
+    def kv_free_bytes(self) -> int:
+        """Free pool capacity in bytes — the admission headroom a scheduler
+        sees; derived from the cache dtype, so a quantized cache reports ~2x
+        the token capacity of bf16 for the same pool budget. Prefix-caching
+        pools count evictable (refcount-0, LRU-reclaimable) blocks too —
+        allocation evicts them on demand."""
+        if not self.block_mode:
+            return 0
+        reclaimable = len(getattr(self.allocator, "evictable", ()))
+        return (len(self.allocator.free) + reclaimable) * self.block_bytes
 
     def add_request(
         self,

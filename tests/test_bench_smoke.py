@@ -25,7 +25,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 ALL_POINTS = {
     "bf16_1b_bs1", "bf16_1b_bs4", "int8_1b_bs1", "serving_1b_int8",
-    "int8_8b_bs1", "bf16_1b_16k",
+    "int8_8b_bs1", "bf16_1b_8k", "bf16_1b_8k_kvq8", "bf16_1b_16k",
+    "bf16_1b_16k_kvq8",
 }
 
 
@@ -55,6 +56,16 @@ def test_bench_suite_tiny(monkeypatch):
     assert final["serving_tok_s"] > 0
     # the 16k long-context row (tiny-scaled) reports prefill TTFT + decode
     assert final["long_ctx_ttft_ms"] > 0 and final["long_ctx_tok_s"] > 0
+    # kv-quant rows (ISSUE 3): every measured point reports the cache's true
+    # HBM cost, and the *_kvq8 rows' kv_bytes land well under the paired
+    # bf16 rows' (int8 codes ~1/4 of the fp32-tiny / 1/2 of bf16 cache,
+    # plus the small scale overhead)
+    for name in ALL_POINTS - {"serving_1b_int8"}:
+        assert points[name]["kv_bytes"] > 0, name
+    assert final["ctx8k_kv_bytes"] > final["kvq8_8k_kv_bytes"] > 0
+    assert final["long_ctx_kv_bytes"] > final["kvq8_16k_kv_bytes"] > 0
+    assert final["kvq8_8k_tok_s"] > 0 and final["kvq8_16k_tok_s"] > 0
+    assert final["kvq8_16k_ttft_ms"] > 0
     assert all(v == "ok" for v in final["points"].values())
 
 

@@ -280,16 +280,22 @@ def test_graph_audit_clean_and_covers_tags():
     findings = graph_audit.run()
     assert findings == [], "\n".join(f.render() for f in findings)
     # coverage floor: the audited tag set is the acceptance-criteria set
+    # (+ the quantized-cache program set, ISSUE 3)
     assert set(graph_audit.AUDIT_TAGS) == {
         "context_encoding",
         "token_generation",
         "fused_speculation",
+        "context_encoding_kvq8",
+        "token_generation_kvq8",
     }
     baseline = graph_audit.load_census_baseline()
     assert set(baseline) == set(graph_audit.AUDIT_TAGS)
     # a tp=2 decode graph must actually communicate: vacuous censuses (all
     # zeros) would mean the auditor is looking at the wrong HLO
     assert baseline["token_generation"]["all-reduce"] > 0
+    # kv-quant must not change the communication pattern: the int8-cache
+    # decode census matches the bf16 one (the scale math is shard-local)
+    assert baseline["token_generation_kvq8"] == baseline["token_generation"]
 
 
 def test_graph_audit_flags_census_drift(tmp_path):
