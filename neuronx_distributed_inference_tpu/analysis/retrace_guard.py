@@ -34,12 +34,33 @@ from typing import List, Optional
 __all__ = [
     "RetraceError",
     "RetraceGuard",
+    "add_trace_listener",
     "guard_enabled",
     "note_trace",
+    "remove_trace_listener",
     "trace_marker",
 ]
 
 _ACTIVE: List["RetraceGuard"] = []
+# telemetry bridge: listeners called as fn(tag, sealed) on EVERY observed
+# trace — including forbidden post-seal retraces, which are counted BEFORE
+# the RetraceError raises so a steady-state recompile surfaces as an
+# operable counter (nxdi_sealed_retrace_total) and not only an assertion.
+# Kept as a plain callback list so this module never imports telemetry
+# (note_trace executes at trace time; a static telemetry reference here
+# would trip tpulint TPU107's recording-under-trace rule).
+_LISTENERS: List = []
+
+
+def add_trace_listener(fn) -> None:
+    """Register ``fn(tag: str, sealed: bool)`` to observe every jit trace."""
+    if fn not in _LISTENERS:
+        _LISTENERS.append(fn)
+
+
+def remove_trace_listener(fn) -> None:
+    if fn in _LISTENERS:
+        _LISTENERS.remove(fn)
 
 
 class RetraceError(RuntimeError):
@@ -62,6 +83,8 @@ def note_trace(tag: str, sealed: bool = False) -> None:
     """
     for g in _ACTIVE:
         g.traces.append(tag)
+    for listener in _LISTENERS:
+        listener(tag, sealed)
     if sealed:
         raise RetraceError(
             f"{tag}: jit re-trace after warmup()/seal() — a steady-state "

@@ -24,6 +24,16 @@ The rules encode contracts the runtime relies on but Python cannot enforce:
   inside a traced body. Legitimate on trace-time-static values (bucket
   tables, permutations) — those sites carry a pragma or a baseline entry —
   but on a traced value it synchronizes or crashes.
+- **TPU107 metric-recording-under-trace** (error): a telemetry call inside a
+  jit-traced body — any reference to a symbol imported from the
+  ``telemetry`` package, or a ``.inc(...)``/``.observe(...)`` metric-method
+  call. Python under trace runs ONCE per compile, so a metric recorded
+  there counts compiles, not steps — it would lie forever (TPU103's
+  failure mode) AND any telemetry that *read* a traced value would force a
+  host sync (TPU101's). Recording belongs in host loops, on values the
+  step's existing batched fetch already landed; this rule is the static
+  half of the zero-device-round-trip telemetry contract
+  (docs/OBSERVABILITY.md).
 
 Traced-body detection: a function is *traced* when it is (a) decorated with
 ``jax.jit`` (possibly through ``partial``), (b) referenced anywhere inside a
@@ -58,6 +68,11 @@ PACKAGE = "neuronx_distributed_inference_tpu"
 HOST_SYNC_ATTRS = {"device_get", "block_until_ready", "item"}
 HOST_TIME_FUNCS = {"time", "perf_counter", "monotonic"}
 NP_SYNC_FUNCS = {"asarray", "array"}
+# telemetry recording: the package prefix (import-based detection) and the
+# metric mutator names distinctive enough to flag bare (heuristic half —
+# catches `self.tel.inc/observe`-style calls the import map cannot resolve)
+TELEMETRY_PKG = PACKAGE + "/telemetry"
+METRIC_RECORD_ATTRS = {"inc", "observe"}
 
 _PRAGMA_RE = re.compile(r"#\s*tpulint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
 
@@ -462,6 +477,51 @@ class _Linter:
                         def_line=def_line,
                     )
 
+    def rule_telemetry_under_trace(self):
+        """TPU107: no metric recording under a jit trace. Two detectors:
+        references to symbols imported from the telemetry package (resolved
+        through the import maps), and bare ``.inc(...)``/``.observe(...)``
+        metric-mutator calls (the heuristic half for sessions reached
+        through attributes the import map cannot see)."""
+        for mod, info in self.traced_functions():
+            def_line = info.node.lineno
+            local = _local_bindings(info.node)
+            for n in self._body_nodes(info):
+                if isinstance(n, ast.Call):
+                    f = n.func
+                    if isinstance(f, ast.Attribute) and f.attr in METRIC_RECORD_ATTRS:
+                        self._emit(
+                            mod, n, "TPU107", SEV_ERROR,
+                            f"metric `.{f.attr}(...)` inside jit-traced "
+                            f"`{info.name}` — Python under trace runs once "
+                            f"per compile, so this records compiles, not "
+                            f"steps; record in the host loop on the step's "
+                            f"existing batched fetch",
+                            def_line=def_line,
+                        )
+                if isinstance(n, ast.Name) and n.id not in local:
+                    tgt = mod.import_symbols.get(n.id)
+                    if tgt and tgt[0].startswith(TELEMETRY_PKG):
+                        self._emit(
+                            mod, n, "TPU107", SEV_ERROR,
+                            f"telemetry symbol `{n.id}` referenced inside "
+                            f"jit-traced `{info.name}` — recording (or even "
+                            f"resolving a session) belongs in host loops "
+                            f"only; under trace it runs once and lies",
+                            def_line=def_line,
+                        )
+                elif isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name):
+                    rel = mod.import_modules.get(n.value.id)
+                    if rel and rel.startswith(TELEMETRY_PKG):
+                        self._emit(
+                            mod, n, "TPU107", SEV_ERROR,
+                            f"telemetry module access "
+                            f"`{n.value.id}.{n.attr}` inside jit-traced "
+                            f"`{info.name}` — recording belongs in host "
+                            f"loops only; under trace it runs once and lies",
+                            def_line=def_line,
+                        )
+
     def rule_pallas_interpret(self):
         for mod in self.modules.values():
             for n in ast.walk(mod.tree):
@@ -505,6 +565,7 @@ class _Linter:
         self.collect_refs()
         self.propagate_traced()
         self.rule_under_trace()
+        self.rule_telemetry_under_trace()
         self.rule_host_sync_census()
         self.rule_pallas_interpret()
         self.rule_mutable_defaults()

@@ -239,6 +239,11 @@ def build_parser() -> argparse.ArgumentParser:
                      help="tensor-capture tap points (modules/tensor_taps)")
     run.add_argument("--tensor-replacement-points", nargs="+", default=None,
                      help="tap points eligible for teacher forcing")
+    run.add_argument("--metrics-out", default=None,
+                     help="enable runtime telemetry and dump the JSON metrics "
+                          "snapshot (bucket census, step counters, token "
+                          "counts) to this path at exit; pretty-print with "
+                          "scripts/metrics_report.py")
     return p
 
 
@@ -551,6 +556,18 @@ def run_inference(args) -> int:
         from neuronx_distributed_inference_tpu.utils.snapshot import enable_debug_logging
 
         enable_debug_logging()
+    metrics_session = metrics_prev = None
+    if args.metrics_out:
+        # a RUN-scoped session over a fresh registry (not the cumulative
+        # process-default): the snapshot must describe THIS invocation, not
+        # whatever else the embedding process ran earlier
+        from neuronx_distributed_inference_tpu.telemetry import (
+            TelemetrySession,
+            tracing as _tel_tracing,
+        )
+
+        metrics_prev = _tel_tracing.default_session()
+        metrics_session = _tel_tracing.set_default_session(TelemetrySession())
     capture_hook = None
     if args.input_capture_save_dir and args.capture_indices and args.capture_indices != ["auto"]:
         from neuronx_distributed_inference_tpu.utils.snapshot import install_input_capture
@@ -582,6 +599,17 @@ def run_inference(args) -> int:
             out = app.generate(input_ids, attention_mask, **gen_kwargs)
     if capture_hook is not None:
         print(f"[inference_demo] captured {len(capture_hook.saved)} input snapshots",
+              file=sys.stderr)
+    if metrics_session is not None:
+        from neuronx_distributed_inference_tpu.telemetry import (
+            tracing as _tel_tracing,
+        )
+
+        with open(args.metrics_out, "w") as f:
+            json.dump(metrics_session.registry.snapshot(), f, indent=2)
+        _tel_tracing.set_default_session(metrics_prev)
+        metrics_session.close()
+        print(f"[inference_demo] metrics snapshot -> {args.metrics_out}",
               file=sys.stderr)
     for i, seq in enumerate(out.sequences):
         text = tok.decode(seq, skip_special_tokens=True) if tok else seq.tolist()

@@ -50,6 +50,7 @@ from neuronx_distributed_inference_tpu.runtime.model_runner import (
     TAG_CONTEXT_ENCODING,
     TAG_TOKEN_GENERATION,
 )
+from neuronx_distributed_inference_tpu.telemetry.tracing import default_session
 from neuronx_distributed_inference_tpu.utils.hf_checkpoint import load_state_dict
 
 
@@ -565,17 +566,24 @@ class TpuModelForCausalLM:
             else None
         )
 
+        tel = default_session()
+
         # --- chunk 0: CTE ---
         n0 = min(C, S_in)
         pos0 = np.tile(np.arange(n0, dtype=np.int32), (B, 1))
-        inputs, _ = self.context_encoding_model.prepare(
-            input_ids[:, :n0], attention_mask[:, :n0], pos0, seq_ids,
-            sampling_params, adapter_ids=adapter_ids,
-        )
-        out = self.context_encoding_model(
-            self.params, self.kv_cache, inputs, self._sample_key(1_000_000)
-        )
+        with tel.span("app.prefill_windowed", tokens=n0):
+            inputs, _ = self.context_encoding_model.prepare(
+                input_ids[:, :n0], attention_mask[:, :n0], pos0, seq_ids,
+                sampling_params, adapter_ids=adapter_ids,
+            )
+            out = self.context_encoding_model(
+                self.params, self.kv_cache, inputs, self._sample_key(1_000_000)
+            )
         self.kv_cache = out.cache
+        tel.step("prefill")
+        tel.bucket_dispatch(
+            self.context_encoding_model.tag, self.context_encoding_model.last_bucket
+        )
         rows = ctx_lens <= n0
         if rows.any():
             # ONE host round-trip for the step: tokens + logits batched into
@@ -609,15 +617,22 @@ class TpuModelForCausalLM:
             # unreachable for valid queries; junk slots are overwritten
             # (write-then-attend) before any query can see them
             mask = np.ones((B, width), np.int32)
-            inputs, _ = self.token_generation_model.prepare(
-                ids, mask, pos, seq_ids, sampling_params, adapter_ids=adapter_ids
-            )
-            # prefill chunks draw from their own key domain so decode chunks
-            # (step 1, 2, ...) never reuse a prefill key
-            out = self.token_generation_model(
-                self.params, self.kv_cache, inputs, self._sample_key(1_000_000 + step)
-            )
+            with tel.span("app.prefill_windowed", tokens=n):
+                inputs, _ = self.token_generation_model.prepare(
+                    ids, mask, pos, seq_ids, sampling_params, adapter_ids=adapter_ids
+                )
+                # prefill chunks draw from their own key domain so decode
+                # chunks (step 1, 2, ...) never reuse a prefill key
+                out = self.token_generation_model(
+                    self.params, self.kv_cache, inputs,
+                    self._sample_key(1_000_000 + step),
+                )
             self.kv_cache = out.cache
+            tel.step("prefill")
+            tel.bucket_dispatch(
+                self.token_generation_model.tag,
+                self.token_generation_model.last_bucket,
+            )
             rows = (ctx_lens > start) & (ctx_lens <= end)
             if rows.any():
                 toks, lg = jax.device_get(
@@ -715,17 +730,21 @@ class TpuModelForCausalLM:
                 attention_mask = (
                     np.arange(width)[None, :] <= position_ids.max(axis=1)[:, None]
                 ).astype(np.int32)
-        inputs, _ = runner.prepare(
-            input_ids,
-            np.asarray(attention_mask),
-            position_ids,
-            seq_ids,
-            np.asarray(sampling_params, np.float32),
-            slot_mapping=slot_mapping,
-            block_table=block_table,
-        )
-        out = runner(self.params, self.kv_cache, inputs, key)
+        tel = default_session()
+        with tel.span(f"app.forward.{phase}", tokens=S):
+            inputs, _ = runner.prepare(
+                input_ids,
+                np.asarray(attention_mask),
+                position_ids,
+                seq_ids,
+                np.asarray(sampling_params, np.float32),
+                slot_mapping=slot_mapping,
+                block_table=block_table,
+            )
+            out = runner(self.params, self.kv_cache, inputs, key)
         self.kv_cache = out.cache
+        tel.step("prefill" if phase == "cte" else "decode")
+        tel.bucket_dispatch(runner.tag, runner.last_bucket)
         # one host round-trip per step: tokens + logits in a single fetch
         tokens, logits = jax.device_get((out.tokens, out.logits))
         tokens = np.asarray(tokens)[:B]
@@ -795,6 +814,7 @@ class TpuModelForCausalLM:
 
         adapter_ids = self.resolve_adapter_ids(lora_adapter_names)
         ctx_lens = attention_mask.sum(axis=1).astype(np.int32)
+        tel = default_session()
         if windowed:
             if inputs_embeds is not None:
                 raise NotImplementedError(
@@ -811,14 +831,20 @@ class TpuModelForCausalLM:
             # CTE: positions are slot indices [0, S) — padded slots write into
             # the masked tail (reference fill_prefix semantics, kvcache/utils.py)
             position_ids = np.tile(np.arange(S_in, dtype=np.int32), (B, 1))
-            inputs, _ = self.context_encoding_model.prepare(
-                input_ids, attention_mask, position_ids, seq_ids, sampling_params,
-                adapter_ids=adapter_ids, inputs_embeds=inputs_embeds,
-            )
-            out = self.context_encoding_model(
-                self.params, self.kv_cache, inputs, self._sample_key(0)
-            )
+            with tel.span("app.cte", tokens=S_in):
+                inputs, _ = self.context_encoding_model.prepare(
+                    input_ids, attention_mask, position_ids, seq_ids, sampling_params,
+                    adapter_ids=adapter_ids, inputs_embeds=inputs_embeds,
+                )
+                out = self.context_encoding_model(
+                    self.params, self.kv_cache, inputs, self._sample_key(0)
+                )
             self.kv_cache = out.cache
+            tel.step("prefill")
+            tel.bucket_dispatch(
+                self.context_encoding_model.tag,
+                self.context_encoding_model.last_bucket,
+            )
             first_tokens = out.tokens[:B]  # device (B, 1)
             first_logits = out.logits[:B] if self.spec.output_logits else None
         pos = ctx_lens.copy()  # next write position per row
@@ -852,19 +878,22 @@ class TpuModelForCausalLM:
                 chunk = _pick_chunk(remaining, False, headroom)
                 take = min(chunk, remaining)
                 bucket = self._decode_bucket(int(pos.max()) + chunk)
-                tokens_c, logits_c, cache = self.token_generation_model.decode_chunk(
-                    self.params,
-                    self.kv_cache,
-                    last,
-                    pos[:, None],
-                    seq_ids,
-                    sampling_params,
-                    self._sample_key(step),
-                    num_steps=chunk,
-                    bucket=bucket,
-                    adapter_ids=adapter_ids,
-                )
+                with tel.span("app.decode_chunk", steps=chunk):
+                    tokens_c, logits_c, cache = self.token_generation_model.decode_chunk(
+                        self.params,
+                        self.kv_cache,
+                        last,
+                        pos[:, None],
+                        seq_ids,
+                        sampling_params,
+                        self._sample_key(step),
+                        num_steps=chunk,
+                        bucket=bucket,
+                        adapter_ids=adapter_ids,
+                    )
                 self.kv_cache = cache
+                tel.step("decode")
+                tel.bucket_dispatch(self.token_generation_model.tag, bucket)
                 token_chunks.append(tokens_c[:B, :take])
                 if self.spec.output_logits:
                     logit_chunks.append(logits_c[:B, :take])
@@ -884,6 +913,7 @@ class TpuModelForCausalLM:
                 )
             )
             gen = np.asarray(gen)
+            tel.tokens_generated(gen.size)
             sequences = np.concatenate([input_ids, gen.astype(np.int64)], axis=1)
             if logits is not None:
                 logits = np.asarray(logits)
@@ -919,19 +949,22 @@ class TpuModelForCausalLM:
             chunk = _pick_chunk(remaining, True, headroom)
             take = min(chunk, remaining)
             bucket = self._decode_bucket(int(pos.max()) + chunk)
-            tokens_c, logits_c, cache = self.token_generation_model.decode_chunk(
-                self.params,
-                self.kv_cache,
-                last,
-                pos[:, None],
-                seq_ids,
-                sampling_params,
-                self._sample_key(step),
-                num_steps=chunk,
-                bucket=bucket,
-                adapter_ids=adapter_ids,
-            )
+            with tel.span("app.decode_chunk", steps=chunk):
+                tokens_c, logits_c, cache = self.token_generation_model.decode_chunk(
+                    self.params,
+                    self.kv_cache,
+                    last,
+                    pos[:, None],
+                    seq_ids,
+                    sampling_params,
+                    self._sample_key(step),
+                    num_steps=chunk,
+                    bucket=bucket,
+                    adapter_ids=adapter_ids,
+                )
             self.kv_cache = cache
+            tel.step("decode")
+            tel.bucket_dispatch(self.token_generation_model.tag, bucket)
             # the chunk boundary must sync anyway to test EOS; riding the
             # logits on the SAME fetch keeps it one round-trip per chunk
             tokens_c, logits_h = jax.device_get(
@@ -954,6 +987,7 @@ class TpuModelForCausalLM:
             step += 1
 
         gen = np.stack(generated, axis=1).astype(np.int64)  # (B, n)
+        tel.tokens_generated(gen.size)
         sequences = np.concatenate([input_ids, gen], axis=1)
         logits = np.concatenate(logits_acc, axis=1) if logits_acc else None
         return GenerationOutput(sequences=sequences, logits=logits, num_generated=gen.shape[1])

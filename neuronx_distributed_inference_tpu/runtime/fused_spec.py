@@ -48,6 +48,7 @@ from neuronx_distributed_inference_tpu.modules.speculation import (
 from neuronx_distributed_inference_tpu.parallel.mesh import mesh_from_config
 from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
 from neuronx_distributed_inference_tpu.runtime.application import GenerationOutput
+from neuronx_distributed_inference_tpu.telemetry.tracing import default_session
 from neuronx_distributed_inference_tpu.utils.hf_checkpoint import load_state_dict
 
 
@@ -195,6 +196,7 @@ class _SpecAppBase:
         self._rng_key, self._call_key = jax.random.split(self._rng_key)
 
         # --- fused CTE ---
+        tel = default_session()
         bucket = get_target_bucket(self.cte_buckets, S_in)
         pad_s = bucket - S_in
         ids_p = np.pad(input_ids, ((0, 0), (0, pad_s)))
@@ -207,8 +209,12 @@ class _SpecAppBase:
             seq_ids=jnp.asarray(seq_ids),
             sampling_params=jnp.asarray(sp, jnp.float32),
         )
-        out = self._call_cte(inputs, self._step_key(0))
+        with tel.span("fused_spec.cte", tokens=S_in):
+            out = self._call_cte(inputs, self._step_key(0))
+        tel.step("prefill")
+        tel.bucket_dispatch("fused_spec_cte", bucket)
         first = np.asarray(jax.device_get(out.tokens))[:, 0]  # (B,)
+        tel.tokens_generated(B)
 
         collected = [[int(first[b])] for b in range(B)]
         done = np.zeros(B, bool)
@@ -229,7 +235,10 @@ class _SpecAppBase:
                 seq_ids=jnp.asarray(seq_ids),
                 sampling_params=jnp.asarray(sp, jnp.float32),
             )
-            out = self._call_tkg(inputs, self._step_key(step))
+            with tel.span("fused_spec.tkg", round=step):
+                out = self._call_tkg(inputs, self._step_key(step))
+            tel.step("speculate")
+            tel.bucket_dispatch("fused_spec_tkg", bucket)
             # one host round-trip per speculation round: tokens + counts in a
             # single batched fetch (tpulint TPU102 pins this count)
             tokens, counts = jax.device_get((out.tokens, out.counts))
@@ -242,7 +251,15 @@ class _SpecAppBase:
                 if eos_token_id is not None and eos_token_id in accepted:
                     accepted = accepted[: accepted.index(eos_token_id) + 1]
                     done[b] = True
-                collected[b].extend(accepted)
+                # cap the host-side commit at the row's remaining budget:
+                # device state (pos advances by counts) is untouched, the
+                # output is byte-identical (rows were truncated to
+                # max_new_tokens at emit anyway), and the acceptance
+                # histogram's sum becomes exactly the committed token count
+                committed = accepted[: max_new_tokens - len(collected[b])]
+                collected[b].extend(committed)
+                tel.spec_accept(len(committed))
+                tel.tokens_generated(len(committed))
                 if len(collected[b]) >= max_new_tokens:
                     done[b] = True
             last = tokens[np.arange(B), counts - 1]

@@ -73,6 +73,10 @@ class SubModelRunner:
         self.mlp_fn = mlp_fn
         self.layer_fn = layer_fn
         self._decode_fns = {}  # (num_steps, bucket) -> jitted multi-step program
+        # telemetry census source: the bucket the LAST prepare()/decode_chunk
+        # resolved to — the host loops record it so the bucket-dispatch
+        # census can never drift from what actually padded/dispatched
+        self.last_bucket: Optional[int] = None
         # retrace guard (analysis/retrace_guard.py): the step fn notes every
         # jit trace; after warmup() the application may seal() the runner so a
         # steady-state retrace raises instead of silently recompiling
@@ -191,6 +195,7 @@ class SubModelRunner:
             if pad_s:
                 attention_mask = np.pad(attention_mask, ((0, 0), (0, pad_s)))
 
+        self.last_bucket = bucket
         if sampling_params is None:
             sampling_params = prepare_sampling_params(B)
         arrs = {
@@ -243,6 +248,7 @@ class SubModelRunner:
         PAGED cache — blocks must be pre-allocated for pos+num_steps."""
         from neuronx_distributed_inference_tpu.models.base import decode_steps
 
+        self.last_bucket = bucket
         B = self.batch_size
         arrs = self._pad_batch(
             {

@@ -44,6 +44,7 @@ from neuronx_distributed_inference_tpu.modules.autobucketing import (
     pow2_bucket,
 )
 from neuronx_distributed_inference_tpu.modules.sampling import prepare_sampling_params
+from neuronx_distributed_inference_tpu.telemetry.tracing import default_session
 
 
 @dataclass
@@ -73,8 +74,15 @@ class Request:
 
 
 class ServingSession:
-    def __init__(self, app):
+    def __init__(self, app, telemetry=None):
+        """``telemetry``: a :class:`~..telemetry.TelemetrySession` observing
+        this session; defaults to the process-default session (inert unless
+        ``telemetry.enable_default_session()`` ran). Recording is host-side
+        bookkeeping riding the fetches the session already performs — the
+        fetch-parity test pins that enabling it adds ZERO device round
+        trips per step."""
         self.app = app
+        self.tel = telemetry if telemetry is not None else default_session()
         tc = app.config.tpu_config
         if not tc.is_continuous_batching:
             raise ValueError("ServingSession requires is_continuous_batching=True")
@@ -120,6 +128,7 @@ class ServingSession:
         # (device tokens (B, 1), [(req, pos_dispatched), ...])
         self._pending = None
         self.async_decode = bool(tc.async_mode)
+        self.tel.pool_gauges(0, self.kv_pool_bytes, self.kv_free_bytes)
 
     @property
     def free_slots(self) -> List[int]:
@@ -152,8 +161,10 @@ class ServingSession:
         eos_token_id: Optional[int] = None,
     ) -> bool:
         """Admit one request into a free KV line. Returns False if full."""
+        self.tel.request_submitted(req_id)
         free = self.free_slots
         if not free:
+            self.tel.request_dropped(req_id, "no_slot")
             return False
         slot = free[0]
         req = Request(
@@ -168,6 +179,7 @@ class ServingSession:
             req.pos = req.prefill_pos
         self.slots[slot] = req
         self.requests[req_id] = req
+        self.tel.request_admitted(req_id, cached_prefix_tokens=req.prefill_pos)
 
         if self.chunked:
             # prompt runs in chunks inside step(); nothing dispatched yet
@@ -177,11 +189,13 @@ class ServingSession:
             ok = self._prefill_chunks([req], req.prompt_len - req.prefill_pos)
             if not ok:
                 self._drop(req)
+                self.tel.request_dropped(req_id, "kv_blocks")
                 return False
             return True
         ok = self._full_prefill(req)
         if not ok:
             self._drop(req)
+            self.tel.request_dropped(req_id, "kv_blocks")
         return ok
 
     def _drop(self, req: Request):
@@ -228,13 +242,19 @@ class ServingSession:
             except RuntimeError:
                 return False  # out of KV blocks
             slot_mapping = self.allocator.slot_mapping(req.slot, np.arange(S))[None, :]
-        inputs, _ = self.app.context_encoding_model.prepare(
-            ids, mask, pos, seq_ids, slot_mapping=slot_mapping
-        )
-        out = self.app.context_encoding_model(
-            self.app.params, self.app.kv_cache, inputs, None
-        )
+        cte = self.app.context_encoding_model
+        with self.tel.span("serving.prefill", req_id=req.req_id, tokens=S):
+            inputs, _ = cte.prepare(
+                ids, mask, pos, seq_ids, slot_mapping=slot_mapping
+            )
+            out = cte(self.app.params, self.app.kv_cache, inputs, None)
         self.app.kv_cache = out.cache
+        self.tel.step("prefill")
+        self.tel.bucket_dispatch(cte.tag, cte.last_bucket)
+        self.tel.prefill_dispatch(req.req_id, S)
+        self.tel.pool_gauges(
+            len(self.active), self.kv_pool_bytes, self.kv_free_bytes
+        )
         first = int(np.asarray(out.tokens)[0, -1])
         req.prefill_pos = S
         self._finish_prefill(req, first)
@@ -268,12 +288,18 @@ class ServingSession:
         n0 = min(C, S)
         ids0 = req.input_ids[None, :n0]
         pos0 = np.arange(n0, dtype=np.int32)[None, :]
-        inputs, _ = app.context_encoding_model.prepare(
-            ids0, np.ones((1, n0), np.int32), pos0,
-            np.array([s], np.int32), prepare_sampling_params(1),
-        )
-        out = app.context_encoding_model(app.params, app.kv_cache, inputs, None)
+        with self.tel.span("serving.prefill_windowed", req_id=req.req_id, tokens=n0):
+            inputs, _ = app.context_encoding_model.prepare(
+                ids0, np.ones((1, n0), np.int32), pos0,
+                np.array([s], np.int32), prepare_sampling_params(1),
+            )
+            out = app.context_encoding_model(app.params, app.kv_cache, inputs, None)
         app.kv_cache = out.cache
+        self.tel.step("prefill")
+        self.tel.bucket_dispatch(
+            app.context_encoding_model.tag, app.context_encoding_model.last_bucket
+        )
+        self.tel.prefill_dispatch(req.req_id, n0)
         # no fetch here: this path only triggers for S > C, so the chunk loop
         # below always runs and the final chunk's token is the one emitted
 
@@ -296,11 +322,19 @@ class ServingSession:
             mask = np.ones((B, width), np.int32)
             seq_ids = np.full((B,), -1, np.int32)
             seq_ids[s] = s
-            inputs, _ = app.token_generation_model.prepare(
-                ids, mask, pos, seq_ids, prepare_sampling_params(B)
-            )
-            out = app.token_generation_model(app.params, app.kv_cache, inputs, None)
+            with self.tel.span(
+                "serving.prefill_windowed", req_id=req.req_id, tokens=n
+            ):
+                inputs, _ = app.token_generation_model.prepare(
+                    ids, mask, pos, seq_ids, prepare_sampling_params(B)
+                )
+                out = app.token_generation_model(app.params, app.kv_cache, inputs, None)
             app.kv_cache = out.cache
+            self.tel.step("prefill")
+            self.tel.bucket_dispatch(
+                app.token_generation_model.tag, app.token_generation_model.last_bucket
+            )
+            self.tel.prefill_dispatch(req.req_id, n)
             start = end
         # ONE host sync for the whole admission: only the last chunk's token
         # at the final prompt position matters
@@ -312,6 +346,7 @@ class ServingSession:
     def _finish_prefill(self, req: Request, first_token: int):
         req.pos = req.prompt_len
         req.generated.append(first_token)
+        self.tel.request_first_token(req.req_id)
         if self.prefix_caching:
             self.allocator.commit_seq(req.slot, req.input_ids)
         if (req.eos_token_id is not None and first_token == req.eos_token_id) or (
@@ -373,14 +408,21 @@ class ServingSession:
             block_table[s] = self.allocator.block_table(s, mb)
             seq_ids[s] = s
 
-        inputs, _ = self.app.token_generation_model.prepare(
-            ids, mask, positions, seq_ids, prepare_sampling_params(B),
-            slot_mapping=slot_mapping, block_table=block_table,
-        )
-        out = self.app.token_generation_model(
-            self.app.params, self.app.kv_cache, inputs, None
-        )
+        tkg = self.app.token_generation_model
+        with self.tel.span("serving.prefill_chunk", rows=len(rows)):
+            inputs, _ = tkg.prepare(
+                ids, mask, positions, seq_ids, prepare_sampling_params(B),
+                slot_mapping=slot_mapping, block_table=block_table,
+            )
+            out = tkg(self.app.params, self.app.kv_cache, inputs, None)
         self.app.kv_cache = out.cache
+        self.tel.step("prefill")
+        self.tel.bucket_dispatch(tkg.tag, tkg.last_bucket)
+        for req, n in rows:
+            self.tel.prefill_dispatch(req.req_id, n)
+        self.tel.pool_gauges(
+            len(self.active), self.kv_pool_bytes, self.kv_free_bytes
+        )
         tokens = np.asarray(out.tokens)
 
         for req, n in rows:
@@ -391,7 +433,24 @@ class ServingSession:
         return True
 
     def _finish(self, req: Request):
+        # _finish can legitimately run twice for one request (a preempted
+        # row's already-dispatched token is consumed one step later and may
+        # hit a termination condition again) — telemetry must count the
+        # FIRST finish only
+        already_finished = req.finished
         req.finished = True
+        if not already_finished:
+            if req.preempted:
+                reason = "preempted"
+            elif (
+                req.eos_token_id is not None
+                and req.generated
+                and req.generated[-1] == req.eos_token_id
+            ):
+                reason = "eos"
+            else:
+                reason = "length"
+            self.tel.request_finished(req.req_id, reason)
         if req.slot >= 0:
             if self.block_mode:
                 self.allocator.free_seq(req.slot)
@@ -530,14 +589,17 @@ class ServingSession:
                 jnp.asarray(ch), pend_tokens.astype(jnp.int32), jnp.asarray(last)
             )
         # inactive rows: mask garbage anyway
-        inputs, _ = self.app.token_generation_model.prepare(
-            last_arr, mask, pos, seq_ids, prepare_sampling_params(B),
-            block_table=block_table,
-        )
-        out = self.app.token_generation_model(
-            self.app.params, self.app.kv_cache, inputs, None
-        )
+        tkg = self.app.token_generation_model
+        with self.tel.span("serving.decode", rows=len(rows)):
+            inputs, _ = tkg.prepare(
+                last_arr, mask, pos, seq_ids, prepare_sampling_params(B),
+                block_table=block_table,
+            )
+            out = tkg(self.app.params, self.app.kv_cache, inputs, None)
         self.app.kv_cache = out.cache
+        self.tel.step("decode")
+        self.tel.bucket_dispatch(tkg.tag, tkg.last_bucket)
+        self.tel.pool_gauges(len(rows), self.kv_pool_bytes, self.kv_free_bytes)
         return out, [(r, p, r.slot) for r, p in rows]
 
     def _consume(self, pend, results: Dict[str, int]):
@@ -552,6 +614,7 @@ class ServingSession:
                 continue  # preempted in an earlier round; row is stale
             tok = int(tokens[slot])
             req.generated.append(tok)
+            self.tel.request_tokens(req.req_id, 1)
             req.pos = p + 1
             results[req.req_id] = tok
             done = (
@@ -687,12 +750,15 @@ class ServingSession:
                         self.step()
                         return
                     break
-            tokens_c, _, cache = self.app.token_generation_model.decode_chunk(
-                self.app.params, self.app.kv_cache, last_dev, pos, seq_ids,
-                prepare_sampling_params(B), None, num_steps=chunk, bucket=bucket,
-                block_table=block_table,
-            )
+            with self.tel.span("serving.decode_chunk", steps=chunk):
+                tokens_c, _, cache = self.app.token_generation_model.decode_chunk(
+                    self.app.params, self.app.kv_cache, last_dev, pos, seq_ids,
+                    prepare_sampling_params(B), None, num_steps=chunk, bucket=bucket,
+                    block_table=block_table,
+                )
             self.app.kv_cache = cache
+            self.tel.step("decode")
+            self.tel.bucket_dispatch(self.app.token_generation_model.tag, bucket)
             take = min(chunk, total - done)
             chunks.append((tokens_c, take))
             last_dev = tokens_c[:, take - 1 : take]
@@ -708,9 +774,13 @@ class ServingSession:
         for r in active:
             n = min(need[r.slot], done)
             r.generated.extend(int(t) for t in toks[r.slot, :n])
+            self.tel.request_tokens(r.req_id, n)
             r.pos += n
             if len(r.generated) >= r.max_new_tokens:
                 self._finish(r)
+        self.tel.pool_gauges(
+            len(self.active), self.kv_pool_bytes, self.kv_free_bytes
+        )
 
     def _decode_chunk_pass(self, chunk: int):
         """One multi-step decode dispatch for all decoding requests — on the
@@ -764,17 +834,23 @@ class ServingSession:
             if block_table is None:
                 self.step()  # pool exhausted: the per-step path preempts
                 return
-        tokens_c, _, cache = self.app.token_generation_model.decode_chunk(
-            self.app.params, self.app.kv_cache, last, pos, seq_ids,
-            prepare_sampling_params(B), None, num_steps=chunk, bucket=bucket,
-            block_table=block_table,
-        )
+        with self.tel.span("serving.decode_chunk", steps=chunk):
+            tokens_c, _, cache = self.app.token_generation_model.decode_chunk(
+                self.app.params, self.app.kv_cache, last, pos, seq_ids,
+                prepare_sampling_params(B), None, num_steps=chunk, bucket=bucket,
+                block_table=block_table,
+            )
         self.app.kv_cache = cache
+        self.tel.step("decode")
+        self.tel.bucket_dispatch(self.app.token_generation_model.tag, bucket)
         toks = np.asarray(tokens_c)  # ONE sync per chunk tokens
         for r in active:
+            n_obs = 0
+            finished = False
             for j in range(take):
                 tok = int(toks[r.slot, j])
                 r.generated.append(tok)
+                n_obs += 1
                 r.pos += 1
                 done = (
                     (r.eos_token_id is not None and tok == r.eos_token_id)
@@ -782,8 +858,14 @@ class ServingSession:
                     or r.pos + 1 >= tc.seq_len
                 )
                 if done:
-                    self._finish(r)
+                    finished = True
                     break
+            self.tel.request_tokens(r.req_id, n_obs)
+            if finished:
+                self._finish(r)
+        self.tel.pool_gauges(
+            len(self.active), self.kv_pool_bytes, self.kv_free_bytes
+        )
 
 
 class SpeculativeServingSession(ServingSession):
@@ -802,8 +884,8 @@ class SpeculativeServingSession(ServingSession):
     reservations per step).
     """
 
-    def __init__(self, app, draft_app, speculation_length: int = 4):
-        super().__init__(app)
+    def __init__(self, app, draft_app, speculation_length: int = 4, telemetry=None):
+        super().__init__(app, telemetry=telemetry)
         tc_d = draft_app.config.tpu_config
         spec = app.spec
         if self.block_mode or self.chunked:
@@ -917,9 +999,16 @@ class SpeculativeServingSession(ServingSession):
         sp = prepare_sampling_params(B)
 
         # --- draft proposes k-1 tokens per row; target verifies all k -------
-        proposals, _ = draft_propose(self.draft, last, pos, seq_ids, sp, k)
-        cand = np.concatenate([last, proposals], axis=1).astype(np.int32)
-        v_out = target_verify(self.app, cand, pos, seq_ids, sp)
+        with self.tel.span("serving.speculate", rows=len(rows)):
+            proposals, _ = draft_propose(self.draft, last, pos, seq_ids, sp, k)
+            cand = np.concatenate([last, proposals], axis=1).astype(np.int32)
+            v_out = target_verify(self.app, cand, pos, seq_ids, sp)
+        self.tel.step("speculate")
+        self.tel.bucket_dispatch(
+            self.app.token_generation_model.tag,
+            self.app.token_generation_model.last_bucket,
+        )
+        self.tel.pool_gauges(len(rows), self.kv_pool_bytes, self.kv_free_bytes)
         greedy = np.asarray(jax.device_get(v_out.tokens))[:B]  # (B, k)
 
         # --- contiguous-match acceptance, per-request bookkeeping -----------
@@ -933,6 +1022,11 @@ class SpeculativeServingSession(ServingSession):
             room = r.max_new_tokens - len(r.generated)
             row = row[:room]
             r.generated.extend(row)
+            # acceptance-length telemetry: committed (post EOS/budget
+            # truncation) tokens this round — the histogram's sum is exactly
+            # the decode tokens speculation delivered for this session
+            self.tel.spec_accept(len(row))
+            self.tel.request_tokens(r.req_id, len(row))
             r.pos += len(row)
             if row:
                 results[r.req_id] = row[-1]

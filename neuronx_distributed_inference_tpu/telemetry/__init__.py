@@ -1,0 +1,42 @@
+"""Runtime telemetry: serving metrics, request-lifecycle tracing, spans.
+
+Host-side, zero-device-round-trip observability (docs/OBSERVABILITY.md):
+recording piggybacks on fetches the runtime already performs; tpulint rule
+TPU107 statically forbids any recording call under a jit trace.
+"""
+
+from neuronx_distributed_inference_tpu.telemetry.metrics import (
+    ACCEPT_LEN_BUCKETS,
+    CHUNK_COUNT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    LATENCY_MS_BUCKETS,
+    MetricsRegistry,
+    default_registry,
+)
+from neuronx_distributed_inference_tpu.telemetry.tracing import (
+    RequestTrace,
+    TelemetrySession,
+    default_session,
+    enable_default_session,
+    load_events,
+    set_default_session,
+)
+
+__all__ = [
+    "ACCEPT_LEN_BUCKETS",
+    "CHUNK_COUNT_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "LATENCY_MS_BUCKETS",
+    "MetricsRegistry",
+    "RequestTrace",
+    "TelemetrySession",
+    "default_registry",
+    "default_session",
+    "enable_default_session",
+    "load_events",
+    "set_default_session",
+]

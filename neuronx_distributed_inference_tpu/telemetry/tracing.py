@@ -1,0 +1,408 @@
+"""Request-lifecycle tracing, step-timeline spans, and the JSONL event log.
+
+One :class:`TelemetrySession` observes one serving session (or one demo/bench
+process via the module default). Everything here is HOST-side bookkeeping:
+
+- **Request lifecycle** — submitted → admitted → (prefill chunks) → first
+  token (TTFT) → per-token decode (ITL) → finished/preempted/dropped. Each
+  request keeps an exact :class:`RequestTrace` (for percentile math) and
+  feeds the fixed-bucket histograms in :mod:`.metrics`.
+- **Step timeline** — :meth:`TelemetrySession.span` wraps each dispatch in a
+  ``jax.profiler.TraceAnnotation`` named scope (visible in XProf host lines
+  next to the device ops it launched) and logs a structured span event.
+- **Event log** — every lifecycle/step event appends one JSON object; with
+  ``jsonl_path`` set they stream to disk for offline replay
+  (:func:`load_events`).
+
+Timing contract (the zero-device-round-trip rule): timestamps are taken with
+``time.perf_counter`` when the HOST observes a value that an already-issued
+fetch returned. Nothing here calls ``device_get``/``block_until_ready`` —
+the fetch-parity test in tests/test_telemetry.py pins that a serving run
+performs the identical number of device fetches with telemetry on and off.
+Two consequences, documented rather than hidden:
+
+- under async 1-ahead decode, a token's timestamp is its *observation* time
+  (one step() late), not its device-completion time;
+- multi-step decode chunks observe N tokens in one fetch, so ITL is
+  amortized — the elapsed time since the previous observation divided by N,
+  observed N times (sum and count stay exact; per-token jitter inside a
+  chunk is invisible by construction, the chunk IS the latency unit there).
+
+The retrace-guard bridge: an enabled session registers a listener with
+``analysis.retrace_guard`` so every jit trace increments
+``nxdi_jit_traces_total{tag}`` and a forbidden post-seal retrace increments
+``nxdi_sealed_retrace_total{tag}`` — steady-state recompiles become an
+operable counter instead of only an assertion.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+
+from neuronx_distributed_inference_tpu.analysis import retrace_guard
+from neuronx_distributed_inference_tpu.telemetry import metrics as metrics_mod
+
+FINISH_REASONS = ("eos", "length", "preempted", "dropped")
+
+
+@dataclass
+class RequestTrace:
+    """Exact per-request lifecycle record (histograms are for fleets;
+    traces are for percentiles and tests)."""
+
+    req_id: str
+    t_submit: float
+    t_admit: Optional[float] = None
+    t_first_dispatch: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_last_token: Optional[float] = None
+    t_finish: Optional[float] = None
+    tokens: int = 0
+    prefill_chunks: int = 0
+    cached_prefix_tokens: int = 0
+    finish_reason: Optional[str] = None
+    itl_s: List[float] = field(default_factory=list)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.t_first_dispatch is None:
+            return None
+        return self.t_first_dispatch - self.t_submit
+
+
+class TelemetrySession:
+    """Metrics + traces + events for one serving session / process.
+
+    ``enabled=False`` builds an inert session: every record method returns
+    immediately, no instruments are created, no retrace listener installs —
+    the disabled path is a handful of attribute loads per call.
+    """
+
+    def __init__(
+        self,
+        registry: Optional[metrics_mod.MetricsRegistry] = None,
+        enabled: bool = True,
+        jsonl_path: Optional[str] = None,
+        clock=time.perf_counter,
+        max_events: int = 10000,
+        max_completed: int = 10000,
+    ):
+        self.enabled = bool(enabled)
+        self.registry = registry if registry is not None else metrics_mod.MetricsRegistry()
+        self.clock = clock
+        self.traces: Dict[str, RequestTrace] = {}
+        # exact traces are for percentiles and tests; the fleet metrics live
+        # in the (bounded) histograms — cap retention so a long-lived
+        # serving process cannot grow trace memory linearly with requests
+        self.completed = deque(maxlen=max_completed)
+        self.events = deque(maxlen=max_events)
+        self._jsonl_path = jsonl_path
+        self._jsonl_file = None
+        self._listener = None
+        if not self.enabled:
+            return
+        r = self.registry
+        self._submitted = r.counter(
+            "nxdi_requests_submitted_total", "requests offered to the session")
+        self._admitted = r.counter(
+            "nxdi_requests_admitted_total", "requests that got a KV line")
+        self._dropped = r.counter(
+            "nxdi_requests_dropped_total",
+            "requests rejected at admission", labels=("reason",))
+        self._preempted = r.counter(
+            "nxdi_requests_preempted_total",
+            "requests evicted mid-stream (KV pool exhausted)")
+        self._finished = r.counter(
+            "nxdi_requests_finished_total", "requests completed",
+            labels=("reason",))
+        self._ttft = r.histogram(
+            "nxdi_ttft_ms", "submit -> first token (host-observed)",
+            buckets=metrics_mod.LATENCY_MS_BUCKETS)
+        self._itl = r.histogram(
+            "nxdi_itl_ms",
+            "inter-token latency (amortized over multi-token fetches)",
+            buckets=metrics_mod.LATENCY_MS_BUCKETS)
+        self._queue_wait = r.histogram(
+            "nxdi_queue_wait_ms", "submit -> first model dispatch",
+            buckets=metrics_mod.LATENCY_MS_BUCKETS)
+        self._chunks_per_req = r.histogram(
+            "nxdi_prefill_chunks_per_request",
+            "prefill passes a request consumed before its first token",
+            buckets=metrics_mod.CHUNK_COUNT_BUCKETS)
+        self._tokens = r.counter(
+            "nxdi_tokens_generated_total", "tokens committed to requests")
+        self._prefill_tokens = r.counter(
+            "nxdi_tokens_prefilled_total", "prompt tokens written to KV")
+        self._steps = r.counter(
+            "nxdi_steps_total", "model dispatches", labels=("kind",))
+        self._bucket = r.counter(
+            "nxdi_bucket_dispatch_total",
+            "compiled-program census: which (model, bucket) served",
+            labels=("model", "bucket"))
+        self._occupancy = r.gauge(
+            "nxdi_batch_occupancy", "live rows in the last decode dispatch")
+        self._kv_pool = r.gauge(
+            "nxdi_kv_pool_bytes", "total paged-pool HBM (cache dtype)")
+        self._kv_free = r.gauge(
+            "nxdi_kv_free_bytes", "free + evictable paged-pool HBM")
+        self._accept = r.histogram(
+            "nxdi_spec_accept_len",
+            "tokens committed per speculation round (sums to committed "
+            "decode tokens)", buckets=metrics_mod.ACCEPT_LEN_BUCKETS)
+        self._jit_traces = r.counter(
+            "nxdi_jit_traces_total", "jit traces observed (compiles)",
+            labels=("tag",))
+        self._sealed_retrace = r.counter(
+            "nxdi_sealed_retrace_total",
+            "forbidden post-seal retraces (steady-state recompiles)",
+            labels=("tag",))
+        if jsonl_path:
+            self._jsonl_file = open(jsonl_path, "a")
+        self._listener = self._on_trace
+        retrace_guard.add_trace_listener(self._listener)
+
+    # ---- lifecycle of the session itself ---------------------------------
+
+    def close(self) -> None:
+        if self._listener is not None:
+            retrace_guard.remove_trace_listener(self._listener)
+            self._listener = None
+        if self._jsonl_file is not None:
+            self._jsonl_file.close()
+            self._jsonl_file = None
+
+    def __enter__(self) -> "TelemetrySession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- event log -------------------------------------------------------
+
+    def event(self, etype: str, **fields) -> None:
+        if not self.enabled:
+            return
+        rec = {"ts": self.clock(), "type": etype, **fields}
+        self.events.append(rec)
+        if self._jsonl_file is not None:
+            self._jsonl_file.write(json.dumps(rec) + "\n")
+            self._jsonl_file.flush()
+
+    @contextmanager
+    def span(self, name: str, **fields):
+        """Named step-timeline scope: a ``jax.profiler.TraceAnnotation`` on
+        the host timeline plus a structured span event. Bounds the HOST-side
+        dispatch (async dispatches return before the device finishes — no
+        sync is forced)."""
+        if not self.enabled:
+            yield
+            return
+        t0 = self.clock()
+        with jax.profiler.TraceAnnotation(name):
+            yield
+        self.event("span", name=name, dur_ms=(self.clock() - t0) * 1e3, **fields)
+
+    # ---- request lifecycle -----------------------------------------------
+
+    def request_submitted(self, req_id: str) -> None:
+        if not self.enabled:
+            return
+        self._submitted.inc()
+        self.traces[req_id] = RequestTrace(req_id=req_id, t_submit=self.clock())
+        self.event("request_submitted", req_id=req_id)
+
+    def request_admitted(self, req_id: str, cached_prefix_tokens: int = 0) -> None:
+        if not self.enabled:
+            return
+        self._admitted.inc()
+        tr = self.traces.get(req_id)
+        if tr is not None:
+            tr.t_admit = self.clock()
+            tr.cached_prefix_tokens = cached_prefix_tokens
+        self.event("request_admitted", req_id=req_id,
+                   cached_prefix_tokens=cached_prefix_tokens)
+
+    def request_dropped(self, req_id: str, reason: str) -> None:
+        if not self.enabled:
+            return
+        self._dropped.child((reason,)).inc()
+        tr = self.traces.pop(req_id, None)
+        if tr is not None:
+            tr.finish_reason = "dropped"
+            tr.t_finish = self.clock()
+            self.completed.append(tr)
+        self.event("request_dropped", req_id=req_id, reason=reason)
+
+    def prefill_dispatch(self, req_id: str, n_tokens: int) -> None:
+        """One prefill pass advanced this request by ``n_tokens`` prompt
+        tokens (whole-prompt CTE counts as one chunk)."""
+        if not self.enabled:
+            return
+        self._prefill_tokens.inc(n_tokens)
+        tr = self.traces.get(req_id)
+        if tr is not None:
+            tr.prefill_chunks += 1
+            if tr.t_first_dispatch is None:
+                tr.t_first_dispatch = self.clock()
+                self._queue_wait.observe((tr.t_first_dispatch - tr.t_submit) * 1e3)
+
+    def request_first_token(self, req_id: str) -> None:
+        if not self.enabled:
+            return
+        now = self.clock()
+        self._tokens.inc()
+        tr = self.traces.get(req_id)
+        if tr is not None:
+            if tr.t_first_dispatch is None:
+                # non-chunked admission: prefill dispatch == first dispatch
+                tr.t_first_dispatch = now
+                self._queue_wait.observe((now - tr.t_submit) * 1e3)
+            tr.t_first_token = tr.t_last_token = now
+            tr.tokens += 1
+            self._ttft.observe((now - tr.t_submit) * 1e3)
+            self._chunks_per_req.observe(max(1, tr.prefill_chunks))
+        self.event("first_token", req_id=req_id)
+
+    def request_tokens(self, req_id: str, n: int) -> None:
+        """``n`` decode tokens observed for this request in one fetch; ITL is
+        the elapsed time since the previous observation amortized over n."""
+        if not self.enabled or n <= 0:
+            return
+        now = self.clock()
+        self._tokens.inc(n)
+        tr = self.traces.get(req_id)
+        if tr is not None and tr.t_last_token is not None:
+            per_tok = (now - tr.t_last_token) / n
+            for _ in range(n):
+                self._itl.observe(per_tok * 1e3)
+                tr.itl_s.append(per_tok)
+            tr.t_last_token = now
+            tr.tokens += n
+
+    def tokens_generated(self, n: int) -> None:
+        """Bare token count for host loops with no request identity
+        (application.generate, the fused-spec loop)."""
+        if not self.enabled or n <= 0:
+            return
+        self._tokens.inc(n)
+
+    def request_finished(self, req_id: str, reason: str = "length") -> None:
+        if not self.enabled:
+            return
+        if reason == "preempted":
+            self._preempted.inc()
+        self._finished.child((reason,)).inc()
+        tr = self.traces.pop(req_id, None)
+        if tr is not None:
+            tr.finish_reason = reason
+            tr.t_finish = self.clock()
+            self.completed.append(tr)
+        self.event("request_finished", req_id=req_id, reason=reason)
+
+    # ---- step-level ------------------------------------------------------
+
+    def step(self, kind: str) -> None:
+        if not self.enabled:
+            return
+        self._steps.child((kind,)).inc()
+
+    def bucket_dispatch(self, model: str, bucket: int) -> None:
+        if not self.enabled:
+            return
+        self._bucket.child((model, str(int(bucket)))).inc()
+
+    def pool_gauges(self, occupancy: int, kv_pool_bytes: int, kv_free_bytes: int) -> None:
+        if not self.enabled:
+            return
+        self._occupancy.set(occupancy)
+        self._kv_pool.set(kv_pool_bytes)
+        self._kv_free.set(kv_free_bytes)
+
+    def spec_accept(self, committed: int) -> None:
+        """One speculation round committed ``committed`` tokens for one
+        request (post EOS/budget truncation — the histogram's sum is exactly
+        the decode tokens speculation delivered)."""
+        if not self.enabled or committed <= 0:
+            return
+        self._accept.observe(committed)
+
+    # ---- retrace-guard bridge --------------------------------------------
+
+    def _on_trace(self, tag: str, sealed: bool) -> None:
+        self._jit_traces.child((tag,)).inc()
+        if sealed:
+            self._sealed_retrace.child((tag,)).inc()
+            self.event("sealed_retrace", tag=tag)
+
+    # ---- summaries -------------------------------------------------------
+
+    def percentile(self, values: List[float], q: float) -> Optional[float]:
+        if not values:
+            return None
+        s = sorted(values)
+        k = min(len(s) - 1, int(round(q * (len(s) - 1))))
+        return s[k]
+
+    def ttft_values_s(self) -> List[float]:
+        return [t.ttft_s for t in self.completed if t.ttft_s is not None]
+
+    def itl_values_s(self) -> List[float]:
+        out: List[float] = []
+        for t in self.completed:
+            out.extend(t.itl_s)
+        return out
+
+
+def load_events(jsonl_path: str) -> List[dict]:
+    """Read a session's JSONL event log back for offline replay."""
+    out = []
+    with open(jsonl_path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out
+
+
+# ---- module default (demo / bench / fused-spec apps) -----------------------
+
+_default_session = TelemetrySession(
+    registry=metrics_mod.default_registry(), enabled=False
+)
+
+
+def default_session() -> TelemetrySession:
+    """The process-default session. Disabled (inert) until
+    :func:`enable_default_session` — ServingSession and the fused-spec host
+    loops record into it when no explicit session is passed."""
+    return _default_session
+
+
+def set_default_session(session: TelemetrySession) -> TelemetrySession:
+    global _default_session
+    _default_session = session
+    return session
+
+
+def enable_default_session(jsonl_path: Optional[str] = None) -> TelemetrySession:
+    """Swap in an ENABLED default session over the process-default registry
+    (idempotent: an already-enabled default is returned as-is)."""
+    global _default_session
+    if not _default_session.enabled:
+        _default_session = TelemetrySession(
+            registry=metrics_mod.default_registry(), jsonl_path=jsonl_path
+        )
+    return _default_session

@@ -54,8 +54,18 @@ def profile_fn(fn: Callable, out_dir: str, n_warmup: int = 1, n_profile: int = 2
 
 
 def _find_xplane(out_dir: str) -> Optional[str]:
-    paths = sorted(glob.glob(os.path.join(out_dir, "**", "*.xplane.pb"), recursive=True))
-    return paths[-1] if paths else None
+    """Newest xplane artifact under ``out_dir`` — plain OR gzipped.
+
+    jax/xprof write ``*.xplane.pb`` or ``*.xplane.pb.gz`` depending on
+    version; ``_parse_xplane_minimal`` already handles gzip, so both must be
+    discoverable. Newest-by-mtime (not lexicographic) so repeated captures
+    into one directory summarize the latest trace."""
+    paths = [
+        p
+        for pat in ("*.xplane.pb", "*.xplane.pb.gz")
+        for p in glob.glob(os.path.join(out_dir, "**", pat), recursive=True)
+    ]
+    return max(paths, key=os.path.getmtime) if paths else None
 
 
 def summarize_trace(out_dir: str, top: int = 25) -> Dict:

@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Pretty-print a telemetry metrics snapshot (``--metrics-out`` JSON).
+
+    python scripts/metrics_report.py metrics.json
+    python scripts/metrics_report.py metrics.json --prometheus   # raw text
+
+Stdlib-only on purpose: the snapshot format is the JSON side of the
+exposition contract (docs/OBSERVABILITY.md), and this script is its
+reference consumer — ``render()`` is imported by the test suite so the
+format cannot drift silently.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List
+
+
+def _labels_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
+
+
+def _hist_quantile(buckets: Dict[str, int], count: int, q: float):
+    """Bucket-resolution quantile from a cumulative {le: count} map."""
+    if not count:
+        return None
+    rank = q * count
+    for le, c in buckets.items():
+        if c >= rank:
+            return le
+    return "+Inf"
+
+
+def render(snapshot: Dict) -> str:
+    """One aligned table per metric kind from a registry snapshot dict."""
+    counters: List[str] = []
+    gauges: List[str] = []
+    hists: List[str] = []
+    for name, fam in sorted(snapshot.items()):
+        kind = fam.get("type")
+        for s in fam.get("samples", []):
+            label = f"{name}{_labels_str(s.get('labels', {}))}"
+            if kind == "counter":
+                counters.append(f"  {label:<64} {s['value']:>14g}")
+            elif kind == "gauge":
+                gauges.append(f"  {label:<64} {s['value']:>14g}")
+            elif kind == "histogram":
+                count = s["count"]
+                mean = (s["sum"] / count) if count else 0.0
+                p50 = _hist_quantile(s["buckets"], count, 0.50)
+                p99 = _hist_quantile(s["buckets"], count, 0.99)
+                hists.append(
+                    f"  {label:<52} n={count:<8} sum={s['sum']:<12.6g} "
+                    f"mean={mean:<10.4g} p50<={p50} p99<={p99}"
+                )
+    out = []
+    if counters:
+        out.append("counters:")
+        out.extend(counters)
+    if gauges:
+        out.append("gauges:")
+        out.extend(gauges)
+    if hists:
+        out.append("histograms (quantiles are bucket upper bounds):")
+        out.extend(hists)
+    if not out:
+        out.append("(empty snapshot)")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("path", help="metrics snapshot JSON (--metrics-out output)")
+    p.add_argument(
+        "--prometheus", action="store_true",
+        help="re-emit as Prometheus text instead of the pretty table",
+    )
+    args = p.parse_args(argv)
+    with open(args.path) as f:
+        snapshot = json.load(f)
+    if args.prometheus:
+        from neuronx_distributed_inference_tpu.telemetry.metrics import (
+            MetricsRegistry,
+        )
+
+        reg = MetricsRegistry()
+        for name, fam in snapshot.items():
+            for s in fam.get("samples", []):
+                lnames = tuple(sorted(s.get("labels", {})))
+                lvals = tuple(s["labels"][k] for k in lnames)
+                if fam["type"] == "counter":
+                    fam_obj = reg.counter(name, fam.get("help", ""), labels=lnames)
+                    (fam_obj.child(lvals) if lnames else fam_obj).inc(s["value"])
+                elif fam["type"] == "gauge":
+                    fam_obj = reg.gauge(name, fam.get("help", ""), labels=lnames)
+                    (fam_obj.child(lvals) if lnames else fam_obj).set(s["value"])
+                # histograms can't round-trip exactly from cumulative counts;
+                # the pretty table is their consumer
+        print(reg.prometheus_text())
+    else:
+        print(render(snapshot))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    sys.exit(main())

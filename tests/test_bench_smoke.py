@@ -44,6 +44,11 @@ def test_bench_suite_tiny(monkeypatch):
             assert p["ttft_ms"] > 0, (name, p)
     assert points["bf16_1b_bs1"]["prefill_tok_s"] > 0
     assert points["serving_1b_int8"]["ttft_p99_ms"] >= points["serving_1b_int8"]["ttft_ms"]
+    # ISSUE 4 satellite: serving TTFT/ITL are sourced from the runtime
+    # telemetry traces; the row and the summary carry both
+    assert points["serving_1b_int8"]["ttft_ms"] > 0
+    assert points["serving_1b_int8"]["itl_ms"] is not None
+    assert points["serving_1b_int8"]["itl_p99_ms"] >= points["serving_1b_int8"]["itl_ms"]
     # emit fired after EVERY point (the incremental-summary contract) and
     # every snapshot produces a valid summary line
     assert len(emitted) == len(ALL_POINTS)
@@ -67,6 +72,18 @@ def test_bench_suite_tiny(monkeypatch):
     assert final["kvq8_8k_tok_s"] > 0 and final["kvq8_16k_tok_s"] > 0
     assert final["kvq8_16k_ttft_ms"] > 0
     assert all(v == "ok" for v in final["points"].values())
+    assert final["serving_itl_p50_ms"] is not None
+    assert final["serving_itl_p99_ms"] is not None
+    # --metrics-out: the tiny suite ran the serving point in-process, so the
+    # process-default registry must hold the full serving metric set
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("r", suffix=".json") as f:
+        bench._dump_metrics(f.name)
+        snap = json.load(open(f.name))
+    assert snap["nxdi_ttft_ms"]["samples"][0]["count"] > 0
+    assert snap["nxdi_itl_ms"]["samples"][0]["count"] > 0
+    assert snap["nxdi_tokens_generated_total"]["samples"][0]["value"] > 0
 
 
 def test_bench_budget_skips_but_parses(monkeypatch):

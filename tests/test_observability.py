@@ -140,6 +140,38 @@ def test_profiler_capture(tmp_path, app):
     )
 
 
+def test_find_xplane_includes_gz_and_picks_newest(tmp_path):
+    """ISSUE 4 satellite: the xplane glob must see gzipped traces
+    (*.xplane.pb.gz — _parse_xplane_minimal already handles gzip) and pick
+    the NEWEST artifact by mtime, not lexicographic order."""
+    from neuronx_distributed_inference_tpu.utils.profiling import (
+        _find_xplane,
+        summarize_trace,
+    )
+
+    d1 = tmp_path / "plugins" / "profile" / "2024_01_01"
+    d2 = tmp_path / "plugins" / "profile" / "2024_01_02"
+    d1.mkdir(parents=True)
+    d2.mkdir(parents=True)
+    old = d1 / "host.xplane.pb"
+    old.write_bytes(b"")
+    new = d2 / "host.xplane.pb.gz"  # gzipped: previously NEVER found
+    import gzip as _gzip
+
+    new.write_bytes(_gzip.compress(b""))
+    os.utime(old, (1_000_000, 1_000_000))
+    os.utime(new, (2_000_000, 2_000_000))
+    assert _find_xplane(str(tmp_path)) == str(new)
+    # the gz artifact parses through the existing gzip-aware reader
+    summary = summarize_trace(str(tmp_path))
+    assert summary == {"total_us": 0.0, "ops": []}
+
+    # newest-by-mtime also holds within one suffix, against lexicographic
+    os.utime(old, (3_000_000, 3_000_000))
+    assert _find_xplane(str(tmp_path)) == str(old)
+    assert _find_xplane(str(tmp_path / "empty-nowhere")) is None
+
+
 def _decode_from_cache(a, history, pos, n_steps):
     """Decode directly off a (reconstructed) cache: re-feed the last history
     token at ITS position (idempotent write) and emit the successors."""

@@ -205,6 +205,44 @@ def test_rule_np_asarray_under_trace_and_pragma(tmp_path):
     assert sum(1 for f in findings if f.rule == "TPU106") == 1
 
 
+def test_rule_telemetry_under_trace(tmp_path):
+    """TPU107: metric recording under a jit trace — both the import-based
+    detector (telemetry symbols) and the mutator heuristic (.inc/.observe)
+    must fire; host-side recording stays clean."""
+    pkg = tmp_path / "neuronx_distributed_inference_tpu"
+    (pkg / "telemetry").mkdir(parents=True)
+    tel_init = pkg / "telemetry" / "__init__.py"
+    tel_init.write_text("def default_session():\n    return None\n")
+    snippet = pkg / "snippet.py"
+    snippet.write_text(
+        textwrap.dedent(
+            """
+            import jax
+            from neuronx_distributed_inference_tpu.telemetry import (
+                default_session,
+            )
+
+            @jax.jit
+            def step(x, m):
+                m.inc(1)                 # BUG: metric mutator under trace
+                tel = default_session()  # BUG: telemetry symbol under trace
+                return x
+
+            def host_loop(x, m):
+                m.inc(1)                 # fine: host side
+                m.observe(2.0)           # fine: host side
+                return default_session()
+            """
+        )
+    )
+    findings = lint_paths([snippet, tel_init], tmp_path)
+    t107 = [f for f in findings if f.rule == "TPU107"]
+    assert len(t107) == 2
+    assert all(f.severity == "error" for f in t107)
+    msgs = " ".join(f.message for f in t107)
+    assert ".inc(...)" in msgs and "default_session" in msgs
+
+
 def test_pragma_suppresses_on_def_line(tmp_path):
     findings = _lint_snippet(
         tmp_path,
