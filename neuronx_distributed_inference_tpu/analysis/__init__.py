@@ -1,15 +1,26 @@
 """Static-analysis subsystem: graph-contract auditing for the AOT stack.
 
-Three cooperating passes, one finding/baseline format, one CLI
-(``python -m neuronx_distributed_inference_tpu.analysis``):
+Cooperating passes, one finding/baseline format, one CLI
+(``python -m neuronx_distributed_inference_tpu.analysis``, parser shared
+with ``scripts/run_static_analysis.py`` via :mod:`.cli`):
 
 - :mod:`.graph_audit` — jaxpr/HLO contract auditor: per sub-model tag ×
   bucket, collective census, dtype discipline, KV-cache donation, and
   bucket skeleton invariance (rules GRAPH2xx).
+- :mod:`.shard_audit` — sharding-contract auditor: realized vs declared
+  PartitionSpec per weight/cache leaf, no replicated cache, no in-loop
+  weight gathers, pinned sharding census (rules GRAPH30x).
+- :mod:`.memory_audit` — HBM memory contracts: the compiled
+  ``input_output_alias`` table must alias every donated cache leaf, and a
+  per-(phase, bucket) footprint model is pinned with a percentage
+  regression gate (rules MEM40x).
+- :mod:`.programs` — the shared harness that traces/lowers/compiles the
+  tiny audit programs ONCE per process for all three graph-level suites.
 - :mod:`.retrace_guard` — trace-time hooks + a context manager that fail
   steady-state recompiles after ``warmup()``.
 - :mod:`.tpulint` — AST rules for host-sync/print/time under trace, Pallas
-  ``interpret`` plumbing, and mutable defaults (rules TPU1xx).
+  ``interpret`` plumbing, mutable defaults, and large unsharded in-graph
+  constants (rules TPU1xx).
 - :mod:`.flag_audit` — no silently-ignored config flags (rule FLAG301).
 
 This module stays import-light (no jax) so the retrace-guard hooks can be

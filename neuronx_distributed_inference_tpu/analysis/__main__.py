@@ -1,101 +1,20 @@
 """CLI: ``python -m neuronx_distributed_inference_tpu.analysis``.
 
-Runs the analysis suites and exits non-zero when any NON-BASELINED finding
-exists. Designed to run on a CPU-only host (``JAX_PLATFORMS=cpu``): the
-graph audit traces tiny tp-sharded models on 8 virtual devices.
-
-    python -m neuronx_distributed_inference_tpu.analysis            # text
-    python -m neuronx_distributed_inference_tpu.analysis --json     # JSON
-    python -m ... --suites lint,flags      # skip the (slower) graph audit
-    python -m ... --write-baseline         # accept current findings/census
+Thin module-entry shim — the parser, suite dispatch and baseline-diff logic
+live in :mod:`.cli`, which ``scripts/run_static_analysis.py`` shares (one
+arg parser, no flag drift between entry points).
 """
 
 from __future__ import annotations
 
-import argparse
-import os
 import sys
-from typing import List
 
-from neuronx_distributed_inference_tpu.analysis import findings as findings_mod
-from neuronx_distributed_inference_tpu.analysis.findings import Baseline, Finding
-
-TPULINT_BASELINE = os.path.join(os.path.dirname(__file__), "tpulint_baseline.json")
-
-ALL_SUITES = ("lint", "flags", "graph")
-
-
-def _prepare_jax_cpu():
-    """Force the CPU backend with 8 virtual devices (idempotent; a no-op if
-    a backend is already initialized by the embedding process)."""
-    flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
-    import jax
-
-    try:
-        jax.config.update("jax_platforms", os.environ.get("JAX_PLATFORMS") or "cpu")
-    except Exception:
-        pass
-
-
-def run_suites(
-    suites: List[str], write_baseline: bool = False
-) -> tuple[List[Finding], List[Finding]]:
-    """Run the requested suites; return (all findings, new findings)."""
-    all_findings: List[Finding] = []
-    baselined: List[Finding] = []  # findings subject to the tpulint baseline
-    unbaselined: List[Finding] = []  # graph/flag findings: always new
-
-    if "lint" in suites:
-        from neuronx_distributed_inference_tpu.analysis import tpulint
-
-        baselined.extend(tpulint.run())
-    if "flags" in suites:
-        from neuronx_distributed_inference_tpu.analysis import flag_audit
-
-        unbaselined.extend(flag_audit.run())
-    if "graph" in suites:
-        _prepare_jax_cpu()
-        from neuronx_distributed_inference_tpu.analysis import graph_audit
-
-        unbaselined.extend(graph_audit.run(write_baseline=write_baseline))
-
-    all_findings = baselined + unbaselined
-    if write_baseline and "lint" in suites:
-        Baseline.from_findings(baselined).save(TPULINT_BASELINE)
-        new = list(unbaselined)
-    else:
-        new = Baseline.load(TPULINT_BASELINE).filter_new(baselined) + unbaselined
-    return all_findings, new
-
-
-def main(argv=None) -> int:
-    parser = argparse.ArgumentParser(
-        prog="python -m neuronx_distributed_inference_tpu.analysis",
-        description="Static-analysis gate: tpulint + flag audit + graph audit",
-    )
-    parser.add_argument("--json", action="store_true", help="JSON report")
-    parser.add_argument(
-        "--suites",
-        default=",".join(ALL_SUITES),
-        help=f"comma list of {ALL_SUITES} (default: all)",
-    )
-    parser.add_argument(
-        "--write-baseline",
-        action="store_true",
-        help="accept current lint findings + graph census as the baseline",
-    )
-    args = parser.parse_args(argv)
-    suites = [s.strip() for s in args.suites.split(",") if s.strip()]
-    unknown = set(suites) - set(ALL_SUITES)
-    if unknown:
-        parser.error(f"unknown suite(s) {sorted(unknown)}; pick from {ALL_SUITES}")
-
-    all_findings, new = run_suites(suites, write_baseline=args.write_baseline)
-    print(findings_mod.render_report(all_findings, new, as_json=args.json, suites=suites))
-    return 1 if new else 0
-
+from neuronx_distributed_inference_tpu.analysis.cli import (  # noqa: F401
+    ALL_SUITES,
+    build_parser,
+    main,
+    run_suites,
+)
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -112,23 +112,31 @@ def render_report(
     new_findings: List[Finding],
     as_json: bool = False,
     suites: Optional[List[str]] = None,
+    extras: Optional[Dict] = None,
+    extras_text: Optional[str] = None,
 ) -> str:
     """Text or JSON report. JSON carries every finding plus the subset that
-    is new (non-baselined); text shows new findings and a summary line."""
+    is new (non-baselined); text shows new findings and a summary line.
+
+    ``extras`` merges suite-specific payloads into the JSON report (e.g. the
+    memory audit's per-bucket HBM breakdown under ``"memory"``);
+    ``extras_text`` is its pre-rendered text-mode counterpart."""
     if as_json:
-        return json.dumps(
-            {
-                "suites": suites or [],
-                "total": len(findings),
-                "new": len(new_findings),
-                "findings": [f.to_dict() for f in findings],
-                "new_findings": [f.to_dict() for f in new_findings],
-            },
-            indent=2,
-        )
+        payload = {
+            "suites": suites or [],
+            "total": len(findings),
+            "new": len(new_findings),
+            "findings": [f.to_dict() for f in findings],
+            "new_findings": [f.to_dict() for f in new_findings],
+        }
+        if extras:
+            payload.update(extras)
+        return json.dumps(payload, indent=2)
     lines = []
     for f in new_findings:
         lines.append(f.render())
+    if extras_text:
+        lines.append(extras_text)
     lines.append(
         f"{len(findings)} finding(s), {len(new_findings)} new (non-baselined)"
         + (f" [suites: {', '.join(suites)}]" if suites else "")

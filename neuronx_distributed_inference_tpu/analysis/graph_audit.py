@@ -19,37 +19,42 @@ hardware) and assert the graph invariants the AOT latency model relies on:
   design; ``cast_logits_fp32`` is outside the scan).
 - **GRAPH204 missing-donation** — KV-cache donation must survive to lowering
   (``tf.aliasing_output`` / ``jax.buffer_donor`` attrs on the cache leaves);
-  otherwise every decode step double-buffers the whole cache.
+  otherwise every decode step double-buffers the whole cache. The memory
+  audit (MEM401, ``memory_audit.py``) carries this further: the COMPILED
+  executable's ``input_output_alias`` table must actually alias every
+  donated cache leaf.
 - **GRAPH205 bucket-skeleton-drift** — the jaxpr equation skeleton (the
   recursive sequence of primitive names) must be identical across buckets of
   one tag: same program, different constants, exactly the frozen-executable
   contract.
 
-Everything runs from ``jax.make_jaxpr``-level tracing plus a CPU compile of
-tiny (2-layer, 64-hidden) models — a few seconds per tag, no device state.
+Program construction (tiny 2-layer models, CPU compile, a few seconds per
+tag) lives in :mod:`.programs` and is SHARED with the shard and memory
+audits — the three suites trace each program family once per process.
 """
 
 from __future__ import annotations
 
 import json
 import pathlib
-import re
 from typing import Dict, List, Optional, Tuple
 
+from neuronx_distributed_inference_tpu.analysis import programs
 from neuronx_distributed_inference_tpu.analysis.findings import (
     Finding,
     SEV_ERROR,
 )
+from neuronx_distributed_inference_tpu.analysis.programs import (  # noqa: F401
+    COLLECTIVE_OPS,
+    TAG_CONTEXT_ENCODING,
+    TAG_CONTEXT_ENCODING_KVQ8,
+    TAG_FUSED_SPECULATION,
+    TAG_TOKEN_GENERATION,
+    TAG_TOKEN_GENERATION_KVQ8,
+    tiny_config as _tiny_config,
+)
 
 BASELINE_PATH = pathlib.Path(__file__).resolve().parent / "graph_baseline.json"
-
-COLLECTIVE_OPS = (
-    "all-reduce",
-    "all-gather",
-    "reduce-scatter",
-    "collective-permute",
-    "all-to-all",
-)
 
 # Files allowed to upcast bf16 -> f32 inside the decode scan: numerically
 # deliberate (fp32 softmax/norm/rope/sampling), mirrored by config flags
@@ -69,74 +74,12 @@ F32_UPCAST_ALLOWLIST = (
     "block_kvcache.py",
 )
 
-TAG_CONTEXT_ENCODING = "context_encoding"
-TAG_TOKEN_GENERATION = "token_generation"
-TAG_FUSED_SPECULATION = "fused_speculation"
-# the same CTE/TKG programs compiled with kv_cache_dtype="int8" — the
-# quantized-cache program set gets its own census/skeleton/dtype contract
-TAG_CONTEXT_ENCODING_KVQ8 = "context_encoding_kvq8"
-TAG_TOKEN_GENERATION_KVQ8 = "token_generation_kvq8"
-
-AUDIT_TAGS = (
-    TAG_CONTEXT_ENCODING,
-    TAG_TOKEN_GENERATION,
-    TAG_FUSED_SPECULATION,
-    TAG_CONTEXT_ENCODING_KVQ8,
-    TAG_TOKEN_GENERATION_KVQ8,
-)
+AUDIT_TAGS = programs.COMMITTED_TAGS
 
 
 # ---------------------------------------------------------------------------
-# tiny audit model
+# jaxpr walks
 # ---------------------------------------------------------------------------
-
-
-def _tiny_hf_attrs(vocab: int = 128) -> dict:
-    return dict(
-        model_type="llama",
-        hidden_size=64,
-        intermediate_size=128,
-        num_attention_heads=4,
-        num_key_value_heads=2,
-        num_hidden_layers=2,
-        vocab_size=vocab,
-        rms_norm_eps=1e-5,
-        rope_theta=10000.0,
-        max_position_embeddings=256,
-        hidden_act="silu",
-        tie_word_embeddings=False,
-    )
-
-
-def _tiny_config(**tpu_overrides):
-    from neuronx_distributed_inference_tpu.config import TpuConfig
-    from neuronx_distributed_inference_tpu.models.llama import LlamaInferenceConfig
-
-    attrs = _tiny_hf_attrs()
-
-    def load_config(cfg):
-        for k, v in attrs.items():
-            setattr(cfg, k, v)
-
-    tc_kwargs = dict(
-        batch_size=2,
-        seq_len=128,
-        dtype="bfloat16",
-        tp_degree=2,
-        context_encoding_buckets=[64, 128],
-        token_generation_buckets=[64, 128],
-    )
-    tc_kwargs.update(tpu_overrides)
-    return LlamaInferenceConfig(TpuConfig(**tc_kwargs), load_config=load_config)
-
-
-def _census(hlo_text: str) -> Dict[str, int]:
-    counts = {}
-    for op in COLLECTIVE_OPS:
-        # ops appear as `%all-reduce.12 = ...` / `all-gather-start`; count
-        # result definitions so fused start/done pairs count once
-        counts[op] = len(re.findall(r"%?" + op + r"(?:-start)?(?:\.\d+)? = ", hlo_text))
-    return counts
 
 
 def _skeleton(jaxpr) -> Tuple:
@@ -182,128 +125,6 @@ def _walk_scan_upcasts(jaxpr, hits: List[Tuple[str, Optional[str]]], in_scan: bo
                 )
 
 
-def _donation_count(lowered_text: str) -> int:
-    return lowered_text.count("tf.aliasing_output") + lowered_text.count(
-        "jax.buffer_donor"
-    )
-
-
-# ---------------------------------------------------------------------------
-# per-tag tracing
-# ---------------------------------------------------------------------------
-
-
-def _audit_causal_lm(kv_quant: bool = False):
-    """Trace/lower/compile the CTE and TKG programs across buckets.
-
-    ``kv_quant``: compile the same programs with kv_cache_dtype="int8"
-    (codes + scale cache leaves; fused quantize/dequantize in the graph).
-
-    Returns {tag: {bucket: (jaxpr, lowered_text, census, donation_count,
-    n_cache_leaves)}}.
-    """
-    import jax
-
-    from neuronx_distributed_inference_tpu.runtime.application import (
-        TpuModelForCausalLM,
-    )
-
-    cfg = _tiny_config(**(dict(kv_cache_dtype="int8") if kv_quant else {}))
-    app = TpuModelForCausalLM(None, cfg)
-    app.load(random_weights=True)
-    results = {}
-    for tag, runner in (
-        (
-            TAG_CONTEXT_ENCODING_KVQ8 if kv_quant else TAG_CONTEXT_ENCODING,
-            app.context_encoding_model,
-        ),
-        (
-            TAG_TOKEN_GENERATION_KVQ8 if kv_quant else TAG_TOKEN_GENERATION,
-            app.token_generation_model,
-        ),
-    ):
-        per_bucket = {}
-        n_cache_leaves = len(jax.tree.leaves(app.kv_cache))
-        for bucket in runner.buckets:
-            inputs = runner.example_inputs(bucket)
-            with jax.set_mesh(app.mesh):
-                traced = runner._fn.trace(app.params, app.kv_cache, inputs, None)
-                lowered = traced.lower()
-                compiled = lowered.compile()
-            lowered_text = lowered.as_text()
-            per_bucket[bucket] = (
-                traced.jaxpr,
-                lowered_text,
-                _census(compiled.as_text()),
-                _donation_count(lowered_text),
-                n_cache_leaves,
-            )
-        results[tag] = per_bucket
-    return results
-
-
-def _audit_fused_spec():
-    """Trace/lower/compile the fused-speculation decode program across ≥2
-    TKG bucket widths (draft chain + target verify in ONE graph)."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
-
-    from neuronx_distributed_inference_tpu.config import (
-        FusedSpecConfig,
-        OnDeviceSamplingConfig,
-    )
-    from neuronx_distributed_inference_tpu.models.base import StepInputs
-    from neuronx_distributed_inference_tpu.modules.sampling import (
-        prepare_sampling_params,
-    )
-    from neuronx_distributed_inference_tpu.runtime.fused_spec import (
-        TpuFusedSpecModelForCausalLM,
-    )
-
-    cfg = _tiny_config(
-        speculation_length=3,
-        enable_fused_speculation=True,
-        on_device_sampling_config=OnDeviceSamplingConfig(do_sample=False),
-    )
-    cfg.fused_spec_config = FusedSpecConfig(
-        draft_model_name="tiny-draft", draft_config=_tiny_config()
-    )
-    app = TpuFusedSpecModelForCausalLM(None, cfg)
-    app.load(random_weights=True)
-
-    B = cfg.tpu_config.batch_size
-    sp = prepare_sampling_params(B)
-    per_bucket = {}
-    n_cache_leaves = len(jax.tree.leaves(app.draft_cache)) + len(
-        jax.tree.leaves(app.target_cache)
-    )
-    for bucket in app.tkg_buckets:
-        inputs = StepInputs(
-            input_ids=jnp.zeros((B, 1), jnp.int32),
-            attention_mask=jnp.zeros((B, bucket), jnp.int32),
-            position_ids=jnp.full((B, 1), 7, jnp.int32),
-            seq_ids=jnp.asarray(np.arange(B, dtype=np.int32)),
-            sampling_params=jnp.asarray(sp, jnp.float32),
-        )
-        with jax.set_mesh(app.mesh):
-            traced = app._tkg_fn.trace(
-                app.draft_params, app.target_params, app.draft_cache,
-                app.target_cache, inputs, None,
-            )
-            lowered = traced.lower()
-            compiled = lowered.compile()
-        lowered_text = lowered.as_text()
-        per_bucket[bucket] = (
-            traced.jaxpr,
-            lowered_text,
-            _census(compiled.as_text()),
-            _donation_count(lowered_text),
-            n_cache_leaves,
-        )
-    return {TAG_FUSED_SPECULATION: per_bucket}
-
-
 # ---------------------------------------------------------------------------
 # entry point
 # ---------------------------------------------------------------------------
@@ -332,14 +153,7 @@ def run(
 ) -> List[Finding]:
     """Run the graph audit over the requested tags; return findings."""
     findings: List[Finding] = []
-    results = {}
-    if TAG_CONTEXT_ENCODING in tags or TAG_TOKEN_GENERATION in tags:
-        results.update(_audit_causal_lm())
-    if TAG_FUSED_SPECULATION in tags:
-        results.update(_audit_fused_spec())
-    if TAG_CONTEXT_ENCODING_KVQ8 in tags or TAG_TOKEN_GENERATION_KVQ8 in tags:
-        results.update(_audit_causal_lm(kv_quant=True))
-    results = {t: results[t] for t in tags if t in results}
+    results = programs.collect_programs(tuple(tags))
 
     baseline = load_census_baseline(baseline_path)
     observed_census: Dict[str, Dict[str, int]] = {}
@@ -348,24 +162,24 @@ def run(
         buckets = sorted(per_bucket)
         # -- GRAPH204 donation ---------------------------------------------
         for bucket in buckets:
-            _, _, _, donated, n_cache = per_bucket[bucket]
-            if donated < n_cache:
+            rec = per_bucket[bucket]
+            if rec.donation_count < rec.n_cache_leaves:
                 findings.append(
                     Finding(
                         rule="GRAPH204",
                         severity=SEV_ERROR,
                         location=f"{tag}/{bucket}",
                         message=(
-                            f"KV-cache donation missing: {donated} aliased/"
-                            f"donor buffers in the lowering, expected ≥ "
-                            f"{n_cache} cache leaves — decode would "
-                            f"double-buffer the cache"
+                            f"KV-cache donation missing: {rec.donation_count} "
+                            f"aliased/donor buffers in the lowering, expected "
+                            f"≥ {rec.n_cache_leaves} cache leaves — decode "
+                            f"would double-buffer the cache"
                         ),
                         key=tag,
                     )
                 )
         # -- GRAPH202/201 census -------------------------------------------
-        censuses = {b: per_bucket[b][2] for b in buckets}
+        censuses = {b: per_bucket[b].census for b in buckets}
         ref_bucket = buckets[0]
         for b in buckets[1:]:
             if censuses[b] != censuses[ref_bucket]:
@@ -409,7 +223,7 @@ def run(
                 )
             )
         # -- GRAPH205 skeleton ---------------------------------------------
-        skels = {b: _skeleton(per_bucket[b][0].jaxpr) for b in buckets}
+        skels = {b: _skeleton(per_bucket[b].jaxpr.jaxpr) for b in buckets}
         for b in buckets[1:]:
             if skels[b] != skels[ref_bucket]:
                 findings.append(
@@ -433,7 +247,7 @@ def run(
             TAG_TOKEN_GENERATION_KVQ8,
         ):
             hits: List[Tuple[str, Optional[str]]] = []
-            _walk_scan_upcasts(per_bucket[ref_bucket][0].jaxpr, hits)
+            _walk_scan_upcasts(per_bucket[ref_bucket].jaxpr.jaxpr, hits)
             for eqn_str, src in hits:
                 base = pathlib.Path(src).name if src else "<unknown>"
                 if src is not None and base in F32_UPCAST_ALLOWLIST:

@@ -1,0 +1,341 @@
+"""HBM memory-contract auditor: donation-alias proof + budget-pinned
+per-(phase, bucket) accounting.
+
+Two silent HBM catastrophes this suite makes loud, both decided from the
+partitioned executables :mod:`.programs` already compiles on CPU:
+
+- **MEM401 donation-alias proof** — ``donate_argnums`` is a REQUEST;
+  aliasing is what the compiler actually grants. The compiled module's
+  ``input_output_alias`` table must contain EVERY donated cache leaf
+  (QuantizedKV code AND scale leaves, across the contiguous, ring-bounded
+  and paged cache variants). A cache leaf missing from the table means the
+  step double-buffers the largest tensor in the system — at 16k context
+  with the quantized cache's 2× block-admission math, that is exactly the
+  OOM the pool accounting promised could not happen.
+- **MEM402 per-bucket HBM accounting** — a static footprint model per
+  (phase, bucket): weight bytes (post-sharding, true dtype including
+  int8/fp8 codes) + cache bytes (codes + scales, the same per-leaf math the
+  serving pool rides) + the executable's largest live temp (XLA's own
+  buffer assignment via ``compiled.memory_analysis()``, with an HLO-text
+  scan fallback). Pinned to ``analysis/memory_baseline.json`` with a
+  percentage regression gate; ``--json`` carries the per-bucket breakdown
+  so bench and docs cite one number.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from neuronx_distributed_inference_tpu.analysis import programs
+from neuronx_distributed_inference_tpu.analysis.findings import (
+    Finding,
+    SEV_ERROR,
+)
+
+BASELINE_PATH = pathlib.Path(__file__).resolve().parent / "memory_baseline.json"
+
+MEMORY_AUDIT_TAGS = programs.ALL_TAGS
+
+#: allowed relative drift per accounting component before MEM402 fires; the
+#: committed baseline may override (``tolerance_pct`` key)
+DEFAULT_TOLERANCE_PCT = 2.0
+
+_COMPONENTS = ("weights_bytes", "cache_bytes", "temp_bytes", "total_bytes")
+
+_ALIAS_ENTRY_RE = re.compile(r"\((\d+),\s*\{[^}]*\},\s*(?:may|must)-alias\)")
+
+#: set by :func:`run` — the per-bucket breakdown the CLI embeds in --json
+_LAST_REPORT: Dict = {}
+
+
+# ---------------------------------------------------------------------------
+# MEM401: donation-alias proof
+# ---------------------------------------------------------------------------
+
+
+def aliased_param_numbers(hlo_text: str) -> Set[int]:
+    """Parameter numbers granted aliasing in a compiled module's
+    ``input_output_alias`` table (brace-matched: the table nests braces for
+    output/parameter tuple indices)."""
+    start = hlo_text.find("input_output_alias={")
+    if start < 0:
+        return set()
+    i = start + len("input_output_alias=")
+    depth = 0
+    end = i
+    for j in range(i, len(hlo_text)):
+        c = hlo_text[j]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                end = j + 1
+                break
+    table = hlo_text[i:end]
+    return {int(m.group(1)) for m in _ALIAS_ENTRY_RE.finditer(table)}
+
+
+def donation_findings(
+    hlo_text: str,
+    cache_param_range: Tuple[int, int],
+    cache_leaf_paths: List[str],
+    location: str,
+    key: str,
+) -> List[Finding]:
+    """MEM401 detector over one compiled module: every flat parameter number
+    in ``cache_param_range`` must appear in the alias table. Standalone so
+    the proven-detector test can feed it a program compiled with donation
+    disabled."""
+    aliased = aliased_param_numbers(hlo_text)
+    lo, hi = cache_param_range
+    missing = [i for i in range(lo, hi) if i not in aliased]
+    if not missing:
+        return []
+    names = [
+        cache_leaf_paths[i - lo] if 0 <= i - lo < len(cache_leaf_paths) else str(i)
+        for i in missing
+    ]
+    return [
+        Finding(
+            rule="MEM401",
+            severity=SEV_ERROR,
+            location=location,
+            message=(
+                f"KV-cache donation does NOT alias: {len(missing)} of "
+                f"{hi - lo} donated cache leaves are absent from the "
+                f"compiled input_output_alias table ({', '.join(names[:6])}"
+                f"{'...' if len(names) > 6 else ''}) — the step "
+                f"double-buffers the cache; check donate_argnums and that "
+                f"the output cache keeps the input's shape/dtype/sharding"
+            ),
+            key=key,
+        )
+    ]
+
+
+def cache_leaf_paths(rec) -> List[str]:
+    """Flat cache leaf paths in HLO parameter order (pytree flatten order),
+    in the same ``programs.path_str`` format the shard census pins."""
+    import jax.tree_util as jtu
+
+    return [
+        programs.path_str(path)
+        for path, _leaf in jtu.tree_flatten_with_path(rec.cache)[0]
+    ]
+
+
+# ---------------------------------------------------------------------------
+# MEM402: static accounting
+# ---------------------------------------------------------------------------
+
+
+def _sharded_bytes(tree, shardings) -> int:
+    """Per-device bytes of a committed tree: each leaf's shard shape under
+    its realized sharding × the TRUE dtype itemsize (int8/fp8 codes count 1
+    byte; fp32 scales count 4)."""
+    import jax.tree_util as jtu
+    import numpy as np
+
+    total = 0
+    for leaf, sh in zip(jtu.tree_leaves(tree), jtu.tree_leaves(shardings)):
+        shard_shape = sh.shard_shape(leaf.shape)
+        total += int(np.prod(shard_shape, dtype=np.int64)) * leaf.dtype.itemsize
+    return int(total)
+
+
+_OP_CALL_RE = re.compile(r"\s[a-z][\w\-]*\(")
+
+
+def _largest_temp_from_hlo(hlo_text: str) -> int:
+    """Fallback temp estimate when ``memory_analysis`` is unavailable: the
+    largest non-parameter RESULT buffer defined in the module (the typed
+    result sits between ``" = "`` and the op-name call; operand types after
+    the op name are someone else's results or parameters and must not
+    count)."""
+    from neuronx_distributed_inference_tpu.analysis.shard_audit import (
+        _max_buffer_bytes,
+    )
+
+    best = 0
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        if " = " not in s or s.startswith("ROOT") or "parameter(" in s:
+            continue
+        rhs = s.split(" = ", 1)[1]
+        m = _OP_CALL_RE.search(rhs)
+        result_part = rhs[: m.start()] if m else rhs
+        best = max(best, _max_buffer_bytes(result_part))
+    return best
+
+
+def temp_bytes(rec) -> Tuple[int, str]:
+    """(largest-live-temp bytes, source) for one compiled program."""
+    try:
+        ma = rec.compiled.memory_analysis()
+        if ma is not None and getattr(ma, "temp_size_in_bytes", None) is not None:
+            return int(ma.temp_size_in_bytes), "memory_analysis"
+    except Exception:
+        pass
+    return _largest_temp_from_hlo(rec.compiled_text), "hlo_scan"
+
+
+def accounting(rec) -> Dict[str, int]:
+    """The static per-device HBM footprint model for one (tag, bucket)."""
+    weights = _sharded_bytes(rec.params, rec.realized_param_shardings)
+    cache = _sharded_bytes(rec.cache, rec.realized_cache_shardings)
+    temp, source = temp_bytes(rec)
+    return {
+        "weights_bytes": weights,
+        "cache_bytes": cache,
+        "temp_bytes": temp,
+        "total_bytes": weights + cache + temp,
+        "temp_source": source,
+    }
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def load_memory_baseline(path: Optional[pathlib.Path] = None) -> Dict:
+    p = path or BASELINE_PATH
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return {}
+
+
+def save_memory_baseline(data: Dict, path: Optional[pathlib.Path] = None):
+    p = path or BASELINE_PATH
+    with open(p, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def last_report() -> Dict:
+    """Per-bucket breakdown of the most recent :func:`run` (what the CLI
+    embeds under ``"memory"`` in --json and renders as the text table)."""
+    return dict(_LAST_REPORT)
+
+
+def render_breakdown(report: Optional[Dict] = None) -> str:
+    """Human-readable per-(tag, bucket) HBM table."""
+    report = report if report is not None else last_report()
+    if not report:
+        return ""
+    lines = [
+        "per-(phase, bucket) HBM accounting (per-device bytes):",
+        f"  {'program':<28} {'bucket':>6} {'weights':>10} {'cache':>10} "
+        f"{'temp':>10} {'total':>11}",
+    ]
+    for tag in sorted(report):
+        for bucket in sorted(report[tag], key=int):
+            row = report[tag][bucket]
+            lines.append(
+                f"  {tag:<28} {bucket:>6} {row['weights_bytes']:>10} "
+                f"{row['cache_bytes']:>10} {row['temp_bytes']:>10} "
+                f"{row['total_bytes']:>11}"
+            )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def run(
+    write_baseline: bool = False,
+    baseline_path: Optional[pathlib.Path] = None,
+    tags: Tuple[str, ...] = MEMORY_AUDIT_TAGS,
+    tolerance_pct: Optional[float] = None,
+) -> List[Finding]:
+    """Run the memory audit over the requested tags; return findings."""
+    global _LAST_REPORT
+    findings: List[Finding] = []
+    results = programs.collect_programs(tuple(tags))
+    baseline = load_memory_baseline(baseline_path)
+    tol = (
+        tolerance_pct
+        if tolerance_pct is not None
+        else float(baseline.get("tolerance_pct", DEFAULT_TOLERANCE_PCT))
+    )
+    base_programs = baseline.get("programs", {})
+    observed: Dict[str, Dict[str, Dict[str, int]]] = {}
+
+    for tag, per_bucket in results.items():
+        observed[tag] = {}
+        for bucket in sorted(per_bucket):
+            rec = per_bucket[bucket]
+            # -- MEM401 ----------------------------------------------------
+            findings.extend(
+                donation_findings(
+                    rec.compiled_text,
+                    rec.cache_param_range,
+                    cache_leaf_paths(rec),
+                    f"{tag}/{bucket}",
+                    tag,
+                )
+            )
+            # -- MEM402 ----------------------------------------------------
+            acct = accounting(rec)
+            observed[tag][str(bucket)] = acct
+            if write_baseline:
+                continue
+            expected = base_programs.get(tag, {}).get(str(bucket))
+            if expected is None:
+                findings.append(
+                    Finding(
+                        rule="MEM402",
+                        severity=SEV_ERROR,
+                        location=f"{tag}/{bucket}",
+                        message=(
+                            f"no committed HBM accounting for ({tag}, "
+                            f"{bucket}) — run --write-baseline and "
+                            f"review/commit memory_baseline.json"
+                        ),
+                        key=tag,
+                    )
+                )
+                continue
+            for comp in _COMPONENTS:
+                old = int(expected.get(comp, 0))
+                new = int(acct[comp])
+                if old == new:
+                    continue
+                pct = abs(new - old) / max(old, 1) * 100.0
+                if pct <= tol:
+                    continue
+                direction = "grew" if new > old else "shrank"
+                findings.append(
+                    Finding(
+                        rule="MEM402",
+                        severity=SEV_ERROR,
+                        location=f"{tag}/{bucket}",
+                        message=(
+                            f"HBM accounting {comp} {direction} "
+                            f"{pct:.1f}% vs baseline ({old} -> {new} bytes, "
+                            f"tolerance {tol}%) — an intentional footprint "
+                            f"change must regenerate memory_baseline.json "
+                            f"(--write-baseline) and the diff reviewed; an "
+                            f"unintentional one is the regression this gate "
+                            f"exists for"
+                        ),
+                        key=tag,
+                    )
+                )
+
+    _LAST_REPORT = observed
+    if write_baseline:
+        merged = dict(load_memory_baseline(baseline_path))
+        merged.setdefault("programs", {})
+        merged["programs"].update(observed)
+        merged["tolerance_pct"] = tol
+        save_memory_baseline(merged, baseline_path)
+    return findings

@@ -1,0 +1,535 @@
+"""Sharding-contract auditor: the realized GSPMD placement of every
+committed program must match the PartitionSpec contract the builder/mesh
+declare.
+
+GSPMD makes the two most expensive sharding bugs SILENT: a tensor-parallel
+weight that loads replicated costs tp_degree× its HBM budget and still
+computes the right numbers; a weight-materializing all-gather inside the
+decode loop body turns a memory bug into a per-token latency bug. Both are
+fully decidable from the partitioned executable we already produce on CPU
+(:mod:`.programs`), checked against the machine-readable declarations
+(``builder.param_pspecs()`` / ``builder.cache_pspecs()`` via
+``TpuModelForCausalLM.declared_pspecs()``):
+
+- **GRAPH301 weight-sharding-mismatch** — every weight leaf's REALIZED input
+  sharding in the compiled executable must be equivalent to the declared
+  PartitionSpec: no silently replicated tp-sharded weights, no unexpectedly
+  sharded replicated leaves (norms, rope tables, the deepseek MLA scale
+  leaves — whose replication is declared, not special-cased).
+- **GRAPH302 cache-sharding** — no cache leaf may diverge from the declared
+  cache spec, no cache-sized (data) leaf may be fully replicated on a >1
+  model-parallel mesh, and the step OUTPUT's cache sharding must equal its
+  input sharding (a per-step cache reshard would defeat donation).
+- **GRAPH303 reshard-in-loop** — no weight-sized all-gather inside the
+  decode step's while body (the collective census counts collectives; this
+  rule adds POSITION: a gather that runs once at entry is setup cost, the
+  same gather inside the loop body re-materializes a weight every token).
+- **GRAPH304 sharding census** — the per-program {leaf-path: spec} census
+  (params + cache + mesh axis sizes) is pinned to
+  ``analysis/shard_baseline.json`` and must not drift without an explicit
+  ``--write-baseline`` regeneration; realized shardings must also be
+  identical across buckets of one tag.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from neuronx_distributed_inference_tpu.analysis import programs
+from neuronx_distributed_inference_tpu.analysis.findings import (
+    Finding,
+    SEV_ERROR,
+)
+
+BASELINE_PATH = pathlib.Path(__file__).resolve().parent / "shard_baseline.json"
+
+SHARD_AUDIT_TAGS = programs.COMMITTED_TAGS
+
+#: floor for the GRAPH303 weight-sized threshold, so a degenerate tiny model
+#: can never classify activation-sized gathers as weights
+MIN_WEIGHT_BYTES = 1024
+
+_HLO_DTYPE_BYTES = {
+    "pred": 1,
+    "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3": 1, "f8e3m4": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+
+# ---------------------------------------------------------------------------
+# pytree helpers
+# ---------------------------------------------------------------------------
+
+
+def _flatten_contract(declared, realized, values):
+    """Zip (leaf path, declared PartitionSpec, realized sharding, value) —
+    PartitionSpec subclasses tuple, so the declared tree flattens with an
+    explicit is_leaf. Returns None on tree-structure mismatch (itself a
+    finding at the call site)."""
+    import jax.tree_util as jtu
+    from jax.sharding import PartitionSpec as P
+
+    decl = jtu.tree_flatten_with_path(
+        declared, is_leaf=lambda x: x is None or isinstance(x, P)
+    )[0]
+    reals = jtu.tree_leaves(realized)
+    vals = jtu.tree_leaves(values)
+    if not (len(decl) == len(reals) == len(vals)):
+        return None
+    return [
+        (programs.path_str(path), spec, real, val)
+        for (path, spec), real, val in zip(decl, reals, vals)
+    ]
+
+
+def _expected(mesh, spec):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    return NamedSharding(mesh, spec if spec is not None else P())
+
+
+def _model_group_size(mesh) -> int:
+    """Devices a single batch row's model state spans (every axis but the
+    whole-model data-parallel one)."""
+    size = 1
+    for name, n in zip(mesh.axis_names, mesh.devices.shape):
+        if name != "ddp":
+            size *= n
+    return size
+
+
+def _spec_str(sharding) -> str:
+    from neuronx_distributed_inference_tpu.parallel.mesh import sharding_str
+
+    return sharding_str(sharding)
+
+
+# ---------------------------------------------------------------------------
+# GRAPH303: in-loop weight gathers
+# ---------------------------------------------------------------------------
+
+
+def _computations(hlo_text: str) -> Dict[str, List[str]]:
+    """Map computation name -> its body lines in a compiled HLO module."""
+    comps: Dict[str, List[str]] = {}
+    cur: Optional[str] = None
+    for line in hlo_text.splitlines():
+        if line and not line.startswith(" ") and line.rstrip().endswith("{") \
+                and not line.startswith("HloModule"):
+            head = line.strip()
+            if head.startswith("ENTRY"):
+                head = head[len("ENTRY"):].strip()
+            cur = head.split("(", 1)[0].strip().lstrip("%").strip()
+            comps[cur] = []
+        elif cur is not None:
+            if line.strip() == "}":
+                cur = None
+            else:
+                comps[cur].append(line)
+    return comps
+
+
+_CALLEE_RE = re.compile(
+    r"(?:calls|to_apply|body|condition|branch_computations=\{)=?%?([\w.\-]+)"
+)
+
+
+def _loop_reachable(comps: Dict[str, List[str]]) -> Set[str]:
+    """Computation names reachable from any while-loop BODY (transitively
+    through calls/fusions) — "inside the decode step loop" for GRAPH303."""
+    bodies: Set[str] = set()
+    for lines in comps.values():
+        for line in lines:
+            m = re.search(r"body=%?([\w.\-]+)", line)
+            if m:
+                bodies.add(m.group(1))
+    seen: Set[str] = set()
+    frontier = [b for b in bodies if b in comps]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for line in comps.get(name, ()):
+            for m in _CALLEE_RE.finditer(line):
+                callee = m.group(1)
+                if callee in comps and callee not in seen:
+                    frontier.append(callee)
+    return seen
+
+
+def _max_buffer_bytes(line: str) -> int:
+    """Largest typed buffer mentioned on an HLO line (result or operand)."""
+    best = 0
+    for m in re.finditer(r"(\w+)\[([0-9,]*)\]", line):
+        dtype, dims = m.group(1), m.group(2)
+        nbytes = _HLO_DTYPE_BYTES.get(dtype)
+        if nbytes is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        best = max(best, n * nbytes)
+    return best
+
+
+def in_loop_gather_findings(
+    hlo_text: str, min_bytes: int, location: str, key: str
+) -> List[Finding]:
+    """GRAPH303 detector over one compiled module's text: weight-sized
+    all-gathers inside while-body-reachable computations. Exposed standalone
+    so the proven-detector test can feed it a deliberately broken program."""
+    findings: List[Finding] = []
+    comps = _computations(hlo_text)
+    in_loop = _loop_reachable(comps)
+    for name in sorted(in_loop):
+        for line in comps[name]:
+            if "all-gather(" not in line and "all-gather-start(" not in line:
+                continue
+            nbytes = _max_buffer_bytes(line)
+            if nbytes < min_bytes:
+                continue
+            findings.append(
+                Finding(
+                    rule="GRAPH303",
+                    severity=SEV_ERROR,
+                    location=location,
+                    message=(
+                        f"weight-materializing all-gather ({nbytes} bytes ≥ "
+                        f"threshold {min_bytes}) INSIDE the step's loop body "
+                        f"(computation {name}) — a weight is re-gathered "
+                        f"every iteration; hoist the reshard out of the loop "
+                        f"or fix the constraint that forces it: "
+                        f"{line.strip()[:120]}"
+                    ),
+                    key=key,
+                )
+            )
+    return findings
+
+
+def weight_gather_threshold(rec) -> int:
+    """Weight-sized byte threshold for GRAPH303: the smallest per-layer full
+    size among the program's tensor-parallel-declared weight leaves (stacked
+    ``layers/...`` leaves divide out their leading L). Anything the loop
+    body gathers at or above this size is weight-shaped, not an
+    activation."""
+    contract = _flatten_contract(
+        rec.declared_param_pspecs, rec.realized_param_shardings, rec.params
+    )
+    best: Optional[int] = None
+    for path, spec, _real, leaf in contract or ():
+        if spec is None or not any(e is not None for e in spec):
+            continue  # replicated leaf: not a tp-sharded weight
+        nbytes = int(leaf.nbytes)
+        if "layers" in path.split("/"):
+            nbytes //= max(1, int(leaf.shape[0]))
+        best = nbytes if best is None else min(best, nbytes)
+    return max(MIN_WEIGHT_BYTES, best or MIN_WEIGHT_BYTES)
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def load_shard_baseline(path: Optional[pathlib.Path] = None) -> Dict:
+    p = path or BASELINE_PATH
+    try:
+        with open(p) as f:
+            return json.load(f).get("census", {})
+    except FileNotFoundError:
+        return {}
+
+
+def save_shard_baseline(census: Dict, path: Optional[pathlib.Path] = None):
+    p = path or BASELINE_PATH
+    with open(p, "w") as f:
+        json.dump({"census": census}, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def _audit_leaves(
+    tag: str,
+    bucket: int,
+    rule: str,
+    kind: str,
+    declared,
+    realized,
+    values,
+    mesh,
+    findings: List[Finding],
+) -> Dict[str, str]:
+    """Shared GRAPH301/302 per-leaf walk. Returns the {path: spec} census
+    fragment for the realized shardings."""
+    contract = _flatten_contract(declared, realized, values)
+    if contract is None:
+        findings.append(
+            Finding(
+                rule=rule,
+                severity=SEV_ERROR,
+                location=f"{tag}/{bucket}",
+                message=(
+                    f"declared {kind} PartitionSpec tree does not match the "
+                    f"committed {kind} tree structure — the declaration "
+                    f"drifted from what load() actually shards"
+                ),
+                key=tag,
+            )
+        )
+        return {}
+    census: Dict[str, str] = {}
+    for path, spec, real, leaf in contract:
+        census[path] = _spec_str(real)
+        exp = _expected(mesh, spec)
+        if real.is_equivalent_to(exp, leaf.ndim):
+            continue
+        declared_sharded = spec is not None and any(e is not None for e in spec)
+        if declared_sharded and real.is_fully_replicated:
+            detail = (
+                f"declared tp-sharded but realized FULLY REPLICATED — this "
+                f"leaf costs {_model_group_size(mesh)}x its budgeted HBM"
+            )
+        elif not declared_sharded and not real.is_fully_replicated:
+            detail = "declared replicated but realized sharded"
+        else:
+            detail = "realized sharding diverges from the declaration"
+        findings.append(
+            Finding(
+                rule=rule,
+                severity=SEV_ERROR,
+                location=f"{tag}/{bucket}",
+                message=(
+                    f"{kind} leaf {path}: {detail} (declared "
+                    f"{_spec_str(_expected(mesh, spec))}, realized "
+                    f"{_spec_str(real)})"
+                ),
+                key=tag,
+            )
+        )
+    return census
+
+
+def cache_replication_findings(
+    declared, realized, values, mesh, location: str, key: str
+) -> List[Finding]:
+    """GRAPH302 catastrophic-replication check: no cache-sized (data) leaf
+    may be fully replicated on a >1 model-parallel mesh — replication
+    multiplies the largest tensor in the system by the group size. Scale
+    leaves ((L, H) floats) are audited by the declared-spec walk; the size
+    gate keeps them out of this check. A leaf whose DECLARED spec is
+    replicated is exempt: that replication is the builder's explicit
+    contract (the deepseek MLA latent streams), already audited by the
+    declared-spec walk — this check is for replication nobody asked for.
+    Standalone so the proven-detector test can feed it a deliberately
+    replicated cache."""
+    group = _model_group_size(mesh)
+    if group <= 1:
+        return []
+    findings: List[Finding] = []
+    cache_leaves = _flatten_contract(declared, realized, values)
+    data_bytes = [int(leaf.nbytes) for _, _, _, leaf in cache_leaves or ()]
+    big = max(data_bytes, default=0) // 4  # data leaves dwarf scales
+    for path, spec, real, leaf in cache_leaves or ():
+        declared_replicated = spec is None or not any(
+            e is not None for e in spec
+        )
+        if declared_replicated:
+            continue
+        if int(leaf.nbytes) >= max(big, 1) and real.is_fully_replicated:
+            findings.append(
+                Finding(
+                    rule="GRAPH302",
+                    severity=SEV_ERROR,
+                    location=location,
+                    message=(
+                        f"cache leaf {path} ({int(leaf.nbytes)} bytes) "
+                        f"is FULLY REPLICATED across the {group}-device "
+                        f"model group — the cache is the largest tensor "
+                        f"in the system; it must shard"
+                    ),
+                    key=key,
+                )
+            )
+    return findings
+
+
+def run(
+    write_baseline: bool = False,
+    baseline_path: Optional[pathlib.Path] = None,
+    tags: Tuple[str, ...] = SHARD_AUDIT_TAGS,
+) -> List[Finding]:
+    """Run the shard audit over the requested tags; return findings."""
+    import jax.tree_util as jtu
+
+    from neuronx_distributed_inference_tpu.parallel.mesh import mesh_axis_sizes
+
+    findings: List[Finding] = []
+    results = programs.collect_programs(tuple(tags))
+    baseline = load_shard_baseline(baseline_path)
+    observed: Dict[str, Dict] = {}
+
+    for tag, per_bucket in results.items():
+        buckets = sorted(per_bucket)
+        ref_bucket = buckets[0]
+        ref = per_bucket[ref_bucket]
+
+        # GRAPH301/302 leaf walks run on EVERY bucket (no extra tracing —
+        # the shardings are already on the compiled records), so a placement
+        # that diverges only at a larger bucket still surfaces at its own
+        # location
+        param_censuses: Dict[int, Dict[str, str]] = {}
+        cache_censuses: Dict[int, Dict[str, str]] = {}
+        for b in buckets:
+            rec = per_bucket[b]
+            param_censuses[b] = _audit_leaves(
+                tag, b, "GRAPH301", "weight",
+                rec.declared_param_pspecs, rec.realized_param_shardings,
+                rec.params, rec.mesh, findings,
+            )
+            cache_censuses[b] = _audit_leaves(
+                tag, b, "GRAPH302", "cache",
+                rec.declared_cache_pspecs, rec.realized_cache_shardings,
+                rec.cache, rec.mesh, findings,
+            )
+            findings.extend(
+                cache_replication_findings(
+                    rec.declared_cache_pspecs, rec.realized_cache_shardings,
+                    rec.cache, rec.mesh, f"{tag}/{b}", tag,
+                )
+            )
+            # GRAPH302: the step output must hand the cache back in the SAME
+            # sharding it came in with (donation aliases the buffers; a
+            # reshard would force a copy every step)
+            if rec.output_cache_shardings is None:
+                continue
+            in_flat = jtu.tree_leaves(rec.realized_cache_shardings)
+            out_flat = jtu.tree_leaves(rec.output_cache_shardings)
+            if len(in_flat) != len(out_flat):
+                continue
+            cache_paths = [
+                p for p, *_ in _flatten_contract(
+                    rec.declared_cache_pspecs,
+                    rec.realized_cache_shardings,
+                    rec.cache,
+                ) or ()
+            ]
+            for path, s_in, s_out, leaf in zip(
+                cache_paths, in_flat, out_flat, jtu.tree_leaves(rec.cache)
+            ):
+                if not s_out.is_equivalent_to(s_in, leaf.ndim):
+                    findings.append(
+                        Finding(
+                            rule="GRAPH302",
+                            severity=SEV_ERROR,
+                            location=f"{tag}/{b}",
+                            message=(
+                                f"cache leaf {path} changes sharding "
+                                f"across the step ({_spec_str(s_in)} in, "
+                                f"{_spec_str(s_out)} out) — donation "
+                                f"cannot alias a resharded buffer"
+                            ),
+                            key=tag,
+                        )
+                    )
+        param_census = param_censuses[ref_bucket]
+        cache_census = cache_censuses[ref_bucket]
+
+        # GRAPH303: decode-phase programs must not re-gather weights in-loop
+        if ref.phase == programs.PHASE_TKG:
+            threshold = weight_gather_threshold(ref)
+            for b in buckets:
+                findings.extend(
+                    in_loop_gather_findings(
+                        per_bucket[b].compiled_text, threshold,
+                        f"{tag}/{b}", tag,
+                    )
+                )
+
+        # GRAPH304: sharding census — identical across buckets, pinned to
+        # the committed baseline
+        tag_census = {
+            "mesh": {k: int(v) for k, v in mesh_axis_sizes(ref.mesh).items()},
+            "params": param_census,
+            "cache": cache_census,
+        }
+        for b in buckets[1:]:
+            if (
+                param_censuses[b] != param_census
+                or cache_censuses[b] != cache_census
+            ):
+                which = (
+                    "weight" if param_censuses[b] != param_census else "cache"
+                )
+                findings.append(
+                    Finding(
+                        rule="GRAPH304",
+                        severity=SEV_ERROR,
+                        location=f"{tag}/{b}",
+                        message=(
+                            f"realized {which} shardings differ between "
+                            f"buckets {ref_bucket} and {b} — buckets must "
+                            f"share one placement"
+                        ),
+                        key=tag,
+                    )
+                )
+        observed[tag] = tag_census
+        expected = None if write_baseline else baseline.get(tag)
+        if expected is not None and expected != tag_census:
+            drift = sorted(
+                k
+                for section in ("params", "cache")
+                for k in (
+                    set(expected.get(section, {})) | set(tag_census[section])
+                )
+                if expected.get(section, {}).get(k)
+                != tag_census[section].get(k)
+            ) or ["mesh"]
+            findings.append(
+                Finding(
+                    rule="GRAPH304",
+                    severity=SEV_ERROR,
+                    location=f"{tag}/{ref_bucket}",
+                    message=(
+                        f"sharding census drifted from shard_baseline.json "
+                        f"(changed leaves: {drift[:6]}"
+                        f"{'...' if len(drift) > 6 else ''}) — regenerate "
+                        f"with --write-baseline only for an intentional "
+                        f"placement change and review the diff"
+                    ),
+                    key=tag,
+                )
+            )
+        elif expected is None and not write_baseline:
+            findings.append(
+                Finding(
+                    rule="GRAPH304",
+                    severity=SEV_ERROR,
+                    location=f"{tag}/{ref_bucket}",
+                    message=(
+                        f"no committed sharding census for tag {tag} — run "
+                        f"--write-baseline and review/commit "
+                        f"shard_baseline.json"
+                    ),
+                    key=tag,
+                )
+            )
+
+    if write_baseline:
+        merged = dict(load_shard_baseline(baseline_path))
+        merged.update(observed)
+        save_shard_baseline(merged, baseline_path)
+    return findings

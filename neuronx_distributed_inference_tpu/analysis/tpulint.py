@@ -34,6 +34,15 @@ The rules encode contracts the runtime relies on but Python cannot enforce:
   step's existing batched fetch already landed; this rule is the static
   half of the zero-device-round-trip telemetry contract
   (docs/OBSERVABILITY.md).
+- **TPU108 large-unsharded-constant** (warning, baselined — zero entries
+  expected): a ``jnp.zeros/ones/full/arange/eye/...`` call with a
+  STATICALLY-known element count ≥ 2**20 inside a jit-traced body, not
+  wrapped in a sharding constraint (``with_sharding_constraint`` /
+  ``constrain`` / ``device_put``). GSPMD replicates unconstrained
+  constants, so a large table materialized in-graph silently costs
+  model-group× its HBM — this catches it at the AST, before the shard
+  audit (GRAPH301/302) ever sees a compile. Census format shared with
+  TPU102 (per-file counts against the committed baseline).
 
 Traced-body detection: a function is *traced* when it is (a) decorated with
 ``jax.jit`` (possibly through ``partial``), (b) referenced anywhere inside a
@@ -73,6 +82,16 @@ NP_SYNC_FUNCS = {"asarray", "array"}
 # catches `self.tel.inc/observe`-style calls the import map cannot resolve)
 TELEMETRY_PKG = PACKAGE + "/telemetry"
 METRIC_RECORD_ATTRS = {"inc", "observe"}
+
+# TPU108: jnp array creators whose result REPLICATES when unconstrained
+# under GSPMD (the *_like variants inherit their prototype's sharding and
+# are exempt), and the element-count threshold above which a replicated
+# constant is an HBM problem worth flagging (2**20 elems = 4 MiB in f32,
+# PER DEVICE, times the model-group size).
+JNP_ARRAY_CREATORS = {"zeros", "ones", "full", "empty", "arange", "eye", "linspace"}
+TPU108_ELEM_THRESHOLD = 1 << 20
+# wrappers that give the fresh array a placement, silencing TPU108
+SHARDING_WRAPPERS = {"with_sharding_constraint", "constrain", "device_put"}
 
 _PRAGMA_RE = re.compile(r"#\s*tpulint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
 
@@ -169,6 +188,68 @@ def _names_in(expr: ast.AST) -> List[ast.AST]:
         elif isinstance(n, ast.Attribute) and isinstance(n.value, ast.Name):
             out.append(n)
     return out
+
+
+def _static_elem_count(call: ast.Call) -> Optional[int]:
+    """Element count of a jnp array-creating call when it is statically
+    decidable from literal arguments (positional OR keyword — a
+    ``jnp.zeros(shape=(4096, 4096))`` is just as provably large); None when
+    shape flows from variables (the conservative direction for a lint: only
+    flag what is PROVABLY large)."""
+    name = call.func.attr if isinstance(call.func, ast.Attribute) else None
+    kwargs = {k.arg: k.value for k in call.keywords if k.arg}
+
+    def arg(pos: int, kw: str):
+        if pos < len(call.args):
+            return call.args[pos]
+        return kwargs.get(kw)
+
+    def _lit_int(node) -> Optional[int]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, int):
+            return node.value
+        return None
+
+    def _shape_count(node) -> Optional[int]:
+        if node is None:
+            return None
+        one = _lit_int(node)
+        if one is not None:
+            return one
+        if isinstance(node, (ast.Tuple, ast.List)):
+            total = 1
+            for el in node.elts:
+                d = _lit_int(el)
+                if d is None:
+                    return None
+                total *= d
+            return total
+        return None
+
+    if name in ("zeros", "ones", "full", "empty"):
+        return _shape_count(arg(0, "shape"))
+    if name == "arange":
+        # arange(stop) / arange(start, stop[, step]) with literal ints
+        nodes = [arg(0, "start"), arg(1, "stop"), arg(2, "step")]
+        vals = [None if n is None else _lit_int(n) for n in nodes]
+        if nodes[0] is None or vals[0] is None:
+            return None
+        if nodes[1] is None:
+            return max(0, vals[0])  # arange(stop)
+        if vals[1] is None:
+            return None
+        step = 1 if nodes[2] is None else vals[2]
+        if not step:
+            return None
+        return max(0, -(-(vals[1] - vals[0]) // step))
+    if name == "eye":
+        n = _lit_int(arg(0, "N")) if arg(0, "N") is not None else None
+        m_node = arg(1, "M")
+        m = _lit_int(m_node) if m_node is not None else n
+        return None if n is None or m is None else n * m
+    if name == "linspace":
+        num_node = arg(2, "num")
+        return 50 if num_node is None else _lit_int(num_node)
+    return None
 
 
 def _is_jit_expr(expr: ast.AST) -> bool:
@@ -522,6 +603,46 @@ class _Linter:
                             def_line=def_line,
                         )
 
+    def rule_large_unsharded_constants(self):
+        """TPU108: statically-sized jnp array creation ≥ the element
+        threshold inside a traced body, with no sharding wrapper anywhere
+        above it in the expression."""
+        for mod, info in self.traced_functions():
+            def_line = info.node.lineno
+            wrapped: Set[int] = set()
+            for n in self._body_nodes(info):
+                if not isinstance(n, ast.Call):
+                    continue
+                f = n.func
+                name = f.attr if isinstance(f, ast.Attribute) else getattr(f, "id", None)
+                if name in SHARDING_WRAPPERS:
+                    for sub in ast.walk(n):
+                        wrapped.add(id(sub))
+            for n in self._body_nodes(info):
+                if not isinstance(n, ast.Call) or id(n) in wrapped:
+                    continue
+                f = n.func
+                if not (
+                    isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "jnp"
+                    and f.attr in JNP_ARRAY_CREATORS
+                ):
+                    continue
+                count = _static_elem_count(n)
+                if count is None or count < TPU108_ELEM_THRESHOLD:
+                    continue
+                self._emit(
+                    mod, n, "TPU108", SEV_WARNING,
+                    f"`jnp.{f.attr}` creates {count} elements inside "
+                    f"jit-traced `{info.name}` with no sharding constraint — "
+                    f"GSPMD replicates unconstrained constants, so this "
+                    f"costs model-group× its HBM; wrap it in "
+                    f"with_sharding_constraint (or build it host-side and "
+                    f"device_put it sharded)",
+                    def_line=def_line,
+                )
+
     def rule_pallas_interpret(self):
         for mod in self.modules.values():
             for n in ast.walk(mod.tree):
@@ -566,6 +687,7 @@ class _Linter:
         self.propagate_traced()
         self.rule_under_trace()
         self.rule_telemetry_under_trace()
+        self.rule_large_unsharded_constants()
         self.rule_host_sync_census()
         self.rule_pallas_interpret()
         self.rule_mutable_defaults()

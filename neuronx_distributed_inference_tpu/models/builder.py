@@ -464,13 +464,25 @@ class DecoderModelBuilder:
         decoder_layer (models/base.py). MLA-style attention overrides this."""
         return None
 
+    def cache_pspecs(self):
+        """Declared PartitionSpec tree for this model's KV cache — the
+        machine-readable sharding contract the static analyzer audits
+        realized programs against (analysis/shard_audit.py GRAPH302).
+        :meth:`init_kv_cache` shards through THIS tree, so declaration and
+        placement cannot drift. Plugins with non-standard cache streams
+        (MLA latent, interleaved ring) override both together."""
+        from neuronx_distributed_inference_tpu.modules.kvcache import cache_spec
+
+        tc = self.config.tpu_config
+        batch_shards = tc.attention_dp_degree * tc.data_parallel_degree
+        return cache_spec(
+            tc.cp_degree > 1, batch_shards > 1, quantized=tc.kv_quantized
+        )
+
     def init_kv_cache(self, mesh):
         """Allocate + shard this model's contiguous KV cache. Plugins with
         non-standard cache streams (MLA latent cache) override."""
-        from neuronx_distributed_inference_tpu.modules.kvcache import (
-            cache_spec,
-            init_cache,
-        )
+        from neuronx_distributed_inference_tpu.modules.kvcache import init_cache
         from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
 
         tc = self.config.tpu_config
@@ -488,8 +500,4 @@ class DecoderModelBuilder:
             dtype=dt,
             dp=batch_shards,
         )
-        return shard_pytree(
-            cache,
-            cache_spec(tc.cp_degree > 1, batch_shards > 1, quantized=tc.kv_quantized),
-            mesh,
-        )
+        return shard_pytree(cache, self.cache_pspecs(), mesh)

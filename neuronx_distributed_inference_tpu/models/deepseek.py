@@ -278,11 +278,22 @@ class DeepseekV3ModelBuilder(DecoderModelBuilder):
 
     # ---- cache: latent stream ------------------------------------------
 
+    def cache_pspecs(self):
+        # single-"head" latent streams replicate over the model axes
+        # (quantized caches carry an extra scale leaf per stream); the shard
+        # auditor reads this declaration, so the replicated-cache exception
+        # for MLA is explicit instead of a special case in the analyzer
+        from neuronx_distributed_inference_tpu.modules.kvcache import KVCache
+
+        if self.config.tpu_config.kv_quantized:
+            from neuronx_distributed_inference_tpu.modules.kvcache import QuantizedKV
+
+            stream = QuantizedKV(data=P(), scale=P())
+            return KVCache(k=stream, v=stream)
+        return KVCache(k=P(), v=P())
+
     def init_kv_cache(self, mesh):
-        from neuronx_distributed_inference_tpu.modules.kvcache import (
-            KVCache,
-            init_cache,
-        )
+        from neuronx_distributed_inference_tpu.modules.kvcache import init_cache
         from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
 
         cfg = self.config
@@ -295,16 +306,7 @@ class DeepseekV3ModelBuilder(DecoderModelBuilder):
             dtype=dt,
             v_heads=1, v_head_dim=cfg.qk_rope_head_dim,  # V stream: rope keys
         )
-        # single-"head" latent streams replicate over the model axes
-        # (quantized caches carry an extra scale leaf per stream)
-        if tc.kv_quantized:
-            from neuronx_distributed_inference_tpu.modules.kvcache import QuantizedKV
-
-            stream = QuantizedKV(data=P(), scale=P())
-            spec = KVCache(k=stream, v=stream)
-        else:
-            spec = KVCache(k=P(), v=P())
-        return shard_pytree(cache, spec, mesh)
+        return shard_pytree(cache, self.cache_pspecs(), mesh)
 
     # ---- params ----------------------------------------------------------
 

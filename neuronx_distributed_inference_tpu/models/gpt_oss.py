@@ -113,13 +113,21 @@ class GptOssModelBuilder(DecoderModelBuilder):
             ring_window=sw if ring else None,
         )
 
+    def cache_pspecs(self):
+        if self.model_spec().ring_window is None:
+            return super().cache_pspecs()
+        from neuronx_distributed_inference_tpu.modules.kvcache import (
+            interleaved_cache_spec,
+        )
+
+        return interleaved_cache_spec()
+
     def init_kv_cache(self, mesh):
         spec = self.model_spec()
         if spec.ring_window is None:
             return super().init_kv_cache(mesh)
         from neuronx_distributed_inference_tpu.modules.kvcache import (
             init_interleaved_cache,
-            interleaved_cache_spec,
         )
         from neuronx_distributed_inference_tpu.parallel.sharding import shard_pytree
 
@@ -144,7 +152,7 @@ class GptOssModelBuilder(DecoderModelBuilder):
             self.head_dim,
             dtype=to_dtype(tc.kv_cache_dtype or tc.dtype),
         )
-        return shard_pytree(cache, interleaved_cache_spec(), mesh)
+        return shard_pytree(cache, self.cache_pspecs(), mesh)
 
     def moe_spec(self) -> MoESpec:
         cfg = self.config

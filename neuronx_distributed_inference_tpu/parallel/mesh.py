@@ -157,3 +157,42 @@ def named_sharding(mesh: Mesh, *spec) -> NamedSharding:
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def pspec_str(spec: Optional[P]) -> str:
+    """Canonical machine-readable serialization of a PartitionSpec.
+
+    The shard-audit census (analysis/shard_audit.py GRAPH304) pins these
+    strings in ``shard_baseline.json``, so the form must be deterministic
+    and insensitive to cosmetic differences: trailing ``None`` entries are
+    trimmed (``P(None, 'tp')`` == ``P(None, 'tp', None)``) and multi-axis
+    entries render as a ``+``-joined group (``('ep','cp','tp')`` ->
+    ``(ep+cp+tp)``). ``None`` serializes as the fully replicated ``P()``."""
+    entries = [] if spec is None else list(spec)
+    while entries and entries[-1] is None:
+        entries.pop()
+
+    def one(e) -> str:
+        if e is None:
+            return "None"
+        if isinstance(e, (tuple, list)):
+            return "(" + "+".join(str(a) for a in e) + ")"
+        return str(e)
+
+    return "P(" + ", ".join(one(e) for e in entries) + ")"
+
+
+def sharding_str(sharding) -> str:
+    """``pspec_str`` of a NamedSharding (the realized-sharding side of the
+    census); non-named shardings fall back to their repr."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return repr(sharding)
+    return pspec_str(spec)
+
+
+def mesh_axis_sizes(mesh: Mesh) -> dict:
+    """Machine-readable ``{axis: size}`` declaration of a mesh — recorded in
+    the shard-audit census so a baseline diff shows WHICH axis layout the
+    pinned specs were committed against."""
+    return dict(zip(mesh.axis_names, mesh.devices.shape))

@@ -116,6 +116,7 @@ class TpuModelForCausalLM:
         # demo run can never poison the real checkpoint's artifact
         self._random_weights = False
         self.kv_cache: Optional[KVCache] = None
+        self._cache_pspecs = None
         self._rng_key = jax.random.PRNGKey(tc.seed)
         self._call_key = self._rng_key
         self.lora_manager = None
@@ -209,6 +210,17 @@ class TpuModelForCausalLM:
         self.init_kv_cache()
         return self
 
+    def declared_pspecs(self):
+        """(param PartitionSpec tree, cache PartitionSpec tree) as committed
+        at load() — the sharding contract the static analyzer audits realized
+        programs against (analysis/shard_audit.py GRAPH301/302). The param
+        tree reflects every load-time transform (quantization scale leaves,
+        LoRA adapters); the cache tree is the builder's declaration (or the
+        block-cache spec for the paged layout)."""
+        if self.params is None or self._cache_pspecs is None:
+            raise RuntimeError("call load() before declared_pspecs()")
+        return self._pspecs, self._cache_pspecs
+
     def init_kv_cache(self):
         tc = self.config.tpu_config
         dt = to_dtype(tc.kv_cache_dtype or tc.dtype)
@@ -242,10 +254,10 @@ class TpuModelForCausalLM:
                 self.spec.attn.head_dim,
                 dtype=dt,
             )
-            self.kv_cache = shard_pytree(
-                cache, block_cache_spec(quantized=tc.kv_quantized), self.mesh
-            )
+            self._cache_pspecs = block_cache_spec(quantized=tc.kv_quantized)
+            self.kv_cache = shard_pytree(cache, self._cache_pspecs, self.mesh)
             return
+        self._cache_pspecs = self.builder.cache_pspecs()
         self.kv_cache = self.builder.init_kv_cache(self.mesh)
 
     def load_lora_adapters(self, adapters=None, dynamic: bool = False):

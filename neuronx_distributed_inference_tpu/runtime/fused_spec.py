@@ -113,6 +113,27 @@ class _SpecAppBase:
         """Arm the retrace guard for the fused CTE/TKG programs."""
         self._sealed = True
 
+    def declared_pspecs(self):
+        """(param PartitionSpec trees, cache PartitionSpec trees), each keyed
+        ``{"draft": ..., "target": ...}`` in the fused program's argument
+        order — the sharding contract the shard/memory audits check the
+        compiled fused-speculation program against."""
+        if self.draft_params is None or getattr(self, "_param_pspecs", None) is None:
+            raise RuntimeError("call load() before declared_pspecs()")
+        return self._param_pspecs, self._cache_pspecs
+
+    def trace_tkg_program(self, inputs, rng=None):
+        """Trace + lower + compile the fused decode program without executing
+        it (the SubModelRunner.trace_program analogue for the fused graph)."""
+        with jax.set_mesh(self.mesh):
+            traced = self._tkg_fn.trace(
+                self.draft_params, self.target_params, self.draft_cache,
+                self.target_cache, inputs, rng,
+            )
+            lowered = traced.lower()
+            compiled = lowered.compile()
+        return traced, lowered, compiled
+
     # subclasses define _make_fns / _call_cte / _call_tkg
 
     def load(
@@ -148,6 +169,10 @@ class _SpecAppBase:
         dt = to_dtype(tc.kv_cache_dtype or tc.dtype)
         # same layout as the model graph's (quantized caches add scale leaves)
         cspec = cache_spec(tc.cp_degree > 1, quantized=tc.kv_quantized)
+        # declared sharding contract for the shard/memory audits — keyed
+        # draft/target in the fused program's argument order
+        self._param_pspecs = {"draft": d_pspecs, "target": t_pspecs}
+        self._cache_pspecs = {"draft": cspec, "target": cspec}
         self.target_cache = shard_pytree(
             init_cache(
                 self.target_spec.num_layers, kv_batch, tc.seq_len,

@@ -218,6 +218,21 @@ class SubModelRunner:
         arrs = self._pad_batch(arrs, self.batch_size)
         return StepInputs(**{k: jnp.asarray(v) for k, v in arrs.items()}), B
 
+    def trace_program(self, params, cache: KVCache, inputs: StepInputs, rng=None):
+        """Trace + lower + compile this runner's step program WITHOUT
+        executing it — the static analyzer's entry point
+        (analysis/programs.py). Returns (traced, lowered, compiled): the
+        jaxpr, the donation-annotated StableHLO, and the partitioned
+        executable whose HLO carries the realized shardings and the
+        ``input_output_alias`` table the shard/memory audits parse. Runs
+        under the runner's mesh so in-graph constraints resolve exactly as
+        they do in :meth:`__call__`."""
+        with jax.set_mesh(self.mesh):
+            traced = self._fn.trace(params, cache, inputs, rng)
+            lowered = traced.lower()
+            compiled = lowered.compile()
+        return traced, lowered, compiled
+
     def __call__(self, params, cache: KVCache, inputs: StepInputs, rng=None):
         """Run one step. Returns StepOutput (tokens/logits device arrays + new cache).
 

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# The pre-PR check: the FULL static-analysis gate (tpulint + flag audit +
+# graph/shard/memory audits) plus the static_analysis pytest subset, as one
+# command with a nonzero exit on ANY finding or test failure.
+#
+#   bash scripts/ci_check.sh            # text reports
+#   bash scripts/ci_check.sh --json     # gate report as JSON
+#
+# Everything runs on a CPU-only host: the traced audits build tiny
+# tp-sharded models on 8 virtual devices (the same GSPMD path hardware
+# takes). After an INTENTIONAL contract change, regenerate baselines with
+#   python scripts/run_static_analysis.py --write-baseline
+# review the printed unified diff, and commit the *.json next to the code.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+case "${XLA_FLAGS:-}" in
+  *xla_force_host_platform_device_count*) ;;
+  *) export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" ;;
+esac
+
+rc=0
+
+echo "== static-analysis gate (lint, flags, graph, shard, memory) =="
+python scripts/run_static_analysis.py "$@" || rc=$?
+
+echo
+echo "== static_analysis pytest subset =="
+python -m pytest tests -q -m static_analysis -p no:cacheprovider || rc=$?
+
+if [ "$rc" -ne 0 ]; then
+  echo "ci_check: FAILED (rc=$rc)" >&2
+else
+  echo "ci_check: OK"
+fi
+exit "$rc"

@@ -7,11 +7,13 @@ so CI configs and humans share one entry point:
     JAX_PLATFORMS=cpu python scripts/run_static_analysis.py [--json]
     python scripts/run_static_analysis.py --suites lint,flags   # no tracing
 
-The graph audit traces tiny tp-sharded models on a CPU mesh — no accelerator
-required; the whole gate fits inside the tier-1 timeout. After an
-INTENTIONAL contract change (a new collective, a new host-sync site),
-regenerate the committed baselines with ``--write-baseline`` and review the
-diff like code.
+The graph/shard/memory audits trace tiny tp-sharded models on a CPU mesh —
+no accelerator required; the whole gate fits inside the tier-1 timeout.
+After an INTENTIONAL contract change (a new collective, a resharded weight,
+a footprint change, a new host-sync site), regenerate the committed
+baselines with ``--write-baseline`` and review the printed unified diff
+like code. ``bash scripts/ci_check.sh`` runs this gate plus the
+static_analysis pytest subset as the one pre-PR command.
 """
 
 import os
@@ -26,7 +28,9 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from neuronx_distributed_inference_tpu.analysis.__main__ import main  # noqa: E402
+# the parser/dispatch is the SAME object the module CLI uses (analysis/cli.py)
+# so --json/--suites/--write-baseline cannot drift between entry points
+from neuronx_distributed_inference_tpu.analysis.cli import main  # noqa: E402
 
 if __name__ == "__main__":
     sys.exit(main())

@@ -1,14 +1,23 @@
 """The static-analysis subsystem analyzing itself and the tree.
 
-Three layers:
+Layers:
 1. fixture snippets with KNOWN violations — every tpulint rule must fire
    (host-sync under jit, print/time under trace, pallas without interpret,
-   mutable defaults, np.asarray under trace) and pragmas must suppress;
+   mutable defaults, np.asarray under trace, large unsharded constants) and
+   pragmas must suppress;
 2. the REAL package must be clean: zero non-baselined tpulint findings,
    zero flag-audit findings, zero graph-audit findings (collective census,
    dtype discipline, KV donation, bucket skeleton invariance across
-   context-encoding / token-generation / fused-speculation × 2 buckets);
-3. the retrace guard must prove steady-state decode performs ZERO recompiles
+   context-encoding / token-generation / fused-speculation × 2 buckets),
+   zero shard-audit findings (realized-vs-declared PartitionSpec per leaf,
+   no replicated cache, no in-loop weight gathers, pinned sharding census)
+   and zero memory-audit findings (donation-alias proof across all three
+   cache variants, pinned per-bucket HBM accounting);
+3. every GRAPH30x/MEM40x rule has a PROVEN detector: a deliberately broken
+   synthetic program (replicated weight, replicated cache, in-loop gather,
+   undonated cache, doctored baseline) the rule must flag — green never
+   means "didn't look";
+4. the retrace guard must prove steady-state decode performs ZERO recompiles
    after warmup — and must catch an induced retrace.
 """
 
@@ -17,6 +26,7 @@ import textwrap
 
 import numpy as np
 import pytest
+from jax.sharding import PartitionSpec as P
 
 from tests.conftest import make_random_hf_state_dict, make_tiny_config
 
@@ -241,6 +251,40 @@ def test_rule_telemetry_under_trace(tmp_path):
     assert all(f.severity == "error" for f in t107)
     msgs = " ".join(f.message for f in t107)
     assert ".inc(...)" in msgs and "default_session" in msgs
+
+
+def test_rule_large_unsharded_constant(tmp_path):
+    """TPU108: a statically-large jnp creation under trace fires; wrapping
+    it in a sharding constraint (or being small / dynamically shaped)
+    silences it."""
+    findings = _lint_snippet(
+        tmp_path,
+        """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x, n):
+            big = jnp.zeros((2048, 1024))                  # BUG: 2M elems, replicated
+            tab = jnp.arange(3000000)                      # BUG: 3M elems
+            kw = jnp.ones(shape=(4096, 1024))              # BUG: kw-form is just as static
+            ok_small = jnp.ones((8, 8))                    # fine: tiny
+            ok_dyn = jnp.zeros((n, 1024))                  # fine: not static
+            ok_wrapped = jax.lax.with_sharding_constraint(
+                jnp.zeros((2048, 1024)), None              # fine: constrained
+            )
+            return x + big[0, 0] + tab[0] + kw[0, 0] + ok_small[0, 0] + ok_dyn[0, 0]
+
+        def host(x):
+            return jnp.zeros((4096, 4096)) + x             # fine: not traced
+        """,
+    )
+    t108 = [f for f in findings if f.rule == "TPU108"]
+    assert len(t108) == 3
+    assert all(f.severity == "warning" for f in t108)
+    assert any("jnp.zeros" in f.message for f in t108)
+    assert any("jnp.arange" in f.message for f in t108)
+    assert any("jnp.ones" in f.message for f in t108)
 
 
 def test_pragma_suppresses_on_def_line(tmp_path):
@@ -487,3 +531,458 @@ def test_cli_main_clean_tree_exits_zero(capsys):
     report = json.loads(out)
     assert report["new"] == 0
     assert report["total"] >= 1  # the pinned host-sync census is visible
+
+
+def test_cli_unknown_suite_errors_nonzero(capsys):
+    """An unknown --suites name must ERROR with the known list — a typo
+    must never select nothing and exit 0 (vacuous green)."""
+    from neuronx_distributed_inference_tpu.analysis.__main__ import main
+
+    with pytest.raises(SystemExit) as exc:
+        main(["--suites", "shardz"])
+    assert exc.value.code not in (0, None)
+    err = capsys.readouterr().err
+    assert "unknown suite" in err
+    for known in ("lint", "flags", "graph", "shard", "memory"):
+        assert known in err
+    # an all-whitespace selection is equally vacuous
+    with pytest.raises(SystemExit) as exc:
+        main(["--suites", " , "])
+    assert exc.value.code not in (0, None)
+
+
+def test_cli_entry_points_share_one_parser():
+    """scripts/run_static_analysis.py and the module CLI must expose the
+    SAME flag surface (the drift this satellite existed to fix)."""
+    import importlib.util
+
+    from neuronx_distributed_inference_tpu.analysis import cli
+    from neuronx_distributed_inference_tpu.analysis.__main__ import (
+        main as module_main,
+    )
+
+    spec = importlib.util.spec_from_file_location(
+        "run_static_analysis",
+        pathlib.Path(__file__).resolve().parents[1]
+        / "scripts" / "run_static_analysis.py",
+    )
+    script = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(script)
+    assert script.main is cli.main
+    assert module_main is cli.main
+    flags = {a.option_strings[0] for a in cli.build_parser()._actions if a.option_strings}
+    assert {"--json", "--suites", "--write-baseline"} <= flags
+
+
+def test_write_baseline_diff_rendering():
+    """--write-baseline prints a reviewable unified diff of every baseline
+    file it rewrote."""
+    from neuronx_distributed_inference_tpu.analysis import cli
+
+    before = {"graph_baseline.json": '{"census": {"a": 1}}\n'}
+    after = {"graph_baseline.json": '{"census": {"a": 2}}\n'}
+    diff = cli.baseline_diffs(before, after)
+    assert "a/analysis/graph_baseline.json" in diff
+    assert '-{"census": {"a": 1}}' in diff
+    assert '+{"census": {"a": 2}}' in diff
+    assert cli.baseline_diffs(before, dict(before)) == ""
+
+
+def test_cli_full_json_schema(capsys):
+    """--json over ALL suites: machine-readable report with suite list,
+    finding records (rule/severity/location with file:line or tag/bucket),
+    and the memory suite's per-bucket HBM breakdown."""
+    from neuronx_distributed_inference_tpu.analysis.__main__ import main
+
+    rc = main(["--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    import json
+
+    report = json.loads(out)
+    assert report["suites"] == ["lint", "flags", "graph", "shard", "memory"]
+    assert report["new"] == 0
+    assert {"total", "findings", "new_findings", "memory"} <= set(report)
+    for f in report["findings"]:
+        assert {"rule", "severity", "location", "message", "key"} <= set(f)
+        assert f["rule"][:3] in ("TPU", "GRA", "MEM", "FLA")
+        # file:line for source rules, tag/bucket for graph rules
+        assert (":" in f["location"]) or ("/" in f["location"])
+    mem = report["memory"]
+    for tag in ("token_generation", "token_generation_ring", "token_generation_paged"):
+        assert tag in mem
+        for bucket, row in mem[tag].items():
+            assert int(bucket) > 0
+            assert {"weights_bytes", "cache_bytes", "temp_bytes", "total_bytes"} <= set(row)
+            assert row["total_bytes"] == (
+                row["weights_bytes"] + row["cache_bytes"] + row["temp_bytes"]
+            )
+
+
+# ---------------------------------------------------------------------------
+# shard audit (GRAPH30x)
+# ---------------------------------------------------------------------------
+
+
+def _toy_sharded_program(weight_spec, cache_spec_p, declared_weight, declared_cache):
+    """Compile a toy (params, cache, x) step on the 8-device CPU mesh with
+    the given REALIZED placements, returning what the shard-audit leaf walk
+    consumes. The declared specs may deliberately disagree."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh, NamedSharding
+
+    mesh = Mesh(mesh_utils.create_device_mesh((8,)), ("tp",))
+    params = {
+        "w": jax.device_put(
+            np.ones((64, 128), np.float32), NamedSharding(mesh, weight_spec)
+        )
+    }
+    cache = {
+        "k": jax.device_put(
+            np.zeros((2, 64, 64), np.float32), NamedSharding(mesh, cache_spec_p)
+        ),
+        "v": jax.device_put(
+            np.zeros((2, 64, 64), np.float32), NamedSharding(mesh, cache_spec_p)
+        ),
+    }
+    x = jax.device_put(np.ones((4, 64), np.float32), NamedSharding(mesh, P()))
+
+    def step(params, cache, x):
+        y = x @ params["w"]
+        new_cache = {k: v + 1.0 for k, v in cache.items()}
+        return y, new_cache
+
+    import neuronx_distributed_inference_tpu  # noqa: F401  (jax.set_mesh shim)
+
+    with jax.set_mesh(mesh):
+        compiled = (
+            jax.jit(step, donate_argnums=(1,)).lower(params, cache, x).compile()
+        )
+    ish = compiled.input_shardings[0]
+    declared_p = {"w": declared_weight}
+    declared_c = {"k": declared_cache, "v": declared_cache}
+    return mesh, params, cache, compiled, ish, declared_p, declared_c
+
+
+def test_shard_audit_clean_and_covers_committed_tags():
+    """The shard auditor over the real programs: zero findings, the
+    committed five-tag set, ≥2 buckets per causal/fused family, and a
+    census whose tp-sharded weights are actually pinned sharded."""
+    from neuronx_distributed_inference_tpu.analysis import programs, shard_audit
+
+    findings = shard_audit.run()
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert set(shard_audit.SHARD_AUDIT_TAGS) == {
+        "context_encoding",
+        "token_generation",
+        "fused_speculation",
+        "context_encoding_kvq8",
+        "token_generation_kvq8",
+    }
+    records = programs.collect_programs(shard_audit.SHARD_AUDIT_TAGS)
+    for tag, per_bucket in records.items():
+        assert len(per_bucket) >= 2, f"{tag}: need ≥2 buckets"
+    baseline = shard_audit.load_shard_baseline()
+    assert set(baseline) == set(shard_audit.SHARD_AUDIT_TAGS)
+    tg = baseline["token_generation"]
+    # a vacuous census (everything replicated) would mean the auditor reads
+    # the wrong executable: the MLP projections must pin as tp-sharded
+    assert "tp" in tg["params"]["layers/mlp/gate_proj/weight"]
+    assert "tp" in tg["cache"]["k"]
+    # the quantized pair pins the scale leaves head-sharded
+    assert "tp" in baseline["token_generation_kvq8"]["cache"]["k/scale"]
+    assert baseline["token_generation"]["mesh"]["tp"] == 2
+
+
+def test_graph301_detects_silently_replicated_weight():
+    """Proven detector: a weight DECLARED tp-sharded but realized fully
+    replicated must produce GRAPH301 with the replication cost spelled
+    out; the matching placement stays clean."""
+    from neuronx_distributed_inference_tpu.analysis import shard_audit
+
+    mesh, params, cache, compiled, ish, declared_p, _ = _toy_sharded_program(
+        weight_spec=P(),  # BUG: loads replicated
+        cache_spec_p=P(None, None, "tp"),
+        declared_weight=P(None, "tp"),  # contract says column-sharded
+        declared_cache=P(None, None, "tp"),
+    )
+    findings = []
+    shard_audit._audit_leaves(
+        "toy", 64, "GRAPH301", "weight", declared_p, ish[0], params, mesh, findings
+    )
+    assert [f.rule for f in findings] == ["GRAPH301"]
+    assert "FULLY REPLICATED" in findings[0].message
+    assert "8x" in findings[0].message
+    # the honest placement is clean
+    mesh, params, cache, compiled, ish, declared_p, _ = _toy_sharded_program(
+        P(None, "tp"), P(None, None, "tp"), P(None, "tp"), P(None, None, "tp")
+    )
+    findings = []
+    shard_audit._audit_leaves(
+        "toy", 64, "GRAPH301", "weight", declared_p, ish[0], params, mesh, findings
+    )
+    assert findings == []
+
+
+def test_graph301_detects_unexpectedly_sharded_replicated_leaf():
+    """The inverse direction: a leaf DECLARED replicated (a norm, an MLA
+    scale) that realizes sharded is equally a contract break."""
+    from neuronx_distributed_inference_tpu.analysis import shard_audit
+
+    mesh, params, cache, compiled, ish, declared_p, _ = _toy_sharded_program(
+        weight_spec=P(None, "tp"),  # realized sharded
+        cache_spec_p=P(None, None, "tp"),
+        declared_weight=P(),  # contract says replicated
+        declared_cache=P(None, None, "tp"),
+    )
+    findings = []
+    shard_audit._audit_leaves(
+        "toy", 64, "GRAPH301", "weight", declared_p, ish[0], params, mesh, findings
+    )
+    assert [f.rule for f in findings] == ["GRAPH301"]
+    assert "declared replicated but realized sharded" in findings[0].message
+
+
+def test_graph302_detects_replicated_cache():
+    """Proven detector: a fully replicated cache-sized leaf on a >1 model
+    group must produce GRAPH302 (the double-HBM catastrophic case), via
+    both the declared-spec walk and the replication check."""
+    from neuronx_distributed_inference_tpu.analysis import shard_audit
+
+    mesh, params, cache, compiled, ish, _, declared_c = _toy_sharded_program(
+        weight_spec=P(None, "tp"),
+        cache_spec_p=P(),  # BUG: cache replicated
+        declared_weight=P(None, "tp"),
+        declared_cache=P(None, None, "tp"),
+    )
+    findings = shard_audit.cache_replication_findings(
+        declared_c, ish[1], cache, mesh, "toy/64", "toy"
+    )
+    assert len(findings) == 2  # k and v
+    assert all(f.rule == "GRAPH302" for f in findings)
+    assert "FULLY REPLICATED" in findings[0].message
+    # sharded cache is clean
+    mesh, params, cache, compiled, ish, _, declared_c = _toy_sharded_program(
+        P(None, "tp"), P(None, None, "tp"), P(None, "tp"), P(None, None, "tp")
+    )
+    assert (
+        shard_audit.cache_replication_findings(
+            declared_c, ish[1], cache, mesh, "toy/64", "toy"
+        )
+        == []
+    )
+    # a DECLARED-replicated cache (the deepseek MLA latent streams) is the
+    # builder's explicit contract, not a silent bug: no finding
+    mesh, params, cache, compiled, ish, _, declared_c = _toy_sharded_program(
+        P(None, "tp"), P(), P(None, "tp"), P()
+    )
+    assert (
+        shard_audit.cache_replication_findings(
+            declared_c, ish[1], cache, mesh, "toy/64", "toy"
+        )
+        == []
+    )
+
+
+def test_graph303_detects_in_loop_weight_gather():
+    """Proven detector: a sharded stacked weight forced replicated INSIDE a
+    scan body compiles to an all-gather in the while loop — GRAPH303 must
+    flag it; the same gather hoisted out of the loop stays clean."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import mesh_utils
+    from jax.sharding import Mesh, NamedSharding
+
+    from neuronx_distributed_inference_tpu.analysis import shard_audit
+
+    mesh = Mesh(mesh_utils.create_device_mesh((8,)), ("tp",))
+    W = jax.device_put(
+        np.ones((4, 256, 256), np.float32), NamedSharding(mesh, P(None, None, "tp"))
+    )
+    x = jax.device_put(np.ones((4, 256), np.float32), NamedSharding(mesh, P()))
+
+    def bad_body(carry, w):
+        wr = jax.lax.with_sharding_constraint(w, NamedSharding(mesh, P()))
+        return jnp.tanh(carry @ wr), None
+
+    def bad_step(x, W):
+        y, _ = jax.lax.scan(bad_body, x, W)
+        return y
+
+    def good_body(carry, w):
+        return jnp.tanh(carry @ w), None
+
+    def good_step(x, W):
+        y, _ = jax.lax.scan(good_body, x, W)
+        return y
+
+    with jax.set_mesh(mesh):
+        bad = jax.jit(bad_step).lower(x, W).compile().as_text()
+        good = jax.jit(good_step).lower(x, W).compile().as_text()
+    threshold = 256 * 256 * 4  # one layer's full weight
+    findings = shard_audit.in_loop_gather_findings(bad, threshold, "toy/64", "toy")
+    assert len(findings) >= 1
+    assert all(f.rule == "GRAPH303" for f in findings)
+    assert "INSIDE the step's loop body" in findings[0].message
+    assert shard_audit.in_loop_gather_findings(good, threshold, "toy/64", "toy") == []
+
+
+def test_graph304_detects_census_drift(tmp_path):
+    """A doctored sharding baseline must produce GRAPH304; a missing tag
+    must demand a reviewed regeneration instead of passing vacuously."""
+    from neuronx_distributed_inference_tpu.analysis import shard_audit
+
+    good = shard_audit.load_shard_baseline()
+    doctored = {t: {k: dict(v) if isinstance(v, dict) else v for k, v in c.items()}
+                for t, c in good.items()}
+    doctored["token_generation"]["params"]["layers/mlp/gate_proj/weight"] = "P()"
+    p = tmp_path / "shard_baseline.json"
+    shard_audit.save_shard_baseline(doctored, p)
+    findings = shard_audit.run(baseline_path=p, tags=("token_generation",))
+    assert any(f.rule == "GRAPH304" and "drifted" in f.message for f in findings)
+    # an absent tag is a finding, not silence
+    findings = shard_audit.run(
+        baseline_path=tmp_path / "empty.json", tags=("token_generation",)
+    )
+    assert any(f.rule == "GRAPH304" and "no committed" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# memory audit (MEM40x)
+# ---------------------------------------------------------------------------
+
+
+def test_memory_audit_clean_and_covers_cache_variants():
+    """The memory auditor over the real programs: zero findings, and the
+    audited tag set covers all three cache variants (contiguous incl. the
+    quantized pair, ring-bounded, paged) — the MEM401 donation-alias proof
+    therefore holds for QuantizedKV code+scale leaves in every variant."""
+    from neuronx_distributed_inference_tpu.analysis import memory_audit, programs
+
+    findings = memory_audit.run()
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert set(memory_audit.MEMORY_AUDIT_TAGS) == {
+        "context_encoding",
+        "token_generation",
+        "fused_speculation",
+        "context_encoding_kvq8",
+        "token_generation_kvq8",
+        "token_generation_ring",
+        "token_generation_paged",
+    }
+    records = programs.collect_programs(memory_audit.MEMORY_AUDIT_TAGS)
+    # the quantized contiguous/ring/paged programs all donate code AND scale
+    # leaves: 4 cache leaves each (k/v × data/scale)
+    for tag in ("token_generation_kvq8", "token_generation_ring", "token_generation_paged"):
+        rec = next(iter(records[tag].values()))
+        assert rec.n_cache_leaves == 4, tag
+        paths = memory_audit.cache_leaf_paths(rec)
+        assert {"k/data", "k/scale", "v/data", "v/scale"} == set(paths)
+        # and the alias table really contains them (the proof MEM401 ran)
+        aliased = memory_audit.aliased_param_numbers(rec.compiled_text)
+        lo, hi = rec.cache_param_range
+        assert set(range(lo, hi)) <= aliased, tag
+    report = memory_audit.last_report()
+    # the quantized cache halves the bf16 cache bytes (plus small scales)
+    bf16 = report["token_generation"]["64"]["cache_bytes"]
+    q8 = report["token_generation_kvq8"]["64"]["cache_bytes"]
+    assert q8 < 0.6 * bf16
+
+
+def test_mem401_detects_undonated_cache():
+    """Proven detector: the SAME step compiled without donate_argnums has no
+    alias-table entries for the cache leaves — MEM401 must fail loudly on
+    the double-buffer case, and pass on the donated compile."""
+    import jax
+    import numpy as np
+
+    from neuronx_distributed_inference_tpu.analysis import memory_audit
+
+    params = {"w": np.ones((128, 128), np.float32)}
+    cache = {"k": np.zeros((2, 64, 128), np.float32),
+             "v": np.zeros((2, 64, 128), np.float32)}
+    x = np.ones((4, 128), np.float32)
+
+    def step(params, cache, x):
+        y = x @ params["w"]
+        return y, {k: v + 1.0 for k, v in cache.items()}
+
+    donated = jax.jit(step, donate_argnums=(1,)).lower(params, cache, x).compile()
+    undonated = jax.jit(step).lower(params, cache, x).compile()
+    cache_range = (1, 3)  # flat args: w, k, v, x
+    paths = ["k", "v"]
+    assert (
+        memory_audit.donation_findings(
+            donated.as_text(), cache_range, paths, "toy/64", "toy"
+        )
+        == []
+    )
+    findings = memory_audit.donation_findings(
+        undonated.as_text(), cache_range, paths, "toy/64", "toy"
+    )
+    assert len(findings) == 1
+    assert findings[0].rule == "MEM401"
+    assert "double-buffers" in findings[0].message
+    assert "k" in findings[0].message and "v" in findings[0].message
+
+
+def test_mem402_hlo_temp_fallback_reads_result_buffers():
+    """The memory_analysis fallback must size RESULT buffers (between ' = '
+    and the op call), not operands or parameters — the LHS carries no type
+    at all."""
+    from neuronx_distributed_inference_tpu.analysis import memory_audit
+
+    hlo = "\n".join(
+        [
+            "ENTRY %main (p.0: f32[512,512]) -> f32[64,64] {",
+            "  %p.0 = f32[512,512]{1,0} parameter(0)",  # param: excluded
+            "  %big = f32[128,128]{1,0} add(f32[512,512] %p.0, f32[512,512] %p.0)",
+            "  %small = bf16[8,8]{1,0} multiply(bf16[8,8] %x, bf16[8,8] %x)",
+            "  ROOT %out = f32[64,64]{1,0} tuple(f32[64,64] %y)",  # ROOT: excluded
+            "}",
+        ]
+    )
+    # 128*128*4 from %big's RESULT — not 512*512*4 from its operands
+    assert memory_audit._largest_temp_from_hlo(hlo) == 128 * 128 * 4
+
+
+def test_mem402_detects_footprint_regression(tmp_path):
+    """Proven detector: a doctored baseline (committed footprint 25% below
+    what the tree builds) must produce MEM402 with the component and
+    percentage; within-tolerance drift stays green; a missing bucket is a
+    finding, not silence."""
+    import json
+
+    from neuronx_distributed_inference_tpu.analysis import memory_audit
+
+    good = memory_audit.load_memory_baseline()
+    doctored = json.loads(json.dumps(good))  # deep copy
+    row = doctored["programs"]["token_generation"]["64"]
+    shrunk = dict(row)
+    shrunk["cache_bytes"] = int(row["cache_bytes"] * 0.75)
+    shrunk["total_bytes"] = (
+        shrunk["weights_bytes"] + shrunk["cache_bytes"] + shrunk["temp_bytes"]
+    )
+    doctored["programs"]["token_generation"]["64"] = shrunk
+    p = tmp_path / "memory_baseline.json"
+    memory_audit.save_memory_baseline(doctored, p)
+    findings = memory_audit.run(baseline_path=p, tags=("token_generation",))
+    mem402 = [f for f in findings if f.rule == "MEM402"]
+    assert mem402, "25% cache growth over baseline must trip the gate"
+    assert any("cache_bytes" in f.message and "grew" in f.message for f in mem402)
+    # within tolerance: a 1% nudge passes with the default 2% gate
+    nudged = json.loads(json.dumps(good))
+    row = nudged["programs"]["token_generation"]["64"]
+    row["temp_bytes"] = int(row["temp_bytes"] * 1.01)
+    memory_audit.save_memory_baseline(nudged, p)
+    findings = memory_audit.run(baseline_path=p, tags=("token_generation",))
+    assert [f for f in findings if "temp_bytes" in f.message] == []
+    # missing bucket: loud
+    findings = memory_audit.run(
+        baseline_path=tmp_path / "missing.json", tags=("token_generation",)
+    )
+    assert any(f.rule == "MEM402" and "no committed" in f.message for f in findings)
