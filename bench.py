@@ -11,6 +11,10 @@ Points (VERDICT r3 #1/#3, r4 #1/#2/#3):
 - llama-3.2-1B int8: bs=1 decode + TTFT (HBM-bound decode ⇒ int8 halves traffic)
 - serving-under-load: 8 concurrent 1B int8 requests through ServingSession
   (chunked prefill + paged cache): aggregate decode tok/s + p50/p99 TTFT
+- the SAME serving mix through the ragged mixed-step dispatch
+  (serving_ragged=True, ISSUE 6): one ragged paged-attention dispatch per
+  step instead of the CTE/TKG pair — the ragged_* summary keys (incl. the
+  padded-token fraction) are the split-vs-ragged comparison
 - llama-3.1-8B int8: bs=1 decode + TTFT (the closest single-chip proxy for the
   BASELINE.json 8B north star; int8 8B fits one 16G v5e chip)
 - llama-3.2-1B bf16 16k long-context (VERDICT r5 weak #5): 16384-token
@@ -377,7 +381,7 @@ def measure_serving(app, *, n_requests, prompt_len, gen_len):
         v = tel.percentile(vals, p / 100)
         return round(v, 1) if v is not None else None
 
-    return {
+    res = {
         "decode_tok_s": round(total_tokens / total_s, 2),
         "ttft_ms": pct(ttfts, 50),
         "ttft_p99_ms": pct(ttfts, 99),
@@ -386,6 +390,18 @@ def measure_serving(app, *, n_requests, prompt_len, gen_len):
         "n_requests": n_requests,
         "total_tokens": total_tokens,
     }
+    # ragged mixed-step dispatch (serving_ragged): padded-token fraction of
+    # the packed total-token buckets, from the mixed-step composition
+    # histogram the session records per dispatch
+    mixed = tel.registry.snapshot().get("nxdi_mixed_step_rows")
+    if mixed:
+        sums = {s["labels"]["kind"]: s["sum"] for s in mixed["samples"]}
+        denom = sums.get("padded_slots", 0) + sums.get("query_tokens", 0)
+        if denom:
+            res["padded_token_frac"] = round(
+                sums.get("padded_slots", 0) / denom, 4
+            )
+    return res
 
 
 def _suite_params(tiny):
@@ -441,6 +457,16 @@ def _suite_params(tiny):
             attrs=attrs_1b, quantized=True, serving=serving,
             cache_key="int8_1b" if not tiny else None,
         ),
+        # SAME request mix through the ragged mixed-step dispatch (ISSUE 6):
+        # one ragged dispatch per step replaces the CTE/TKG pair — the pair
+        # of rows is the split-vs-ragged serving comparison for the next
+        # hardware session. Own artifact key: serving_ragged is part of the
+        # config fingerprint, so sharing int8_1b's would thrash it.
+        "serving_1b_int8_ragged": dict(
+            attrs=attrs_1b, quantized=True, serving=serving,
+            extra_tpu=dict(serving_ragged=True),
+            cache_key="int8_1b_ragged" if not tiny else None,
+        ),
         # single-chip proxy for the BASELINE 8B north star: int8 8B fits 16G
         "int8_8b_bs1": dict(
             attrs=attrs_8b, batch=1, seq=seq, ce=ce[:1], tkg=tkg[:1],
@@ -493,6 +519,7 @@ def run_point(name, tiny=False):
             quantized=p["quantized"], cache_key=p.get("cache_key"),
             block_kv=dict(num_blocks=s["blocks"], block_size=s["block_size"],
                           max_seqs=s["max_seqs"]),
+            extra_tpu=p.get("extra_tpu"),
         )
         res = measure_serving(
             app, n_requests=s["n_requests"], prompt_len=s["prompt"],
@@ -539,6 +566,16 @@ def summary_line(points):
         "serving_ttft_p99_ms": g("serving_1b_int8", "ttft_p99_ms"),
         "serving_itl_p50_ms": g("serving_1b_int8", "itl_ms"),
         "serving_itl_p99_ms": g("serving_1b_int8", "itl_p99_ms"),
+        # ragged mixed-step serving row (ISSUE 6): same request mix, ONE
+        # ragged dispatch per step — compare against serving_* above; the
+        # padded-token fraction quantifies the packing efficiency the
+        # per-phase split was throwing away
+        "ragged_tok_s": g("serving_1b_int8_ragged", "decode_tok_s"),
+        "ragged_ttft_p50_ms": g("serving_1b_int8_ragged", "ttft_ms"),
+        "ragged_ttft_p99_ms": g("serving_1b_int8_ragged", "ttft_p99_ms"),
+        "ragged_itl_p50_ms": g("serving_1b_int8_ragged", "itl_ms"),
+        "ragged_itl_p99_ms": g("serving_1b_int8_ragged", "itl_p99_ms"),
+        "ragged_padded_frac": g("serving_1b_int8_ragged", "padded_token_frac"),
         "int8_8b_tok_s": g("int8_8b_bs1", "decode_tok_s"),
         "int8_8b_ttft_ms": g("int8_8b_bs1", "ttft_ms"),
         # 16k long-context row: TTFT ~= the 16k prefill wall time

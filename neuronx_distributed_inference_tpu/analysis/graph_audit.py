@@ -68,6 +68,7 @@ F32_UPCAST_ALLOWLIST = (
     "rope.py",
     "sampling.py",
     "decode_attention.py",
+    "ragged_paged_attention.py",
     "masks.py",
     "quant.py",
     "kvcache.py",
@@ -245,6 +246,7 @@ def run(
             TAG_TOKEN_GENERATION,
             TAG_FUSED_SPECULATION,
             TAG_TOKEN_GENERATION_KVQ8,
+            programs.TAG_MIXED_STEP,
         ):
             hits: List[Tuple[str, Optional[str]]] = []
             _walk_scan_upcasts(per_bucket[ref_bucket].jaxpr.jaxpr, hits)

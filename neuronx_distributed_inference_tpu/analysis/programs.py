@@ -17,9 +17,11 @@ module builds them ONCE per process and hands each suite a
 
 Program families:
 
-- the five committed tags the graph audit has always covered —
-  ``context_encoding`` / ``token_generation`` / ``fused_speculation`` plus
-  the ``*_kvq8`` quantized-cache pair (contiguous cache), and
+- the committed tags the graph audit covers —
+  ``context_encoding`` / ``token_generation`` / ``fused_speculation``, the
+  ``*_kvq8`` quantized-cache pair (contiguous cache), and ``mixed_step``
+  (the ragged mixed prefill+decode serving program on the int8 paged
+  cache, bucketed by TOTAL packed query tokens), and
 - two cache-VARIANT decode programs for the memory audit's donation proof:
   ``token_generation_ring`` (ring-bounded sliding-window cache) and
   ``token_generation_paged`` (paged block cache), both compiled with
@@ -40,6 +42,11 @@ TAG_CONTEXT_ENCODING_KVQ8 = "context_encoding_kvq8"
 TAG_TOKEN_GENERATION_KVQ8 = "token_generation_kvq8"
 TAG_TOKEN_GENERATION_RING = "token_generation_ring"
 TAG_TOKEN_GENERATION_PAGED = "token_generation_paged"
+# ragged mixed prefill+decode serving step (serving_ragged): int8 PAGED
+# cache, bucket axis = total packed query tokens (runtime/model_runner.py
+# MixedStepRunner) — committed so the graph/shard/memory audits cover the
+# one-dispatch serving program family from day one
+TAG_MIXED_STEP = "mixed_step"
 
 #: the committed program set (graph + shard audits)
 COMMITTED_TAGS = (
@@ -48,6 +55,7 @@ COMMITTED_TAGS = (
     TAG_FUSED_SPECULATION,
     TAG_CONTEXT_ENCODING_KVQ8,
     TAG_TOKEN_GENERATION_KVQ8,
+    TAG_MIXED_STEP,
 )
 #: cache-variant decode programs (memory audit: donation across variants)
 CACHE_VARIANT_TAGS = (
@@ -253,9 +261,10 @@ def _build_causal(
     """CTE + TKG programs of the tiny causal LM.
 
     ``kv_quant``: contiguous cache with kv_cache_dtype="int8" (the kvq8 tag
-    pair). ``variant``: "ring" (sliding-window ring-bounded cache) or
-    "paged" (block cache) — decode-only tags, compiled int8 so the
-    QuantizedKV code+scale leaves are covered in every cache variant.
+    pair). ``variant``: "ring" (sliding-window ring-bounded cache), "paged"
+    (block cache) or "mixed" (the ragged mixed-step serving program on the
+    paged cache, serving_ragged) — compiled int8 so the QuantizedKV
+    code+scale leaves are covered in every cache variant.
     """
     from neuronx_distributed_inference_tpu.runtime.application import (
         TpuModelForCausalLM,
@@ -270,6 +279,20 @@ def _build_causal(
         overrides.update(
             is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=18
         )
+    elif variant == "mixed":
+        from neuronx_distributed_inference_tpu.config import ChunkedPrefillConfig
+
+        overrides.update(
+            is_block_kv_layout=True,
+            pa_block_size=16,
+            pa_num_blocks=24,
+            is_continuous_batching=True,
+            is_chunked_prefill=True,
+            chunked_prefill_config=ChunkedPrefillConfig(
+                max_num_seqs=2, kernel_q_tile_size=16
+            ),
+            serving_ragged=True,
+        )
     cfg = tiny_config(**overrides)
     app = TpuModelForCausalLM(None, cfg)
     app.load(random_weights=True)
@@ -279,6 +302,8 @@ def _build_causal(
         pairs = [(TAG_TOKEN_GENERATION_RING, PHASE_TKG, app.token_generation_model)]
     elif variant == "paged":
         pairs = [(TAG_TOKEN_GENERATION_PAGED, PHASE_TKG, app.token_generation_model)]
+    elif variant == "mixed":
+        pairs = [(TAG_MIXED_STEP, PHASE_TKG, app.mixed_step_model)]
     elif kv_quant:
         pairs = [
             (TAG_CONTEXT_ENCODING_KVQ8, PHASE_CTE, app.context_encoding_model),
@@ -389,6 +414,7 @@ _BUILDERS = (
         lambda: _build_causal(kv_quant=True),
     ),
     ((TAG_FUSED_SPECULATION,), _build_fused),
+    ((TAG_MIXED_STEP,), lambda: _build_causal(variant="mixed")),
     ((TAG_TOKEN_GENERATION_RING,), lambda: _build_causal(variant="ring")),
     ((TAG_TOKEN_GENERATION_PAGED,), lambda: _build_causal(variant="paged")),
 )

@@ -334,6 +334,17 @@ class TpuConfig:
     chunked_prefill_config: Optional[ChunkedPrefillConfig] = None
     kv_cache_batch_size: Optional[int] = None
     kv_cache_padding_size: int = 0
+    # ragged mixed-step serving dispatch (runtime/serving.py): pack admitted
+    # prefill chunks AND active decode rows into ONE ragged paged-attention
+    # dispatch per step() (ops/ragged_paged_attention.py), collapsing the
+    # CTE/TKG split on the serving path. Requires the paged cache
+    # (is_block_kv_layout) under continuous batching; plain full-length
+    # attention only. Default OFF until hardware-validated — the legacy
+    # split dispatch stays byte-identical (pinned by test; quantized KV
+    # caches agree within the kv-quant tolerance instead: the running
+    # absmax couples whatever one dispatch co-writes, and the ragged step
+    # groups writes differently — docs/SERVING.md).
+    serving_ragged: bool = False
 
     # --- attention -------------------------------------------------------
     fused_qkv: bool = False
@@ -540,6 +551,31 @@ class TpuConfig:
                              "set is_continuous_batching=True")
         if self.is_prefix_caching and not self.is_block_kv_layout:
             raise ValueError("prefix caching requires block KV layout")
+        if self.serving_ragged:
+            if not self.is_block_kv_layout:
+                raise ValueError(
+                    "serving_ragged requires the paged cache "
+                    "(is_block_kv_layout=True): the ragged kernel addresses "
+                    "rows through block tables"
+                )
+            if not self.is_continuous_batching:
+                raise ValueError(
+                    "serving_ragged runs through the serving session: set "
+                    "is_continuous_batching=True"
+                )
+            if self.sliding_window or self.attention_chunk_size:
+                raise NotImplementedError(
+                    "serving_ragged implements the plain causal+prefix mask "
+                    "only (no sliding-window/chunked attention)"
+                )
+            if (
+                self.attention_dp_degree > 1
+                or self.cp_degree > 1
+                or self.data_parallel_degree > 1
+            ):
+                raise NotImplementedError(
+                    "serving_ragged is single-shard-parallel (tp only)"
+                )
         if (
             self.is_block_kv_layout
             and self.pa_num_blocks is None

@@ -133,6 +133,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument("--is-prefix-caching", action="store_true")
     run.add_argument("--is-chunked-prefill", action="store_true")
+    run.add_argument(
+        "--serving-ragged", action="store_true",
+        help="ragged mixed-step serving dispatch: pack prefill chunks AND "
+        "decode rows into ONE ragged paged-attention launch per step "
+        "(requires --is-block-kv-layout under continuous batching; "
+        "docs/SERVING.md)",
+    )
     run.add_argument("--cp-max-num-seqs", type=int, default=8,
                      help="chunked prefill: max sequences per chunk batch")
     run.add_argument("--cp-kernel-q-tile-size", type=int, default=128)
@@ -349,6 +356,7 @@ def create_tpu_config(args) -> TpuConfig:
         is_prefix_caching=args.is_prefix_caching,
         is_chunked_prefill=args.is_chunked_prefill,
         chunked_prefill_config=cpc,
+        serving_ragged=args.serving_ragged,
         on_device_sampling_config=ods,
         max_topk=args.max_topk,
         output_logits=args.output_logits

@@ -59,6 +59,9 @@ from neuronx_distributed_inference_tpu.modules.sampling import (
 PHASE_CONTEXT_ENCODING = "context_encoding"
 PHASE_TOKEN_GENERATION = "token_generation"
 PHASE_SPECULATION = "speculation"
+# ragged mixed prefill+decode serving step (models run via mixed_forward; one
+# dispatch covers prefill chunks AND decode rows against the paged cache)
+PHASE_MIXED = "mixed"
 
 
 @dataclass(frozen=True)
@@ -161,6 +164,27 @@ class StepOutput:
     tokens: jax.Array  # (B, K) int32
     logits: Optional[jax.Array]  # (B, K, V) or None
     cache: KVCache
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class MixedStepInputs:
+    """Device inputs of ONE ragged mixed prefill+decode step (mixed_forward).
+
+    All rows' query tokens are PACKED along one axis of length T (the
+    total-query-token bucket): row r owns packed slots
+    ``[row_start[r], row_start[r] + row_len[r])``; slots between segments
+    are padding (position/slot ``-1``). Row index == serving slot ==
+    block-table row, so R is the session's slot count."""
+
+    input_ids: jax.Array  # (1, T) int32 packed tokens
+    position_ids: jax.Array  # (1, T) int32 absolute positions; -1 = padded
+    slot_mapping: jax.Array  # (1, T) int32 flat paged write slots; -1 = drop
+    block_table: jax.Array  # (R, MB) int32
+    row_start: jax.Array  # (R,) int32 packed offset per row
+    row_len: jax.Array  # (R,) int32 query tokens per row; 0 = inactive
+    ctx_len: jax.Array  # (R,) int32 total kv length per row (incl. new)
+    sampling_params: jax.Array  # (R, 3) float32
 
 
 def act_fn(name: str) -> Callable:
@@ -390,6 +414,11 @@ def decoder_layer(
     # run_decoder_layers certifies the STEP-level fused-kernel preconditions
     # (plain decode, no rope/mask overrides, no taps/adapters, single shard)
     fused_block_ok: bool = False,
+    # ragged mixed-step descriptors (row_start, row_len, ctx_len), each (R,):
+    # attention runs the ragged paged kernel/fallback instead of the
+    # per-phase paths (phase == PHASE_MIXED; mask is unused — the kernel
+    # derives it from the descriptors)
+    ragged_rows: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One decoder layer (reference NeuronLlamaDecoderLayer, modeling_llama.py:1188).
 
@@ -532,6 +561,20 @@ def decoder_layer(
             )
         if spec.cp_enabled:
             attn_out = cpx.shard_attn_out(attn_out)
+    elif ragged_rows is not None:
+        # ragged mixed step: prefill-chunk AND decode rows in ONE attention
+        # launch off the paged cache, masks derived in-kernel from the
+        # (row_start, row_len, ctx_len) descriptors (PAPERS.md ragged paged
+        # attention); native gather fallback keeps every config on CPU
+        from neuronx_distributed_inference_tpu.ops.ragged_paged_attention import (
+            ragged_attention,
+        )
+
+        rs, rl, cl = ragged_rows
+        attn_out = ragged_attention(
+            q, k_cache, v_cache, layer_idx, block_inputs[1], positions,
+            rs, rl, cl, aspec, interpret=kernel_interpret(),
+        )
     elif is_block:
         from neuronx_distributed_inference_tpu.ops.paged_flash_attention import (
             _use_paged_flash,
@@ -1260,6 +1303,111 @@ def decode_steps(
     tokens = jnp.swapaxes(tokens, 0, 1)  # (B, num_steps)
     out_logits = jnp.swapaxes(logits, 0, 1) if spec.output_logits else None
     return tokens, out_logits, cache
+
+
+def mixed_forward(
+    params: dict,
+    cache,  # BlockKVCache (donated by the runner)
+    inputs: MixedStepInputs,
+    rng: Optional[jax.Array],
+    *,
+    spec: ModelSpec,
+    mlp_fn: Callable = gated_mlp,
+    layer_fn: Optional[Callable] = None,
+) -> StepOutput:
+    """ONE traced program for a ragged mixed prefill+decode serving step.
+
+    The packed-token analogue of :func:`forward`: the batch axis is the
+    TOTAL query-token axis (bucketed by total tokens, not per phase), rows
+    are described by ``(row_start, row_len, ctx_len)`` descriptors, cache
+    writes scatter through the packed ``slot_mapping``, and attention runs
+    the ragged paged kernel (ops/ragged_paged_attention.py). Emits ONE
+    next-token per row — sampled from each row's LAST packed query position
+    (a prefill chunk that completes its prompt emits the first generated
+    token; a decode row its next token), so one dispatch replaces the
+    CTE/TKG pair the split serving path interleaved on the host.
+
+    Returns StepOutput with tokens (R, 1); inactive rows (row_len == 0)
+    carry garbage tokens the host ignores.
+    """
+    if spec.layer_groups is not None or spec.bounded_window or spec.ring_window:
+        raise NotImplementedError(
+            "the ragged mixed step supports uniform full-length layer stacks "
+            "only (no ring-bounded/interleaved caches or layer groups)"
+        )
+    if spec.sliding_window or spec.attention_chunk_size or spec.attn.has_sink:
+        raise NotImplementedError(
+            "the ragged paged kernel implements the plain causal+prefix mask "
+            "only (no sliding-window/chunked attention, no sinks)"
+        )
+    if layer_fn is not None:
+        raise NotImplementedError(
+            "the ragged mixed step runs the standard decoder_layer only"
+        )
+    if spec.cp_enabled or spec.attention_dp > 1 or spec.data_parallel > 1:
+        raise NotImplementedError(
+            "the ragged mixed step is single-shard-parallel (tp only)"
+        )
+
+    from jax.sharding import PartitionSpec as _P
+
+    from neuronx_distributed_inference_tpu.parallel.sharding import constrain
+
+    hidden = embed(params, inputs.input_ids)  # (1, T, H)
+    # pin the scan-carried hidden replicated: without the constraint GSPMD
+    # shards the packed hidden along H (propagated back from the per-row
+    # gather) and re-gathers it before EVERY layer's qkv matmul — an
+    # in-loop activation all-gather per layer (GRAPH303 catches this)
+    hidden = constrain(hidden, _P(None, None, None))
+    inv_freq = params["rope"]["inv_freq"]
+    positions = inputs.position_ids
+    cos, sin = rope_cos_sin(positions, inv_freq, spec.attention_scaling)
+
+    k_cache, v_cache = cache.k, cache.v
+    block_inputs = (inputs.slot_mapping, inputs.block_table, inputs.ctx_len)
+    ragged = (inputs.row_start, inputs.row_len, inputs.ctx_len)
+    # paged writes route through slot_mapping; slot_ids is unused ballast
+    slot_ids = jnp.zeros((1,), jnp.int32)
+    num_layers = jax.tree.leaves(params["layers"])[0].shape[0]
+
+    def scan_body(carry, xs):
+        h, k_c, v_c = carry
+        layer_params, li = xs
+        h = constrain(h, _P(None, None, None))
+        h, k_c, v_c = decoder_layer(
+            layer_params, h, cos, sin, k_c, v_c, li, None, slot_ids,
+            positions, spec, PHASE_MIXED, mlp_fn,
+            block_inputs=block_inputs, ragged_rows=ragged,
+        )
+        return (h, k_c, v_c), None
+
+    (hidden, k_cache, v_cache), _ = jax.lax.scan(
+        scan_body,
+        (hidden, k_cache, v_cache),
+        (params["layers"], jnp.arange(num_layers, dtype=jnp.int32)),
+    )
+    new_cache = type(cache)(k=k_cache, v=v_cache)
+
+    hidden = apply_norm(hidden, params["norm"]["weight"], spec.rms_eps, spec.norm_type)
+    # per-row last-token gather off the packed axis (the ragged analogue of
+    # gather_last_token); inactive rows clamp to slot 0 — garbage the host
+    # never reads
+    T = hidden.shape[1]
+    last_idx = jnp.clip(inputs.row_start + inputs.row_len - 1, 0, T - 1)
+    rows_h = jnp.take(hidden[0], last_idx, axis=0)[:, None, :]  # (R, 1, H)
+    logits = lm_head(params, rows_h, spec)[..., : spec.vocab_size]  # (R, 1, V)
+    if spec.on_device_sampling:
+        tokens = sample_tokens(
+            logits,
+            inputs.sampling_params,
+            rng if spec.do_sample else None,
+            spec.max_topk,
+            spec.do_sample,
+        )
+    else:
+        tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    out_logits = logits if spec.output_logits else None
+    return StepOutput(tokens=tokens, logits=out_logits, cache=new_cache)
 
 
 def forward(

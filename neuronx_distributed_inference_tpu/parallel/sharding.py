@@ -121,6 +121,15 @@ def constrain(x, spec: P):
         return jax.lax.with_sharding_constraint(x, spec)
     except (ValueError, TypeError):
         return x
+    except RuntimeError as e:
+        # jax's "no mesh at the call site" (e.g. AOT lowering a step
+        # function without a mesh context) degrades to a no-op like the
+        # single-device case; any OTHER RuntimeError must surface — a
+        # swallowed constraint here silently drops sharding pins the
+        # programs depend on (e.g. the mixed-step scan-carried hidden)
+        if "requires a non-empty mesh" in str(e):
+            return x
+        raise
 
 
 def make_sharding_fn(mesh: Mesh):

@@ -160,6 +160,44 @@ class TpuModelForCausalLM:
             **block_kwargs,
         )
         self.runners = [self.context_encoding_model, self.token_generation_model]
+        # ragged mixed-step program family (serving_ragged): ONE dispatch per
+        # serving step covers prefill chunks AND decode rows; its bucket axis
+        # is the TOTAL packed query-token count, not a per-phase shape
+        self.mixed_step_model = None
+        if tc.serving_ragged:
+            from neuronx_distributed_inference_tpu.ops.ragged_paged_attention import (
+                RAGGED_Q_TILE,
+            )
+            from neuronx_distributed_inference_tpu.runtime.model_runner import (
+                MixedStepRunner,
+            )
+
+            cpc = tc.chunked_prefill_config
+            chunk = cpc.kernel_q_tile_size if cpc else 128
+            max_seqs = cpc.max_num_seqs if cpc else 8
+            num_rows = tc.kv_cache_batch_size or tc.max_batch_size
+            # worst-case packed step: max_seqs tile-aligned prefill chunks
+            # plus every slot decoding (one q tile each)
+            top = (
+                autobucketing.round_up(chunk, RAGGED_Q_TILE) * max_seqs
+                + num_rows * RAGGED_Q_TILE
+            )
+            mixed_buckets = []
+            b = RAGGED_Q_TILE
+            while b < top:
+                mixed_buckets.append(b)
+                b *= 2
+            mixed_buckets.append(b)
+            self.mixed_step_model = MixedStepRunner(
+                self.spec,
+                mixed_buckets,
+                num_rows,
+                self.mesh,
+                mlp_fn,
+                tc.pa_block_size,
+                tkg_buckets,
+                layer_fn=layer_fn,
+            )
 
     # ---- weights / cache -------------------------------------------------
 
@@ -445,6 +483,14 @@ class TpuModelForCausalLM:
             self.kv_cache = runner.warmup(
                 self.params, self.kv_cache, self._sample_key(0),
                 chunk_q_lens=chunk_q if runner is self.token_generation_model else None,
+            )
+        if self.mixed_step_model is not None and tc.is_prefill_stage is None:
+            # ragged mixed-step family: every total-token bucket compiles at
+            # the largest kv width; smaller widths compile lazily like the
+            # chunk-q ladder, so the runner stays unsealed here (callers
+            # pinning zero steady-state recompiles warm their mix and seal())
+            self.kv_cache = self.mixed_step_model.warmup(
+                self.params, self.kv_cache, self._sample_key(0)
             )
         from neuronx_distributed_inference_tpu.analysis.retrace_guard import (
             guard_enabled,

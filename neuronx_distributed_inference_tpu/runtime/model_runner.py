@@ -44,6 +44,7 @@ TAG_CONTEXT_ENCODING = "context_encoding_model"
 TAG_TOKEN_GENERATION = "token_generation_model"
 TAG_SPECULATION = "speculation_model"
 TAG_FUSED_SPECULATION = "fused_speculation_model"
+TAG_MIXED_STEP = "mixed_step_model"
 
 
 class SubModelRunner:
@@ -391,6 +392,178 @@ class SubModelRunner:
                 out = self._fn(
                     params, cache, self.example_inputs(self.buckets[-1], q_len=q), rng
                 )
+                out.tokens.block_until_ready()
+                cache = out.cache
+        return cache
+
+
+class MixedStepRunner:
+    """Runner of the RAGGED mixed prefill+decode serving program family.
+
+    Unlike :class:`SubModelRunner`, whose bucket axis is per-phase (context
+    length for CTE, cache width for TKG), this family's primary bucket axis
+    is the TOTAL packed query-token count of one serving step — prefill
+    chunks and decode rows share it — crossed with the kv-width ladder the
+    block table covers (a 2-D (q_total, kv_width) family, like the chunked-
+    prefill programs). One ``step()`` dispatch of one program replaces the
+    CTE/TKG pair the split serving path interleaved on the host.
+
+    Packing contract: row r's segment starts at ``row_start[r]`` (a multiple
+    of :attr:`q_tile`, so a kernel q tile never spans two rows) and row
+    index == serving slot == block-table row. :meth:`prepare` pads the
+    packed axis to the bucket and the block table to the kv width.
+    """
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        buckets: List[int],  # total-query-token ladder
+        num_rows: int,  # serving slot count (fixed R axis)
+        mesh,
+        mlp_fn: Callable,
+        block_size: int,
+        kv_buckets: List[int],  # kv-width ladder (block-aligned TKG buckets)
+        layer_fn=None,
+    ):
+        from neuronx_distributed_inference_tpu.models.base import mixed_forward
+        from neuronx_distributed_inference_tpu.ops.ragged_paged_attention import (
+            RAGGED_Q_TILE,
+        )
+
+        self.tag = TAG_MIXED_STEP
+        self.phase = "mixed"
+        self.spec = spec
+        self.buckets = sorted(buckets)
+        self.num_rows = num_rows
+        self.mesh = mesh
+        self.block_size = block_size
+        self.kv_buckets = sorted(kv_buckets)
+        self.q_tile = RAGGED_Q_TILE
+        self.last_bucket: Optional[int] = None
+        self._sealed = False
+        step = partial(mixed_forward, spec=spec, mlp_fn=mlp_fn, layer_fn=layer_fn)
+        self._fn = jax.jit(
+            trace_marker(TAG_MIXED_STEP, step, owner=self),
+            donate_argnums=(1,),  # paged cache in-place (same KV aliasing)
+        )
+
+    def seal(self):
+        """Arm the retrace guard (see SubModelRunner.seal): call after every
+        (q_total, kv_width) program this runner will serve has compiled."""
+        self._sealed = True
+
+    @contextmanager
+    def seal_suspended(self):
+        was_sealed, self._sealed = self._sealed, False
+        try:
+            yield self
+        finally:
+            self._sealed = was_sealed
+
+    def prepare(
+        self,
+        input_ids: np.ndarray,  # (T,) packed tokens
+        positions: np.ndarray,  # (T,) absolute positions; -1 = padded
+        slot_mapping: np.ndarray,  # (T,) flat paged write slots; -1 = drop
+        row_start: np.ndarray,  # (R,)
+        row_len: np.ndarray,  # (R,)
+        ctx_len: np.ndarray,  # (R,)
+        block_table: np.ndarray,  # (R, mb) covering each row's blocks
+        width: int,  # kv width bucket (block-aligned)
+        sampling_params: Optional[np.ndarray] = None,
+    ):
+        """Pad the packed axis to its total-token bucket and the block table
+        to ``width // block_size`` columns; build MixedStepInputs. Returns
+        (inputs, T_real)."""
+        from neuronx_distributed_inference_tpu.models.base import MixedStepInputs
+
+        T = int(input_ids.shape[0])
+        bucket = get_target_bucket(self.buckets, max(T, self.q_tile))
+        pad = bucket - T
+        if pad:
+            input_ids = np.pad(input_ids, (0, pad))
+            positions = np.pad(positions, (0, pad), constant_values=-1)
+            slot_mapping = np.pad(slot_mapping, (0, pad), constant_values=-1)
+        mb = max(1, width // self.block_size)
+        R, mb_in = block_table.shape
+        if R != self.num_rows:
+            raise ValueError(
+                f"{self.tag}: block table has {R} rows, compiled for "
+                f"{self.num_rows}"
+            )
+        if mb_in < mb:
+            block_table = np.pad(block_table, ((0, 0), (0, mb - mb_in)))
+        elif mb_in > mb:
+            raise ValueError(
+                f"{self.tag}: block table covers {mb_in} blocks > width "
+                f"bucket {width} ({mb} blocks)"
+            )
+        self.last_bucket = bucket
+        if sampling_params is None:
+            sampling_params = prepare_sampling_params(self.num_rows)
+        inputs = MixedStepInputs(
+            input_ids=jnp.asarray(input_ids.astype(np.int32)[None, :]),
+            position_ids=jnp.asarray(positions.astype(np.int32)[None, :]),
+            slot_mapping=jnp.asarray(slot_mapping.astype(np.int32)[None, :]),
+            block_table=jnp.asarray(block_table.astype(np.int32)),
+            row_start=jnp.asarray(row_start.astype(np.int32)),
+            row_len=jnp.asarray(row_len.astype(np.int32)),
+            ctx_len=jnp.asarray(ctx_len.astype(np.int32)),
+            sampling_params=jnp.asarray(sampling_params.astype(np.float32)),
+        )
+        return inputs, T
+
+    def trace_program(self, params, cache, inputs, rng=None):
+        """Trace + lower + compile WITHOUT executing (the static analyzer's
+        entry point — see SubModelRunner.trace_program)."""
+        with jax.set_mesh(self.mesh):
+            traced = self._fn.trace(params, cache, inputs, rng)
+            lowered = traced.lower()
+            compiled = lowered.compile()
+        return traced, lowered, compiled
+
+    def __call__(self, params, cache, inputs, rng=None):
+        with jax.set_mesh(self.mesh):
+            out = self._fn(params, cache, inputs, rng)
+        debug_log_step(self.tag, inputs, out)
+        return out
+
+    # ---- warmup ----------------------------------------------------------
+
+    def example_inputs(self, bucket: int, width: Optional[int] = None):
+        """A warmup/audit step at one total-token bucket: as many rows as
+        fit claim one q-tile decode segment each (writes dropped via slot
+        -1, reads off the reserved garbage block — the field-presence and
+        shapes match real serving calls exactly, so the warmed program IS
+        the served program)."""
+        width = width if width is not None else self.kv_buckets[-1]
+        R = self.num_rows
+        tq = self.q_tile
+        n_fit = min(R, bucket // tq)
+        ids = np.zeros(bucket, np.int32)
+        pos = np.full(bucket, -1, np.int32)
+        sm = np.full(bucket, -1, np.int32)
+        row_start = np.zeros(R, np.int32)
+        row_len = np.zeros(R, np.int32)
+        ctx_len = np.zeros(R, np.int32)
+        for r in range(n_fit):
+            row_start[r] = r * tq
+            row_len[r] = 1
+            ctx_len[r] = 1
+            pos[r * tq] = 0
+        bt = np.zeros((R, max(1, width // self.block_size)), np.int32)
+        inputs, _ = self.prepare(
+            ids, pos, sm, row_start, row_len, ctx_len, bt, width
+        )
+        return inputs
+
+    def warmup(self, params, cache, rng=None):
+        """Compile + execute every total-token bucket once at the LARGEST kv
+        width (smaller widths compile lazily at first use, like the chunked-
+        prefill q-ladder programs — model_runner.warmup docstring)."""
+        with jax.set_mesh(self.mesh):
+            for bucket in self.buckets:
+                out = self._fn(params, cache, self.example_inputs(bucket), rng)
                 out.tokens.block_until_ready()
                 cache = out.cache
         return cache

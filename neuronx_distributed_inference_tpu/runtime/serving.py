@@ -33,6 +33,7 @@ two async dispatches with static shapes beat one megakernel under XLA.
 
 from __future__ import annotations
 
+import warnings
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -128,6 +129,37 @@ class ServingSession:
         # (device tokens (B, 1), [(req, pos_dispatched), ...])
         self._pending = None
         self.async_decode = bool(tc.async_mode)
+        # ragged mixed-step dispatch (TpuConfig.serving_ragged): step() packs
+        # admitted prefill chunks AND active decode rows into ONE dispatch of
+        # the mixed_step program family — the CTE/TKG split collapses on the
+        # serving path (ops/ragged_paged_attention.py)
+        self.ragged = bool(getattr(tc, "serving_ragged", False))
+        self.mixed_runner = None
+        if self.ragged:
+            self.mixed_runner = getattr(app, "mixed_step_model", None)
+            if self.mixed_runner is None:
+                raise ValueError(
+                    "serving_ragged=True but the application carries no "
+                    "mixed_step program family (build the app with the same "
+                    "config that constructs this session)"
+                )
+            # tokens are consumed on the step that dispatched them (the mixed
+            # program emits exactly one token per row); no 1-ahead chaining
+            self.async_decode = False
+            aspec = app.spec.attn
+            if aspec.model_parallel > 1 and not aspec.use_flash_kernel:
+                # pallas custom calls carry no GSPMD partitioning rule, so
+                # the ragged kernel is single-model-parallel-shard only: on
+                # a tp>1 mesh every mixed step runs the native gather
+                # fallback, which materializes per-token KV views — loudly
+                # flag the degraded path the operator probably didn't want
+                warnings.warn(
+                    "serving_ragged on a model_parallel>1 mesh dispatches "
+                    "the NATIVE ragged fallback (the Pallas ragged kernel "
+                    "requires a single model-parallel shard) — correct but "
+                    "slow; see docs/SERVING.md",
+                    stacklevel=2,
+                )
         self.tel.pool_gauges(0, self.kv_pool_bytes, self.kv_free_bytes)
 
     @property
@@ -469,6 +501,16 @@ class ServingSession:
     def prefilling(self) -> List[Request]:
         return [r for r in self.slots if r is not None and r.prefilling]
 
+    def _is_done(self, req: Request, tok: int) -> bool:
+        """Request-termination predicate, shared by every consume path — the
+        split, multi-step-chunk and ragged dispatch modes must agree on it
+        (the ragged mode's byte-identical-outputs contract depends on it)."""
+        return (
+            (req.eos_token_id is not None and tok == req.eos_token_id)
+            or len(req.generated) >= req.max_new_tokens
+            or req.pos + 1 >= self.app.config.tpu_config.seq_len
+        )
+
     def step(self) -> Dict[str, int]:
         """Advance the session: one chunked-prefill pass (if pending) + one
         decode step for every decoding request. Returns {req_id: token} for
@@ -484,6 +526,8 @@ class ServingSession:
         with ``async_mode=False`` for dispatch+fetch-per-step behavior;
         :meth:`run_to_completion` always uses the fastest chained modes.
         """
+        if self.ragged:
+            return self._ragged_step()
         results: Dict[str, int] = {}
         prefill_finished: set = set()
         if self.chunked and self.prefilling:
@@ -533,6 +577,122 @@ class ServingSession:
                 self._pending = (out2.tokens[:, -1:], snap2)
         if pend is not None:
             self._consume(pend, results)
+        return results
+
+    def _ragged_step(self) -> Dict[str, int]:
+        """One RAGGED mixed dispatch: admitted prefill chunks (up to
+        ``max_prefill_seqs``, ``chunk_size`` tokens each) and every decoding
+        row pack into ONE launch of the ``mixed_step`` program — no CTE/TKG
+        split, no per-phase padding, chunked prefill co-scheduled with
+        decode. Row index == slot; segments are q-tile aligned (the ragged
+        kernel's packing contract); one host fetch consumes every row's
+        token. Returns {req_id: token} exactly like the split step()."""
+        results: Dict[str, int] = {}
+        rows = []  # (req, kind, n_tokens)
+        if self.chunked:
+            for req in self.prefilling[: self.max_prefill_seqs]:
+                n = min(self.chunk_size, req.prompt_len - req.prefill_pos)
+                if n <= 0:
+                    continue
+                try:
+                    self.allocator.alloc_seq(req.slot, req.prefill_pos + n)
+                except RuntimeError:
+                    # pool exhausted: preempt so the session never stalls
+                    # (same policy as _prefill_chunks(preempt=True))
+                    req.preempted = True
+                    self._finish(req)
+                    continue
+                rows.append((req, "prefill", n))
+        for r in self.decoding:
+            try:
+                self.allocator.alloc_seq(r.slot, r.pos + 1)
+            except RuntimeError:
+                r.preempted = True
+                self._finish(r)
+                continue
+            rows.append((r, "decode", 1))
+        if not rows:
+            return results
+        rows.sort(key=lambda t: t[0].slot)
+
+        mr = self.mixed_runner
+        tq = mr.q_tile
+        R = self.num_slots
+        row_start = np.zeros(R, np.int32)
+        row_len = np.zeros(R, np.int32)
+        ctx_len = np.zeros(R, np.int32)
+        cursor = 0
+        for req, _kind, n in rows:
+            row_start[req.slot] = cursor
+            row_len[req.slot] = n
+            cursor += -(-n // tq) * tq  # q-tile-aligned segment
+        T = cursor
+        ids = np.zeros(T, np.int32)
+        positions = np.full(T, -1, np.int32)
+        slot_mapping = np.full(T, -1, np.int32)
+        max_ctx = 0
+        for req, kind, n in rows:
+            s = row_start[req.slot]
+            p0 = req.prefill_pos if kind == "prefill" else req.pos
+            if kind == "prefill":
+                ids[s : s + n] = req.input_ids[p0 : p0 + n]
+            else:
+                ids[s] = req.last_token
+            positions[s : s + n] = np.arange(p0, p0 + n, dtype=np.int32)
+            slot_mapping[s : s + n] = self.allocator.slot_mapping(
+                req.slot, np.arange(p0, p0 + n)
+            )
+            ctx_len[req.slot] = p0 + n
+            max_ctx = max(max_ctx, p0 + n)
+        width = get_target_bucket(
+            self.app.token_generation_model.buckets, max_ctx
+        )
+        mb = max(1, width // self.allocator.block_size)
+        block_table = np.zeros((R, mb), np.int32)
+        for req, _kind, _n in rows:
+            block_table[req.slot] = self.allocator.block_table(req.slot, mb)
+
+        with self.tel.span("serving.mixed_step", rows=len(rows), tokens=T):
+            inputs, _ = mr.prepare(
+                ids, positions, slot_mapping, row_start, row_len, ctx_len,
+                block_table, width, prepare_sampling_params(R),
+            )
+            out = mr(self.app.params, self.app.kv_cache, inputs, None)
+        self.app.kv_cache = out.cache
+        self.tel.step("mixed")
+        self.tel.bucket_dispatch(mr.tag, mr.last_bucket)
+        n_prefill = sum(1 for _, kind, _ in rows if kind == "prefill")
+        real_tokens = int(sum(n for *_, n in rows))
+        self.tel.mixed_step(
+            prefill_rows=n_prefill,
+            decode_rows=len(rows) - n_prefill,
+            padded_slots=mr.last_bucket - real_tokens,
+            query_tokens=real_tokens,
+        )
+        for req, kind, n in rows:
+            if kind == "prefill":
+                self.tel.prefill_dispatch(req.req_id, n)
+        self.tel.pool_gauges(
+            len(self.active), self.kv_pool_bytes, self.kv_free_bytes
+        )
+
+        tokens = np.asarray(out.tokens)  # the only device sync per step
+        for req, kind, n in rows:
+            tok = int(tokens[req.slot, 0])
+            if kind == "prefill":
+                req.prefill_pos += n
+                if req.prefill_pos >= req.prompt_len:
+                    # the last prompt token's output IS the first generated
+                    # token (same contract as _prefill_chunks)
+                    self._finish_prefill(req, tok)
+                    results[req.req_id] = tok
+                continue
+            req.generated.append(tok)
+            self.tel.request_tokens(req.req_id, 1)
+            req.pos += 1
+            results[req.req_id] = tok
+            if self._is_done(req, tok):
+                self._finish(req)
         return results
 
     def _dispatch_decode(self, rows, last_override=None):
@@ -617,12 +777,7 @@ class ServingSession:
             self.tel.request_tokens(req.req_id, 1)
             req.pos = p + 1
             results[req.req_id] = tok
-            done = (
-                (req.eos_token_id is not None and tok == req.eos_token_id)
-                or len(req.generated) >= req.max_new_tokens
-                or req.pos + 1 >= self.app.config.tpu_config.seq_len
-            )
-            if done:
+            if self._is_done(req, tok):
                 self._finish(req)
 
     def run_to_completion(self, decode_chunk_size: int = 16) -> Dict[str, List[int]]:
@@ -637,6 +792,12 @@ class ServingSession:
         are truncated on consume). Per-step semantics (step()) are unchanged
         for interactive callers."""
         spec = self.app.spec
+        if self.ragged:
+            # the ragged mode's whole point is ONE mixed dispatch per step;
+            # the multi-step TKG drain paths would reintroduce the split
+            while self.active:
+                self.step()
+            return {rid: r.generated for rid, r in self.requests.items()}
         ring_cache = bool(spec.bounded_window or spec.ring_window)
         while self.active:
             if (
@@ -697,7 +858,6 @@ class ServingSession:
         active = self.decoding
         if not active:
             return
-        tc = self.app.config.tpu_config
         import jax.numpy as jnp
 
         B = self.num_slots
@@ -794,7 +954,6 @@ class ServingSession:
         active = self.decoding
         if not active:
             return
-        tc = self.app.config.tpu_config
         pos_limit = self.app._pos_limit()
         max_pos = max(r.pos for r in active)
         take = min(
@@ -852,12 +1011,7 @@ class ServingSession:
                 r.generated.append(tok)
                 n_obs += 1
                 r.pos += 1
-                done = (
-                    (r.eos_token_id is not None and tok == r.eos_token_id)
-                    or len(r.generated) >= r.max_new_tokens
-                    or r.pos + 1 >= tc.seq_len
-                )
-                if done:
+                if self._is_done(r, tok):
                     finished = True
                     break
             self.tel.request_tokens(r.req_id, n_obs)

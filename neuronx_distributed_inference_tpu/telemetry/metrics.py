@@ -41,6 +41,11 @@ LATENCY_MS_BUCKETS = (
 ACCEPT_LEN_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0)
 # prefill chunks consumed per request before the first token
 CHUNK_COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+# ragged mixed-step composition (rows / slots per dispatch): spans one
+# decode row up to a fully-packed total-token bucket
+MIXED_STEP_BUCKETS = (
+    0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0, 1024.0,
+)
 
 
 def _fmt_labels(names: Tuple[str, ...], values: Tuple[str, ...], extra: str = "") -> str:

@@ -162,6 +162,12 @@ class TelemetrySession:
             "nxdi_spec_accept_len",
             "tokens committed per speculation round (sums to committed "
             "decode tokens)", buckets=metrics_mod.ACCEPT_LEN_BUCKETS)
+        self._mixed = r.histogram(
+            "nxdi_mixed_step_rows",
+            "ragged mixed-step dispatch composition: prefill_rows / "
+            "decode_rows / padded_slots / query_tokens per dispatch (each "
+            "label's observation count == mixed dispatches)",
+            labels=("kind",), buckets=metrics_mod.MIXED_STEP_BUCKETS)
         self._jit_traces = r.counter(
             "nxdi_jit_traces_total", "jit traces observed (compiles)",
             labels=("tag",))
@@ -330,6 +336,26 @@ class TelemetrySession:
         self._occupancy.set(occupancy)
         self._kv_pool.set(kv_pool_bytes)
         self._kv_free.set(kv_free_bytes)
+
+    def mixed_step(
+        self,
+        prefill_rows: int,
+        decode_rows: int,
+        padded_slots: int,
+        query_tokens: int,
+    ) -> None:
+        """Composition of ONE ragged mixed dispatch (serving_ragged): rows
+        serving prefill chunks, rows serving decode, padded packed slots and
+        real query tokens in the dispatched total-token bucket. Each label's
+        observation COUNT equals the number of mixed dispatches (pinned by
+        test); padded_slots/(padded_slots+query_tokens) is the padded-token
+        fraction the split dispatch was paying per phase."""
+        if not self.enabled:
+            return
+        self._mixed.child(("prefill_rows",)).observe(prefill_rows)
+        self._mixed.child(("decode_rows",)).observe(decode_rows)
+        self._mixed.child(("padded_slots",)).observe(padded_slots)
+        self._mixed.child(("query_tokens",)).observe(query_tokens)
 
     def spec_accept(self, committed: int) -> None:
         """One speculation round committed ``committed`` tokens for one

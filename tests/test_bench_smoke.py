@@ -25,9 +25,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 ALL_POINTS = {
     "bf16_1b_bs1", "bf16_1b_bs4", "int8_1b_bs1", "serving_1b_int8",
-    "int8_8b_bs1", "bf16_1b_8k", "bf16_1b_8k_kvq8", "bf16_1b_16k",
-    "bf16_1b_16k_kvq8",
+    "serving_1b_int8_ragged", "int8_8b_bs1", "bf16_1b_8k", "bf16_1b_8k_kvq8",
+    "bf16_1b_16k", "bf16_1b_16k_kvq8",
 }
+SERVING_POINTS = {"serving_1b_int8", "serving_1b_int8_ragged"}
 
 
 @pytest.mark.slow
@@ -40,7 +41,7 @@ def test_bench_suite_tiny(monkeypatch):
     assert set(points) == ALL_POINTS
     for name, p in points.items():
         assert p["decode_tok_s"] > 0, (name, p)
-        if name != "serving_1b_int8":
+        if name not in SERVING_POINTS:
             assert p["ttft_ms"] > 0, (name, p)
     assert points["bf16_1b_bs1"]["prefill_tok_s"] > 0
     assert points["serving_1b_int8"]["ttft_p99_ms"] >= points["serving_1b_int8"]["ttft_ms"]
@@ -49,6 +50,11 @@ def test_bench_suite_tiny(monkeypatch):
     assert points["serving_1b_int8"]["ttft_ms"] > 0
     assert points["serving_1b_int8"]["itl_ms"] is not None
     assert points["serving_1b_int8"]["itl_p99_ms"] >= points["serving_1b_int8"]["itl_ms"]
+    # ISSUE 6 satellite: the ragged mixed-step row runs the SAME mix and
+    # reports the padded-token fraction of the packed dispatches
+    ragged = points["serving_1b_int8_ragged"]
+    assert ragged["ttft_ms"] > 0 and ragged["itl_ms"] is not None
+    assert 0.0 <= ragged["padded_token_frac"] < 1.0
     # emit fired after EVERY point (the incremental-summary contract) and
     # every snapshot produces a valid summary line
     assert len(emitted) == len(ALL_POINTS)
@@ -65,7 +71,7 @@ def test_bench_suite_tiny(monkeypatch):
     # HBM cost, and the *_kvq8 rows' kv_bytes land well under the paired
     # bf16 rows' (int8 codes ~1/4 of the fp32-tiny / 1/2 of bf16 cache,
     # plus the small scale overhead)
-    for name in ALL_POINTS - {"serving_1b_int8"}:
+    for name in ALL_POINTS - SERVING_POINTS:
         assert points[name]["kv_bytes"] > 0, name
     assert final["ctx8k_kv_bytes"] > final["kvq8_8k_kv_bytes"] > 0
     assert final["long_ctx_kv_bytes"] > final["kvq8_16k_kv_bytes"] > 0
@@ -74,6 +80,8 @@ def test_bench_suite_tiny(monkeypatch):
     assert all(v == "ok" for v in final["points"].values())
     assert final["serving_itl_p50_ms"] is not None
     assert final["serving_itl_p99_ms"] is not None
+    assert final["ragged_tok_s"] > 0
+    assert final["ragged_padded_frac"] is not None
     # --metrics-out: the tiny suite ran the serving point in-process, so the
     # process-default registry must hold the full serving metric set
     import tempfile

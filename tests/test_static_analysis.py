@@ -362,13 +362,15 @@ def test_graph_audit_clean_and_covers_tags():
     findings = graph_audit.run()
     assert findings == [], "\n".join(f.render() for f in findings)
     # coverage floor: the audited tag set is the acceptance-criteria set
-    # (+ the quantized-cache program set, ISSUE 3)
+    # (+ the quantized-cache program set, ISSUE 3; + the ragged mixed-step
+    # serving family, ISSUE 6)
     assert set(graph_audit.AUDIT_TAGS) == {
         "context_encoding",
         "token_generation",
         "fused_speculation",
         "context_encoding_kvq8",
         "token_generation_kvq8",
+        "mixed_step",
     }
     baseline = graph_audit.load_census_baseline()
     assert set(baseline) == set(graph_audit.AUDIT_TAGS)
@@ -669,7 +671,7 @@ def _toy_sharded_program(weight_spec, cache_spec_p, declared_weight, declared_ca
 
 def test_shard_audit_clean_and_covers_committed_tags():
     """The shard auditor over the real programs: zero findings, the
-    committed five-tag set, ≥2 buckets per causal/fused family, and a
+    committed tag set, ≥2 buckets per causal/fused family, and a
     census whose tp-sharded weights are actually pinned sharded."""
     from neuronx_distributed_inference_tpu.analysis import programs, shard_audit
 
@@ -681,6 +683,7 @@ def test_shard_audit_clean_and_covers_committed_tags():
         "fused_speculation",
         "context_encoding_kvq8",
         "token_generation_kvq8",
+        "mixed_step",
     }
     records = programs.collect_programs(shard_audit.SHARD_AUDIT_TAGS)
     for tag, per_bucket in records.items():
@@ -871,13 +874,15 @@ def test_memory_audit_clean_and_covers_cache_variants():
         "fused_speculation",
         "context_encoding_kvq8",
         "token_generation_kvq8",
+        "mixed_step",
         "token_generation_ring",
         "token_generation_paged",
     }
     records = programs.collect_programs(memory_audit.MEMORY_AUDIT_TAGS)
     # the quantized contiguous/ring/paged programs all donate code AND scale
     # leaves: 4 cache leaves each (k/v × data/scale)
-    for tag in ("token_generation_kvq8", "token_generation_ring", "token_generation_paged"):
+    for tag in ("token_generation_kvq8", "token_generation_ring",
+                "token_generation_paged", "mixed_step"):
         rec = next(iter(records[tag].values()))
         assert rec.n_cache_leaves == 4, tag
         paths = memory_audit.cache_leaf_paths(rec)
