@@ -371,6 +371,7 @@ def measure_serving(app, *, n_requests, prompt_len, gen_len):
         return tel, counts, total_s
 
     run_once()  # warmup / compile pass over all (q, kv) chunk programs
+    base_snap = default_registry().snapshot()  # census delta baseline
     tel, counts, total_s = run_once(default_registry())
     ttfts = [t * 1e3 for t in tel.ttft_values_s()]
     itls = [t * 1e3 for t in tel.itl_values_s()]
@@ -390,12 +391,42 @@ def measure_serving(app, *, n_requests, prompt_len, gen_len):
         "n_requests": n_requests,
         "total_tokens": total_tokens,
     }
+    # fault-containment census (ISSUE 7): rejected/quarantined/preempted
+    # counts sourced from the telemetry registry — on clean traffic all
+    # three MUST be 0 (the containment layer's overhead proof; the first
+    # hardware session compares these rows against pre-containment numbers).
+    # The registry is the PROCESS-default (shared across bench points), so
+    # each point reports the delta over its own measured run, not the
+    # cumulative process totals.
+    snap = tel.registry.snapshot()
+
+    def _ctr(name):
+        def total(s):
+            fam = s.get(name)
+            if not fam:
+                return 0
+            return int(sum(smp["value"] for smp in fam["samples"]))
+
+        return total(snap) - total(base_snap)
+
+    res["rejected"] = _ctr("nxdi_requests_rejected_total")
+    res["quarantined"] = _ctr("nxdi_rows_quarantined_total")
+    res["preempted"] = _ctr("nxdi_requests_preempted_total")
     # ragged mixed-step dispatch (serving_ragged): padded-token fraction of
     # the packed total-token buckets, from the mixed-step composition
     # histogram the session records per dispatch
-    mixed = tel.registry.snapshot().get("nxdi_mixed_step_rows")
+    mixed = snap.get("nxdi_mixed_step_rows")
     if mixed:
-        sums = {s["labels"]["kind"]: s["sum"] for s in mixed["samples"]}
+        base_mixed = base_snap.get("nxdi_mixed_step_rows")
+        base_sums = (
+            {s["labels"]["kind"]: s["sum"] for s in base_mixed["samples"]}
+            if base_mixed
+            else {}
+        )
+        sums = {
+            s["labels"]["kind"]: s["sum"] - base_sums.get(s["labels"]["kind"], 0)
+            for s in mixed["samples"]
+        }
         denom = sums.get("padded_slots", 0) + sums.get("query_tokens", 0)
         if denom:
             res["padded_token_frac"] = round(
@@ -576,6 +607,13 @@ def summary_line(points):
         "ragged_itl_p50_ms": g("serving_1b_int8_ragged", "itl_ms"),
         "ragged_itl_p99_ms": g("serving_1b_int8_ragged", "itl_p99_ms"),
         "ragged_padded_frac": g("serving_1b_int8_ragged", "padded_token_frac"),
+        # fault-containment census (ISSUE 7), sourced from the telemetry
+        # registry over the measured serving run: clean traffic MUST report
+        # 0/0/0 — the containment layer's ~0-overhead proof the first
+        # hardware session checks before flipping any policy knob
+        "serving_rejected": g("serving_1b_int8", "rejected"),
+        "serving_quarantined": g("serving_1b_int8", "quarantined"),
+        "serving_preempted": g("serving_1b_int8", "preempted"),
         "int8_8b_tok_s": g("int8_8b_bs1", "decode_tok_s"),
         "int8_8b_ttft_ms": g("int8_8b_bs1", "ttft_ms"),
         # 16k long-context row: TTFT ~= the 16k prefill wall time

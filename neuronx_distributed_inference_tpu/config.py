@@ -429,6 +429,27 @@ class TpuConfig:
     tensor_capture_config: Optional[TensorCaptureConfig] = None
     tensor_replacement_config: Optional[TensorReplacementConfig] = None
 
+    # --- serving fault containment (runtime/serving.py, runtime/faults.py;
+    # docs/SERVING.md "Failure containment") ------------------------------
+    # validate requests at admission (token-id range vs vocab, empty/over-
+    # long prompts, non-positive budgets): malformed requests get a typed
+    # terminal REJECTED verdict instead of raising (or NaN-ing) mid-batch.
+    # False restores the legacy raise-late behavior.
+    admission_validation: bool = True
+    # wall-clock TTL per request in seconds (None = no deadline): requests
+    # past it are dropped with terminal reason `deadline_exceeded`, checked
+    # at step boundaries. Per-request override: add_request(deadline_s=...).
+    request_deadline_s: Optional[float] = None
+    # transient dispatch errors retry with capped exponential backoff up to
+    # this many times; after that only the in-flight rows fail
+    # (FAILED(dispatch_error)) — never the process.
+    dispatch_max_retries: int = 2
+    # no-forward-progress watchdog: after this many consecutive steps with
+    # zero committed tokens / prefill advance / admissions (while work is
+    # live), preempt the largest request; a second full window raises
+    # WatchdogError with a diagnostic snapshot. 0 disables.
+    watchdog_no_progress_steps: int = 256
+
     # --- misc ------------------------------------------------------------
     seed: int = 0
     # True (default): generate() chains CTE -> decode chunks with
@@ -504,6 +525,21 @@ class TpuConfig:
                     "set pa_num_blocks OR pa_pool_bytes, not both (the pool "
                     "byte budget derives the block count from the cache dtype)"
                 )
+        if self.request_deadline_s is not None and not self.request_deadline_s > 0:
+            raise ValueError(
+                "request_deadline_s must be > 0 seconds (None disables "
+                "per-request deadlines)"
+            )
+        if self.dispatch_max_retries < 0:
+            raise ValueError(
+                "dispatch_max_retries must be >= 0 (0 = fail in-flight rows "
+                "on the first transient dispatch error)"
+            )
+        if self.watchdog_no_progress_steps < 0:
+            raise ValueError(
+                "watchdog_no_progress_steps must be >= 0 (0 disables the "
+                "no-progress watchdog)"
+            )
         if self.attention_dp_degree > 1 and not self.is_continuous_batching:
             raise ValueError("attention_dp_degree > 1 requires is_continuous_batching")
         if self.attention_dp_degree > 1 and self.max_batch_size % self.attention_dp_degree != 0:

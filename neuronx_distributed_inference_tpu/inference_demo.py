@@ -145,6 +145,27 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--cp-kernel-q-tile-size", type=int, default=128)
     run.add_argument("--cp-kernel-kv-tile-size", type=int, default=512)
 
+    # serving fault containment (runtime/serving.py; docs/SERVING.md)
+    onoff("admission-validation", True, dest="admission_validation",
+          help="typed REJECTED verdicts for malformed requests at admission "
+          "(out-of-vocab ids, empty/over-long prompts, bad budgets) instead "
+          "of raising mid-batch")
+    run.add_argument(
+        "--request-deadline-s", type=float, default=None,
+        help="wall-clock TTL per request in seconds; past it the request is "
+        "dropped with terminal reason deadline_exceeded",
+    )
+    run.add_argument(
+        "--dispatch-max-retries", type=int, default=2,
+        help="transient dispatch errors retried with capped backoff this "
+        "many times; then only the in-flight rows fail",
+    )
+    run.add_argument(
+        "--watchdog-no-progress-steps", type=int, default=256,
+        help="serving steps with zero progress before the watchdog preempts "
+        "the largest request (second window: loud WatchdogError); 0 disables",
+    )
+
     # sampling (reference on-device sampling flags)
     run.add_argument("--on-device-sampling", action="store_true")
     run.add_argument("--do-sample", action="store_true")
@@ -357,6 +378,10 @@ def create_tpu_config(args) -> TpuConfig:
         is_chunked_prefill=args.is_chunked_prefill,
         chunked_prefill_config=cpc,
         serving_ragged=args.serving_ragged,
+        admission_validation=args.admission_validation,
+        request_deadline_s=args.request_deadline_s,
+        dispatch_max_retries=args.dispatch_max_retries,
+        watchdog_no_progress_steps=args.watchdog_no_progress_steps,
         on_device_sampling_config=ods,
         max_topk=args.max_topk,
         output_logits=args.output_logits

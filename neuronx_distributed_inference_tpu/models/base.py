@@ -166,6 +166,25 @@ class StepOutput:
     cache: KVCache
 
 
+#: sentinel emitted in place of a sampled/argmax token when the row's logits
+#: are non-finite. argmax over an all-NaN row returns an arbitrary-but-valid
+#: token id, so without the sentinel the host cannot tell a poisoned row from
+#: a healthy one off the token fetch it already performs. -1 is outside every
+#: vocab, rides the existing int32 token stream (no extra fetch, no program
+#: output added), and the serving session quarantines the row on sight
+#: (runtime/serving.py FAILED(non_finite)).
+NON_FINITE_TOKEN = -1
+
+
+def mark_non_finite_tokens(tokens: jax.Array, logits: jax.Array) -> jax.Array:
+    """Fold a per-position logits-finiteness flag into the token stream:
+    positions whose logits contain NaN/Inf emit :data:`NON_FINITE_TOKEN`
+    instead of the (meaningless) sampled token. Healthy rows are untouched,
+    so byte-identical-output pins across dispatch modes are unaffected."""
+    finite = jnp.all(jnp.isfinite(logits), axis=-1)
+    return jnp.where(finite, tokens, jnp.int32(NON_FINITE_TOKEN))
+
+
 @jax.tree_util.register_dataclass
 @dataclass
 class MixedStepInputs:
@@ -1283,6 +1302,7 @@ def decode_steps(
             tok = sample_tokens(logits, sampling_params, step_rng, spec.max_topk, True)
         else:
             tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tok = mark_non_finite_tokens(tok, logits)
         out_logits = logits[:, 0] if spec.output_logits else jnp.zeros((), logits.dtype)
         return (cache, tok, pos + 1), (tok[:, 0], out_logits)
 
@@ -1406,6 +1426,7 @@ def mixed_forward(
         )
     else:
         tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    tokens = mark_non_finite_tokens(tokens, logits)
     out_logits = logits if spec.output_logits else None
     return StepOutput(tokens=tokens, logits=out_logits, cache=new_cache)
 
@@ -1435,6 +1456,7 @@ def forward(
         )
     else:
         tokens = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    tokens = mark_non_finite_tokens(tokens, logits)
 
     out_logits = logits if spec.output_logits else None
     return StepOutput(tokens=tokens, logits=out_logits, cache=new_cache)

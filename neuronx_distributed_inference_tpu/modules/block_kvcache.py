@@ -204,6 +204,19 @@ def read_block_cache_at_layer(
     v_l = jax.lax.dynamic_index_in_dim(v_cache, layer_idx, axis=0, keepdims=False)
     k = k_l[block_table]  # (B, MB, H, bs, D)
     v = v_l[block_table]
+    # NaN-scrub garbage reads: table-zero entries (unused tails, and the
+    # surplus positions of finished drain rows) all point at reserved block
+    # 0, whose contents are whatever invalid-slot writes last dumped there —
+    # including NaN from a poisoned co-batched row's lockstep surplus steps.
+    # Masked attention cannot filter that (the masked probability is exactly
+    # 0 but 0*NaN = NaN in the P·V product), so corruption would leak across
+    # rows through the shared block. Zeroing the gathered garbage blocks
+    # restores "masked contribution == exactly 0" for finite AND non-finite
+    # junk; healthy outputs are byte-identical (those positions were already
+    # exact zeros after the mask).
+    valid = (block_table != GARBAGE_BLOCK)[:, :, None, None, None]
+    k = jnp.where(valid, k, jnp.zeros((), k.dtype))
+    v = jnp.where(valid, v, jnp.zeros((), v.dtype))
     k = k.transpose(0, 1, 3, 2, 4).reshape(B, MB * bs, H, D)
     v = v.transpose(0, 1, 3, 2, 4).reshape(B, MB * bs, H, D)
     return k, v
@@ -242,6 +255,14 @@ class BlockAllocator:
 
     def free_seq(self, seq_id: int):
         self.free.extend(self.seq_blocks.pop(seq_id, []))
+
+    def quarantine_seq(self, seq_id: int) -> List[int]:
+        """Poisoned release: free this sequence's blocks and return the ids
+        the caller must zero-scrub before reuse. Plain-allocator blocks are
+        exclusively owned, so every block is scrubbable."""
+        blocks = self.seq_blocks.pop(seq_id, [])
+        self.free.extend(blocks)
+        return blocks
 
     def slot_mapping(self, seq_id: int, positions: np.ndarray) -> np.ndarray:
         """Logical positions -> global flat slots for this sequence."""
@@ -360,3 +381,26 @@ class PrefixCachingAllocator(BlockAllocator):
                     self.evictable[b] = None  # matchable until evicted
             else:
                 self.free.append(b)
+
+    def quarantine_seq(self, seq_id: int) -> List[int]:
+        """Poisoned release: this sequence's KV must never be read again.
+        Blocks another live sequence still references are left registered
+        and UNTOUCHED — their content is a healthy prefill's writes (a
+        prompt whose final logits went non-finite is quarantined BEFORE
+        commit_seq registers it) and zeroing them would corrupt the
+        sharers' attention. Every other block is deregistered from the
+        prefix index (its content must not be matchable again), freed, and
+        returned for the caller to zero-scrub."""
+        scrub: List[int] = []
+        for b in self.seq_blocks.pop(seq_id, []):
+            if b in self.hash_of_block:
+                self.refcount[b] -= 1
+                if self.refcount[b] > 0:
+                    continue  # a live sharer still attends this block
+                key = self.hash_of_block.pop(b)
+                self.block_by_hash.pop(key, None)
+                self.refcount.pop(b, None)
+                self.evictable.pop(b, None)
+            self.free.append(b)
+            scrub.append(b)
+        return scrub

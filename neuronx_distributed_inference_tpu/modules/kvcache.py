@@ -121,17 +121,24 @@ def _quantized_update(
 ) -> Tuple[jax.Array, jax.Array]:
     """Running-absmax scale update + quantize for one layer's write.
 
-    ``new``: (B, S, H, D) values about to be scattered; ``valid``: (B, S)
-    mask of tokens that actually land in the cache (padded/sentinel writes
-    must not inflate the scale). Returns (codes, updated (L, H) scale). The
-    write quantizes with the UPDATED scale, so a steady-state decode step
-    never re-reads the cache to rescale — earlier entries keep their codes
-    and dequantize with the (monotonically grown) running scale.
+    ``new``: (B, S, H, D) values about to be scattered; padded/sentinel
+    writes (``valid``: (B, S) mask) and non-finite elements must not
+    inflate the scale — the scale is SHARED across the batch per (layer,
+    head) and grows monotonically, so one poisoned row's NaN folding into
+    it would dequantize every co-batched row (and all future requests) to
+    NaN: the one cross-row coupling channel the serving quarantine cannot
+    scrub after the fact. Returns (codes, updated (L, H) scale). The write
+    quantizes with the UPDATED scale, so a steady-state decode step never
+    re-reads the cache to rescale — earlier entries keep their codes and
+    dequantize with the (monotonically grown) running scale.
     """
     li = jnp.asarray(layer_idx, jnp.int32)
     xf = new.astype(jnp.float32)
     amax_new = jnp.max(
-        jnp.where(valid[:, :, None, None], jnp.abs(xf), 0.0), axis=(0, 1, 3)
+        jnp.where(
+            valid[:, :, None, None] & jnp.isfinite(xf), jnp.abs(xf), 0.0
+        ),
+        axis=(0, 1, 3),
     )  # (H,)
     cur = jax.lax.dynamic_index_in_dim(stream.scale, li, 0, keepdims=False)
     s = jnp.maximum(cur, amax_new)

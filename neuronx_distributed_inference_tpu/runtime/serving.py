@@ -33,9 +33,12 @@ two async dispatches with static shapes beat one megakernel under XLA.
 
 from __future__ import annotations
 
+import json
+import time
 import warnings
+from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -45,13 +48,68 @@ from neuronx_distributed_inference_tpu.modules.autobucketing import (
     pow2_bucket,
 )
 from neuronx_distributed_inference_tpu.modules.sampling import prepare_sampling_params
+from neuronx_distributed_inference_tpu.runtime.faults import (
+    RETRYABLE_DISPATCH_ERRORS,
+    WatchdogError,
+    fill_kv_rows,
+)
 from neuronx_distributed_inference_tpu.telemetry.tracing import default_session
+
+# ---------------------------------------------------------------------------
+# fault containment: request statuses, typed admission verdicts, retry policy
+# (docs/SERVING.md "Failure containment")
+# ---------------------------------------------------------------------------
+
+#: request lifecycle statuses. ACTIVE = holds a slot; WAITING = preempted and
+#: queued for re-admission (ahead of new arrivals); the rest are terminal.
+STATUS_ACTIVE = "active"
+STATUS_WAITING = "waiting"
+STATUS_FINISHED = "finished"
+STATUS_FAILED = "failed"
+STATUS_REJECTED = "rejected"
+
+#: finish reasons that mark a request FAILED rather than FINISHED
+FAILURE_REASONS = frozenset(
+    {"non_finite", "dispatch_error", "deadline_exceeded", "preempted"}
+)
+
+#: capped exponential backoff for transient dispatch retries:
+#: base * 2**attempt, clamped to the cap (sleeps through the session's
+#: injectable sleep so tests stay fast and deterministic)
+DISPATCH_BACKOFF_BASE_S = 0.02
+DISPATCH_BACKOFF_CAP_S = 0.5
+
+#: ``session.rejected`` keeps the most recent terminal-REJECTED requests
+#: (prompt included, for diagnostics) and evicts oldest-first past this cap:
+#: rejection volume is attacker-controlled (malformed traffic), so the
+#: record must not grow host memory without bound
+REJECTED_HISTORY_MAX = 1024
+
+
+@dataclass(frozen=True)
+class AdmissionResult:
+    """Typed verdict from :meth:`ServingSession.add_request`. Truthiness ==
+    admitted, so existing ``assert sess.add_request(...)`` call sites keep
+    working; ``reason`` carries the reject/drop cause (``no_slot`` /
+    ``kv_blocks`` / ``backlog`` for capacity, or a validation reason like
+    ``token_id_out_of_range`` — then the request is terminal REJECTED and
+    queryable via ``session.rejected``)."""
+
+    admitted: bool
+    reason: Optional[str] = None
+
+    def __bool__(self) -> bool:
+        return self.admitted
+
+
+ADMITTED = AdmissionResult(True)
 
 
 @dataclass
 class Request:
     req_id: str
-    input_ids: np.ndarray  # (S,)
+    input_ids: np.ndarray  # (S,) effective prompt (re-admission folds
+    # previously-generated tokens in; `absorbed` counts them)
     max_new_tokens: int = 64
     eos_token_id: Optional[int] = None
     slot: int = -1
@@ -59,7 +117,15 @@ class Request:
     prefill_pos: int = 0  # prompt tokens already in the KV cache
     generated: List[int] = field(default_factory=list)
     finished: bool = False
-    preempted: bool = False  # evicted mid-decode (KV pool exhausted)
+    preempted: bool = False  # currently evicted (queued for re-admission)
+    # --- fault containment ------------------------------------------------
+    status: str = STATUS_ACTIVE
+    fail_reason: Optional[str] = None  # set when status == failed/rejected
+    deadline_s: Optional[float] = None  # wall-clock TTL from submission
+    t_submit: float = 0.0  # session-clock submission time
+    preemptions: int = 0  # pool-exhaustion evictions survived
+    absorbed: int = 0  # generated tokens folded into input_ids (re-admission)
+    epoch: int = 0  # bumped per eviction: stale in-flight rows are discarded
 
     @property
     def prompt_len(self) -> int:
@@ -75,16 +141,47 @@ class Request:
 
 
 class ServingSession:
-    def __init__(self, app, telemetry=None):
+    def __init__(
+        self,
+        app,
+        telemetry=None,
+        fault_injector=None,
+        clock: Optional[Callable[[], float]] = None,
+        sleep_fn: Optional[Callable[[float], None]] = None,
+    ):
         """``telemetry``: a :class:`~..telemetry.TelemetrySession` observing
         this session; defaults to the process-default session (inert unless
         ``telemetry.enable_default_session()`` ran). Recording is host-side
         bookkeeping riding the fetches the session already performs — the
         fetch-parity test pins that enabling it adds ZERO device round
-        trips per step."""
+        trips per step.
+
+        ``fault_injector``: a :class:`~.faults.FaultInjector` whose armed
+        faults fire at this session's host boundaries (tests only; an idle
+        injector is byte-identical to none). ``clock``/``sleep_fn``: the
+        wall-clock source for deadlines/backoff (default ``time.monotonic``
+        / ``time.sleep``) — injectable so deadline and backoff policies pin
+        deterministically."""
         self.app = app
         self.tel = telemetry if telemetry is not None else default_session()
         tc = app.config.tpu_config
+        # --- fault containment (docs/SERVING.md "Failure containment") ----
+        self.faults = fault_injector
+        self._clock = clock if clock is not None else time.monotonic
+        self._sleep = sleep_fn if sleep_fn is not None else time.sleep
+        self.admission_validation = bool(getattr(tc, "admission_validation", True))
+        self.deadline_s = getattr(tc, "request_deadline_s", None)
+        self.max_dispatch_retries = int(getattr(tc, "dispatch_max_retries", 2))
+        self.watchdog_steps = int(getattr(tc, "watchdog_no_progress_steps", 0))
+        self.rejected: Dict[str, Request] = {}  # terminal REJECTED requests
+        self._readmit: deque = deque()  # preempted, aged ahead of arrivals
+        self._step_index = 0
+        self._no_progress = 0
+        self._watchdog_fired = False
+        self._prefilled_total = 0  # monotone: prompt tokens written
+        self._committed_total = 0  # monotone: tokens committed to requests
+        self._terminal_total = 0  # monotone: requests reaching a terminal state
+        self._last_dispatch_error: Optional[str] = None
         if not tc.is_continuous_batching:
             raise ValueError("ServingSession requires is_continuous_batching=True")
         self.num_slots = tc.kv_cache_batch_size or tc.max_batch_size
@@ -191,51 +288,382 @@ class ServingSession:
         input_ids: np.ndarray,
         max_new_tokens: int = 64,
         eos_token_id: Optional[int] = None,
-    ) -> bool:
-        """Admit one request into a free KV line. Returns False if full."""
+        deadline_s: Optional[float] = None,
+    ) -> AdmissionResult:
+        """Admit one request into a free KV line. Returns a truthy
+        :class:`AdmissionResult` when admitted; falsy with a ``reason``
+        otherwise. Malformed requests (out-of-range token ids, empty
+        prompt, over-long prompt, non-positive budget) get a terminal
+        REJECTED verdict at the door instead of raising mid-batch —
+        ``admission_validation=False`` restores the legacy raise-late
+        behavior. ``deadline_s`` overrides the config-wide
+        ``request_deadline_s`` wall-clock TTL for this request."""
         self.tel.request_submitted(req_id)
-        free = self.free_slots
-        if not free:
-            self.tel.request_dropped(req_id, "no_slot")
-            return False
-        slot = free[0]
         req = Request(
             req_id=req_id,
             input_ids=np.asarray(input_ids, np.int32).reshape(-1),
             max_new_tokens=max_new_tokens,
             eos_token_id=eos_token_id,
-            slot=slot,
+            deadline_s=deadline_s if deadline_s is not None else self.deadline_s,
+            t_submit=self._clock(),
         )
+        if self.admission_validation:
+            reason = self._validate_request(req)
+            if reason is not None:
+                return self._reject(req, reason)
+        # aging: preempted requests re-enter AHEAD of new arrivals — a new
+        # request may not claim the capacity an older evicted one is
+        # waiting for (repeated pool exhaustion cannot starve it)
+        self._readmit_preempted()
+        if self._readmit:
+            self.tel.request_dropped(req_id, "backlog")
+            return AdmissionResult(False, "backlog")
+        free = self.free_slots
+        if not free:
+            self.tel.request_dropped(req_id, "no_slot")
+            return AdmissionResult(False, "no_slot")
+        return self._admit(req, free[0])
+
+    def _validate_request(self, req: Request) -> Optional[str]:
+        """Typed admission checks; returns a reject reason or None. Every
+        reason here is a malformed INPUT the model could only answer with
+        garbage (or a mid-batch exception) — capacity refusals stay on the
+        drop path and are retryable by the caller."""
+        if req.prompt_len == 0:
+            return "empty_prompt"
+        if req.max_new_tokens < 1:
+            return "invalid_max_new_tokens"
+        ids = req.input_ids
+        vocab = int(self.app.config.vocab_size)
+        if int(ids.min()) < 0 or int(ids.max()) >= vocab:
+            # an out-of-vocab id gathers garbage embeddings (the
+            # ROADMAP-named NaN-row source) — refuse it at the door
+            return "token_id_out_of_range"
+        if req.prompt_len > self._max_admissible_prompt():
+            return "prompt_too_long"
+        return None
+
+    def _max_admissible_prompt(self) -> int:
+        """Longest prompt this session can admit without raising mid-batch:
+        at least one position must remain for the first generated token, and
+        a paged cache WITHOUT chunked prefill runs admission through a
+        single context program (runtime paths mirror _full_prefill)."""
+        limit = self.app._pos_limit() - 1
+        if self.block_mode and not self.chunked:
+            ring_w = self.app.spec.bounded_window or self.app.spec.ring_window
+            limit = min(limit, self.app.context_encoding_model.buckets[-1])
+            if ring_w:
+                limit = min(limit, ring_w)
+        return limit
+
+    def _reject(self, req: Request, reason: str) -> AdmissionResult:
+        """Terminal REJECTED: recorded (queryable via ``session.rejected``,
+        bounded oldest-evicted at REJECTED_HISTORY_MAX) but never admitted —
+        no slot, no dispatch, no effect on co-batched requests."""
+        req.finished = True
+        req.status = STATUS_REJECTED
+        req.fail_reason = reason
+        self.rejected[req.req_id] = req
+        while len(self.rejected) > REJECTED_HISTORY_MAX:
+            self.rejected.pop(next(iter(self.rejected)))
+        self.tel.request_rejected(req.req_id, reason)
+        return AdmissionResult(False, reason)
+
+    def _admit(self, req: Request, slot: int, fresh: bool = True) -> AdmissionResult:
+        """Bind ``req`` to ``slot`` and run its admission prefill.
+        ``fresh=False`` is the re-admission path: a capacity failure
+        re-queues the request instead of dropping it."""
+        req.slot = slot
+        req.status = STATUS_ACTIVE
+        req.preempted = False
+        req.prefill_pos = 0
+        req.pos = 0
         if self.prefix_caching:
             req.prefill_pos = self.allocator.match_prefix(slot, req.input_ids)
             req.pos = req.prefill_pos
         self.slots[slot] = req
-        self.requests[req_id] = req
-        self.tel.request_admitted(req_id, cached_prefix_tokens=req.prefill_pos)
+        self.requests[req.req_id] = req
+        self.tel.request_admitted(req.req_id, cached_prefix_tokens=req.prefill_pos)
 
         if self.chunked:
             # prompt runs in chunks inside step(); nothing dispatched yet
-            return True
+            return ADMITTED
         if req.prefill_pos > 0:
             # prefix hit: only the uncached suffix runs (prior-KV prefill)
             ok = self._prefill_chunks([req], req.prompt_len - req.prefill_pos)
-            if not ok:
-                self._drop(req)
-                self.tel.request_dropped(req_id, "kv_blocks")
-                return False
-            return True
-        ok = self._full_prefill(req)
-        if not ok:
-            self._drop(req)
-            self.tel.request_dropped(req_id, "kv_blocks")
-        return ok
+        else:
+            ok = self._full_prefill(req)
+        if ok:
+            return ADMITTED
+        # out of KV blocks at admission-time prefill
+        self._release_slot(req)
+        if fresh:
+            self.requests.pop(req.req_id, None)
+            self.tel.request_dropped(req.req_id, "kv_blocks")
+        return AdmissionResult(False, "kv_blocks")
 
-    def _drop(self, req: Request):
-        if self.block_mode and req.slot >= 0:
-            self.allocator.free_seq(req.slot)
-        if req.slot >= 0:
-            self.slots[req.slot] = None
-        self.requests.pop(req.req_id, None)
+    # ---- fault containment: release/scrub, preempt/re-admit, deadlines, ---
+    # ---- watchdog, bounded dispatch retry ---------------------------------
+
+    def _release_slot(self, req: Request, scrub: bool = False):
+        """Free a request's KV line/blocks and slot. ``scrub=True`` zeroes
+        the released KV on device FIRST (quarantine path): a poisoned row's
+        NaNs must not survive into the free pool, where a later request
+        would gather them as masked-but-non-finite stale positions
+        (0 * NaN = NaN — the same coupling the garbage-block read scrub
+        closes for block 0)."""
+        if req.slot < 0:
+            return
+        if self.block_mode:
+            if scrub:
+                # allocator-mediated: with prefix caching, blocks a live
+                # sharer still references must NOT be zeroed (their content
+                # is a healthy prefill's), and the victim's registered
+                # blocks must leave the match index before they recycle
+                blocks = self.allocator.quarantine_seq(req.slot)
+                if blocks:
+                    self.app.kv_cache = fill_kv_rows(self.app.kv_cache, blocks, 0.0)
+            else:
+                self.allocator.free_seq(req.slot)
+        elif scrub:
+            self.app.kv_cache = fill_kv_rows(
+                self.app.kv_cache, [self._cache_line_of_slot(req.slot)], 0.0
+            )
+        self.slots[req.slot] = None
+        req.slot = -1
+
+    def _cache_line_of_slot(self, slot: int) -> int:
+        """Contiguous-cache line for a serving slot (the attention-DP layout
+        interleaves one garbage line per dp shard; kvcache.init_cache)."""
+        dp = int(getattr(self.app.config.tpu_config, "attention_dp_degree", 1) or 1)
+        if dp <= 1:
+            return slot
+        sr = self.num_slots // dp
+        return (slot // sr) * (sr + 1) + slot % sr
+
+    def _garbage_lines(self) -> List[int]:
+        """Contiguous-cache garbage line indices (one per dp shard)."""
+        dp = int(getattr(self.app.config.tpu_config, "attention_dp_degree", 1) or 1)
+        if dp <= 1:
+            return [self.num_slots]
+        sr = self.num_slots // dp
+        return [shard * (sr + 1) + sr for shard in range(dp)]
+
+    def _alloc(self, slot: int, num_tokens: int):
+        """Allocator gateway for the serving step paths: the fault injector
+        forces pool exhaustion here without shrinking the real pool."""
+        if self.faults is not None and self.faults.pool_exhausted(self):
+            raise RuntimeError("out of KV blocks (injected fault)")
+        return self.allocator.alloc_seq(slot, num_tokens)
+
+    def _preempt(self, req: Request):
+        """NON-terminal pool-exhaustion eviction: roll the request back to
+        its committed host state (any in-flight device step is discarded —
+        greedy decode regenerates the identical token after re-admission),
+        free its slot/blocks, and queue it for re-admission AHEAD of new
+        arrivals (aging: repeated exhaustion cannot starve it forever)."""
+        if req.finished or req.preempted:
+            return
+        req.preempted = True
+        req.preemptions += 1
+        req.epoch += 1  # stale in-flight rows are dropped on consume
+        req.status = STATUS_WAITING
+        self._release_slot(req)
+        self._readmit.append(req)  # FIFO among evicted: oldest first
+        self.tel.request_preempted(req.req_id)
+
+    def _readmit_preempted(self) -> int:
+        """Re-admit evicted requests (oldest first) into free capacity.
+        The committed tokens fold into the prefill prompt, so the request
+        resumes exactly where it rolled back — byte-identical to a run that
+        was never preempted. Stops at the first request that still cannot
+        fit (FIFO order is the aging guarantee)."""
+        n = 0
+        while self._readmit and self.free_slots:
+            req = self._readmit[0]
+            new = req.generated[req.absorbed:]
+            if new:
+                req.input_ids = np.concatenate(
+                    [req.input_ids, np.asarray(new, np.int32)]
+                )
+                req.absorbed += len(new)
+            never_fits = req.prompt_len > self._max_admissible_prompt() or (
+                # re-prefilling prompt+committed can NEVER fit the whole
+                # pool: retrying would spin (each cycle preempts again)
+                self.block_mode
+                and -(-req.prompt_len // self.allocator.block_size)
+                > self.allocator.num_blocks
+            )
+            if never_fits or len(req.generated) >= req.max_new_tokens:
+                # can never re-admit (or nothing left to generate): terminal
+                self._readmit.popleft()
+                self._finish(req, "preempted" if len(req.generated) <
+                             req.max_new_tokens else None)
+                continue
+            self._readmit.popleft()
+            if not self._admit(req, self.free_slots[0], fresh=False):
+                req.preempted = True
+                req.status = STATUS_WAITING
+                if not self.active:
+                    # nothing live will ever free more capacity: terminal
+                    self._finish(req, "preempted")
+                    continue
+                # pool still exhausted: back to the FRONT, stop trying
+                self._readmit.appendleft(req)
+                break
+            n += 1
+        return n
+
+    def _expire_deadlines(self):
+        """Drop every live request past its wall-clock TTL (terminal
+        ``deadline_exceeded``); checked at step boundaries, so the observed
+        overrun is bounded by step latency."""
+        now = self._clock()
+        live = [r for r in self.slots if r is not None] + list(self._readmit)
+        for req in live:
+            if req.finished or req.deadline_s is None:
+                continue
+            overrun = now - (req.t_submit + req.deadline_s)
+            if overrun <= 0:
+                continue
+            try:
+                self._readmit.remove(req)
+            except ValueError:
+                pass
+            self.tel.deadline_exceeded(req.req_id, overrun)
+            self._finish(req, "deadline_exceeded")
+
+    def _progress_signature(self):
+        """Monotone progress markers: admissions (new requests), committed
+        tokens, terminal transitions, and prefilled prompt tokens. A step
+        that changes none of these made zero forward progress. All four are
+        O(1) session counters — the signature must not walk ``requests``
+        (which grows for the life of the session) on the per-step hot
+        path."""
+        return (
+            len(self.requests),
+            self._committed_total,
+            self._terminal_total,
+            self._prefilled_total,
+        )
+
+    def _watchdog_tick(self, progressed: bool):
+        """No-forward-progress watchdog: after ``watchdog_no_progress_steps``
+        consecutive zero-progress steps with live work, preempt the largest
+        request (frees the most pool — the likely deadlock hold-and-wait);
+        if a FULL second window then passes with still zero progress, fail
+        loudly with a diagnostic snapshot instead of spinning forever."""
+        if self.watchdog_steps <= 0:
+            return
+        if progressed or not (self.active or self._readmit):
+            self._no_progress = 0
+            self._watchdog_fired = False
+            return
+        self._no_progress += 1
+        if self._no_progress < self.watchdog_steps:
+            return
+        window = self._no_progress
+        self._no_progress = 0
+        victim = None
+        if not self._watchdog_fired:
+            victim = max(
+                self.active,
+                key=lambda r: max(r.pos, r.prefill_pos),
+                default=None,
+            )
+        if victim is not None:
+            self._watchdog_fired = True
+            self.tel.watchdog_preempted(victim.req_id)
+            self._preempt(victim)
+            return
+        self.tel.watchdog_tripped(window)
+        snap = self.diagnostic_snapshot()
+        raise WatchdogError(
+            f"serving session made no forward progress for {window} "
+            f"consecutive steps (zero committed tokens, zero prefill "
+            f"advance, zero admissions) after a watchdog preemption already "
+            f"fired — failing loudly instead of spinning. Diagnostic "
+            f"snapshot: {json.dumps(snap, default=str)}",
+            snapshot=snap,
+        )
+
+    def diagnostic_snapshot(self) -> dict:
+        """Host-state dump for the watchdog's loud failure (and operators):
+        who holds what, who waits, and what the pool looks like."""
+        return {
+            "step_index": self._step_index,
+            "watchdog_window": self.watchdog_steps,
+            "active": [
+                {
+                    "req_id": r.req_id,
+                    "slot": r.slot,
+                    "status": r.status,
+                    "pos": r.pos,
+                    "prefill_pos": r.prefill_pos,
+                    "generated": len(r.generated),
+                    "preemptions": r.preemptions,
+                }
+                for r in self.active
+            ],
+            "waiting": [r.req_id for r in self._readmit],
+            "free_slots": self.free_slots,
+            "kv_pool_bytes": self.kv_pool_bytes,
+            "kv_free_bytes": self.kv_free_bytes,
+            "free_blocks": len(self.allocator.free) if self.block_mode else None,
+            "last_dispatch_error": self._last_dispatch_error,
+        }
+
+    def _guarded_dispatch(self, label: str, reqs: List[Request], fn):
+        """Run one device dispatch with bounded-backoff retry. Transient
+        errors (RETRYABLE_DISPATCH_ERRORS) retry up to
+        ``dispatch_max_retries`` times with capped exponential backoff;
+        exhaustion terminally FAILs only the in-flight ``reqs``
+        (dispatch_error) and returns None — the session, and every other
+        request, keeps running. Anything non-transient propagates: that is
+        a programming error, not weather."""
+        attempt = 0
+        while True:
+            try:
+                if self.faults is not None:
+                    self.faults.on_dispatch(self, label)
+                return fn()
+            except RETRYABLE_DISPATCH_ERRORS as e:
+                attempt += 1
+                if attempt > self.max_dispatch_retries:
+                    self._last_dispatch_error = repr(e)
+                    if self.faults is not None:
+                        self.faults.dispatch_gave_up(self)
+                    for r in reqs:
+                        if not r.finished:
+                            self._finish(r, "dispatch_error")
+                    return None
+                self.tel.dispatch_retry(label)
+                self._sleep(
+                    min(
+                        DISPATCH_BACKOFF_CAP_S,
+                        DISPATCH_BACKOFF_BASE_S * (2 ** (attempt - 1)),
+                    )
+                )
+
+    def _quarantine(self, req: Request):
+        """FAILED(non_finite): the host observed the non-finite sentinel on
+        this row's fetched tokens. Only this row dies — its KV is zero-
+        scrubbed on release so the recycled blocks/line cannot poison a
+        later request, and co-batched rows stay byte-identical (pinned)."""
+        if req.finished:
+            return
+        self.tel.row_quarantined(req.req_id)
+        self._finish(req, "non_finite", scrub=True)
+
+    def _note_prefill(self, req: Request, n: int):
+        self._prefilled_total += n
+        self.tel.prefill_dispatch(req.req_id, n)
+
+    def _commit_tokens(self, req: Request, n: int):
+        """Every decode-token commit routes through here so the watchdog's
+        progress counter cannot drift from what ``generated`` received."""
+        self._committed_total += n
+        self.tel.request_tokens(req.req_id, n)
 
     def _full_prefill(self, req: Request) -> bool:
         """Whole-prompt context encoding (flash-kernel eligible CTE path).
@@ -270,20 +698,26 @@ class ServingSession:
         slot_mapping = None
         if self.block_mode:
             try:
-                self.allocator.alloc_seq(req.slot, S)
+                self._alloc(req.slot, S)
             except RuntimeError:
                 return False  # out of KV blocks
             slot_mapping = self.allocator.slot_mapping(req.slot, np.arange(S))[None, :]
         cte = self.app.context_encoding_model
-        with self.tel.span("serving.prefill", req_id=req.req_id, tokens=S):
-            inputs, _ = cte.prepare(
-                ids, mask, pos, seq_ids, slot_mapping=slot_mapping
-            )
-            out = cte(self.app.params, self.app.kv_cache, inputs, None)
+
+        def dispatch():
+            with self.tel.span("serving.prefill", req_id=req.req_id, tokens=S):
+                inputs, _ = cte.prepare(
+                    ids, mask, pos, seq_ids, slot_mapping=slot_mapping
+                )
+                return cte(self.app.params, self.app.kv_cache, inputs, None)
+
+        out = self._guarded_dispatch("prefill", [req], dispatch)
+        if out is None:
+            return True  # terminal FAILED(dispatch_error); slot released
         self.app.kv_cache = out.cache
         self.tel.step("prefill")
         self.tel.bucket_dispatch(cte.tag, cte.last_bucket)
-        self.tel.prefill_dispatch(req.req_id, S)
+        self._note_prefill(req, S)
         self.tel.pool_gauges(
             len(self.active), self.kv_pool_bytes, self.kv_free_bytes
         )
@@ -320,18 +754,28 @@ class ServingSession:
         n0 = min(C, S)
         ids0 = req.input_ids[None, :n0]
         pos0 = np.arange(n0, dtype=np.int32)[None, :]
-        with self.tel.span("serving.prefill_windowed", req_id=req.req_id, tokens=n0):
-            inputs, _ = app.context_encoding_model.prepare(
-                ids0, np.ones((1, n0), np.int32), pos0,
-                np.array([s], np.int32), prepare_sampling_params(1),
-            )
-            out = app.context_encoding_model(app.params, app.kv_cache, inputs, None)
+
+        def dispatch_cte():
+            with self.tel.span(
+                "serving.prefill_windowed", req_id=req.req_id, tokens=n0
+            ):
+                inputs, _ = app.context_encoding_model.prepare(
+                    ids0, np.ones((1, n0), np.int32), pos0,
+                    np.array([s], np.int32), prepare_sampling_params(1),
+                )
+                return app.context_encoding_model(
+                    app.params, app.kv_cache, inputs, None
+                )
+
+        out = self._guarded_dispatch("prefill_windowed", [req], dispatch_cte)
+        if out is None:
+            return True  # terminal FAILED(dispatch_error); slot released
         app.kv_cache = out.cache
         self.tel.step("prefill")
         self.tel.bucket_dispatch(
             app.context_encoding_model.tag, app.context_encoding_model.last_bucket
         )
-        self.tel.prefill_dispatch(req.req_id, n0)
+        self._note_prefill(req, n0)
         # no fetch here: this path only triggers for S > C, so the chunk loop
         # below always runs and the final chunk's token is the one emitted
 
@@ -354,19 +798,27 @@ class ServingSession:
             mask = np.ones((B, width), np.int32)
             seq_ids = np.full((B,), -1, np.int32)
             seq_ids[s] = s
-            with self.tel.span(
-                "serving.prefill_windowed", req_id=req.req_id, tokens=n
-            ):
-                inputs, _ = app.token_generation_model.prepare(
-                    ids, mask, pos, seq_ids, prepare_sampling_params(B)
-                )
-                out = app.token_generation_model(app.params, app.kv_cache, inputs, None)
+
+            def dispatch_chunk(ids=ids, mask=mask, pos=pos, seq_ids=seq_ids, n=n):
+                with self.tel.span(
+                    "serving.prefill_windowed", req_id=req.req_id, tokens=n
+                ):
+                    inputs, _ = app.token_generation_model.prepare(
+                        ids, mask, pos, seq_ids, prepare_sampling_params(B)
+                    )
+                    return app.token_generation_model(
+                        app.params, app.kv_cache, inputs, None
+                    )
+
+            out = self._guarded_dispatch("prefill_windowed", [req], dispatch_chunk)
+            if out is None:
+                return True  # terminal FAILED(dispatch_error); slot released
             app.kv_cache = out.cache
             self.tel.step("prefill")
             self.tel.bucket_dispatch(
                 app.token_generation_model.tag, app.token_generation_model.last_bucket
             )
-            self.tel.prefill_dispatch(req.req_id, n)
+            self._note_prefill(req, n)
             start = end
         # ONE host sync for the whole admission: only the last chunk's token
         # at the final prompt position matters
@@ -376,8 +828,16 @@ class ServingSession:
         return True
 
     def _finish_prefill(self, req: Request, first_token: int):
+        if first_token < 0:
+            # the non-finite sentinel (models/base.NON_FINITE_TOKEN): this
+            # row's logits were NaN/Inf at its final prompt position —
+            # quarantine it instead of committing a garbage token
+            req.pos = req.prompt_len
+            self._quarantine(req)
+            return
         req.pos = req.prompt_len
         req.generated.append(first_token)
+        self._committed_total += 1
         self.tel.request_first_token(req.req_id)
         if self.prefix_caching:
             self.allocator.commit_seq(req.slot, req.input_ids)
@@ -402,12 +862,11 @@ class ServingSession:
             if n <= 0:
                 continue
             try:
-                self.allocator.alloc_seq(req.slot, req.prefill_pos + n)
+                self._alloc(req.slot, req.prefill_pos + n)
             except RuntimeError:
                 if not preempt:
                     return False
-                req.preempted = True
-                self._finish(req)
+                self._preempt(req)
                 continue
             rows.append((req, n))
         if not rows:
@@ -441,17 +900,23 @@ class ServingSession:
             seq_ids[s] = s
 
         tkg = self.app.token_generation_model
-        with self.tel.span("serving.prefill_chunk", rows=len(rows)):
-            inputs, _ = tkg.prepare(
-                ids, mask, positions, seq_ids, prepare_sampling_params(B),
-                slot_mapping=slot_mapping, block_table=block_table,
-            )
-            out = tkg(self.app.params, self.app.kv_cache, inputs, None)
+
+        def dispatch():
+            with self.tel.span("serving.prefill_chunk", rows=len(rows)):
+                inputs, _ = tkg.prepare(
+                    ids, mask, positions, seq_ids, prepare_sampling_params(B),
+                    slot_mapping=slot_mapping, block_table=block_table,
+                )
+                return tkg(self.app.params, self.app.kv_cache, inputs, None)
+
+        out = self._guarded_dispatch("prefill_chunk", [r for r, _ in rows], dispatch)
+        if out is None:
+            return True  # in-flight rows terminally FAILED(dispatch_error)
         self.app.kv_cache = out.cache
         self.tel.step("prefill")
         self.tel.bucket_dispatch(tkg.tag, tkg.last_bucket)
         for req, n in rows:
-            self.tel.prefill_dispatch(req.req_id, n)
+            self._note_prefill(req, n)
         self.tel.pool_gauges(
             len(self.active), self.kv_pool_bytes, self.kv_free_bytes
         )
@@ -464,30 +929,34 @@ class ServingSession:
                 self._finish_prefill(req, int(tokens[req.slot, n - 1]))
         return True
 
-    def _finish(self, req: Request):
-        # _finish can legitimately run twice for one request (a preempted
-        # row's already-dispatched token is consumed one step later and may
-        # hit a termination condition again) — telemetry must count the
-        # FIRST finish only
+    def _finish(self, req: Request, reason: Optional[str] = None, scrub: bool = False):
+        # _finish can legitimately run twice for one request (an already-
+        # dispatched row's token is consumed one step later and may hit a
+        # termination condition again) — telemetry must count the FIRST
+        # finish only. ``reason=None`` derives eos/length from the stream;
+        # explicit reasons come from the containment paths (non_finite /
+        # dispatch_error / deadline_exceeded / terminal preempted).
         already_finished = req.finished
         req.finished = True
         if not already_finished:
-            if req.preempted:
-                reason = "preempted"
-            elif (
-                req.eos_token_id is not None
-                and req.generated
-                and req.generated[-1] == req.eos_token_id
-            ):
-                reason = "eos"
+            self._terminal_total += 1
+            if reason is None:
+                reason = (
+                    "eos"
+                    if (
+                        req.eos_token_id is not None
+                        and req.generated
+                        and req.generated[-1] == req.eos_token_id
+                    )
+                    else "length"
+                )
+            if reason in FAILURE_REASONS:
+                req.status = STATUS_FAILED
+                req.fail_reason = reason
             else:
-                reason = "length"
+                req.status = STATUS_FINISHED
             self.tel.request_finished(req.req_id, reason)
-        if req.slot >= 0:
-            if self.block_mode:
-                self.allocator.free_seq(req.slot)
-            self.slots[req.slot] = None
-            req.slot = -1
+        self._release_slot(req, scrub=scrub)
 
     @property
     def active(self) -> List[Request]:
@@ -516,6 +985,12 @@ class ServingSession:
         decode step for every decoding request. Returns {req_id: token} for
         tokens produced this step.
 
+        Containment wrapper (docs/SERVING.md "Failure containment"): each
+        step also expires deadlines, re-admits preempted requests (aged
+        ahead of new arrivals), fires any armed fault injections, and feeds
+        the no-forward-progress watchdog. All of it is host bookkeeping —
+        zero extra device fetches (fetch-parity pinned).
+
         Async 1-ahead semantics (``async_mode=True``, the default): decode
         results are consumed one step() LATE — a request's first decode token
         appears on the step() AFTER the one that dispatched it, and its final
@@ -526,6 +1001,27 @@ class ServingSession:
         with ``async_mode=False`` for dispatch+fetch-per-step behavior;
         :meth:`run_to_completion` always uses the fastest chained modes.
         """
+        self._step_index += 1
+        # progress baseline BEFORE re-admission: a successful re-admission
+        # commits real tokens (the resumed prefill's next token) and those
+        # must count as forward progress, or a preempt/re-admit churn that
+        # advances one token per cycle would trip a spurious WatchdogError.
+        # The genuinely-livelocked case still escalates: a failed
+        # re-admission moves none of the signature counters.
+        before = self._progress_signature()
+        if self.faults is not None:
+            self.faults.on_step_begin(self)
+        self._expire_deadlines()
+        self._readmit_preempted()
+        if self.faults is not None and self.faults.stalled(self):
+            results: Dict[str, int] = {}
+        else:
+            results = self._step_inner()
+        progressed = bool(results) or self._progress_signature() != before
+        self._watchdog_tick(progressed)
+        return results
+
+    def _step_inner(self) -> Dict[str, int]:
         if self.ragged:
             return self._ragged_step()
         results: Dict[str, int] = {}
@@ -561,7 +1057,19 @@ class ServingSession:
         # consume.
         pend = self._pending
         self._pending = None
-        pend_pos = {id(req): p for req, p, _ in pend[1]} if pend else {}
+        # chain only rows whose pending entry is still CURRENT: a row that
+        # was preempted/quarantined since its dispatch carries a stale epoch
+        # and must restart from host state (its in-flight token is discarded
+        # and — greedy — regenerated identically after re-admission)
+        pend_pos = (
+            {
+                id(req): p
+                for req, p, _s, e in pend[1]
+                if e == req.epoch and not req.finished and not req.preempted
+            }
+            if pend
+            else {}
+        )
         rows: List = []
         chained_slots: List[int] = []
         for r in active:
@@ -595,20 +1103,18 @@ class ServingSession:
                 if n <= 0:
                     continue
                 try:
-                    self.allocator.alloc_seq(req.slot, req.prefill_pos + n)
+                    self._alloc(req.slot, req.prefill_pos + n)
                 except RuntimeError:
-                    # pool exhausted: preempt so the session never stalls
-                    # (same policy as _prefill_chunks(preempt=True))
-                    req.preempted = True
-                    self._finish(req)
+                    # pool exhausted: preempt (re-queued with aging) so the
+                    # session never stalls — _prefill_chunks(preempt=True)
+                    self._preempt(req)
                     continue
                 rows.append((req, "prefill", n))
         for r in self.decoding:
             try:
-                self.allocator.alloc_seq(r.slot, r.pos + 1)
+                self._alloc(r.slot, r.pos + 1)
             except RuntimeError:
-                r.preempted = True
-                self._finish(r)
+                self._preempt(r)
                 continue
             rows.append((r, "decode", 1))
         if not rows:
@@ -652,12 +1158,17 @@ class ServingSession:
         for req, _kind, _n in rows:
             block_table[req.slot] = self.allocator.block_table(req.slot, mb)
 
-        with self.tel.span("serving.mixed_step", rows=len(rows), tokens=T):
-            inputs, _ = mr.prepare(
-                ids, positions, slot_mapping, row_start, row_len, ctx_len,
-                block_table, width, prepare_sampling_params(R),
-            )
-            out = mr(self.app.params, self.app.kv_cache, inputs, None)
+        def dispatch():
+            with self.tel.span("serving.mixed_step", rows=len(rows), tokens=T):
+                inputs, _ = mr.prepare(
+                    ids, positions, slot_mapping, row_start, row_len, ctx_len,
+                    block_table, width, prepare_sampling_params(R),
+                )
+                return mr(self.app.params, self.app.kv_cache, inputs, None)
+
+        out = self._guarded_dispatch("mixed_step", [r for r, *_ in rows], dispatch)
+        if out is None:
+            return results  # in-flight rows terminally FAILED(dispatch_error)
         self.app.kv_cache = out.cache
         self.tel.step("mixed")
         self.tel.bucket_dispatch(mr.tag, mr.last_bucket)
@@ -671,12 +1182,14 @@ class ServingSession:
         )
         for req, kind, n in rows:
             if kind == "prefill":
-                self.tel.prefill_dispatch(req.req_id, n)
+                self._note_prefill(req, n)
         self.tel.pool_gauges(
             len(self.active), self.kv_pool_bytes, self.kv_free_bytes
         )
 
         tokens = np.asarray(out.tokens)  # the only device sync per step
+        if self.faults is not None:
+            tokens = self.faults.corrupt_tokens(self, tokens)
         for req, kind, n in rows:
             tok = int(tokens[req.slot, 0])
             if kind == "prefill":
@@ -685,10 +1198,16 @@ class ServingSession:
                     # the last prompt token's output IS the first generated
                     # token (same contract as _prefill_chunks)
                     self._finish_prefill(req, tok)
-                    results[req.req_id] = tok
+                    if req.status != STATUS_FAILED:  # not quarantined
+                        results[req.req_id] = tok
+                continue
+            if tok < 0:
+                # non-finite sentinel: only this row dies, co-batched rows
+                # stay byte-identical (pinned by the fault suite)
+                self._quarantine(req)
                 continue
             req.generated.append(tok)
-            self.tel.request_tokens(req.req_id, 1)
+            self._commit_tokens(req, 1)
             req.pos += 1
             results[req.req_id] = tok
             if self._is_done(req, tok):
@@ -722,13 +1241,13 @@ class ServingSession:
             block_table = np.zeros((B, mb), np.int32)
             for r, p in list(rows):
                 try:
-                    self.allocator.alloc_seq(r.slot, p + 1)
+                    self._alloc(r.slot, p + 1)
                 except RuntimeError:
                     # pool exhausted mid-decode: preempt this request so the
-                    # others keep running (vLLM-style preemption; the caller
-                    # can re-submit with the tokens generated so far)
-                    r.preempted = True
-                    self._finish(r)
+                    # others keep running (vLLM-style preemption; it re-
+                    # queues AHEAD of new arrivals and resumes byte-
+                    # identically once blocks free up)
+                    self._preempt(r)
                     rows.remove((r, p))
                     continue
                 block_table[r.slot] = self.allocator.block_table(r.slot, mb)
@@ -750,31 +1269,41 @@ class ServingSession:
             )
         # inactive rows: mask garbage anyway
         tkg = self.app.token_generation_model
-        with self.tel.span("serving.decode", rows=len(rows)):
-            inputs, _ = tkg.prepare(
-                last_arr, mask, pos, seq_ids, prepare_sampling_params(B),
-                block_table=block_table,
-            )
-            out = tkg(self.app.params, self.app.kv_cache, inputs, None)
+
+        def dispatch():
+            with self.tel.span("serving.decode", rows=len(rows)):
+                inputs, _ = tkg.prepare(
+                    last_arr, mask, pos, seq_ids, prepare_sampling_params(B),
+                    block_table=block_table,
+                )
+                return tkg(self.app.params, self.app.kv_cache, inputs, None)
+
+        out = self._guarded_dispatch("decode", [r for r, _ in rows], dispatch)
+        if out is None:
+            return None, []  # in-flight rows terminally FAILED(dispatch_error)
         self.app.kv_cache = out.cache
         self.tel.step("decode")
         self.tel.bucket_dispatch(tkg.tag, tkg.last_bucket)
         self.tel.pool_gauges(len(rows), self.kv_pool_bytes, self.kv_free_bytes)
-        return out, [(r, p, r.slot) for r, p in rows]
+        return out, [(r, p, r.slot, r.epoch) for r, p in rows]
 
     def _consume(self, pend, results: Dict[str, int]):
         """Fetch a dispatched decode step and apply termination bookkeeping.
         Rows whose request already finished (terminated after that dispatch)
-        are speculative leftovers — discarded."""
+        or was evicted since (stale epoch) are speculative leftovers —
+        discarded; rows carrying the non-finite sentinel are quarantined."""
         tokens = np.asarray(pend[0])[:, -1]  # the only device sync per step
-        for req, p, slot in pend[1]:
-            if req.finished and not req.preempted:
+        if self.faults is not None:
+            tokens = self.faults.corrupt_tokens(self, tokens)
+        for req, p, slot, epoch in pend[1]:
+            if req.finished or req.preempted or req.epoch != epoch:
                 continue
-            if req.preempted and req.pos != p:
-                continue  # preempted in an earlier round; row is stale
             tok = int(tokens[slot])
+            if tok < 0:
+                self._quarantine(req)
+                continue
             req.generated.append(tok)
-            self.tel.request_tokens(req.req_id, 1)
+            self._commit_tokens(req, 1)
             req.pos = p + 1
             results[req.req_id] = tok
             if self._is_done(req, tok):
@@ -795,11 +1324,16 @@ class ServingSession:
         if self.ragged:
             # the ragged mode's whole point is ONE mixed dispatch per step;
             # the multi-step TKG drain paths would reintroduce the split
-            while self.active:
+            while self.active or self._readmit:
                 self.step()
             return {rid: r.generated for rid, r in self.requests.items()}
         ring_cache = bool(spec.bounded_window or spec.ring_window)
-        while self.active:
+        while self.active or self._readmit:
+            self._expire_deadlines()
+            if not self.active:
+                # only evicted requests remain: step() re-admits (aging)
+                self.step()
+                continue
             if (
                 self.prefilling
                 # ring caches: pow2 surplus steps would overwrite live ring
@@ -910,12 +1444,23 @@ class ServingSession:
                         self.step()
                         return
                     break
-            with self.tel.span("serving.decode_chunk", steps=chunk):
-                tokens_c, _, cache = self.app.token_generation_model.decode_chunk(
-                    self.app.params, self.app.kv_cache, last_dev, pos, seq_ids,
-                    prepare_sampling_params(B), None, num_steps=chunk, bucket=bucket,
-                    block_table=block_table,
-                )
+            def dispatch(last_dev=last_dev, pos=pos, chunk=chunk, bucket=bucket,
+                         block_table=block_table):
+                with self.tel.span("serving.decode_chunk", steps=chunk):
+                    return self.app.token_generation_model.decode_chunk(
+                        self.app.params, self.app.kv_cache, last_dev, pos,
+                        seq_ids, prepare_sampling_params(B), None,
+                        num_steps=chunk, bucket=bucket, block_table=block_table,
+                    )
+
+            res = self._guarded_dispatch("decode_chunk", active, dispatch)
+            if res is None:
+                # in-flight rows terminally FAILED(dispatch_error); commit
+                # nothing past their last consumed state
+                if not chunks:
+                    return
+                break
+            tokens_c, _, cache = res
             self.app.kv_cache = cache
             self.tel.step("decode")
             self.tel.bucket_dispatch(self.app.token_generation_model.tag, bucket)
@@ -927,14 +1472,30 @@ class ServingSession:
         toks = np.concatenate(
             [np.asarray(c)[:, :take] for c, take in chunks], axis=1
         )  # ONE sync
+        if self.faults is not None:
+            toks = self.faults.corrupt_tokens(self, toks)
+        done = toks.shape[1]  # == sum of committed takes (early break safe)
         # rows advance in LOCKSTEP, so the highest-position row's headroom
         # caps this pass at `done` steps; rows needing more loop back through
         # run_to_completion (the capped row finishes at its bound first and
         # frees the headroom) — never silently under-generate
         for r in active:
+            if r.finished or r.preempted:
+                continue  # failed mid-drain (dispatch_error) or evicted
             n = min(need[r.slot], done)
-            r.generated.extend(int(t) for t in toks[r.slot, :n])
-            self.tel.request_tokens(r.req_id, n)
+            row_toks = toks[r.slot, :n]
+            neg = np.flatnonzero(row_toks < 0)
+            if neg.size:
+                # non-finite sentinel mid-chunk: commit the finite prefix,
+                # quarantine the row — co-batched rows are untouched
+                m = int(neg[0])
+                r.generated.extend(int(t) for t in row_toks[:m])
+                self._commit_tokens(r, m)
+                r.pos += m
+                self._quarantine(r)
+                continue
+            r.generated.extend(int(t) for t in row_toks)
+            self._commit_tokens(r, n)
             r.pos += n
             if len(r.generated) >= r.max_new_tokens:
                 self._finish(r)
@@ -993,29 +1554,43 @@ class ServingSession:
             if block_table is None:
                 self.step()  # pool exhausted: the per-step path preempts
                 return
-        with self.tel.span("serving.decode_chunk", steps=chunk):
-            tokens_c, _, cache = self.app.token_generation_model.decode_chunk(
-                self.app.params, self.app.kv_cache, last, pos, seq_ids,
-                prepare_sampling_params(B), None, num_steps=chunk, bucket=bucket,
-                block_table=block_table,
-            )
+        def dispatch():
+            with self.tel.span("serving.decode_chunk", steps=chunk):
+                return self.app.token_generation_model.decode_chunk(
+                    self.app.params, self.app.kv_cache, last, pos, seq_ids,
+                    prepare_sampling_params(B), None, num_steps=chunk,
+                    bucket=bucket, block_table=block_table,
+                )
+
+        res = self._guarded_dispatch("decode_chunk", active, dispatch)
+        if res is None:
+            return  # in-flight rows terminally FAILED(dispatch_error)
+        tokens_c, _, cache = res
         self.app.kv_cache = cache
         self.tel.step("decode")
         self.tel.bucket_dispatch(self.app.token_generation_model.tag, bucket)
         toks = np.asarray(tokens_c)  # ONE sync per chunk tokens
+        if self.faults is not None:
+            toks = self.faults.corrupt_tokens(self, toks)
         for r in active:
             n_obs = 0
             finished = False
+            quarantined = False
             for j in range(take):
                 tok = int(toks[r.slot, j])
+                if tok < 0:
+                    quarantined = True  # non-finite sentinel mid-chunk
+                    break
                 r.generated.append(tok)
                 n_obs += 1
                 r.pos += 1
                 if self._is_done(r, tok):
                     finished = True
                     break
-            self.tel.request_tokens(r.req_id, n_obs)
-            if finished:
+            self._commit_tokens(r, n_obs)
+            if quarantined:
+                self._quarantine(r)
+            elif finished:
                 self._finish(r)
         self.tel.pool_gauges(
             len(self.active), self.kv_pool_bytes, self.kv_free_bytes
@@ -1038,8 +1613,23 @@ class SpeculativeServingSession(ServingSession):
     reservations per step).
     """
 
-    def __init__(self, app, draft_app, speculation_length: int = 4, telemetry=None):
-        super().__init__(app, telemetry=telemetry)
+    def __init__(
+        self,
+        app,
+        draft_app,
+        speculation_length: int = 4,
+        telemetry=None,
+        fault_injector=None,
+        clock=None,
+        sleep_fn=None,
+    ):
+        super().__init__(
+            app,
+            telemetry=telemetry,
+            fault_injector=fault_injector,
+            clock=clock,
+            sleep_fn=sleep_fn,
+        )
         tc_d = draft_app.config.tpu_config
         spec = app.spec
         if self.block_mode or self.chunked:
@@ -1080,6 +1670,23 @@ class SpeculativeServingSession(ServingSession):
         self.k = speculation_length
         self.async_decode = False  # accept/reject is a host decision per step
 
+    def _max_admissible_prompt(self) -> int:
+        # the speculative session cannot run the windowed admission path
+        # (the draft prefill is a single CTE pass): cap admission at one
+        # context program of BOTH apps so _full_prefill's
+        # NotImplementedError becomes a typed REJECT at the door
+        tc = self.app.config.tpu_config
+        limit = min(
+            super()._max_admissible_prompt(),
+            tc.max_context_length,
+            self.draft.context_encoding_model.buckets[-1],
+        )
+        if self.app.spec.bounded_window:
+            limit = min(limit, self.app.spec.bounded_window)
+        if self.app.spec.ring_window:
+            limit = min(limit, self.app.spec.ring_window)
+        return limit
+
     def _full_prefill(self, req: Request) -> bool:
         # fail BEFORE any state mutates: the draft prefill below is a single
         # CTE pass, so prompts needing the windowed path are rejected here
@@ -1103,19 +1710,31 @@ class SpeculativeServingSession(ServingSession):
         mask = np.ones((1, S), np.int32)
         pos = np.arange(S, dtype=np.int32)[None, :]
         seq_ids = np.array([req.slot], np.int32)
-        inputs, _ = self.draft.context_encoding_model.prepare(
-            ids, mask, pos, seq_ids, prepare_sampling_params(1)
-        )
-        out = self.draft.context_encoding_model(
-            self.draft.params, self.draft.kv_cache, inputs, None
-        )
+
+        def dispatch_draft():
+            inputs, _ = self.draft.context_encoding_model.prepare(
+                ids, mask, pos, seq_ids, prepare_sampling_params(1)
+            )
+            return self.draft.context_encoding_model(
+                self.draft.params, self.draft.kv_cache, inputs, None
+            )
+
+        # guarded like every other dispatch: a transient draft failure must
+        # not escape add_request with the slot leaked — past the retry
+        # budget the request terminally FAILs (dispatch_error, slot
+        # released) and the session keeps serving
+        out = self._guarded_dispatch("prefill_draft", [req], dispatch_draft)
+        if out is None:
+            return True  # terminal FAILED(dispatch_error); slot released
         self.draft.kv_cache = out.cache
         return True
 
-    def step(self) -> Dict[str, int]:
+    def _step_inner(self) -> Dict[str, int]:
         """One speculation round for every decoding request. Returns ALL
         tokens accepted this round, {req_id: last_accepted_token} (use
-        request.generated for the full stream)."""
+        request.generated for the full stream). The containment wrapper
+        (deadlines, re-admission, watchdog, fault hooks) lives in the base
+        class's :meth:`ServingSession.step`."""
         import jax
 
         results: Dict[str, int] = {}
@@ -1153,10 +1772,17 @@ class SpeculativeServingSession(ServingSession):
         sp = prepare_sampling_params(B)
 
         # --- draft proposes k-1 tokens per row; target verifies all k -------
-        with self.tel.span("serving.speculate", rows=len(rows)):
-            proposals, _ = draft_propose(self.draft, last, pos, seq_ids, sp, k)
-            cand = np.concatenate([last, proposals], axis=1).astype(np.int32)
-            v_out = target_verify(self.app, cand, pos, seq_ids, sp)
+        def dispatch():
+            with self.tel.span("serving.speculate", rows=len(rows)):
+                proposals, _ = draft_propose(self.draft, last, pos, seq_ids, sp, k)
+                cand = np.concatenate([last, proposals], axis=1).astype(np.int32)
+                v_out = target_verify(self.app, cand, pos, seq_ids, sp)
+                return cand, v_out
+
+        res = self._guarded_dispatch("speculate", rows, dispatch)
+        if res is None:
+            return results  # in-flight rows terminally FAILED(dispatch_error)
+        cand, v_out = res
         self.tel.step("speculate")
         self.tel.bucket_dispatch(
             self.app.token_generation_model.tag,
@@ -1164,12 +1790,21 @@ class SpeculativeServingSession(ServingSession):
         )
         self.tel.pool_gauges(len(rows), self.kv_pool_bytes, self.kv_free_bytes)
         greedy = np.asarray(jax.device_get(v_out.tokens))[:B]  # (B, k)
+        if self.faults is not None:
+            greedy = self.faults.corrupt_tokens(self, greedy)
 
         # --- contiguous-match acceptance, per-request bookkeeping -----------
         matches = (cand[:, 1:] == greedy[:, :-1]).astype(np.int64)
         counts = np.cumprod(matches, axis=1).sum(axis=1) + 1  # in [1, k]
         for r in rows:
             s = r.slot
+            if (greedy[s, : counts[s]] < 0).any():
+                # non-finite sentinel inside the accepted window: a poisoned
+                # TARGET row — quarantine it (a poisoned DRAFT merely
+                # mis-proposes and costs acceptance length, never output
+                # correctness: the target's own greedy tokens are emitted)
+                self._quarantine(r)
+                continue
             row = greedy[s, : counts[s]].tolist()
             if r.eos_token_id is not None and r.eos_token_id in row:
                 row = row[: row.index(r.eos_token_id) + 1]
@@ -1180,7 +1815,7 @@ class SpeculativeServingSession(ServingSession):
             # truncation) tokens this round — the histogram's sum is exactly
             # the decode tokens speculation delivered for this session
             self.tel.spec_accept(len(row))
-            self.tel.request_tokens(r.req_id, len(row))
+            self._commit_tokens(r, len(row))
             r.pos += len(row)
             if row:
                 results[r.req_id] = row[-1]
@@ -1193,6 +1828,6 @@ class SpeculativeServingSession(ServingSession):
         return results
 
     def run_to_completion(self, decode_chunk_size: int = 16) -> Dict[str, List[int]]:
-        while self.active:
+        while self.active or self._readmit:
             self.step()
         return {rid: r.generated for rid, r in self.requests.items()}

@@ -49,7 +49,13 @@ import jax
 from neuronx_distributed_inference_tpu.analysis import retrace_guard
 from neuronx_distributed_inference_tpu.telemetry import metrics as metrics_mod
 
-FINISH_REASONS = ("eos", "length", "preempted", "dropped")
+FINISH_REASONS = (
+    "eos", "length", "preempted", "dropped",
+    # fault-containment terminals (runtime/serving.py, runtime/faults.py):
+    # rejected by admission validation, wall-clock TTL expiry, non-finite
+    # quarantine, and dispatch-retry exhaustion
+    "rejected", "deadline_exceeded", "non_finite", "dispatch_error",
+)
 
 
 @dataclass
@@ -121,10 +127,32 @@ class TelemetrySession:
             "nxdi_requests_admitted_total", "requests that got a KV line")
         self._dropped = r.counter(
             "nxdi_requests_dropped_total",
-            "requests rejected at admission", labels=("reason",))
+            "requests refused at admission (capacity)", labels=("reason",))
+        self._rejected = r.counter(
+            "nxdi_requests_rejected_total",
+            "requests refused by admission validation (terminal REJECTED)",
+            labels=("reason",))
         self._preempted = r.counter(
             "nxdi_requests_preempted_total",
-            "requests evicted mid-stream (KV pool exhausted)")
+            "pool-exhaustion evictions (requests re-queue for re-admission)")
+        self._quarantined = r.counter(
+            "nxdi_rows_quarantined_total",
+            "rows failed and evicted on a non-finite logits/tokens "
+            "observation (FAILED(non_finite); KV scrubbed on release)")
+        self._retries = r.counter(
+            "nxdi_dispatch_retries_total",
+            "transient dispatch errors retried with capped backoff")
+        self._deadline_overrun = r.histogram(
+            "nxdi_deadline_overrun_ms",
+            "how far past its wall-clock deadline a request was when dropped",
+            buckets=metrics_mod.LATENCY_MS_BUCKETS)
+        self._watchdog_preempt = r.counter(
+            "nxdi_watchdog_preemptions_total",
+            "largest-request preemptions forced by the no-progress watchdog")
+        self._watchdog_trips = r.counter(
+            "nxdi_watchdog_trips_total",
+            "no-progress windows that tripped the watchdog (a second "
+            "consecutive trip raises WatchdogError)")
         self._finished = r.counter(
             "nxdi_requests_finished_total", "requests completed",
             labels=("reason",))
@@ -233,8 +261,17 @@ class TelemetrySession:
     def request_admitted(self, req_id: str, cached_prefix_tokens: int = 0) -> None:
         if not self.enabled:
             return
-        self._admitted.inc()
         tr = self.traces.get(req_id)
+        if tr is not None and tr.t_admit is not None:
+            # RE-admission after a pool-exhaustion eviction: the request
+            # already holds its admission accounting (t_admit, the admitted
+            # counter) — re-counting would make admitted > submitted and
+            # shift queue-wait/TTFT baselines. Only the event log records
+            # the resumption.
+            self.event("request_readmitted", req_id=req_id,
+                       cached_prefix_tokens=cached_prefix_tokens)
+            return
+        self._admitted.inc()
         if tr is not None:
             tr.t_admit = self.clock()
             tr.cached_prefix_tokens = cached_prefix_tokens
@@ -252,6 +289,66 @@ class TelemetrySession:
             self.completed.append(tr)
         self.event("request_dropped", req_id=req_id, reason=reason)
 
+    def request_rejected(self, req_id: str, reason: str) -> None:
+        """Admission validation refused this request (terminal REJECTED):
+        malformed input — out-of-vocab token ids, empty prompt, over-long
+        prompt, invalid budget — never reaches a dispatch."""
+        if not self.enabled:
+            return
+        self._rejected.child((reason,)).inc()
+        tr = self.traces.pop(req_id, None)
+        if tr is not None:
+            tr.finish_reason = "rejected"
+            tr.t_finish = self.clock()
+            self.completed.append(tr)
+        self.event("request_rejected", req_id=req_id, reason=reason)
+
+    def request_preempted(self, req_id: str) -> None:
+        """NON-terminal pool-exhaustion eviction: the request re-queues for
+        re-admission (aging), so its trace stays open — only the preemption
+        counter and the event log record the eviction."""
+        if not self.enabled:
+            return
+        self._preempted.inc()
+        self.event("request_preempted", req_id=req_id)
+
+    def row_quarantined(self, req_id: str) -> None:
+        """A consumed row carried the non-finite sentinel: the serving
+        session fails the request and scrubs+releases its KV. The terminal
+        accounting rides the matching request_finished("non_finite")."""
+        if not self.enabled:
+            return
+        self._quarantined.inc()
+        self.event("row_quarantined", req_id=req_id)
+
+    def dispatch_retry(self, label: str) -> None:
+        if not self.enabled:
+            return
+        self._retries.inc()
+        self.event("dispatch_retry", label=label)
+
+    def deadline_exceeded(self, req_id: str, overrun_s: float) -> None:
+        """Observed at drop time: how late past its TTL the request was when
+        the session noticed (bounded by step latency — deadlines are checked
+        at step boundaries). Terminal accounting rides
+        request_finished("deadline_exceeded")."""
+        if not self.enabled:
+            return
+        self._deadline_overrun.observe(max(0.0, overrun_s) * 1e3)
+        self.event("deadline_exceeded", req_id=req_id, overrun_s=overrun_s)
+
+    def watchdog_preempted(self, req_id: str) -> None:
+        if not self.enabled:
+            return
+        self._watchdog_preempt.inc()
+        self.event("watchdog_preempted", req_id=req_id)
+
+    def watchdog_tripped(self, no_progress_steps: int) -> None:
+        if not self.enabled:
+            return
+        self._watchdog_trips.inc()
+        self.event("watchdog_tripped", no_progress_steps=no_progress_steps)
+
     def prefill_dispatch(self, req_id: str, n_tokens: int) -> None:
         """One prefill pass advanced this request by ``n_tokens`` prompt
         tokens (whole-prompt CTE counts as one chunk)."""
@@ -268,9 +365,18 @@ class TelemetrySession:
     def request_first_token(self, req_id: str) -> None:
         if not self.enabled:
             return
+        tr = self.traces.get(req_id)
+        if tr is not None and tr.t_first_token is not None:
+            # the resumed prefill of a RE-admitted request emits a token the
+            # same way a fresh admission does, but the request's first token
+            # happened before its eviction: record a regular token
+            # observation (its "ITL" spans the preempted gap — the latency
+            # the user actually saw) and leave t_first_token/TTFT alone, so
+            # "TTFT count == finished requests" holds under preemption.
+            self.request_tokens(req_id, 1)
+            return
         now = self.clock()
         self._tokens.inc()
-        tr = self.traces.get(req_id)
         if tr is not None:
             if tr.t_first_dispatch is None:
                 # non-chunked admission: prefill dispatch == first dispatch
@@ -306,10 +412,11 @@ class TelemetrySession:
         self._tokens.inc(n)
 
     def request_finished(self, req_id: str, reason: str = "length") -> None:
+        # NOTE: preemption is counted at EVICTION time (request_preempted) —
+        # it is no longer a terminal event (the session re-admits with
+        # aging); reason="preempted" here means re-admission was impossible
         if not self.enabled:
             return
-        if reason == "preempted":
-            self._preempted.inc()
         self._finished.child((reason,)).inc()
         tr = self.traces.pop(req_id, None)
         if tr is not None:

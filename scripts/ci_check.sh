@@ -30,6 +30,10 @@ echo
 echo "== static_analysis pytest subset =="
 python -m pytest tests -q -m static_analysis -p no:cacheprovider || rc=$?
 
+echo
+echo "== robustness (serving fault-containment) pytest subset =="
+python -m pytest tests -q -m robustness -p no:cacheprovider || rc=$?
+
 if [ "$rc" -ne 0 ]; then
   echo "ci_check: FAILED (rc=$rc)" >&2
 else

@@ -82,6 +82,16 @@ def test_bench_suite_tiny(monkeypatch):
     assert final["serving_itl_p99_ms"] is not None
     assert final["ragged_tok_s"] > 0
     assert final["ragged_padded_frac"] is not None
+    # ISSUE 7 satellite: containment census rides the serving rows — clean
+    # traffic must report EXACTLY zero rejections/quarantines/preemptions
+    # (the ~0-overhead proof), and the summary carries the keys
+    for p in SERVING_POINTS:
+        assert points[p]["rejected"] == 0, points[p]
+        assert points[p]["quarantined"] == 0, points[p]
+        assert points[p]["preempted"] == 0, points[p]
+    assert final["serving_rejected"] == 0
+    assert final["serving_quarantined"] == 0
+    assert final["serving_preempted"] == 0
     # --metrics-out: the tiny suite ran the serving point in-process, so the
     # process-default registry must hold the full serving metric set
     import tempfile

@@ -37,6 +37,36 @@ def test_chunked_prefill_requires_block_kv():
         TpuConfig(is_chunked_prefill=True, is_block_kv_layout=False)
 
 
+def test_fault_containment_knob_defaults():
+    """ISSUE 7: the containment knobs exist, default sane (validation on,
+    bounded retries, watchdog armed, no deadline), and round-trip to_dict."""
+    tc = TpuConfig()
+    assert tc.admission_validation is True
+    assert tc.request_deadline_s is None
+    assert tc.dispatch_max_retries == 2
+    assert tc.watchdog_no_progress_steps == 256
+    d = tc.to_dict()
+    tc2 = TpuConfig.from_dict(d)
+    assert tc2.admission_validation is True
+    assert tc2.watchdog_no_progress_steps == 256
+
+
+@pytest.mark.parametrize(
+    "kwargs, match",
+    [
+        (dict(request_deadline_s=0.0), "request_deadline_s"),
+        (dict(request_deadline_s=-1.5), "request_deadline_s"),
+        (dict(dispatch_max_retries=-1), "dispatch_max_retries"),
+        (dict(watchdog_no_progress_steps=-5), "watchdog_no_progress_steps"),
+    ],
+)
+def test_fault_containment_knob_validation(kwargs, match):
+    """Rejected-by-validation containment configs fail loudly at
+    construction, never mid-serving."""
+    with pytest.raises(ValueError, match=match):
+        TpuConfig(**kwargs)
+
+
 def test_json_round_trip(tmp_path, tiny_config):
     tiny_config.tpu_config.on_device_sampling_config = OnDeviceSamplingConfig(
         do_sample=True, top_k=5

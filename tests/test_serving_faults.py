@@ -1,0 +1,941 @@
+"""Serving fault-containment suite (ISSUE 7): admission validation,
+poisoned-row quarantine, deadlines, dispatch retry, watchdog, preemption
+re-admission fairness — every FaultInjector mode against BOTH session
+classes, driven deterministically.
+
+The headline pins:
+- an injected NaN row fails ONLY that row: co-batched rows' outputs stay
+  byte-identical to a clean run on the legacy split AND the ragged paths
+  (the ROADMAP-named garbage-block coupling bug, fixed by the non-finite
+  token sentinel + the block-0 read scrub + quarantine scrub-on-release);
+- injected dispatch faults retry with bounded backoff, then fail only the
+  in-flight rows — the session keeps serving;
+- a zero-progress livelock becomes a watchdog preemption and then a LOUD
+  WatchdogError with a diagnostic snapshot, never an invisible spin;
+- repeated pool exhaustion cannot starve a request: evictions re-queue
+  AHEAD of new arrivals and resume byte-identically.
+"""
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_tiny_config, make_random_hf_state_dict
+
+from neuronx_distributed_inference_tpu.config import ChunkedPrefillConfig
+from neuronx_distributed_inference_tpu.runtime.application import TpuModelForCausalLM
+from neuronx_distributed_inference_tpu.runtime.faults import (
+    FaultInjector,
+    WatchdogError,
+    fill_kv_rows,
+)
+from neuronx_distributed_inference_tpu.runtime.serving import (
+    ServingSession,
+    SpeculativeServingSession,
+)
+from neuronx_distributed_inference_tpu.telemetry import TelemetrySession
+
+pytestmark = pytest.mark.robustness
+
+PROMPTS = {
+    "r1": [5, 17, 92, 41, 8, 3, 77, 21, 60, 14, 2, 90],  # 12 tokens
+    "r2": list(range(30, 52)),  # 22 tokens: prefills across several chunks
+    "r3": [7, 7, 7],
+}
+
+
+class FakeClock:
+    """Deterministic clock whose sleep() advances it — deadlines and
+    backoff pin exactly, tests never actually wait."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def sleep(self, s: float):
+        self.t += float(s)
+
+
+def _paged_cfg(ragged=False, **extra):
+    tpu = dict(
+        is_continuous_batching=True, batch_size=4, ctx_batch_size=1,
+        is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=24,
+        is_chunked_prefill=True,
+        chunked_prefill_config=ChunkedPrefillConfig(
+            max_num_seqs=2, kernel_q_tile_size=16
+        ),
+        serving_ragged=ragged, seq_len=64,
+    )
+    tpu.update(extra)
+    return make_tiny_config(tpu=tpu)
+
+
+@pytest.fixture(scope="module")
+def paged_apps():
+    sd = make_random_hf_state_dict(_paged_cfg(False))
+    legacy = TpuModelForCausalLM(None, _paged_cfg(False)).load(state_dict=sd)
+    ragged = TpuModelForCausalLM(None, _paged_cfg(True)).load(state_dict=sd)
+    return legacy, ragged
+
+
+@pytest.fixture(scope="module")
+def plain_app():
+    cfg = make_tiny_config(
+        tpu=dict(is_continuous_batching=True, batch_size=4, ctx_batch_size=1)
+    )
+    return TpuModelForCausalLM(None, cfg).load(
+        state_dict=make_random_hf_state_dict(cfg)
+    )
+
+
+@pytest.fixture(scope="module")
+def spec_apps():
+    mk = lambda: make_tiny_config(
+        tpu=dict(is_continuous_batching=True, batch_size=2, ctx_batch_size=1)
+    )
+    sd = make_random_hf_state_dict(mk(), seed=0)
+    target = TpuModelForCausalLM(None, mk()).load(state_dict=sd)
+    draft = TpuModelForCausalLM(None, mk()).load(
+        state_dict=make_random_hf_state_dict(mk(), seed=7)
+    )
+    return target, draft
+
+
+def _drive(sess, max_steps=300):
+    """Per-step drain (every fault fires on step() granularity)."""
+    for _ in range(max_steps):
+        if not (sess.active or sess._readmit):
+            break
+        sess.step()
+    else:
+        raise AssertionError("session failed to drain within max_steps")
+    return {rid: list(r.generated) for rid, r in sess.requests.items()}
+
+
+def _mix(app, injector=None, telemetry=None, n_tokens=6):
+    """The standard 3-request mix, per-step driven, fresh cache."""
+    app.init_kv_cache()
+    sess = ServingSession(app, telemetry=telemetry, fault_injector=injector)
+    for rid, prompt in PROMPTS.items():
+        assert sess.add_request(rid, prompt, max_new_tokens=n_tokens)
+    out = _drive(sess)
+    return sess, out
+
+
+# ---------------------------------------------------------------------------
+# admission validation
+# ---------------------------------------------------------------------------
+
+
+def test_admission_validation_rejects_typed(plain_app):
+    """Malformed requests get terminal REJECTED verdicts with reasons —
+    never a raise, never a NaN row — and healthy co-batched requests are
+    byte-identical to a clean run."""
+    plain_app.init_kv_cache()
+    golden_sess = ServingSession(plain_app)
+    assert golden_sess.add_request("g", PROMPTS["r1"], max_new_tokens=6)
+    golden = _drive(golden_sess)["g"]
+
+    plain_app.init_kv_cache()
+    tel = TelemetrySession()
+    sess = ServingSession(plain_app, telemetry=tel)
+    bad = {
+        "oov_hi": dict(input_ids=[5, 500], reason="token_id_out_of_range"),
+        "oov_neg": dict(input_ids=[-3, 5], reason="token_id_out_of_range"),
+        "empty": dict(input_ids=[], reason="empty_prompt"),
+        "toolong": dict(input_ids=list(range(1, 100)), reason="prompt_too_long"),
+        "nobudget": dict(
+            input_ids=[5, 6], max_new_tokens=0, reason="invalid_max_new_tokens"
+        ),
+    }
+    assert sess.add_request("good", PROMPTS["r1"], max_new_tokens=6)
+    for rid, spec in bad.items():
+        res = sess.add_request(
+            rid, spec["input_ids"],
+            max_new_tokens=spec.get("max_new_tokens", 4),
+        )
+        assert not res and res.reason == spec["reason"], (rid, res)
+        assert sess.rejected[rid].status == "rejected"
+        assert sess.rejected[rid].fail_reason == spec["reason"]
+        assert rid not in sess.requests  # never admitted, no slot burned
+    out = _drive(sess)
+    assert out["good"] == golden  # rejects cost co-batched rows nothing
+    tel.close()
+    rej = {
+        s["labels"]["reason"]: s["value"]
+        for s in tel.registry.snapshot()["nxdi_requests_rejected_total"]["samples"]
+    }
+    assert rej == {
+        "token_id_out_of_range": 2, "empty_prompt": 1,
+        "prompt_too_long": 1, "invalid_max_new_tokens": 1,
+    }
+
+
+def test_admission_validation_off_restores_legacy(plain_app):
+    """admission_validation=False: the session admits unvalidated requests
+    (legacy raise-late behavior) — the knob is real, not cosmetic."""
+    tc = plain_app.config.tpu_config
+    plain_app.init_kv_cache()
+    tc.admission_validation = False
+    try:
+        sess = ServingSession(plain_app)
+        assert sess.admission_validation is False
+        # out-of-vocab id: admitted (embedding lookup clamps; the row runs)
+        assert sess.add_request("oov", [5, 500], max_new_tokens=2)
+        _drive(sess)
+    finally:
+        tc.admission_validation = True
+
+
+# ---------------------------------------------------------------------------
+# poisoned-row quarantine: the ROADMAP-named NaN coupling bug
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["legacy", "ragged"])
+def test_nan_row_quarantined_cobatch_byte_identical(paged_apps, mode):
+    """A NaN-poisoned row (device KV NaN -> non-finite logits -> sentinel
+    token) fails ONLY that row: healthy co-batched rows are byte-identical
+    to a clean run on the legacy split AND the ragged dispatch paths, the
+    poisoned blocks are scrubbed before the pool recycles them, and a new
+    request reusing the freed capacity decodes byte-identically."""
+    app = paged_apps[0] if mode == "legacy" else paged_apps[1]
+    _, golden = _mix(app)
+
+    inj = FaultInjector(seed=0).poison_kv_row(step=4, slot=1)  # r2's slot
+    tel = TelemetrySession()
+    sess, out = _mix(app, injector=inj, telemetry=tel)
+    assert any(f["kind"] == "poison_kv_row" for f in inj.log)
+
+    victim = sess.requests["r2"]
+    assert victim.status == "failed" and victim.fail_reason == "non_finite"
+    # the victim kept its pre-poison tokens (a clean-run prefix), no garbage
+    assert out["r2"] == golden["r2"][: len(out["r2"])]
+    assert len(out["r2"]) < len(golden["r2"])
+    # co-batched rows: byte-identical to the clean run
+    assert out["r1"] == golden["r1"]
+    assert out["r3"] == golden["r3"]
+    # quarantine released the victim's blocks back to the pool...
+    assert len(sess.allocator.free) == sess.allocator.num_blocks
+    # ...and scrubbed them: no NaN survives anywhere outside the shared
+    # garbage block 0 (which the read path scrubs on every gather)
+    k = np.asarray(sess.app.kv_cache.k)
+    assert not np.isnan(k[:, 1:]).any()
+    tel.close()
+    snap = tel.registry.snapshot()
+    assert snap["nxdi_rows_quarantined_total"]["samples"][0]["value"] == 1
+    fin = {
+        s["labels"]["reason"]: s["value"]
+        for s in snap["nxdi_requests_finished_total"]["samples"]
+    }
+    assert fin["non_finite"] == 1
+
+    # freed-capacity reuse: a new request over the scrubbed blocks decodes
+    # byte-identically to an isolated clean run
+    probe = [42, 10, 11]
+    app.init_kv_cache()
+    iso = ServingSession(app)
+    assert iso.add_request("iso", probe, max_new_tokens=4)
+    golden_probe = _drive(iso)["iso"]
+    assert sess.add_request("r4", probe, max_new_tokens=4)
+    out2 = _drive(sess)
+    assert out2["r4"] == golden_probe
+
+
+@pytest.mark.parametrize("mode", ["legacy", "ragged"])
+def test_poisoned_garbage_block_cannot_couple_rows(paged_apps, mode):
+    """NaN written straight into SHARED garbage block 0 (the
+    post-propagation state of the legacy drain's surplus lockstep writes)
+    changes NO healthy row by a byte: masked reads of the garbage block are
+    scrubbed to exact zeros in the gather (0*NaN=NaN is dead)."""
+    app = paged_apps[0] if mode == "legacy" else paged_apps[1]
+    _, golden = _mix(app)
+    inj = FaultInjector().poison_garbage_block(step=2)
+    _, out = _mix(app, injector=inj)
+    assert any(f["kind"] == "poison_garbage_block" for f in inj.log)
+    assert out == golden  # every row byte-identical, nobody quarantined
+
+
+def test_nan_tokens_host_boundary_quarantine(paged_apps):
+    """The nan_logits injector mode corrupts only the HOST-fetched tokens
+    (device cache stays clean): quarantine bookkeeping in isolation —
+    victim fails, others unaffected, KV released."""
+    legacy, _ = paged_apps
+    _, golden = _mix(legacy)
+    inj = FaultInjector().nan_logits(step=5, slot=0)  # r1's slot
+    tel = TelemetrySession()
+    sess, out = _mix(legacy, injector=inj, telemetry=tel)
+    assert sess.requests["r1"].fail_reason == "non_finite"
+    assert out["r1"] == golden["r1"][: len(out["r1"])]
+    assert out["r2"] == golden["r2"] and out["r3"] == golden["r3"]
+    assert len(sess.allocator.free) == sess.allocator.num_blocks
+    tel.close()
+    assert (
+        tel.registry.snapshot()["nxdi_rows_quarantined_total"]["samples"][0]["value"]
+        == 1
+    )
+
+
+def test_sentinel_in_multistep_chunk_commits_finite_prefix(paged_apps):
+    """The multi-step drain paths scan fetched chunks for the sentinel:
+    the finite prefix commits, the row quarantines, co-batched rows keep
+    their full chunks."""
+    legacy, _ = paged_apps
+    legacy.init_kv_cache()
+    golden_sess = ServingSession(legacy)
+    eos_probe = {"a": [5, 17, 92, 41], "b": [64, 3, 27, 9]}
+    for rid, p in eos_probe.items():
+        assert golden_sess.add_request(rid, p, max_new_tokens=12)
+    golden = golden_sess.run_to_completion(decode_chunk_size=4)
+
+    from neuronx_distributed_inference_tpu.runtime import faults as faults_mod
+
+    legacy.init_kv_cache()
+    sess = ServingSession(legacy)
+    for rid, p in eos_probe.items():
+        assert sess.add_request(rid, p, max_new_tokens=12)
+    # a few committed tokens first, then poison row 0 mid-flight and let the
+    # chunked drain discover the sentinel inside a fetched chunk
+    sess.step()
+    sess.step()
+    faults_mod._poison_row(sess, 0)
+    out = sess.run_to_completion(decode_chunk_size=4)
+    assert sess.requests["a"].fail_reason == "non_finite"
+    assert out["a"] == golden["a"][: len(out["a"])]
+    assert len(out["a"]) < 12
+    assert out["b"] == golden["b"]
+
+
+# ---------------------------------------------------------------------------
+# forced pool exhaustion, preemption re-admission fairness
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("mode", ["legacy", "ragged"])
+def test_injected_pool_exhaustion_resumes_byte_identical(paged_apps, mode):
+    """exhaust_pool evicts every allocating row for one step; evictions
+    re-queue, re-admit, and the final streams are byte-identical to a
+    fault-free run (rollback + greedy re-prefill regenerates exactly)."""
+    app = paged_apps[0] if mode == "legacy" else paged_apps[1]
+    _, golden = _mix(app)
+    inj = FaultInjector().exhaust_pool(3)
+    tel = TelemetrySession()
+    sess, out = _mix(app, injector=inj, telemetry=tel)
+    assert any(f["kind"] == "exhaust_pool" for f in inj.log)
+    assert out == golden
+    preempted = [r for r in sess.requests.values() if r.preemptions > 0]
+    assert preempted, "expected at least one injected eviction"
+    tel.close()
+    snap = tel.registry.snapshot()
+    assert snap["nxdi_requests_preempted_total"]["samples"][0]["value"] >= 1
+    fin = {
+        s["labels"]["reason"]: s["value"]
+        for s in snap["nxdi_requests_finished_total"]["samples"]
+    }
+    assert "preempted" not in fin  # every eviction resumed and finished
+    # re-admission must NOT double-count admissions or first tokens: the
+    # admitted counter stays == unique requests and the TTFT conservation
+    # law (TTFT count == finished requests) holds under preemption
+    assert (
+        snap["nxdi_requests_admitted_total"]["samples"][0]["value"]
+        == len(sess.requests)
+    )
+    assert snap["nxdi_ttft_ms"]["samples"][0]["count"] == sum(fin.values())
+
+
+def test_preempted_readmission_ages_ahead_of_new_arrivals():
+    """The fairness pin (ISSUE 7 satellite): against a tiny pool, an
+    evicted request is re-admitted BEFORE any new arrival may take its
+    capacity — alternating admissions cannot starve it, and it still
+    delivers its full budget byte-identically."""
+    cfg = make_tiny_config(
+        tpu=dict(
+            is_continuous_batching=True, batch_size=2, ctx_batch_size=1,
+            is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=3,
+            seq_len=64,
+        )
+    )
+    sd = make_random_hf_state_dict(cfg)
+    app = TpuModelForCausalLM(None, cfg).load(state_dict=sd)
+
+    # golden: each request alone against an unconstrained session
+    def golden_for(prompt):
+        big = make_tiny_config(
+            tpu=dict(
+                is_continuous_batching=True, batch_size=2, ctx_batch_size=1,
+                is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=16,
+                seq_len=64,
+            )
+        )
+        a = TpuModelForCausalLM(None, big).load(state_dict=sd)
+        s = ServingSession(a)
+        assert s.add_request("g", prompt, max_new_tokens=8)
+        return _drive(s)["g"]
+
+    p1 = list(range(1, 17))
+    p2 = [x + 1 for x in p1]
+    g1, g2 = golden_for(p1), golden_for(p2)
+
+    app.init_kv_cache()
+    sess = ServingSession(app)
+    assert sess.add_request("r1", p1, max_new_tokens=8)
+    assert sess.add_request("r2", p2, max_new_tokens=8)
+    # step until the pool evicts one of them
+    for _ in range(20):
+        sess.step()
+        if sess._readmit:
+            break
+    assert sess._readmit, "expected a pool eviction"
+    waiting = sess._readmit[0].req_id
+    # a NEW arrival while an eviction waits is refused as backlog — it may
+    # not steal the capacity the aged request is queued for
+    res = sess.add_request("r3", [9, 9, 9], max_new_tokens=2)
+    assert not res and res.reason == "backlog"
+    out = _drive(sess)
+    assert out["r1"] == g1 and out["r2"] == g2  # nobody starved, byte-exact
+    assert sess.requests[waiting].preemptions >= 1
+    assert all(r.status == "finished" for r in sess.requests.values())
+    # with the backlog drained, the new arrival admits and completes
+    assert sess.add_request("r3", [9, 9, 9], max_new_tokens=2)
+    assert len(_drive(sess)["r3"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# per-request deadlines + injected latency
+# ---------------------------------------------------------------------------
+
+
+def test_request_deadline_exceeded(plain_app):
+    """A request past its wall-clock TTL is dropped with terminal
+    deadline_exceeded (overrun observed in the histogram); co-batched
+    requests run to completion untouched."""
+    clock = FakeClock()
+    plain_app.init_kv_cache()
+    tel = TelemetrySession()
+    sess = ServingSession(
+        plain_app, telemetry=tel, clock=clock, sleep_fn=clock.sleep
+    )
+    assert sess.add_request("ttl", PROMPTS["r1"], max_new_tokens=30,
+                            deadline_s=1.0)
+    assert sess.add_request("free", PROMPTS["r3"], max_new_tokens=6)
+    sess.step()
+    clock.t += 5.0  # blow way past the 1s TTL
+    out = _drive(sess)
+    ttl = sess.requests["ttl"]
+    assert ttl.status == "failed" and ttl.fail_reason == "deadline_exceeded"
+    assert len(out["ttl"]) < 30
+    assert len(out["free"]) == 6
+    assert len(sess.free_slots) == sess.num_slots
+    tel.close()
+    snap = tel.registry.snapshot()
+    h = snap["nxdi_deadline_overrun_ms"]["samples"][0]
+    assert h["count"] == 1 and h["sum"] >= 3500.0  # ~4s overrun observed
+    fin = {
+        s["labels"]["reason"]: s["value"]
+        for s in snap["nxdi_requests_finished_total"]["samples"]
+    }
+    assert fin["deadline_exceeded"] == 1
+
+
+def test_injected_latency_trips_deadline(plain_app):
+    """FaultInjector latency flows through the session's injectable sleep:
+    a slow step pushes a deadlined request past its TTL deterministically."""
+    clock = FakeClock()
+    plain_app.init_kv_cache()
+    inj = FaultInjector().latency(step=2, seconds=3.0)
+    sess = ServingSession(
+        plain_app, fault_injector=inj, clock=clock, sleep_fn=clock.sleep
+    )
+    assert sess.add_request("ttl", PROMPTS["r1"], max_new_tokens=30,
+                            deadline_s=1.0)
+    _drive(sess)
+    assert any(f["kind"] == "latency" for f in inj.log)
+    assert sess.requests["ttl"].fail_reason == "deadline_exceeded"
+
+
+# ---------------------------------------------------------------------------
+# bounded dispatch retry
+# ---------------------------------------------------------------------------
+
+
+def test_dispatch_retry_recovers_byte_identical(plain_app):
+    """Transient dispatch errors under the retry budget: capped exponential
+    backoff, then success — outputs byte-identical to a clean run, retries
+    counted."""
+    _, golden = (lambda s: (s, _drive(s)))(_plain_sess(plain_app))
+    inj = FaultInjector().dispatch_error(step=2, attempts=2)  # <= retries(2)
+    sleeps = []
+    tel = TelemetrySession()
+    sess = _plain_sess(
+        plain_app, fault_injector=inj, telemetry=tel, sleep_fn=sleeps.append
+    )
+    out = _drive(sess)
+    assert out == golden
+    assert sleeps == [0.02, 0.04]  # base * 2**(attempt-1), capped
+    assert all(r.status == "finished" for r in sess.requests.values())
+    tel.close()
+    snap = tel.registry.snapshot()
+    assert snap["nxdi_dispatch_retries_total"]["samples"][0]["value"] == 2
+
+
+def test_dispatch_retry_exhaustion_fails_rows_not_process(plain_app):
+    """Past the retry budget only the IN-FLIGHT rows fail
+    (dispatch_error); the session survives and keeps admitting + serving
+    new requests."""
+    inj = FaultInjector().dispatch_error(step=2, attempts=10)
+    sleeps = []
+    sess = _plain_sess(plain_app, fault_injector=inj, sleep_fn=sleeps.append)
+    out = _drive(sess)
+    failed = [r for r in sess.requests.values() if r.status == "failed"]
+    assert failed and all(r.fail_reason == "dispatch_error" for r in failed)
+    assert len(sleeps) == 2  # retried the budget before giving up
+    assert len(sess.free_slots) == sess.num_slots  # all resources released
+    # the session is alive: a fresh request admits and completes
+    probe = [42, 10, 11]
+    iso = _plain_sess(plain_app, adds={})
+    assert iso.add_request("g", probe, max_new_tokens=4)
+    golden = _drive(iso)["g"]
+    plain_app.init_kv_cache()
+    assert sess.add_request("after", probe, max_new_tokens=4)
+    assert _drive(sess)["after"] == golden
+
+
+def _plain_sess(app, adds=None, **kw):
+    app.init_kv_cache()
+    sess = ServingSession(app, **kw)
+    adds = PROMPTS if adds is None else adds
+    for rid, prompt in adds.items():
+        assert sess.add_request(rid, prompt, max_new_tokens=6)
+    return sess
+
+
+# ---------------------------------------------------------------------------
+# watchdog: zero-progress livelock -> preempt largest -> loud failure
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_preempts_then_fails_loud(paged_apps):
+    """Stalled dispatches (zero committed tokens, zero admissions): after
+    one watchdog window the largest request is preempted; after a second
+    windowed trip the session raises WatchdogError carrying a diagnostic
+    snapshot — a livelock becomes a debuggable, loud failure."""
+    legacy, _ = paged_apps
+    tc = legacy.config.tpu_config
+    legacy.init_kv_cache()
+    old = tc.watchdog_no_progress_steps
+    tc.watchdog_no_progress_steps = 3
+    try:
+        inj = FaultInjector().stall(*range(1, 40))
+        tel = TelemetrySession()
+        sess = ServingSession(legacy, telemetry=tel, fault_injector=inj)
+        for rid, prompt in PROMPTS.items():
+            assert sess.add_request(rid, prompt, max_new_tokens=6)
+        with pytest.raises(WatchdogError) as ei:
+            for _ in range(40):
+                sess.step()
+        snap = ei.value.snapshot
+        assert snap["step_index"] >= 6  # two full 3-step windows
+        assert snap["active"] or snap["waiting"]
+        assert "free_blocks" in snap and "last_dispatch_error" in snap
+        tel.close()
+        msnap = tel.registry.snapshot()
+        assert (
+            msnap["nxdi_watchdog_preemptions_total"]["samples"][0]["value"] == 1
+        )
+        assert msnap["nxdi_watchdog_trips_total"]["samples"][0]["value"] == 1
+    finally:
+        tc.watchdog_no_progress_steps = old
+
+
+def test_watchdog_quiet_on_healthy_traffic(paged_apps):
+    """A tight watchdog window must never fire on a healthy run (every
+    step commits tokens or advances prefill)."""
+    legacy, _ = paged_apps
+    tc = legacy.config.tpu_config
+    old = tc.watchdog_no_progress_steps
+    tc.watchdog_no_progress_steps = 2  # hair-trigger
+    try:
+        tel = TelemetrySession()
+        _, out = _mix(legacy, telemetry=tel)
+        assert all(len(v) > 0 for v in out.values())
+        tel.close()
+        snap = tel.registry.snapshot()
+        assert snap["nxdi_watchdog_trips_total"]["samples"][0]["value"] == 0
+        assert (
+            snap["nxdi_watchdog_preemptions_total"]["samples"][0]["value"] == 0
+        )
+    finally:
+        tc.watchdog_no_progress_steps = old
+
+
+# ---------------------------------------------------------------------------
+# SpeculativeServingSession under every fault mode
+# ---------------------------------------------------------------------------
+
+
+def _spec_sess(target, draft, **kw):
+    target.init_kv_cache()
+    draft.init_kv_cache()
+    sess = SpeculativeServingSession(target, draft, speculation_length=4, **kw)
+    assert sess.add_request("s1", [5, 17, 92, 41], max_new_tokens=8)
+    assert sess.add_request("s2", [64, 3, 27, 9, 14, 33], max_new_tokens=8)
+    return sess
+
+
+def test_spec_session_nan_quarantine_and_draft_immunity(spec_apps):
+    """Speculative serving: a poisoned TARGET row quarantines (sentinel in
+    the verify window) with the co-batched row byte-identical; a poisoned
+    DRAFT only costs acceptance length — outputs stay byte-identical
+    (greedy verification emits the target's own tokens)."""
+    target, draft = spec_apps
+    golden = _drive(_spec_sess(target, draft))
+
+    # host-boundary corruption of slot 1 (s2)
+    inj = FaultInjector().nan_logits(step=2, slot=1)
+    tel = TelemetrySession()
+    sess = _spec_sess(target, draft, fault_injector=inj, telemetry=tel)
+    out = _drive(sess)
+    assert sess.requests["s2"].fail_reason == "non_finite"
+    assert out["s2"] == golden["s2"][: len(out["s2"])]
+    assert out["s1"] == golden["s1"]
+    tel.close()
+    assert (
+        tel.registry.snapshot()["nxdi_rows_quarantined_total"]["samples"][0]["value"]
+        == 1
+    )
+
+    # device poisoning of the TARGET's cache line for slot 0 (s1)
+    inj2 = FaultInjector().poison_kv_row(step=2, slot=0)
+    sess2 = _spec_sess(target, draft, fault_injector=inj2)
+    out2 = _drive(sess2)
+    assert sess2.requests["s1"].fail_reason == "non_finite"
+    assert out2["s1"] == golden["s1"][: len(out2["s1"])]
+    assert out2["s2"] == golden["s2"]
+
+    # a poisoned DRAFT cannot corrupt outputs: byte-identical, just slower
+    target.init_kv_cache()
+    draft.init_kv_cache()
+    sess3 = SpeculativeServingSession(target, draft, speculation_length=4)
+    assert sess3.add_request("s1", [5, 17, 92, 41], max_new_tokens=8)
+    assert sess3.add_request("s2", [64, 3, 27, 9, 14, 33], max_new_tokens=8)
+    sess3.step()
+    draft.kv_cache = fill_kv_rows(draft.kv_cache, [0], float("nan"))
+    out3 = _drive(sess3)
+    assert out3 == golden
+    assert all(r.status == "finished" for r in sess3.requests.values())
+
+
+def test_spec_session_dispatch_retry_and_deadline(spec_apps):
+    """The containment wrapper is shared: speculative sessions retry
+    transient dispatch faults (byte-identical recovery), fail in-flight
+    rows on exhaustion, and honor per-request deadlines."""
+    target, draft = spec_apps
+    golden = _drive(_spec_sess(target, draft))
+
+    sleeps = []
+    inj = FaultInjector().dispatch_error(step=2, attempts=1)
+    sess = _spec_sess(target, draft, fault_injector=inj, sleep_fn=sleeps.append)
+    assert _drive(sess) == golden
+    assert sleeps == [0.02]
+
+    inj2 = FaultInjector().dispatch_error(step=2, attempts=10)
+    sess2 = _spec_sess(target, draft, fault_injector=inj2,
+                       sleep_fn=sleeps.append)
+    _drive(sess2)
+    failed = [r for r in sess2.requests.values() if r.status == "failed"]
+    assert failed and all(r.fail_reason == "dispatch_error" for r in failed)
+
+    clock = FakeClock()
+    target.init_kv_cache()
+    draft.init_kv_cache()
+    sess3 = SpeculativeServingSession(
+        target, draft, speculation_length=4, clock=clock, sleep_fn=clock.sleep
+    )
+    assert sess3.add_request("ttl", [5, 17, 92, 41], max_new_tokens=30,
+                             deadline_s=1.0)
+    sess3.step()
+    clock.t += 9.0
+    _drive(sess3)
+    assert sess3.requests["ttl"].fail_reason == "deadline_exceeded"
+
+
+def test_spec_session_rejects_overlong_prompt_typed(spec_apps):
+    """The speculative session's admission validation converts the
+    windowed-prompt NotImplementedError into a typed REJECT at the door."""
+    target, draft = spec_apps
+    target.init_kv_cache()
+    draft.init_kv_cache()
+    sess = SpeculativeServingSession(target, draft, speculation_length=4)
+    res = sess.add_request("long", list(range(1, 100)), max_new_tokens=4)
+    assert not res and res.reason == "prompt_too_long"
+    assert sess.rejected["long"].status == "rejected"
+
+
+# ---------------------------------------------------------------------------
+# injector determinism
+# ---------------------------------------------------------------------------
+
+
+def test_fault_injector_seeded_schedules_reproducible():
+    """random_schedule is a pure function of the seed: same seed, same
+    armed plan; a different seed diverges."""
+    def plan(seed):
+        inj = FaultInjector(seed=seed).random_schedule(
+            n_steps=64, rate=0.3,
+            kinds=("exhaust_pool", "dispatch_error", "latency", "stall"),
+        )
+        return (
+            dict(inj._latency), set(inj._stall), set(inj._exhaust_pool),
+            dict(inj._dispatch_fail),
+        )
+
+    assert plan(11) == plan(11)
+    assert plan(11) != plan(12)
+    # at rate 0.3 over 64 steps, a schedule actually armed something
+    lat, stall, pool, derr = plan(11)
+    assert lat or stall or pool or derr
+
+
+# ---------------------------------------------------------------------------
+# quarantine x prefix caching, re-admission progress x watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_spares_shared_prefix_blocks():
+    """Prefix caching: quarantining a row must NOT zero cached prefix
+    blocks a live sharer still attends over (their content is a healthy
+    prefill's writes), and the victim's own registered blocks must leave
+    the match index before their ids recycle — a later identical prompt
+    re-prefills instead of attending scrubbed KV."""
+    cfg = make_tiny_config(
+        tpu=dict(
+            is_continuous_batching=True, batch_size=2, ctx_batch_size=1,
+            is_block_kv_layout=True, pa_block_size=8, pa_num_blocks=24,
+            is_prefix_caching=True, seq_len=64,
+        )
+    )
+    sd = make_random_hf_state_dict(cfg)
+    app = TpuModelForCausalLM(None, cfg).load(state_dict=sd)
+    base = list(range(1, 17))        # two full 8-token shared blocks
+    pa = base + [40, 41, 42, 43]
+    pb = base + list(range(50, 58))  # full third block: "b" registers it
+
+    sess = ServingSession(app)
+    assert sess.add_request("a", pa, max_new_tokens=10)
+    assert sess.add_request("b", pb, max_new_tokens=10)
+    golden = _drive(sess)
+
+    app.init_kv_cache()
+    inj = FaultInjector().nan_logits(step=2, slot=1)  # b's slot
+    sess = ServingSession(app, fault_injector=inj)
+    assert sess.add_request("a", pa, max_new_tokens=10)
+    assert sess.add_request("b", pb, max_new_tokens=10)
+    alloc = sess.allocator
+    shared = list(alloc.seq_blocks[1][:2])  # b attached a's prefix blocks
+    assert shared == alloc.seq_blocks[0][:2]
+    b3 = alloc.seq_blocks[1][2]  # b's own full block, commit-registered
+    out = _drive(sess)
+    assert sess.requests["b"].fail_reason == "non_finite"
+    # the sharer is untouched: byte-identical to the clean run
+    assert out["a"] == golden["a"]
+    # shared prefix blocks survived the scrub: still registered/matchable
+    assert all(b in alloc.hash_of_block for b in shared)
+    # b's registered block left the match index (content not matchable);
+    # a longer same-prefix probe matches ONLY the healthy shared blocks
+    assert b3 not in alloc.hash_of_block
+    assert alloc.match_prefix(1, np.asarray(pb + [59], np.int32)) == 16
+
+
+def test_watchdog_quiet_under_preempt_readmit_churn():
+    """Pool-exhaustion churn that makes real forward progress — each
+    eviction's re-admission commits a token inside step() — must never
+    trip the watchdog: the progress baseline is snapped BEFORE
+    re-admission. Only a genuinely stuck session (failed re-admissions,
+    nothing committed) escalates."""
+    cfg = make_tiny_config(
+        tpu=dict(
+            is_continuous_batching=True, batch_size=2, ctx_batch_size=1,
+            is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=3,
+            seq_len=64, watchdog_no_progress_steps=2,  # hair trigger
+        )
+    )
+    sd = make_random_hf_state_dict(cfg)
+    app = TpuModelForCausalLM(None, cfg).load(state_dict=sd)
+    tel = TelemetrySession()
+    sess = ServingSession(app, telemetry=tel)
+    p1 = list(range(1, 17))
+    assert sess.add_request("r1", p1, max_new_tokens=8)
+    assert sess.add_request("r2", [x + 1 for x in p1], max_new_tokens=8)
+    out = _drive(sess)
+    assert all(len(v) == 8 for v in out.values())
+    assert max(r.preemptions for r in sess.requests.values()) >= 1
+    tel.close()
+    snap = tel.registry.snapshot()
+    assert snap["nxdi_watchdog_trips_total"]["samples"][0]["value"] == 0
+    assert snap["nxdi_watchdog_preemptions_total"]["samples"][0]["value"] == 0
+
+
+def test_containment_actions_count_as_watchdog_progress(plain_app):
+    """Terminal transitions made at the TOP of step() (deadline expiries,
+    re-admission commits) are forward progress: the watchdog baseline is
+    snapped before them. With dispatches stalled but one request resolving
+    per step, the session is draining work, not livelocked — the watchdog
+    must stay quiet instead of spuriously preempting and then raising."""
+    clock = FakeClock()
+    plain_app.init_kv_cache()
+    tc = plain_app.config.tpu_config
+    old = tc.watchdog_no_progress_steps
+    tc.watchdog_no_progress_steps = 2  # hair trigger
+    try:
+        inj = FaultInjector().stall(*range(1, 20))
+        tel = TelemetrySession()
+        sess = ServingSession(
+            plain_app, fault_injector=inj, telemetry=tel,
+            clock=clock, sleep_fn=clock.sleep,
+        )
+        prompts = dict(PROMPTS, r4=[11, 12, 13, 14])
+        for i, (rid, p) in enumerate(prompts.items()):
+            assert sess.add_request(rid, p, max_new_tokens=40,
+                                    deadline_s=0.5 + i * 1.0)
+        for _ in range(8):
+            if not sess.active:
+                break
+            sess.step()
+            clock.t += 1.0  # exactly one TTL expires per step
+        assert all(r.fail_reason == "deadline_exceeded"
+                   for r in sess.requests.values())
+        tel.close()
+        snap = tel.registry.snapshot()
+        assert snap["nxdi_watchdog_trips_total"]["samples"][0]["value"] == 0
+        assert (
+            snap["nxdi_watchdog_preemptions_total"]["samples"][0]["value"] == 0
+        )
+    finally:
+        tc.watchdog_no_progress_steps = old
+
+
+def test_quantized_scale_immune_to_non_finite_writes():
+    """The per-(layer, head) running-absmax scale is SHARED across the
+    batch and monotone: if a poisoned row's NaN write folded into it, every
+    co-batched row (and all future requests) would dequantize to NaN — a
+    cross-row coupling the quarantine scrub cannot undo. Non-finite
+    elements must not inflate the scale; healthy rows' codes must stay
+    byte-identical to an all-healthy write."""
+    import jax.numpy as jnp
+
+    from neuronx_distributed_inference_tpu.modules.kvcache import (
+        QuantizedKV,
+        _quantized_update,
+    )
+
+    L, B, S, H, D = 2, 3, 4, 2, 8
+    rng = np.random.default_rng(0)
+    healthy = rng.standard_normal((B, S, H, D)).astype(np.float32)
+    healthy[1] *= 0.1  # row 1 never sets the absmax: clean == dirty scale
+    valid = jnp.ones((B, S), bool)
+    stream = QuantizedKV(
+        data=jnp.zeros((L, B, S, H, D), jnp.int8),
+        scale=jnp.zeros((L, H), jnp.float32),
+    )
+
+    codes_clean, scale_clean = _quantized_update(
+        stream, jnp.asarray(healthy), 0, valid
+    )
+
+    poisoned = healthy.copy()
+    poisoned[1] = np.nan  # row 1's whole write goes non-finite
+    codes_dirty, scale_dirty = _quantized_update(
+        stream, jnp.asarray(poisoned), 0, valid
+    )
+
+    assert bool(jnp.all(jnp.isfinite(scale_dirty)))
+    # the scale learned only from the finite rows
+    finite_amax = np.abs(np.delete(healthy, 1, axis=0)).max(axis=(0, 1, 3))
+    np.testing.assert_allclose(scale_dirty[0], finite_amax, rtol=1e-6)
+    assert bool(jnp.array_equal(scale_clean, scale_dirty))
+    # healthy rows' codes byte-identical under the co-batched poison
+    # (row 1's own codes are garbage — that row is quarantined and scrubbed)
+    mask = np.ones(B, bool)
+    mask[1] = False
+    assert bool(jnp.array_equal(codes_dirty[mask], codes_clean[mask]))
+
+
+def test_spec_draft_prefill_dispatch_guarded(spec_apps):
+    """The DRAFT-side admission prefill rides _guarded_dispatch like every
+    other dispatch: past the retry budget a transient draft CTE failure
+    terminally FAILs only that request (dispatch_error, slot released)
+    instead of escaping add_request with the slot leaked; under the budget
+    the admission retries and the run stays byte-identical."""
+    from neuronx_distributed_inference_tpu.runtime.faults import (
+        TransientDispatchError,
+    )
+
+    target, draft = spec_apps
+    golden = _drive(_spec_sess(target, draft))
+
+    class FlakyCTE:
+        def __init__(self, inner, fail_times):
+            self._inner = inner
+            self.left = fail_times
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def __call__(self, *a, **kw):
+            if self.left > 0:
+                self.left -= 1
+                raise TransientDispatchError("injected draft CTE failure")
+            return self._inner(*a, **kw)
+
+    # under the budget (2 retries): admission succeeds, outputs byte-equal
+    target.init_kv_cache()
+    draft.init_kv_cache()
+    sleeps = []
+    sess = SpeculativeServingSession(
+        target, draft, speculation_length=4, sleep_fn=sleeps.append
+    )
+    sess.draft.context_encoding_model = FlakyCTE(
+        sess.draft.context_encoding_model, 2
+    )
+    assert sess.add_request("s1", [5, 17, 92, 41], max_new_tokens=8)
+    assert sess.add_request("s2", [64, 3, 27, 9, 14, 33], max_new_tokens=8)
+    assert _drive(sess) == golden
+    assert len(sleeps) == 2
+
+    # past the budget: terminal dispatch_error, slot released, no raise
+    target.init_kv_cache()
+    draft.init_kv_cache()
+    sess = SpeculativeServingSession(
+        target, draft, speculation_length=4, sleep_fn=lambda s: None
+    )
+    sess.draft.context_encoding_model = FlakyCTE(
+        sess.draft.context_encoding_model, 10
+    )
+    assert sess.add_request("s1", [5, 17, 92, 41], max_new_tokens=8)
+    bad = sess.requests["s1"]
+    assert bad.status == "failed" and bad.fail_reason == "dispatch_error"
+    assert bad.slot == -1 and len(sess.free_slots) == sess.num_slots
+    # the session is alive: the co-batched request serves normally
+    sess.draft.context_encoding_model = sess.draft.context_encoding_model._inner
+    assert sess.add_request("s2", [64, 3, 27, 9, 14, 33], max_new_tokens=8)
+    out = _drive(sess)
+    assert out["s2"] == golden["s2"]
+
+
+def test_rejected_history_bounded(plain_app):
+    """Rejection volume is attacker-controlled: session.rejected keeps the
+    newest REJECTED_HISTORY_MAX records and evicts oldest-first instead of
+    growing host memory without bound."""
+    from neuronx_distributed_inference_tpu.runtime.serving import (
+        REJECTED_HISTORY_MAX,
+    )
+
+    plain_app.init_kv_cache()
+    sess = ServingSession(plain_app)
+    n = REJECTED_HISTORY_MAX + 50
+    for i in range(n):
+        assert not sess.add_request(f"bad{i}", [], max_new_tokens=4)
+    assert len(sess.rejected) == REJECTED_HISTORY_MAX
+    assert f"bad{n - 1}" in sess.rejected  # newest kept
+    assert "bad0" not in sess.rejected  # oldest evicted

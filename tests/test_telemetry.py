@@ -227,8 +227,11 @@ def test_slot_exhaustion_drops_are_counted(cb_app):
 def test_pool_exhaustion_preemption_and_admission_drop():
     """Paged pool of 3 usable blocks, block_size=16: two 16-token prompts
     take one block each; the first decode step needs a second block per row
-    — one row gets the last free block, the other is preempted (vLLM-style).
-    A third admission finds no blocks and is dropped as kv_blocks."""
+    — one row gets the last free block, the other is preempted (vLLM-style)
+    and, since ISSUE 7, RE-ADMITTED once the first request frees its blocks:
+    preemption is an eviction event, not a terminal state, and the resumed
+    request still delivers its full budget. A third admission finds no
+    blocks and is dropped as kv_blocks."""
     cfg = make_tiny_config(
         tpu=dict(
             is_continuous_batching=True, batch_size=2, ctx_batch_size=1,
@@ -247,11 +250,14 @@ def test_pool_exhaustion_preemption_and_admission_drop():
     p = list(range(1, 17))  # exactly one block of prompt
     assert sess.add_request("r1", p, max_new_tokens=8)
     assert sess.add_request("r2", [x + 1 for x in p], max_new_tokens=8)
-    while sess.active:
+    while sess.active or sess._readmit:
         sess.step()
-    # one of the two was preempted when the pool ran dry mid-decode
-    preempted = [r for r in sess.requests.values() if r.preempted]
+    # one of the two was evicted when the pool ran dry mid-decode ...
+    preempted = [r for r in sess.requests.values() if r.preemptions > 0]
     assert len(preempted) == 1
+    # ... and re-admitted (aging): BOTH requests complete their full budget
+    assert all(len(r.generated) == 8 for r in sess.requests.values())
+    assert all(r.status == "finished" for r in sess.requests.values())
 
     # admission-time exhaustion: a 2-block prompt admits (2 of 3 blocks),
     # a second 2-block prompt cannot get its blocks -> dropped as kv_blocks
@@ -263,12 +269,15 @@ def test_pool_exhaustion_preemption_and_admission_drop():
     tel.close()
 
     snap = tel.registry.snapshot()
+    # the preemption counter records the EVICTION; the finished census shows
+    # no terminal "preempted" (the request resumed and finished by length)
     assert snap["nxdi_requests_preempted_total"]["samples"][0]["value"] == 1
     fin = {
         s["labels"]["reason"]: s["value"]
         for s in snap["nxdi_requests_finished_total"]["samples"]
     }
-    assert fin["preempted"] == 1
+    assert "preempted" not in fin
+    assert fin["length"] == 2
     drops = {
         s["labels"]["reason"]: s["value"]
         for s in snap["nxdi_requests_dropped_total"]["samples"]
@@ -315,17 +324,19 @@ def test_chunked_prefill_queue_wait_and_chunk_count():
 
 
 def test_double_finish_counts_once(cb_app):
-    """The async preempt-then-consume path can run _finish twice for one
-    request (the already-dispatched token is consumed a step later and may
-    hit a termination condition again) — preemption/finished counters must
-    count the FIRST finish only."""
+    """_finish and _preempt can both legitimately run twice for one request
+    (an already-dispatched row's token is consumed a step later and may hit
+    a termination condition again) — counters must record the FIRST
+    transition only."""
     tel = TelemetrySession()
     sess = ServingSession(cb_app, telemetry=tel)
     assert sess.add_request("r", [1, 2, 3], max_new_tokens=4)
     req = sess.requests["r"]
-    req.preempted = True
-    sess._finish(req)
-    sess._finish(req)
+    sess._preempt(req)
+    sess._preempt(req)  # idempotent: one eviction event
+    sess._readmit.remove(req)
+    sess._finish(req, "preempted")
+    sess._finish(req, "preempted")
     tel.close()
     snap = tel.registry.snapshot()
     assert snap["nxdi_requests_preempted_total"]["samples"][0]["value"] == 1
@@ -334,6 +345,7 @@ def test_double_finish_counts_once(cb_app):
         for s in snap["nxdi_requests_finished_total"]["samples"]
     }
     assert fin == {"preempted": 1}
+    assert req.status == "failed" and req.fail_reason == "preempted"
 
 
 # ---------------------------------------------------------------------------
