@@ -415,6 +415,30 @@ def measure_serving(app, *, n_requests, prompt_len, gen_len):
     # ragged mixed-step dispatch (serving_ragged): padded-token fraction of
     # the packed total-token buckets, from the mixed-step composition
     # histogram the session records per dispatch
+    # host-gap telemetry (ISSUE 8): host-time fraction of serving step wall
+    # time over THIS measured run — ~1.0 means the host loop, not the chip,
+    # bounds throughput; the async-pipelined row should push it (and
+    # absolute host ms/step) down vs the synchronous row. Computed as a
+    # per-run DELTA over the step-timing histograms (like the containment
+    # counters above), NOT from the process-shared cumulative gauge — the
+    # registry spans bench points, and a row that never recorded step
+    # timing must not inherit another row's value (or a default 0.0).
+    def _hist_delta(name):
+        def sc(s):
+            fam = s.get(name)
+            if not fam or not fam.get("samples"):
+                return 0.0, 0
+            smp = fam["samples"][0]
+            return float(smp["sum"]), int(smp["count"])
+
+        s1, c1 = sc(snap)
+        s0, c0 = sc(base_snap)
+        return s1 - s0, c1 - c0
+
+    host_ms, host_n = _hist_delta("nxdi_step_host_ms")
+    wait_ms, _ = _hist_delta("nxdi_step_fetch_wait_ms")
+    if host_n > 0 and host_ms + wait_ms > 0:
+        res["host_frac"] = round(host_ms / (host_ms + wait_ms), 4)
     mixed = snap.get("nxdi_mixed_step_rows")
     if mixed:
         base_mixed = base_snap.get("nxdi_mixed_step_rows")
@@ -493,10 +517,22 @@ def _suite_params(tiny):
         # of rows is the split-vs-ragged serving comparison for the next
         # hardware session. Own artifact key: serving_ragged is part of the
         # config fingerprint, so sharing int8_1b's would thrash it.
+        # serving_ragged_async pinned OFF here: this is the SYNCHRONOUS
+        # ragged row the *_ragged_async row below is measured against.
         "serving_1b_int8_ragged": dict(
             attrs=attrs_1b, quantized=True, serving=serving,
-            extra_tpu=dict(serving_ragged=True),
+            extra_tpu=dict(serving_ragged=True, serving_ragged_async=False),
             cache_key="int8_1b_ragged" if not tiny else None,
+        ),
+        # SAME mix again with async 1-ahead pipelining on the ragged path
+        # (ISSUE 8): step k+1 chains on step k's on-device tokens, the fetch
+        # is non-blocking, host bookkeeping overlaps the device — the
+        # ragged_async_* keys vs ragged_* quantify the overlap win and
+        # serving_host_frac localizes what host gap remains.
+        "serving_1b_int8_ragged_async": dict(
+            attrs=attrs_1b, quantized=True, serving=serving,
+            extra_tpu=dict(serving_ragged=True, serving_ragged_async=True),
+            cache_key="int8_1b_ragged_async" if not tiny else None,
         ),
         # single-chip proxy for the BASELINE 8B north star: int8 8B fits 16G
         "int8_8b_bs1": dict(
@@ -607,6 +643,14 @@ def summary_line(points):
         "ragged_itl_p50_ms": g("serving_1b_int8_ragged", "itl_ms"),
         "ragged_itl_p99_ms": g("serving_1b_int8_ragged", "itl_p99_ms"),
         "ragged_padded_frac": g("serving_1b_int8_ragged", "padded_token_frac"),
+        # async-pipelined ragged serving row (ISSUE 8): same mix, 1-ahead
+        # chained dispatch + non-blocking fetch — compare against the
+        # ragged_* (sync) row; serving_host_frac is the measured host-gap
+        # share of step wall time on the pipelined path
+        "ragged_async_tok_s": g("serving_1b_int8_ragged_async", "decode_tok_s"),
+        "ragged_async_itl_p50_ms": g("serving_1b_int8_ragged_async", "itl_ms"),
+        "ragged_async_ttft_p50_ms": g("serving_1b_int8_ragged_async", "ttft_ms"),
+        "serving_host_frac": g("serving_1b_int8_ragged_async", "host_frac"),
         # fault-containment census (ISSUE 7), sourced from the telemetry
         # registry over the measured serving run: clean traffic MUST report
         # 0/0/0 — the containment layer's ~0-overhead proof the first

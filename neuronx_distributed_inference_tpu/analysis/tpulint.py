@@ -9,7 +9,12 @@ The rules encode contracts the runtime relies on but Python cannot enforce:
 - **TPU102 host-sync-census** (warning, baselined): EVERY host-sync call in
   the package, counted per file. The committed baseline pins the count — the
   batched ``jax.device_get((tokens, logits))`` work in runtime/ stays pinned
-  so a new per-field fetch in a hot loop fails the lint.
+  so a new per-field fetch in a hot loop fails the lint. Calls inside the
+  serving ``step()`` hot path (:data:`SERVING_STEP_HOT_PATH`) additionally
+  count against a separately-pinned ``<file>::step-hot-path`` bucket, so a
+  blocking fetch added to the per-step serving loop trips the gate on its
+  own — the pipelined ragged dispatch depends on the hot path staying
+  fetch-free outside the designated consume points.
 - **TPU103 host-time-under-trace** (error): ``time.time()`` /
   ``time.perf_counter()`` / ``print`` under trace — they execute ONCE at
   trace time and then lie forever.
@@ -94,6 +99,28 @@ TPU108_ELEM_THRESHOLD = 1 << 20
 SHARDING_WRAPPERS = {"with_sharding_constraint", "constrain", "device_put"}
 
 _PRAGMA_RE = re.compile(r"#\s*tpulint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
+
+#: ServingSession step() hot-path functions (runtime/serving.py): every
+#: method a scheduler tick runs through. Host-sync calls inside them get a
+#: SECOND TPU102 census finding keyed `<file>::step-hot-path`, pinned
+#: separately by the baseline, so a future blocking `jax.device_get` added
+#: to the per-step loop (outside the designated consume points) fails the
+#: gate even when the file-level count is rebalanced. The speculative
+#: session's accept/reject fetch in `_step_inner` is the one designated
+#: (baselined) entry.
+SERVING_STEP_HOT_PATH = {
+    "step",
+    "_step_inner",
+    "_ragged_step",
+    "_schedule_mixed",
+    "_build_mixed_descriptors",
+    "_consume_ragged",
+    "_dispatch_decode",
+    "_consume",
+    "_prefill_chunks",
+    "_decode_drain",
+    "_decode_chunk_pass",
+}
 
 
 @dataclass
@@ -436,7 +463,7 @@ class _Linter:
 
     # ---- pass 2: rules ---------------------------------------------------
 
-    def _emit(self, mod, node, rule, severity, message, def_line=None):
+    def _emit(self, mod, node, rule, severity, message, def_line=None, key=None):
         line = getattr(node, "lineno", 0)
         if mod.suppressed(line, rule, def_line):
             return
@@ -446,12 +473,36 @@ class _Linter:
                 severity=severity,
                 location=f"{mod.relpath}:{line}",
                 message=message,
-                key=mod.relpath,
+                key=key if key is not None else mod.relpath,
             )
         )
 
     def rule_host_sync_census(self):
         for mod in self.modules.values():
+            hot_ranges = []
+            if mod.relpath.endswith("runtime/serving.py"):
+                for name, infos in mod.functions.items():
+                    if name not in SERVING_STEP_HOT_PATH:
+                        continue
+                    for info in infos:
+                        node = info.node
+                        hot_ranges.append(
+                            (node.lineno, getattr(node, "end_lineno", node.lineno))
+                        )
+                # a renamed/removed hot-path function must not silently
+                # disarm the gate (the baseline only fails on count
+                # INCREASES, so a bucket quietly dropping to 0 is invisible)
+                # — a stale name is a loud, non-baselined error instead
+                for name in sorted(SERVING_STEP_HOT_PATH - set(mod.functions)):
+                    self._emit(
+                        mod, mod.tree, "TPU102", SEV_ERROR,
+                        f"SERVING_STEP_HOT_PATH names `{name}` but "
+                        f"runtime/serving.py defines no such function — the "
+                        f"step-hot-path census is stale (a renamed per-step "
+                        f"method would silently escape the gate); update "
+                        f"the set in analysis/tpulint.py",
+                        key=f"{mod.relpath}::step-hot-path-stale",
+                    )
             for n in ast.walk(mod.tree):
                 if not isinstance(n, ast.Call):
                     continue
@@ -469,12 +520,30 @@ class _Linter:
                     # `from jax import device_get; device_get(x)` must not
                     # slip past the pinned census
                     name = f.id
-                if name:
+                if not name:
+                    continue
+                self._emit(
+                    mod, n, "TPU102", SEV_WARNING,
+                    f"host-sync call `{name}` (census; the baseline pins "
+                    f"this file's count — batch fetches into one "
+                    f"device_get per step)",
+                )
+                line = getattr(n, "lineno", 0)
+                if any(a <= line <= b for a, b in hot_ranges):
+                    # separately-pinned bucket: the serving step() hot path.
+                    # Its count must stay at the designated consume points —
+                    # a NEW blocking fetch inside step-reachable code trips
+                    # this gate even if the per-file count is rebalanced
+                    # elsewhere in the file (ISSUE 8; the pipelined ragged
+                    # path consumes via np.asarray on an async-copied array,
+                    # which is deliberately NOT a census name).
                     self._emit(
                         mod, n, "TPU102", SEV_WARNING,
-                        f"host-sync call `{name}` (census; the baseline pins "
-                        f"this file's count — batch fetches into one "
-                        f"device_get per step)",
+                        f"host-sync call `{name}` inside the serving step() "
+                        f"hot path (separately-pinned census bucket — a "
+                        f"blocking fetch here stalls the pipelined serving "
+                        f"loop; consume points only)",
+                        key=f"{mod.relpath}::step-hot-path",
                     )
 
     def _body_nodes(self, info: _FuncInfo):

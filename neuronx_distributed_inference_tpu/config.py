@@ -345,6 +345,16 @@ class TpuConfig:
     # absmax couples whatever one dispatch co-writes, and the ragged step
     # groups writes differently — docs/SERVING.md).
     serving_ragged: bool = False
+    # async 1-ahead pipelining for the ragged mixed-step path: serving step
+    # k+1 chains on step k's still-on-device tokens (device-side chained-id
+    # gather, epoch-guarded one-step-late consume) and the step-k fetch is
+    # started non-blocking at dispatch — host bookkeeping (admission,
+    # deadlines, watchdog, telemetry) overlaps the device executing k+1.
+    # None (default) follows async_mode, mirroring the split path's 1-ahead
+    # decode; False forces dispatch+fetch-per-step (step-accurate
+    # debugging). Greedy outputs are byte-identical across sync/async
+    # (pinned). Requires serving_ragged.
+    serving_ragged_async: Optional[bool] = None
 
     # --- attention -------------------------------------------------------
     fused_qkv: bool = False
@@ -612,6 +622,12 @@ class TpuConfig:
                 raise NotImplementedError(
                     "serving_ragged is single-shard-parallel (tp only)"
                 )
+        if self.serving_ragged_async and not self.serving_ragged:
+            raise ValueError(
+                "serving_ragged_async=True pipelines the RAGGED mixed-step "
+                "dispatch: set serving_ragged=True (the legacy split path "
+                "already pipelines via async_mode)"
+            )
         if (
             self.is_block_kv_layout
             and self.pa_num_blocks is None

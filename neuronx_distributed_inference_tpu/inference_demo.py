@@ -140,6 +140,20 @@ def build_parser() -> argparse.ArgumentParser:
         "(requires --is-block-kv-layout under continuous batching; "
         "docs/SERVING.md)",
     )
+    run.add_argument(
+        "--serving-ragged-async", dest="serving_ragged_async",
+        action="store_true", default=None,
+        help="async 1-ahead pipelining for the ragged mixed-step path: "
+        "step k+1 chains on step k's on-device tokens and the token fetch "
+        "is non-blocking, overlapping host bookkeeping with the device "
+        "(requires --serving-ragged; default follows async-mode)",
+    )
+    run.add_argument(
+        "--no-serving-ragged-async", dest="serving_ragged_async",
+        action="store_false",
+        help="force dispatch+fetch-per-step on the ragged path "
+        "(step-accurate debugging)",
+    )
     run.add_argument("--cp-max-num-seqs", type=int, default=8,
                      help="chunked prefill: max sequences per chunk batch")
     run.add_argument("--cp-kernel-q-tile-size", type=int, default=128)
@@ -378,6 +392,7 @@ def create_tpu_config(args) -> TpuConfig:
         is_chunked_prefill=args.is_chunked_prefill,
         chunked_prefill_config=cpc,
         serving_ragged=args.serving_ragged,
+        serving_ragged_async=args.serving_ragged_async,
         admission_validation=args.admission_validation,
         request_deadline_s=args.request_deadline_s,
         dispatch_max_retries=args.dispatch_max_retries,

@@ -204,6 +204,17 @@ class MixedStepInputs:
     row_len: jax.Array  # (R,) int32 query tokens per row; 0 = inactive
     ctx_len: jax.Array  # (R,) int32 total kv length per row (incl. new)
     sampling_params: jax.Array  # (R, 3) float32
+    # async 1-ahead chaining (serving_ragged_async): chain_src[t] names the
+    # row whose PREVIOUS-step token supplies packed position t's input id
+    # (-1 = take input_ids[t] as written by the host). chain_tokens is the
+    # previous mixed step's (R, 1) token output — still on device in steady
+    # state, so a chained decode row's input never round-trips the host.
+    # The synchronous path passes inert values (all -1 / zeros): both modes
+    # run ONE program identity, which is what keeps the sealed-retrace and
+    # byte-identity pins mode-independent. None (e.g. hand-built audit
+    # inputs) skips the gather entirely.
+    chain_src: Optional[jax.Array] = None  # (1, T) int32; -1 = host id
+    chain_tokens: Optional[jax.Array] = None  # (R, 1) int32
 
 
 def act_fn(name: str) -> Callable:
@@ -1373,7 +1384,22 @@ def mixed_forward(
 
     from neuronx_distributed_inference_tpu.parallel.sharding import constrain
 
-    hidden = embed(params, inputs.input_ids)  # (1, T, H)
+    input_ids = inputs.input_ids
+    if inputs.chain_tokens is not None and inputs.chain_src is not None:
+        # device-side chained-id gather (serving_ragged_async): packed
+        # positions whose chain_src names a row take that row's previous-
+        # step token straight off the device — the ragged analogue of the
+        # split path's `last_override` chain. A previous-step NON_FINITE
+        # sentinel (-1) clamps to token 0: the poisoned row computes finite
+        # garbage this (speculative) step and is quarantined at consume.
+        R = inputs.chain_tokens.shape[0]
+        src = inputs.chain_src
+        chained = jnp.take(
+            jnp.maximum(inputs.chain_tokens[:, 0], 0),
+            jnp.clip(src, 0, R - 1),
+        )
+        input_ids = jnp.where(src >= 0, chained, input_ids)
+    hidden = embed(params, input_ids)  # (1, T, H)
     # pin the scan-carried hidden replicated: without the constraint GSPMD
     # shards the packed hidden along H (propagated back from the per-row
     # gather) and re-gathers it before EVERY layer's qkv matmul — an

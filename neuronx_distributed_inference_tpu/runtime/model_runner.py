@@ -471,19 +471,32 @@ class MixedStepRunner:
         block_table: np.ndarray,  # (R, mb) covering each row's blocks
         width: int,  # kv width bucket (block-aligned)
         sampling_params: Optional[np.ndarray] = None,
+        chain_src: Optional[np.ndarray] = None,  # (T,) int32; -1 = host id
+        chain_tokens=None,  # (R, 1) int32; may be an UNFETCHED device array
     ):
         """Pad the packed axis to its total-token bucket and the block table
         to ``width // block_size`` columns; build MixedStepInputs. Returns
-        (inputs, T_real)."""
+        (inputs, T_real).
+
+        ``chain_src``/``chain_tokens`` feed the async 1-ahead chained-id
+        gather (models/base.mixed_forward): omitted, INERT values (all -1 /
+        zeros) are substituted so the synchronous path dispatches the SAME
+        program identity as the pipelined one — the warmed program is the
+        served program in both modes."""
         from neuronx_distributed_inference_tpu.models.base import MixedStepInputs
 
         T = int(input_ids.shape[0])
         bucket = get_target_bucket(self.buckets, max(T, self.q_tile))
         pad = bucket - T
+        if chain_src is None:
+            chain_src = np.full(T, -1, np.int32)
+        if chain_tokens is None:
+            chain_tokens = np.zeros((self.num_rows, 1), np.int32)
         if pad:
             input_ids = np.pad(input_ids, (0, pad))
             positions = np.pad(positions, (0, pad), constant_values=-1)
             slot_mapping = np.pad(slot_mapping, (0, pad), constant_values=-1)
+            chain_src = np.pad(chain_src, (0, pad), constant_values=-1)
         mb = max(1, width // self.block_size)
         R, mb_in = block_table.shape
         if R != self.num_rows:
@@ -510,6 +523,11 @@ class MixedStepRunner:
             row_len=jnp.asarray(row_len.astype(np.int32)),
             ctx_len=jnp.asarray(ctx_len.astype(np.int32)),
             sampling_params=jnp.asarray(sampling_params.astype(np.float32)),
+            chain_src=jnp.asarray(chain_src.astype(np.int32)[None, :]),
+            # a device-resident (R, 1) token array passes through untouched
+            # (jnp.asarray is a no-op on a committed jax.Array) — the chain
+            # never forces a host round-trip
+            chain_tokens=jnp.asarray(chain_tokens, dtype=jnp.int32),
         )
         return inputs, T
 

@@ -232,6 +232,26 @@ class ServingSession:
         # serving path (ops/ragged_paged_attention.py)
         self.ragged = bool(getattr(tc, "serving_ragged", False))
         self.mixed_runner = None
+        # async 1-ahead pipelining for the ragged path (serving_ragged_async,
+        # default follows async_mode): mixed step k+1 chains decode rows on
+        # step k's still-on-device tokens (device-side chained-id gather) and
+        # step k's fetch starts non-blocking at dispatch — host bookkeeping
+        # overlaps the device executing k+1. Tokens are consumed one step()
+        # LATE, with the same epoch-guard/speculative-extra-step semantics as
+        # the split path's 1-ahead decode.
+        self.ragged_async = False
+        # cached (R, 3) sampling params: constant for the session's fixed
+        # slot count, hoisted out of the per-step dispatch closures
+        self._sampling_cache: Optional[np.ndarray] = None
+        # per-slot block-table row cache ((R, MB_max) matrix + per-slot block
+        # counts), refreshed incrementally on alloc/free/preempt/quarantine —
+        # the steady-state descriptor build reads it instead of walking the
+        # allocator's python block lists every step
+        self._bt_matrix: Optional[np.ndarray] = None
+        self._bt_count: Optional[np.ndarray] = None
+        # accumulated blocking-fetch wait inside the current _ragged_step
+        # (host-frac telemetry: step wall minus this is pure host time)
+        self._step_fetch_wait_s = 0.0
         if self.ragged:
             self.mixed_runner = getattr(app, "mixed_step_model", None)
             if self.mixed_runner is None:
@@ -240,9 +260,19 @@ class ServingSession:
                     "mixed_step program family (build the app with the same "
                     "config that constructs this session)"
                 )
-            # tokens are consumed on the step that dispatched them (the mixed
-            # program emits exactly one token per row); no 1-ahead chaining
+            # the split-path 1-ahead machinery stays off: the ragged pipeline
+            # has its own pending-step consume (`_consume_ragged`)
             self.async_decode = False
+            ra = getattr(tc, "serving_ragged_async", None)
+            self.ragged_async = bool(tc.async_mode) if ra is None else bool(ra)
+            if self.block_mode:
+                mb_max = max(
+                    1,
+                    -(-max(app.token_generation_model.buckets[-1], tc.seq_len)
+                      // tc.pa_block_size),
+                )
+                self._bt_matrix = np.zeros((self.num_slots, mb_max), np.int32)
+                self._bt_count = np.zeros(self.num_slots, np.int64)
             aspec = app.spec.attn
             if aspec.model_parallel > 1 and not aspec.use_flash_kernel:
                 # pallas custom calls carry no GSPMD partitioning rule, so
@@ -381,6 +411,7 @@ class ServingSession:
         if self.prefix_caching:
             req.prefill_pos = self.allocator.match_prefix(slot, req.input_ids)
             req.pos = req.prefill_pos
+            self._bt_sync(slot)
         self.slots[slot] = req
         self.requests[req.req_id] = req
         self.tel.request_admitted(req.req_id, cached_prefix_tokens=req.prefill_pos)
@@ -425,6 +456,7 @@ class ServingSession:
                     self.app.kv_cache = fill_kv_rows(self.app.kv_cache, blocks, 0.0)
             else:
                 self.allocator.free_seq(req.slot)
+            self._bt_sync(req.slot)
         elif scrub:
             self.app.kv_cache = fill_kv_rows(
                 self.app.kv_cache, [self._cache_line_of_slot(req.slot)], 0.0
@@ -454,7 +486,38 @@ class ServingSession:
         forces pool exhaustion here without shrinking the real pool."""
         if self.faults is not None and self.faults.pool_exhausted(self):
             raise RuntimeError("out of KV blocks (injected fault)")
-        return self.allocator.alloc_seq(slot, num_tokens)
+        blocks = self.allocator.alloc_seq(slot, num_tokens)
+        self._bt_sync(slot)
+        return blocks
+
+    def _bt_sync(self, slot: int):
+        """Refresh the cached block-table row for ``slot`` against the
+        allocator (no-op unless the block list changed — the steady-state
+        decode step allocates nothing and pays an O(1) length compare).
+        Called at every block-list mutation point: alloc, free/preempt/
+        quarantine release, and prefix-cache attach."""
+        if self._bt_matrix is None:
+            return
+        blocks = self.allocator.seq_blocks.get(slot)
+        n = len(blocks) if blocks else 0
+        if n == int(self._bt_count[slot]):
+            return
+        row = self._bt_matrix[slot]
+        row[:] = 0
+        if n:
+            m = min(n, row.shape[0])
+            row[:m] = blocks[:m]
+        self._bt_count[slot] = n
+
+    def _session_sampling_params(self) -> np.ndarray:
+        """prepare_sampling_params(R) is constant for the session's fixed
+        slot count: built once and reused by every dispatch closure
+        (rebuilt only if the slot count ever changes)."""
+        sp = self._sampling_cache
+        if sp is None or sp.shape[0] != self.num_slots:
+            sp = prepare_sampling_params(self.num_slots)
+            self._sampling_cache = sp
+        return sp
 
     def _preempt(self, req: Request):
         """NON-terminal pool-exhaustion eviction: roll the request back to
@@ -613,14 +676,18 @@ class ServingSession:
             "last_dispatch_error": self._last_dispatch_error,
         }
 
-    def _guarded_dispatch(self, label: str, reqs: List[Request], fn):
+    def _guarded_dispatch(self, label: str, reqs: List[Request], fn, on_give_up=None):
         """Run one device dispatch with bounded-backoff retry. Transient
         errors (RETRYABLE_DISPATCH_ERRORS) retry up to
         ``dispatch_max_retries`` times with capped exponential backoff;
         exhaustion terminally FAILs only the in-flight ``reqs``
         (dispatch_error) and returns None — the session, and every other
         request, keeps running. Anything non-transient propagates: that is
-        a programming error, not weather."""
+        a programming error, not weather. ``on_give_up`` runs BEFORE the
+        in-flight rows are failed — the pipelined ragged path uses it to
+        consume the already-executed previous step, so a request failing at
+        step k+1 still keeps its step-k token (the order the synchronous
+        path commits in)."""
         attempt = 0
         while True:
             try:
@@ -633,6 +700,8 @@ class ServingSession:
                     self._last_dispatch_error = repr(e)
                     if self.faults is not None:
                         self.faults.dispatch_gave_up(self)
+                    if on_give_up is not None:
+                        on_give_up()
                     for r in reqs:
                         if not r.finished:
                             self._finish(r, "dispatch_error")
@@ -904,7 +973,7 @@ class ServingSession:
         def dispatch():
             with self.tel.span("serving.prefill_chunk", rows=len(rows)):
                 inputs, _ = tkg.prepare(
-                    ids, mask, positions, seq_ids, prepare_sampling_params(B),
+                    ids, mask, positions, seq_ids, self._session_sampling_params(),
                     slot_mapping=slot_mapping, block_table=block_table,
                 )
                 return tkg(self.app.params, self.app.kv_cache, inputs, None)
@@ -1093,107 +1162,281 @@ class ServingSession:
         row pack into ONE launch of the ``mixed_step`` program — no CTE/TKG
         split, no per-phase padding, chunked prefill co-scheduled with
         decode. Row index == slot; segments are q-tile aligned (the ragged
-        kernel's packing contract); one host fetch consumes every row's
-        token. Returns {req_id: token} exactly like the split step()."""
+        kernel's packing contract); one CONSUMED host fetch per step.
+        Returns {req_id: token} exactly like the split step().
+
+        Pipelined mode (``ragged_async``, docs/SERVING.md "Pipelined
+        dispatch"): step k+1 is scheduled from each row's EFFECTIVE state
+        (its in-flight step counted as done), chained decode rows take their
+        input id from step k's still-on-device tokens via the mixed
+        program's chained-id gather, the step-k fetch was started
+        non-blocking at dispatch, and step k is consumed AFTER step k+1
+        dispatches — so all host bookkeeping here overlaps the device
+        executing k+1. Rows preempted/quarantined since their dispatch carry
+        a stale epoch: their in-flight token is discarded and (greedy)
+        regenerated identically after re-admission."""
         results: Dict[str, int] = {}
-        rows = []  # (req, kind, n_tokens)
-        if self.chunked:
-            for req in self.prefilling[: self.max_prefill_seqs]:
-                n = min(self.chunk_size, req.prompt_len - req.prefill_pos)
-                if n <= 0:
-                    continue
-                try:
-                    self._alloc(req.slot, req.prefill_pos + n)
-                except RuntimeError:
-                    # pool exhausted: preempt (re-queued with aging) so the
-                    # session never stalls — _prefill_chunks(preempt=True)
-                    self._preempt(req)
-                    continue
-                rows.append((req, "prefill", n))
-        for r in self.decoding:
-            try:
-                self._alloc(r.slot, r.pos + 1)
-            except RuntimeError:
-                self._preempt(r)
-                continue
-            rows.append((r, "decode", 1))
+        t_step0 = self.tel.clock()
+        self._step_fetch_wait_s = 0.0
+        pend = self._pending
+        self._pending = None
+        pend_map: Dict[int, tuple] = {}
+        if pend is not None:
+            for ent in pend[1]:
+                req = ent[0]
+                if (
+                    ent[5] == req.epoch
+                    and not req.finished
+                    and not req.preempted
+                ):
+                    pend_map[id(req)] = ent
+
+        rows = self._schedule_mixed(pend_map)
         if not rows:
+            if pend is not None:
+                self._consume_ragged(pend, results)
+            self._note_step_timing(t_step0)
             return results
-        rows.sort(key=lambda t: t[0].slot)
 
         mr = self.mixed_runner
-        tq = mr.q_tile
-        R = self.num_slots
-        row_start = np.zeros(R, np.int32)
-        row_len = np.zeros(R, np.int32)
-        ctx_len = np.zeros(R, np.int32)
-        cursor = 0
-        for req, _kind, n in rows:
-            row_start[req.slot] = cursor
-            row_len[req.slot] = n
-            cursor += -(-n // tq) * tq  # q-tile-aligned segment
-        T = cursor
-        ids = np.zeros(T, np.int32)
-        positions = np.full(T, -1, np.int32)
-        slot_mapping = np.full(T, -1, np.int32)
-        max_ctx = 0
-        for req, kind, n in rows:
-            s = row_start[req.slot]
-            p0 = req.prefill_pos if kind == "prefill" else req.pos
-            if kind == "prefill":
-                ids[s : s + n] = req.input_ids[p0 : p0 + n]
-            else:
-                ids[s] = req.last_token
-            positions[s : s + n] = np.arange(p0, p0 + n, dtype=np.int32)
-            slot_mapping[s : s + n] = self.allocator.slot_mapping(
-                req.slot, np.arange(p0, p0 + n)
-            )
-            ctx_len[req.slot] = p0 + n
-            max_ctx = max(max_ctx, p0 + n)
-        width = get_target_bucket(
-            self.app.token_generation_model.buckets, max_ctx
-        )
-        mb = max(1, width // self.allocator.block_size)
-        block_table = np.zeros((R, mb), np.int32)
-        for req, _kind, _n in rows:
-            block_table[req.slot] = self.allocator.block_table(req.slot, mb)
+        d = self._build_mixed_descriptors(rows)
+        chain_tokens = pend[0] if (pend is not None and d["chained"]) else None
 
         def dispatch():
-            with self.tel.span("serving.mixed_step", rows=len(rows), tokens=T):
+            with self.tel.span(
+                "serving.mixed_step", rows=len(rows), tokens=d["T"]
+            ):
                 inputs, _ = mr.prepare(
-                    ids, positions, slot_mapping, row_start, row_len, ctx_len,
-                    block_table, width, prepare_sampling_params(R),
+                    d["ids"], d["positions"], d["slot_mapping"],
+                    d["row_start"], d["row_len"], d["ctx_len"],
+                    d["block_table"], d["width"],
+                    self._session_sampling_params(),
+                    chain_src=d["chain_src"], chain_tokens=chain_tokens,
                 )
                 return mr(self.app.params, self.app.kv_cache, inputs, None)
 
-        out = self._guarded_dispatch("mixed_step", [r for r, *_ in rows], dispatch)
+        consumed = [False]
+
+        def give_up():
+            # the previous step already executed on device: commit it BEFORE
+            # the in-flight rows terminally fail, so a request failing at
+            # step k+1 keeps its step-k token (sync-path commit order)
+            if pend is not None and not consumed[0]:
+                consumed[0] = True
+                self._consume_ragged(pend, results)
+
+        out = self._guarded_dispatch(
+            "mixed_step", [t[0] for t in rows], dispatch, on_give_up=give_up
+        )
         if out is None:
-            return results  # in-flight rows terminally FAILED(dispatch_error)
+            # in-flight rows terminally FAILED(dispatch_error); the previous
+            # step was consumed by give_up
+            self._note_step_timing(t_step0)
+            return results
         self.app.kv_cache = out.cache
         self.tel.step("mixed")
         self.tel.bucket_dispatch(mr.tag, mr.last_bucket)
-        n_prefill = sum(1 for _, kind, _ in rows if kind == "prefill")
-        real_tokens = int(sum(n for *_, n in rows))
+        n_prefill = sum(1 for t in rows if t[1] == "prefill")
+        real_tokens = int(sum(t[2] for t in rows))
         self.tel.mixed_step(
             prefill_rows=n_prefill,
             decode_rows=len(rows) - n_prefill,
             padded_slots=mr.last_bucket - real_tokens,
             query_tokens=real_tokens,
         )
-        for req, kind, n in rows:
+        for req, kind, n, _p0, _c in rows:
             if kind == "prefill":
                 self._note_prefill(req, n)
         self.tel.pool_gauges(
             len(self.active), self.kv_pool_bytes, self.kv_free_bytes
         )
+        snap = [
+            (req, kind, n, p0, req.slot, req.epoch)
+            for req, kind, n, p0, _c in rows
+        ]
+        if self.ragged_async:
+            # start the device->host token copy NOW (non-blocking): by the
+            # time next step() consumes it, the transfer has overlapped this
+            # step's remaining host work and the device executing k+1
+            start_copy = getattr(out.tokens, "copy_to_host_async", None)
+            if start_copy is not None:
+                start_copy()
+            self._pending = (out.tokens, snap)
+            if pend is not None:
+                self._consume_ragged(pend, results)
+        else:
+            self._consume_ragged((out.tokens, snap), results)
+        self._note_step_timing(t_step0)
+        return results
 
-        tokens = np.asarray(out.tokens)  # the only device sync per step
+    def _schedule_mixed(self, pend_map: Dict[int, tuple]) -> List[tuple]:
+        """Build this step's row list [(req, kind, n, p0, chained), ...]
+        from each row's EFFECTIVE state: a row with a current pending entry
+        (``pend_map``, epoch-matched) is scheduled as if that dispatched
+        step already committed — its prefill cursor advanced, its decode
+        position +1, its next input id chained from the on-device tokens.
+        With pipelining off ``pend_map`` is always empty and this reduces
+        exactly to the synchronous schedule."""
+        rows: List[tuple] = []
+        seq_len = self.app.config.tpu_config.seq_len
+        if self.chunked:
+            pref = []
+            for r in self.slots:
+                if r is None or r.finished:
+                    continue
+                e = pend_map.get(id(r))
+                eff = (
+                    e[3] + e[2]
+                    if (e is not None and e[1] == "prefill")
+                    else r.prefill_pos
+                )
+                if eff < r.prompt_len:
+                    pref.append((r, eff))
+            for req, eff in pref[: self.max_prefill_seqs]:
+                n = min(self.chunk_size, req.prompt_len - eff)
+                try:
+                    self._alloc(req.slot, eff + n)
+                except RuntimeError:
+                    # pool exhausted: preempt (re-queued with aging) so the
+                    # session never stalls — _prefill_chunks(preempt=True)
+                    self._preempt(req)
+                    continue
+                rows.append((req, "prefill", n, eff, False))
+        scheduled = {id(t[0]) for t in rows}
+        for r in list(self.slots):
+            if r is None or r.finished or id(r) in scheduled:
+                continue
+            e = pend_map.get(id(r))
+            if e is not None and e[1] == "prefill":
+                eff = e[3] + e[2]
+                if eff < r.prompt_len:
+                    continue  # still mid-prompt (or waiting for a chunk slot)
+                # completed its prompt in flight: its first generated token
+                # is on device — chained decode at position == prompt_len
+                p0, chained, committed_after = eff, True, len(r.generated) + 1
+            elif e is not None:
+                p0, chained, committed_after = (
+                    e[3] + 1, True, len(r.generated) + 1
+                )
+            else:
+                if r.prefilling:
+                    continue  # beyond max_prefill_seqs this step
+                p0, chained, committed_after = r.pos, False, len(r.generated)
+            if chained and (
+                committed_after >= r.max_new_tokens
+                or (e[1] == "decode" and e[3] + 2 >= seq_len)
+            ):
+                # the pending token predictably terminates this request at
+                # consume (budget / position limit): don't burn a
+                # speculative row on it. EOS terminations are NOT host-
+                # predictable — those rows do run one extra speculative
+                # step whose token is discarded, like the split path.
+                continue
+            try:
+                self._alloc(r.slot, p0 + 1)
+            except RuntimeError:
+                self._preempt(r)
+                continue
+            rows.append((r, "decode", 1, p0, chained))
+        return rows
+
+    def _build_mixed_descriptors(self, rows: List[tuple]) -> Dict:
+        """Vectorized mixed-step descriptor build. ``rows`` is the schedule
+        [(req, kind, n, p0, chained), ...]; returns the packed arrays the
+        MixedStepRunner consumes. Decode rows — the steady-state bulk — are
+        built with whole-array numpy ops off the incrementally-maintained
+        block-table matrix (no allocator walks, no per-row python loops);
+        only prefill chunks (bounded by ``max_prefill_seqs``) take a
+        per-row slice write. Equivalent, per element, to the per-row
+        reference build (pinned by tests/test_ragged_serving.py)."""
+        rows.sort(key=lambda t: t[0].slot)
+        mr = self.mixed_runner
+        tq = mr.q_tile
+        R = self.num_slots
+        bs = self.allocator.block_size
+        k = len(rows)
+        slots = np.fromiter((t[0].slot for t in rows), np.int64, k)
+        ns = np.fromiter((t[2] for t in rows), np.int64, k)
+        p0s = np.fromiter((t[3] for t in rows), np.int64, k)
+        dec = np.fromiter((t[1] == "decode" for t in rows), np.bool_, k)
+        chain = np.fromiter((t[4] for t in rows), np.bool_, k)
+        seg = -(-ns // tq) * tq  # q-tile-aligned segment sizes
+        starts = np.zeros(k, np.int64)
+        np.cumsum(seg[:-1], out=starts[1:])
+        T = int(seg.sum())
+        row_start = np.zeros(R, np.int32)
+        row_len = np.zeros(R, np.int32)
+        ctx_len = np.zeros(R, np.int32)
+        row_start[slots] = starts
+        row_len[slots] = ns
+        ctx_len[slots] = p0s + ns
+        ids = np.zeros(T, np.int32)
+        positions = np.full(T, -1, np.int32)
+        slot_mapping = np.full(T, -1, np.int32)
+        chain_src = np.full(T, -1, np.int32)
+        if dec.any():
+            dst = starts[dec]
+            dslot = slots[dec]
+            dpos = p0s[dec]
+            ids[dst] = np.fromiter(
+                (t[0].last_token for t in rows if t[1] == "decode"),
+                np.int64, int(dec.sum()),
+            )  # chained rows' host value is a placeholder the gather replaces
+            positions[dst] = dpos
+            slot_mapping[dst] = (
+                self._bt_matrix[dslot, dpos // bs] * bs + dpos % bs
+            )
+            dchain = chain[dec]
+            chain_src[dst[dchain]] = dslot[dchain]
+        for i in np.flatnonzero(~dec):
+            req, _kind, n, p0, _c = rows[i]
+            s = int(starts[i])
+            ids[s : s + n] = req.input_ids[p0 : p0 + n]
+            pr = np.arange(p0, p0 + n)
+            positions[s : s + n] = pr
+            slot_mapping[s : s + n] = (
+                self._bt_matrix[req.slot, pr // bs] * bs + pr % bs
+            )
+        width = get_target_bucket(
+            self.app.token_generation_model.buckets, int((p0s + ns).max())
+        )
+        mb = max(1, width // bs)
+        block_table = np.zeros((R, mb), np.int32)
+        take = min(mb, self._bt_matrix.shape[1])
+        block_table[:, :take] = self._bt_matrix[:, :take]
+        return {
+            "T": T,
+            "ids": ids,
+            "positions": positions,
+            "slot_mapping": slot_mapping,
+            "row_start": row_start,
+            "row_len": row_len,
+            "ctx_len": ctx_len,
+            "block_table": block_table,
+            "width": width,
+            "chain_src": chain_src,
+            "chained": bool(chain.any()),
+        }
+
+    def _consume_ragged(self, pend, results: Dict[str, int]):
+        """Fetch one dispatched mixed step — the step's ONE consumed host
+        sync (started non-blocking at dispatch under pipelining, so the
+        wait here is only whatever the overlap didn't cover) — and apply
+        the commit/termination bookkeeping. Rows whose request finished or
+        was evicted since dispatch (stale epoch) are speculative leftovers
+        and are discarded; rows carrying the non-finite sentinel are
+        quarantined (only that row dies, pinned)."""
+        t0 = self.tel.clock()
+        tokens = np.asarray(pend[0])  # (R, 1)
+        self._step_fetch_wait_s += self.tel.clock() - t0
         if self.faults is not None:
             tokens = self.faults.corrupt_tokens(self, tokens)
-        for req, kind, n in rows:
-            tok = int(tokens[req.slot, 0])
+        for req, kind, n, p0, slot, epoch in pend[1]:
+            if req.finished or req.preempted or req.epoch != epoch:
+                continue
+            tok = int(tokens[slot, 0])
             if kind == "prefill":
-                req.prefill_pos += n
+                req.prefill_pos = p0 + n
                 if req.prefill_pos >= req.prompt_len:
                     # the last prompt token's output IS the first generated
                     # token (same contract as _prefill_chunks)
@@ -1208,11 +1451,22 @@ class ServingSession:
                 continue
             req.generated.append(tok)
             self._commit_tokens(req, 1)
-            req.pos += 1
+            req.pos = p0 + 1
             results[req.req_id] = tok
             if self._is_done(req, tok):
                 self._finish(req)
-        return results
+
+    def _note_step_timing(self, t_step0: float):
+        """Host-vs-device split for this ragged step: everything except the
+        blocking part of the token fetch is host bookkeeping (descriptor
+        build, admission, commits, telemetry). Feeds the
+        ``nxdi_serving_host_frac`` gauge — the fraction of serving wall
+        time the HOST is the bottleneck for."""
+        if not self.tel.enabled:
+            return
+        total_s = self.tel.clock() - t_step0
+        wait_s = min(self._step_fetch_wait_s, total_s)
+        self.tel.step_timing((total_s - wait_s) * 1e3, wait_s * 1e3)
 
     def _dispatch_decode(self, rows, last_override=None):
         """Dispatch ONE batched decode pass for ``rows`` = [(req, pos), ...]
@@ -1273,7 +1527,7 @@ class ServingSession:
         def dispatch():
             with self.tel.span("serving.decode", rows=len(rows)):
                 inputs, _ = tkg.prepare(
-                    last_arr, mask, pos, seq_ids, prepare_sampling_params(B),
+                    last_arr, mask, pos, seq_ids, self._session_sampling_params(),
                     block_table=block_table,
                 )
                 return tkg(self.app.params, self.app.kv_cache, inputs, None)
@@ -1378,6 +1632,9 @@ class ServingSession:
                 self.allocator.alloc_seq(slot, pos + min(chunk, remaining))
             except RuntimeError:
                 return None
+            # no _bt_sync here: the block-table matrix cache exists only on
+            # the ragged path, whose run_to_completion never reaches the
+            # multi-step drain (it would reintroduce the split)
             table[slot] = self.allocator.block_table(slot, mb)
         return table
 
@@ -1449,7 +1706,7 @@ class ServingSession:
                 with self.tel.span("serving.decode_chunk", steps=chunk):
                     return self.app.token_generation_model.decode_chunk(
                         self.app.params, self.app.kv_cache, last_dev, pos,
-                        seq_ids, prepare_sampling_params(B), None,
+                        seq_ids, self._session_sampling_params(), None,
                         num_steps=chunk, bucket=bucket, block_table=block_table,
                     )
 
@@ -1558,7 +1815,7 @@ class ServingSession:
             with self.tel.span("serving.decode_chunk", steps=chunk):
                 return self.app.token_generation_model.decode_chunk(
                     self.app.params, self.app.kv_cache, last, pos, seq_ids,
-                    prepare_sampling_params(B), None, num_steps=chunk,
+                    self._session_sampling_params(), None, num_steps=chunk,
                     bucket=bucket, block_table=block_table,
                 )
 

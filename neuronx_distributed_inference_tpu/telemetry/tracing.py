@@ -190,6 +190,25 @@ class TelemetrySession:
             "nxdi_spec_accept_len",
             "tokens committed per speculation round (sums to committed "
             "decode tokens)", buckets=metrics_mod.ACCEPT_LEN_BUCKETS)
+        self._step_host_ms = r.histogram(
+            "nxdi_step_host_ms",
+            "host-side bookkeeping per serving step (scheduling, descriptor "
+            "build, commits, telemetry — everything except the blocking part "
+            "of the token fetch)",
+            buckets=metrics_mod.LATENCY_MS_BUCKETS)
+        self._step_fetch_wait_ms = r.histogram(
+            "nxdi_step_fetch_wait_ms",
+            "blocking wait on the step's consumed token fetch (under "
+            "pipelined dispatch this is only what the host/device overlap "
+            "did not cover)",
+            buckets=metrics_mod.LATENCY_MS_BUCKETS)
+        self._host_frac = r.gauge(
+            "nxdi_serving_host_frac",
+            "cumulative host-time fraction of serving step wall time "
+            "(host_ms / (host_ms + fetch_wait_ms)); ~1.0 means the host, "
+            "not the chip, is the serving bottleneck")
+        self._host_ms_sum = 0.0
+        self._fetch_wait_ms_sum = 0.0
         self._mixed = r.histogram(
             "nxdi_mixed_step_rows",
             "ragged mixed-step dispatch composition: prefill_rows / "
@@ -443,6 +462,27 @@ class TelemetrySession:
         self._occupancy.set(occupancy)
         self._kv_pool.set(kv_pool_bytes)
         self._kv_free.set(kv_free_bytes)
+
+    def step_timing(self, host_ms: float, fetch_wait_ms: float) -> None:
+        """Host-vs-device split of ONE serving step, both measured with the
+        session clock on the host (no device syncs added — the fetch timed
+        here is one the runtime already performs): ``host_ms`` is the step's
+        wall time minus the blocking fetch wait. The
+        ``nxdi_serving_host_frac`` gauge tracks the cumulative fraction —
+        the host-gap number the async-pipelining work drives down
+        (PERF.md)."""
+        if not self.enabled:
+            return
+        self._step_host_ms.observe(host_ms)
+        self._step_fetch_wait_ms.observe(fetch_wait_ms)
+        self._host_ms_sum += max(0.0, host_ms)
+        self._fetch_wait_ms_sum += max(0.0, fetch_wait_ms)
+        denom = self._host_ms_sum + self._fetch_wait_ms_sum
+        if denom > 0:
+            self._host_frac.set(self._host_ms_sum / denom)
+        self.event(
+            "step_timing", host_ms=host_ms, fetch_wait_ms=fetch_wait_ms
+        )
 
     def mixed_step(
         self,

@@ -25,10 +25,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 ALL_POINTS = {
     "bf16_1b_bs1", "bf16_1b_bs4", "int8_1b_bs1", "serving_1b_int8",
-    "serving_1b_int8_ragged", "int8_8b_bs1", "bf16_1b_8k", "bf16_1b_8k_kvq8",
-    "bf16_1b_16k", "bf16_1b_16k_kvq8",
+    "serving_1b_int8_ragged", "serving_1b_int8_ragged_async", "int8_8b_bs1",
+    "bf16_1b_8k", "bf16_1b_8k_kvq8", "bf16_1b_16k", "bf16_1b_16k_kvq8",
 }
-SERVING_POINTS = {"serving_1b_int8", "serving_1b_int8_ragged"}
+SERVING_POINTS = {
+    "serving_1b_int8", "serving_1b_int8_ragged", "serving_1b_int8_ragged_async",
+}
 
 
 @pytest.mark.slow
@@ -55,6 +57,12 @@ def test_bench_suite_tiny(monkeypatch):
     ragged = points["serving_1b_int8_ragged"]
     assert ragged["ttft_ms"] > 0 and ragged["itl_ms"] is not None
     assert 0.0 <= ragged["padded_token_frac"] < 1.0
+    # ISSUE 8: the async-pipelined ragged row runs the SAME mix with 1-ahead
+    # chained dispatch + non-blocking fetch, and reports the measured
+    # host-time fraction of serving step wall time
+    ragged_async = points["serving_1b_int8_ragged_async"]
+    assert ragged_async["ttft_ms"] > 0 and ragged_async["itl_ms"] is not None
+    assert 0.0 < ragged_async["host_frac"] <= 1.0
     # emit fired after EVERY point (the incremental-summary contract) and
     # every snapshot produces a valid summary line
     assert len(emitted) == len(ALL_POINTS)
@@ -82,6 +90,10 @@ def test_bench_suite_tiny(monkeypatch):
     assert final["serving_itl_p99_ms"] is not None
     assert final["ragged_tok_s"] > 0
     assert final["ragged_padded_frac"] is not None
+    assert final["ragged_async_tok_s"] > 0
+    assert final["ragged_async_itl_p50_ms"] is not None
+    assert final["serving_host_frac"] is not None
+    assert 0.0 < final["serving_host_frac"] <= 1.0
     # ISSUE 7 satellite: containment census rides the serving rows — clean
     # traffic must report EXACTLY zero rejections/quarantines/preemptions
     # (the ~0-overhead proof), and the summary carries the keys

@@ -67,6 +67,38 @@ def test_fault_containment_knob_validation(kwargs, match):
         TpuConfig(**kwargs)
 
 
+def test_serving_ragged_async_knob():
+    """ISSUE 8: the pipelined-ragged knob defaults to None (follows
+    async_mode), round-trips, accepts a valid ragged config, and is
+    rejected without serving_ragged."""
+    tc = TpuConfig()
+    assert tc.serving_ragged_async is None
+    tc2 = TpuConfig.from_dict(tc.to_dict())
+    assert tc2.serving_ragged_async is None
+    ok = TpuConfig(
+        is_continuous_batching=True, is_block_kv_layout=True,
+        serving_ragged=True, serving_ragged_async=True,
+    )
+    assert ok.serving_ragged_async is True
+    off = TpuConfig(
+        is_continuous_batching=True, is_block_kv_layout=True,
+        serving_ragged=True, serving_ragged_async=False,
+    )
+    assert off.serving_ragged_async is False
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        dict(serving_ragged_async=True),  # no serving_ragged
+        dict(serving_ragged_async=True, is_block_kv_layout=True),
+    ],
+)
+def test_serving_ragged_async_rejected_without_ragged(kwargs):
+    with pytest.raises(ValueError, match="serving_ragged_async"):
+        TpuConfig(**kwargs)
+
+
 def test_json_round_trip(tmp_path, tiny_config):
     tiny_config.tpu_config.on_device_sampling_config = OnDeviceSamplingConfig(
         do_sample=True, top_k=5

@@ -73,10 +73,30 @@ def _paged_cfg(ragged=False, **extra):
 
 @pytest.fixture(scope="module")
 def paged_apps():
+    """(legacy split, ragged) — serving_ragged_async defaults to async_mode
+    (True), so the ragged app here exercises the PIPELINED dispatch: every
+    parametrized containment pin below covers the async ragged path."""
     sd = make_random_hf_state_dict(_paged_cfg(False))
     legacy = TpuModelForCausalLM(None, _paged_cfg(False)).load(state_dict=sd)
     ragged = TpuModelForCausalLM(None, _paged_cfg(True)).load(state_dict=sd)
     return legacy, ragged
+
+
+@pytest.fixture(scope="module")
+def sync_ragged_app(paged_apps):
+    """Synchronous-ragged twin of paged_apps[1] (serving_ragged_async=False),
+    sharing the same weights — the sync/async fault-parity reference."""
+    cfg = _paged_cfg(True, serving_ragged_async=False)
+    sd = make_random_hf_state_dict(_paged_cfg(False))
+    return TpuModelForCausalLM(None, cfg).load(state_dict=sd)
+
+
+def _paged_app(paged_apps, sync_ragged_app, mode):
+    return {
+        "legacy": paged_apps[0],
+        "ragged": paged_apps[1],
+        "ragged_sync": sync_ragged_app,
+    }[mode]
 
 
 @pytest.fixture(scope="module")
@@ -193,14 +213,16 @@ def test_admission_validation_off_restores_legacy(plain_app):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("mode", ["legacy", "ragged"])
-def test_nan_row_quarantined_cobatch_byte_identical(paged_apps, mode):
+@pytest.mark.parametrize("mode", ["legacy", "ragged", "ragged_sync"])
+def test_nan_row_quarantined_cobatch_byte_identical(
+    paged_apps, sync_ragged_app, mode
+):
     """A NaN-poisoned row (device KV NaN -> non-finite logits -> sentinel
     token) fails ONLY that row: healthy co-batched rows are byte-identical
     to a clean run on the legacy split AND the ragged dispatch paths, the
     poisoned blocks are scrubbed before the pool recycles them, and a new
     request reusing the freed capacity decodes byte-identically."""
-    app = paged_apps[0] if mode == "legacy" else paged_apps[1]
+    app = _paged_app(paged_apps, sync_ragged_app, mode)
     _, golden = _mix(app)
 
     inj = FaultInjector(seed=0).poison_kv_row(step=4, slot=1)  # r2's slot
@@ -243,13 +265,15 @@ def test_nan_row_quarantined_cobatch_byte_identical(paged_apps, mode):
     assert out2["r4"] == golden_probe
 
 
-@pytest.mark.parametrize("mode", ["legacy", "ragged"])
-def test_poisoned_garbage_block_cannot_couple_rows(paged_apps, mode):
+@pytest.mark.parametrize("mode", ["legacy", "ragged", "ragged_sync"])
+def test_poisoned_garbage_block_cannot_couple_rows(
+    paged_apps, sync_ragged_app, mode
+):
     """NaN written straight into SHARED garbage block 0 (the
     post-propagation state of the legacy drain's surplus lockstep writes)
     changes NO healthy row by a byte: masked reads of the garbage block are
     scrubbed to exact zeros in the gather (0*NaN=NaN is dead)."""
-    app = paged_apps[0] if mode == "legacy" else paged_apps[1]
+    app = _paged_app(paged_apps, sync_ragged_app, mode)
     _, golden = _mix(app)
     inj = FaultInjector().poison_garbage_block(step=2)
     _, out = _mix(app, injector=inj)
@@ -312,12 +336,14 @@ def test_sentinel_in_multistep_chunk_commits_finite_prefix(paged_apps):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("mode", ["legacy", "ragged"])
-def test_injected_pool_exhaustion_resumes_byte_identical(paged_apps, mode):
+@pytest.mark.parametrize("mode", ["legacy", "ragged", "ragged_sync"])
+def test_injected_pool_exhaustion_resumes_byte_identical(
+    paged_apps, sync_ragged_app, mode
+):
     """exhaust_pool evicts every allocating row for one step; evictions
     re-queue, re-admit, and the final streams are byte-identical to a
     fault-free run (rollback + greedy re-prefill regenerates exactly)."""
-    app = paged_apps[0] if mode == "legacy" else paged_apps[1]
+    app = _paged_app(paged_apps, sync_ragged_app, mode)
     _, golden = _mix(app)
     inj = FaultInjector().exhaust_pool(3)
     tel = TelemetrySession()
@@ -939,3 +965,88 @@ def test_rejected_history_bounded(plain_app):
     assert len(sess.rejected) == REJECTED_HISTORY_MAX
     assert f"bad{n - 1}" in sess.rejected  # newest kept
     assert "bad0" not in sess.rejected  # oldest evicted
+
+
+# ---------------------------------------------------------------------------
+# pipelined ragged dispatch under faults (ISSUE 8): the epoch-guarded
+# one-step-late consume must survive every containment policy
+# ---------------------------------------------------------------------------
+
+
+def test_async_ragged_dispatch_retry_recovers_byte_identical(paged_apps):
+    """Transient dispatch errors on the PIPELINED ragged path, within the
+    retry budget: backoff + retry, then success — the full mix is
+    byte-identical to a clean run (the chained previous-step tokens are
+    re-fed to the retried dispatch, nothing is consumed twice)."""
+    app = paged_apps[1]
+    _, golden = _mix(app)
+    inj = FaultInjector().dispatch_error(step=4, attempts=2)  # <= retries(2)
+    sleeps = []
+    app.init_kv_cache()
+    sess = ServingSession(app, fault_injector=inj, sleep_fn=sleeps.append)
+    assert sess.ragged_async
+    for rid, prompt in PROMPTS.items():
+        assert sess.add_request(rid, prompt, max_new_tokens=6)
+    out = _drive(sess)
+    assert out == golden
+    assert sleeps == [0.02, 0.04]
+    assert all(r.status == "finished" for r in sess.requests.values())
+
+
+def test_async_ragged_retry_exhaustion_keeps_pending_tokens(paged_apps):
+    """Past the retry budget on the pipelined path: the already-executed
+    previous step is consumed BEFORE the in-flight rows fail, so every
+    failed request keeps a clean-run PREFIX including its last in-flight
+    token (sync commit order); the session survives and serves new work."""
+    app = paged_apps[1]
+    _, golden = _mix(app)
+    inj = FaultInjector().dispatch_error(step=5, attempts=10)
+    sleeps = []
+    app.init_kv_cache()
+    sess = ServingSession(app, fault_injector=inj, sleep_fn=sleeps.append)
+    for rid, prompt in PROMPTS.items():
+        assert sess.add_request(rid, prompt, max_new_tokens=6)
+    out = _drive(sess)
+    failed = [r for r in sess.requests.values() if r.status == "failed"]
+    assert failed and all(r.fail_reason == "dispatch_error" for r in failed)
+    assert len(sleeps) == 2  # retried the budget before giving up
+    for rid, toks in out.items():
+        assert toks == golden[rid][: len(toks)], rid  # clean-run prefixes
+    assert len(sess.free_slots) == sess.num_slots
+    # alive: a fresh request admits and completes byte-identically
+    probe = [42, 10, 11]
+    app.init_kv_cache()
+    iso = ServingSession(app)
+    assert iso.add_request("iso", probe, max_new_tokens=4)
+    golden_probe = _drive(iso)["iso"]
+    app.init_kv_cache()
+    assert sess.add_request("after", probe, max_new_tokens=4)
+    assert _drive(sess)["after"] == golden_probe
+
+
+def test_async_ragged_deadline_expiry_mid_pipeline(paged_apps):
+    """A request expiring while its dispatched step is still in flight:
+    terminal deadline_exceeded at the step boundary, its in-flight token is
+    discarded (stale entry), and co-batched rows keep their full
+    clean-run streams."""
+    app = paged_apps[1]
+    _, golden = _mix(app, n_tokens=8)
+    clock = FakeClock()
+    app.init_kv_cache()
+    sess = ServingSession(app, clock=clock, sleep_fn=clock.sleep)
+    assert sess.ragged_async
+    assert sess.add_request("r1", PROMPTS["r1"], max_new_tokens=8,
+                            deadline_s=1.0)
+    assert sess.add_request("r2", PROMPTS["r2"], max_new_tokens=8)
+    assert sess.add_request("r3", PROMPTS["r3"], max_new_tokens=8)
+    for _ in range(4):
+        sess.step()  # r1's next step is dispatched and UNCONSUMED here
+    clock.t += 5.0  # r1 expires with a pending in-flight step
+    out = _drive(sess)
+    r1 = sess.requests["r1"]
+    assert r1.status == "failed" and r1.fail_reason == "deadline_exceeded"
+    assert out["r1"] == golden["r1"][: len(out["r1"])]
+    assert len(out["r1"]) < 8
+    assert out["r2"] == golden["r2"]
+    assert out["r3"] == golden["r3"]
+    assert len(sess.free_slots) == sess.num_slots

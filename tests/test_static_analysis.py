@@ -991,3 +991,68 @@ def test_mem402_detects_footprint_regression(tmp_path):
         baseline_path=tmp_path / "missing.json", tags=("token_generation",)
     )
     assert any(f.rule == "MEM402" and "no committed" in f.message for f in findings)
+
+
+def _hot_path_snippet(omit=()):
+    """A fixture serving.py defining every SERVING_STEP_HOT_PATH function
+    (minus ``omit``), with a hot-path fetch in _ragged_step and an
+    admission-path fetch in _windowed_admit."""
+    from neuronx_distributed_inference_tpu.analysis.tpulint import (
+        SERVING_STEP_HOT_PATH,
+    )
+
+    stubs = "\n".join(
+        f"    def {name}(self):\n        pass"
+        for name in sorted(SERVING_STEP_HOT_PATH - {"_ragged_step"} - set(omit))
+    )
+    return textwrap.dedent(
+        """
+        import jax
+
+        class ServingSession:
+            def _ragged_step(self, pend):
+                return jax.device_get(pend)  # BUG: fetch in the step hot path
+
+            def _windowed_admit(self, out):
+                return jax.device_get(out)   # admission path: file bucket only
+        """
+    ) + "\n" + stubs + "\n"
+
+
+def _lint_serving_snippet(tmp_path, source):
+    pkg = tmp_path / "neuronx_distributed_inference_tpu" / "runtime"
+    pkg.mkdir(parents=True, exist_ok=True)
+    f = pkg / "serving.py"
+    f.write_text(source)
+    return lint_paths([f], tmp_path)
+
+
+def test_rule_step_hot_path_census(tmp_path):
+    """ISSUE 8: a blocking `jax.device_get` inside a ServingSession
+    step()-hot-path function earns a SECOND TPU102 finding in the
+    separately-pinned `<file>::step-hot-path` bucket — so a future
+    blocking fetch added to the per-step serving loop trips the gate on
+    its own; the same call on an admission-path function stays in the
+    file-level census only."""
+    findings = _lint_serving_snippet(tmp_path, _hot_path_snippet())
+    census = [x for x in findings if x.rule == "TPU102"]
+    hot = [x for x in census if x.key.endswith("::step-hot-path")]
+    assert len(hot) == 1
+    assert "_ragged_step" not in hot[0].key  # bucket is per-file, not per-fn
+    assert len([x for x in census if not x.key.endswith("::step-hot-path")]) == 2
+
+
+def test_rule_step_hot_path_stale_name_is_loud(tmp_path):
+    """A renamed/removed hot-path function must not silently disarm the
+    gate: a SERVING_STEP_HOT_PATH name with no matching function is a
+    non-baselined ERROR, not a quietly-empty census bucket."""
+    findings = _lint_serving_snippet(
+        tmp_path, _hot_path_snippet(omit=("_consume_ragged",))
+    )
+    stale = [
+        x for x in findings
+        if x.rule == "TPU102" and x.key.endswith("::step-hot-path-stale")
+    ]
+    assert len(stale) == 1
+    assert stale[0].severity == "error"
+    assert "_consume_ragged" in stale[0].message

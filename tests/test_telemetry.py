@@ -569,3 +569,72 @@ def test_span_annotations_nest_without_device_sync(cb_app):
     assert any(e["name"] == "app.cte" for e in spans)
     assert any(e["name"] == "app.decode_chunk" for e in spans)
     assert all(e["dur_ms"] >= 0 for e in spans)
+
+
+# ---------------------------------------------------------------------------
+# serving host-gap telemetry (ISSUE 8): per-step host/fetch split + gauge
+# ---------------------------------------------------------------------------
+
+
+def test_step_timing_unit():
+    """step_timing feeds the host/fetch histograms and the cumulative
+    nxdi_serving_host_frac gauge; the disabled session is a no-op."""
+    tel = TelemetrySession()
+    tel.step_timing(3.0, 1.0)
+    tel.step_timing(1.0, 1.0)
+    tel.close()
+    snap = tel.registry.snapshot()
+    host = snap["nxdi_step_host_ms"]["samples"][0]
+    wait = snap["nxdi_step_fetch_wait_ms"]["samples"][0]
+    assert host["count"] == 2 and host["sum"] == 4.0
+    assert wait["count"] == 2 and wait["sum"] == 2.0
+    frac = snap["nxdi_serving_host_frac"]["samples"][0]["value"]
+    assert frac == pytest.approx(4.0 / 6.0)
+    off = TelemetrySession(enabled=False)
+    off.step_timing(1.0, 1.0)  # must not raise, must record nothing
+
+
+def test_serving_host_frac_recorded_on_ragged_drain():
+    """A pipelined ragged drain records one step-timing observation per
+    ragged step and a host-frac gauge in (0, 1]; with telemetry DISABLED
+    the session records nothing (and still drains identically)."""
+    from neuronx_distributed_inference_tpu.config import ChunkedPrefillConfig
+
+    cfg = make_tiny_config(tpu=dict(
+        is_continuous_batching=True, batch_size=4, ctx_batch_size=1,
+        is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=24,
+        is_chunked_prefill=True,
+        chunked_prefill_config=ChunkedPrefillConfig(
+            max_num_seqs=2, kernel_q_tile_size=16
+        ),
+        serving_ragged=True, seq_len=64,
+    ))
+    app = TpuModelForCausalLM(None, cfg).load(
+        state_dict=make_random_hf_state_dict(cfg)
+    )
+
+    def drain(tel):
+        app.init_kv_cache()
+        sess = ServingSession(app, telemetry=tel)
+        assert sess.ragged_async
+        assert sess.add_request("a", [5, 17, 92, 41], max_new_tokens=6)
+        assert sess.add_request("b", list(range(30, 52)), max_new_tokens=6)
+        return sess.run_to_completion()
+
+    golden = drain(TelemetrySession(enabled=False))
+    with TelemetrySession() as tel:
+        out = drain(tel)
+    assert out == golden
+    snap = tel.registry.snapshot()
+    steps = {
+        s["labels"]["kind"]: s["value"]
+        for s in snap["nxdi_steps_total"]["samples"]
+    }
+    host = snap["nxdi_step_host_ms"]["samples"][0]
+    wait = snap["nxdi_step_fetch_wait_ms"]["samples"][0]
+    # one timing observation per _ragged_step entered (dispatching or not —
+    # a consume-only tail step still times its host work)
+    assert host["count"] >= steps["mixed"]
+    assert wait["count"] == host["count"]
+    frac = snap["nxdi_serving_host_frac"]["samples"][0]["value"]
+    assert 0.0 < frac <= 1.0
