@@ -152,6 +152,7 @@ def build_app(
     cache_key=None,
     block_kv=False,
     extra_tpu=None,
+    devices=None,
 ):
     """Build + load a random-weight app — the exact production code path.
 
@@ -207,7 +208,19 @@ def build_app(
         **kw,
         **(extra_tpu or {}),
     )
-    app = TpuModelForCausalLM(None, LlamaInferenceConfig(tc, load_config=load_cfg))
+    mesh = None
+    if devices is not None:
+        # multi-replica router point: each replica's mesh over its own
+        # device partition (on a 1-chip host the replicas share the chip —
+        # correct but serialized; scale-out needs chips)
+        from neuronx_distributed_inference_tpu.parallel.mesh import (
+            mesh_from_config,
+        )
+
+        mesh = mesh_from_config(tc, devices=devices)
+    app = TpuModelForCausalLM(
+        None, LlamaInferenceConfig(tc, load_config=load_cfg), mesh=mesh
+    )
     artifact = None
     if cache_key:
         artifact = os.path.join(_cache_dir(), cache_key)
@@ -459,6 +472,101 @@ def measure_serving(app, *, n_requests, prompt_len, gen_len):
     return res
 
 
+def measure_router(apps, *, n_requests, prompt_len, gen_len, policy):
+    """Scale-out serving: the SAME staggered request mix routed over N
+    single-chip replica sessions by ServingRouter (ISSUE 10;
+    docs/SERVING.md "Multi-replica front-end"). Aggregate tok/s across
+    replicas plus the router's own product metrics: failover count (MUST be
+    0 on clean traffic — the router layer's zero-overhead proof) and
+    ``balance_frac`` = min-replica tokens / even share (1.0 == the
+    placement policy spread the mix perfectly).
+
+    Containment census matches PR 7's convention: rejected / failover /
+    re-admitted are PER-RUN deltas against a pre-run registry snapshot."""
+    import numpy as np
+
+    from neuronx_distributed_inference_tpu.runtime.router import ServingRouter
+    from neuronx_distributed_inference_tpu.runtime.serving import ServingSession
+    from neuronx_distributed_inference_tpu.telemetry import (
+        TelemetrySession,
+        default_registry,
+    )
+
+    rng = np.random.RandomState(0)
+    vocab = apps[0].config.vocab_size - 10
+    prompts = [
+        rng.randint(0, vocab, size=(prompt_len,)).tolist() for _ in range(n_requests)
+    ]
+
+    def run_once(registry=None):
+        for app in apps:
+            app.init_kv_cache()  # fresh block pool per replica between runs
+        with TelemetrySession(registry=registry) as tel:
+            router = ServingRouter(
+                [ServingSession(app, telemetry=tel) for app in apps],
+                policy=policy, telemetry=tel,
+            )
+            t_start = time.time()
+            next_idx = 0
+            for _ in range(2):
+                router.add_request(str(next_idx), prompts[next_idx],
+                                   max_new_tokens=gen_len)
+                next_idx += 1
+            while True:
+                router.step()
+                if next_idx < n_requests:
+                    router.add_request(str(next_idx), prompts[next_idx],
+                                       max_new_tokens=gen_len)
+                    next_idx += 1
+                    continue
+                if not router.has_live_work:
+                    break
+            total_s = time.time() - t_start
+            counts = {rid: len(r.tokens) for rid, r in router.requests.items()}
+            per_replica = [h.tokens_served for h in router.replicas]
+        return tel, counts, per_replica, total_s
+
+    run_once()  # warmup / compile pass over every replica's programs
+    base_snap = default_registry().snapshot()
+    tel, counts, per_replica, total_s = run_once(default_registry())
+    total_tokens = sum(counts.values())
+    snap = tel.registry.snapshot()
+
+    def _ctr(name):
+        def total(s):
+            fam = s.get(name)
+            if not fam:
+                return 0
+            return int(sum(smp["value"] for smp in fam["samples"]))
+
+        return total(snap) - total(base_snap)
+
+    n = len(apps)
+    even_share = total_tokens / n if n else 0
+    res = {
+        "decode_tok_s": round(total_tokens / total_s, 2),
+        "n_requests": n_requests,
+        "n_replicas": n,
+        "total_tokens": total_tokens,
+        "tokens_per_replica": per_replica,
+        "balance_frac": (
+            round(min(per_replica) / even_share, 4) if even_share else None
+        ),
+        # containment deltas (PR 7 convention): clean traffic MUST report
+        # 0 failovers — the pre-flip check for any failover-policy knob
+        "rejected": _ctr("nxdi_router_rejected_total")
+        + _ctr("nxdi_requests_rejected_total"),
+        "failover": _ctr("nxdi_router_failovers_total"),
+        # re-admissions = pool-exhaustion evictions that re-queued inside a
+        # replica (aging); also exposed under PR 7's "preempted" name so
+        # every serving row carries the same containment key set
+        "readmitted": _ctr("nxdi_requests_preempted_total"),
+        "preempted": _ctr("nxdi_requests_preempted_total"),
+        "quarantined": _ctr("nxdi_rows_quarantined_total"),
+    }
+    return res
+
+
 def _suite_params(tiny):
     if tiny:
         attrs_1b = attrs_8b = TINY
@@ -534,6 +642,19 @@ def _suite_params(tiny):
             extra_tpu=dict(serving_ragged=True, serving_ragged_async=True),
             cache_key="int8_1b_ragged_async" if not tiny else None,
         ),
+        # SAME mix routed over 2 single-chip replicas by ServingRouter
+        # (ISSUE 10): the scale-out row. On a 1-chip host both replicas
+        # share the chip (correct, serialized — the row then measures the
+        # router layer's overhead); with 2+ chips each replica gets its own
+        # device partition and router_tok_s is the data-parallel scale-out
+        # number. Shares the int8_1b serving artifact (identical model
+        # config; the router is a layer above the session).
+        "serving_1b_int8_router": dict(
+            attrs=attrs_1b, quantized=True, serving=serving,
+            router=dict(replicas=2, policy="least_loaded",
+                        n_requests=4 if tiny else 8),
+            cache_key="int8_1b" if not tiny else None,
+        ),
         # single-chip proxy for the BASELINE 8B north star: int8 8B fits 16G
         "int8_8b_bs1": dict(
             attrs=attrs_8b, batch=1, seq=seq, ce=ce[:1], tkg=tkg[:1],
@@ -578,7 +699,30 @@ def run_point(name, tiny=False):
     import jax
 
     p = _suite_params(tiny)[name]
-    if "serving" in p:
+    if "router" in p:
+        from neuronx_distributed_inference_tpu.runtime.router import (
+            partition_devices,
+        )
+
+        s, r = p["serving"], p["router"]
+        parts = partition_devices(r["replicas"])
+        apps = [
+            build_app(
+                p["attrs"], batch=s["max_seqs"], seq_len=s["seq"],
+                ce_buckets=[s["seq"]], tkg_buckets=[s["seq"]],
+                quantized=p["quantized"], cache_key=p.get("cache_key"),
+                block_kv=dict(num_blocks=s["blocks"],
+                              block_size=s["block_size"],
+                              max_seqs=s["max_seqs"]),
+                extra_tpu=p.get("extra_tpu"), devices=parts[i],
+            )
+            for i in range(r["replicas"])
+        ]
+        res = measure_router(
+            apps, n_requests=r["n_requests"], prompt_len=s["prompt"],
+            gen_len=s["gen"], policy=r["policy"],
+        )
+    elif "serving" in p:
         s = p["serving"]
         app = build_app(
             p["attrs"], batch=s["max_seqs"], seq_len=s["seq"],
@@ -658,6 +802,14 @@ def summary_line(points):
         "serving_rejected": g("serving_1b_int8", "rejected"),
         "serving_quarantined": g("serving_1b_int8", "quarantined"),
         "serving_preempted": g("serving_1b_int8", "preempted"),
+        # multi-replica router row (ISSUE 10): same mix over 2 replica
+        # sessions via ServingRouter — router_failover MUST be 0 on clean
+        # traffic (per-run delta, PR 7 convention) and router_balance_frac
+        # (min-replica tokens / even share) is the placement-policy quality
+        # number the first multi-chip session compares policies by
+        "router_tok_s": g("serving_1b_int8_router", "decode_tok_s"),
+        "router_failover": g("serving_1b_int8_router", "failover"),
+        "router_balance_frac": g("serving_1b_int8_router", "balance_frac"),
         "int8_8b_tok_s": g("int8_8b_bs1", "decode_tok_s"),
         "int8_8b_ttft_ms": g("int8_8b_bs1", "ttft_ms"),
         # 16k long-context row: TTFT ~= the 16k prefill wall time

@@ -10,11 +10,15 @@ The rules encode contracts the runtime relies on but Python cannot enforce:
   the package, counted per file. The committed baseline pins the count — the
   batched ``jax.device_get((tokens, logits))`` work in runtime/ stays pinned
   so a new per-field fetch in a hot loop fails the lint. Calls inside the
-  serving ``step()`` hot path (:data:`SERVING_STEP_HOT_PATH`) additionally
-  count against a separately-pinned ``<file>::step-hot-path`` bucket, so a
-  blocking fetch added to the per-step serving loop trips the gate on its
-  own — the pipelined ragged dispatch depends on the hot path staying
-  fetch-free outside the designated consume points.
+  hot-path function sets of :data:`HOT_PATH_BUCKETS` additionally count
+  against separately-pinned per-file buckets — the serving ``step()`` hot
+  path (:data:`SERVING_STEP_HOT_PATH`, ``::step-hot-path``) and the
+  router's placement/failover loop (:data:`ROUTER_HOT_PATH`,
+  ``::route-hot-path``, pinned at ZERO) — so a blocking fetch added to a
+  per-step loop trips the gate on its own: the pipelined ragged dispatch
+  depends on the step path staying fetch-free outside the designated
+  consume points, and the multi-replica router would serialize every
+  replica behind one device.
 - **TPU103 host-time-under-trace** (error): ``time.time()`` /
   ``time.perf_counter()`` / ``print`` under trace — they execute ONCE at
   trace time and then lie forever.
@@ -120,6 +124,39 @@ SERVING_STEP_HOT_PATH = {
     "_prefill_chunks",
     "_decode_drain",
     "_decode_chunk_pass",
+}
+
+#: ServingRouter per-tick functions (runtime/router.py): the placement /
+#: health / failover loop over N replicas. Pure host bookkeeping by
+#: contract — a blocking device fetch here would serialize EVERY replica
+#: behind one device, so its census bucket
+#: (`runtime/router.py::route-hot-path`) is pinned at ZERO entries.
+ROUTER_HOT_PATH = {
+    "step",
+    "_place_pending",
+    "_candidates",
+    "_sync_terminals",
+    "_failover_request",
+    "_failover_replica",
+    "_publish_gauges",
+    "run_to_completion",
+}
+
+#: per-file hot-path census buckets: {relpath suffix: (bucket label,
+#: function-name set, human description of why a fetch there is a bug)}
+HOT_PATH_BUCKETS = {
+    "runtime/serving.py": (
+        "step-hot-path",
+        SERVING_STEP_HOT_PATH,
+        "a blocking fetch here stalls the pipelined serving loop; "
+        "consume points only",
+    ),
+    "runtime/router.py": (
+        "route-hot-path",
+        ROUTER_HOT_PATH,
+        "a blocking fetch in the placement loop serializes every replica "
+        "behind one device; the router is host bookkeeping only",
+    ),
 }
 
 
@@ -480,9 +517,14 @@ class _Linter:
     def rule_host_sync_census(self):
         for mod in self.modules.values():
             hot_ranges = []
-            if mod.relpath.endswith("runtime/serving.py"):
+            bucket = None
+            hot_note = ""
+            for suffix, (label, names, note) in HOT_PATH_BUCKETS.items():
+                if not mod.relpath.endswith(suffix):
+                    continue
+                bucket, hot_note = label, note
                 for name, infos in mod.functions.items():
-                    if name not in SERVING_STEP_HOT_PATH:
+                    if name not in names:
                         continue
                     for info in infos:
                         node = info.node
@@ -493,15 +535,15 @@ class _Linter:
                 # disarm the gate (the baseline only fails on count
                 # INCREASES, so a bucket quietly dropping to 0 is invisible)
                 # — a stale name is a loud, non-baselined error instead
-                for name in sorted(SERVING_STEP_HOT_PATH - set(mod.functions)):
+                for name in sorted(names - set(mod.functions)):
                     self._emit(
                         mod, mod.tree, "TPU102", SEV_ERROR,
-                        f"SERVING_STEP_HOT_PATH names `{name}` but "
-                        f"runtime/serving.py defines no such function — the "
-                        f"step-hot-path census is stale (a renamed per-step "
-                        f"method would silently escape the gate); update "
-                        f"the set in analysis/tpulint.py",
-                        key=f"{mod.relpath}::step-hot-path-stale",
+                        f"the {label} census names `{name}` but {suffix} "
+                        f"defines no such function — the hot-path census is "
+                        f"stale (a renamed per-step method would silently "
+                        f"escape the gate); update the set in "
+                        f"analysis/tpulint.py",
+                        key=f"{mod.relpath}::{label}-stale",
                     )
             for n in ast.walk(mod.tree):
                 if not isinstance(n, ast.Call):
@@ -530,20 +572,18 @@ class _Linter:
                 )
                 line = getattr(n, "lineno", 0)
                 if any(a <= line <= b for a, b in hot_ranges):
-                    # separately-pinned bucket: the serving step() hot path.
-                    # Its count must stay at the designated consume points —
-                    # a NEW blocking fetch inside step-reachable code trips
+                    # separately-pinned bucket per HOT_PATH_BUCKETS: a NEW
+                    # blocking fetch inside step/route-reachable code trips
                     # this gate even if the per-file count is rebalanced
-                    # elsewhere in the file (ISSUE 8; the pipelined ragged
-                    # path consumes via np.asarray on an async-copied array,
-                    # which is deliberately NOT a census name).
+                    # elsewhere in the file (ISSUE 8/10; the pipelined
+                    # ragged path consumes via np.asarray on an
+                    # async-copied array, deliberately NOT a census name).
                     self._emit(
                         mod, n, "TPU102", SEV_WARNING,
-                        f"host-sync call `{name}` inside the serving step() "
-                        f"hot path (separately-pinned census bucket — a "
-                        f"blocking fetch here stalls the pipelined serving "
-                        f"loop; consume points only)",
-                        key=f"{mod.relpath}::step-hot-path",
+                        f"host-sync call `{name}` inside the {bucket} "
+                        f"functions (separately-pinned census bucket — "
+                        f"{hot_note})",
+                        key=f"{mod.relpath}::{bucket}",
                     )
 
     def _body_nodes(self, info: _FuncInfo):

@@ -71,6 +71,11 @@ KV_CACHE_DTYPES = (
 )
 KV_QUANT_DTYPE_NAMES = ("int8", "fp8", "float8_e4m3", "float8_e5m2")
 
+#: multi-replica router placement policies (runtime/router.py consumes this
+#: as its PLACEMENT_POLICIES registry — defined here so config validation
+#: needs no runtime import)
+ROUTER_POLICIES = ("round_robin", "least_loaded", "cache_aware")
+
 
 # ---------------------------------------------------------------------------
 # Sub-configs
@@ -355,6 +360,15 @@ class TpuConfig:
     # debugging). Greedy outputs are byte-identical across sync/async
     # (pinned). Requires serving_ragged.
     serving_ragged_async: Optional[bool] = None
+    # multi-replica serving front-end (runtime/router.py): how many
+    # single-chip replica sessions the ServingRouter runs the demo/bench
+    # serving traffic over (1 = no router layer), and the placement policy
+    # that binds requests to replicas. `least_loaded` scores replicas from
+    # live telemetry signals (re-admission backlog, occupancy, kv_free_bytes
+    # headroom, EWMAs of step-host/queue-wait ms); `round_robin` cycles the
+    # healthy set; `cache_aware` is a prefix-affinity stub.
+    serving_replicas: int = 1
+    router_policy: str = "least_loaded"
 
     # --- attention -------------------------------------------------------
     fused_qkv: bool = False
@@ -549,6 +563,21 @@ class TpuConfig:
             raise ValueError(
                 "watchdog_no_progress_steps must be >= 0 (0 disables the "
                 "no-progress watchdog)"
+            )
+        if self.serving_replicas < 1:
+            raise ValueError(
+                "serving_replicas must be >= 1 (1 = a single session, no "
+                "router layer)"
+            )
+        if self.router_policy not in ROUTER_POLICIES:
+            raise ValueError(
+                f"unknown router_policy {self.router_policy!r}; known "
+                f"placement policies: {ROUTER_POLICIES}"
+            )
+        if self.serving_replicas > 1 and not self.is_continuous_batching:
+            raise ValueError(
+                "serving_replicas > 1 routes over serving sessions: set "
+                "is_continuous_batching=True"
             )
         if self.attention_dp_degree > 1 and not self.is_continuous_batching:
             raise ValueError("attention_dp_degree > 1 requires is_continuous_batching")

@@ -154,6 +154,23 @@ def build_parser() -> argparse.ArgumentParser:
         help="force dispatch+fetch-per-step on the ragged path "
         "(step-accurate debugging)",
     )
+    from neuronx_distributed_inference_tpu.config import ROUTER_POLICIES
+
+    run.add_argument(
+        "--serving-replicas", type=int, default=1,
+        help="multi-replica router config (runtime/router.py, consumed by "
+        "serving drivers like bench.py's router row — the demo itself runs "
+        "one generate() session): how many single-chip replica sessions "
+        "ServingRouter routes over; 1 = no router layer",
+    )
+    run.add_argument(
+        "--router-policy", default="least_loaded",
+        choices=list(ROUTER_POLICIES),
+        help="replica placement policy for the router config above: "
+        "least_loaded scores replicas from live telemetry (backlog, "
+        "occupancy, kv_free_bytes, step/queue-wait EWMAs); cache_aware is "
+        "a prefix-affinity stub",
+    )
     run.add_argument("--cp-max-num-seqs", type=int, default=8,
                      help="chunked prefill: max sequences per chunk batch")
     run.add_argument("--cp-kernel-q-tile-size", type=int, default=128)
@@ -393,6 +410,8 @@ def create_tpu_config(args) -> TpuConfig:
         chunked_prefill_config=cpc,
         serving_ragged=args.serving_ragged,
         serving_ragged_async=args.serving_ragged_async,
+        serving_replicas=args.serving_replicas,
+        router_policy=args.router_policy,
         admission_validation=args.admission_validation,
         request_deadline_s=args.request_deadline_s,
         dispatch_max_retries=args.dispatch_max_retries,

@@ -52,14 +52,6 @@ def _plain_cache(app):
             "disaggregated serving supports the plain contiguous KV cache "
             "(no ring/interleaved/paged layouts)"
         )
-    if app.config.tpu_config.kv_quantized:
-        # codes are only meaningful together with the per-(layer, head)
-        # running scales, and the two stages' scales evolve independently —
-        # a code handover under a different scale silently rescales history
-        raise NotImplementedError(
-            "disaggregated KV handover is not implemented for quantized "
-            "(int8/fp8) caches; use a plain kv_cache_dtype"
-        )
     return cache
 
 
@@ -86,22 +78,50 @@ def extract_request_kv(
 ) -> Dict:
     """Gather the cache lines of ``seq_ids`` from the prefill stage:
     {"k": (L, n, S, Hkv, D), "v": ...} device arrays. ``upto`` bounds the
-    position axis to the populated prefix (transfer only what exists)."""
+    position axis to the populated prefix (transfer only what exists).
+
+    Quantized caches (int8/fp8) hand over the RAW codes plus the
+    per-(layer, head) running-absmax scales (``k_scale``/``v_scale``,
+    each (L, Hkv) fp32) — codes are only meaningful together with the
+    scale they were written under, so the pair travels as one unit and
+    :func:`inject_request_kv` folds the scales into the decode stage's
+    running max (monotone, exactly the write-path semantics)."""
+    from neuronx_distributed_inference_tpu.modules.kvcache import QuantizedKV
+
     cache = _plain_cache(app)
     lines = _host_lines(app, cache, seq_ids)
     S = upto if upto is not None else cache.k.shape[2]
-    return {
-        "k": cache.k[:, lines, :S],
-        "v": cache.v[:, lines, :S],
-        "gqa": app.builder.gqa,  # source KV-head layout for the remap
-    }
+    out = {"gqa": app.builder.gqa}  # source KV-head layout for the remap
+    if isinstance(cache.k, QuantizedKV):
+        out.update(
+            k=cache.k.data[:, lines, :S],
+            v=cache.v.data[:, lines, :S],
+            k_scale=cache.k.scale,
+            v_scale=cache.v.scale,
+            quantized=True,
+        )
+    else:
+        out.update(k=cache.k[:, lines, :S], v=cache.v[:, lines, :S])
+    return out
 
 
 def inject_request_kv(app: TpuModelForCausalLM, seq_ids: np.ndarray, kv: Dict) -> None:
     """Scatter handed-over KV into the decode stage's cache lines. The
     arrays come from the PREFILL stage's mesh; ``jax.device_put`` moves them
-    to the decode mesh (ICI/host copy same-host, DCN across hosts)."""
+    to the decode mesh (ICI/host copy same-host, DCN across hosts).
+
+    Quantized hand-off: the raw codes land untouched in the decode cache
+    and the source's per-(layer, head) scales fold into the decode stage's
+    running absmax via elementwise max — the same monotone scale update the
+    write path performs, so a FRESH decode stage (scale zeros) adopts the
+    prefill stage's scales exactly and the pipeline is byte-identical to
+    the single-app quantized run (pinned by tests/test_disaggregated.py).
+    A decode stage already carrying larger scales dequantizes the handed
+    codes under its grown scale — the documented batch-shared running-
+    absmax coupling, identical to intra-session behavior."""
     from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from neuronx_distributed_inference_tpu.modules.kvcache import QuantizedKV
 
     cache = _plain_cache(app)
     lines = _host_lines(app, cache, seq_ids)
@@ -111,6 +131,16 @@ def inject_request_kv(app: TpuModelForCausalLM, seq_ids: np.ndarray, kv: Dict) -
             f"handed-over KV covers {S} positions but the decode cache holds "
             f"{cache.k.shape[2]}"
         )
+    quantized_src = bool(kv.get("quantized"))
+    quantized_dst = isinstance(cache.k, QuantizedKV)
+    if quantized_src != quantized_dst:
+        raise ValueError(
+            "quantized KV hand-off needs BOTH stages on the same cache "
+            "format: the prefill stage sent "
+            f"{'codes+scales' if quantized_src else 'plain values'} but the "
+            f"decode cache is {'quantized' if quantized_dst else 'plain'} "
+            "(set kv_cache_dtype identically on both stage configs)"
+        )
     # the stages may pad/replicate KV heads differently (GQASharding
     # REPLICATE_TO_TP_DEGREE repeats each head r CONSECUTIVE times for its
     # model-parallel degree): recover the original heads from the source
@@ -118,17 +148,33 @@ def inject_request_kv(app: TpuModelForCausalLM, seq_ids: np.ndarray, kv: Dict) -
     src_gqa = kv.get("gqa")
     dst_gqa = app.builder.gqa
     k_arr, v_arr = kv["k"], kv["v"]
-    if src_gqa is not None and (
+    k_scale, v_scale = kv.get("k_scale"), kv.get("v_scale")
+    remap = src_gqa is not None and (
         src_gqa.kv_repeat != dst_gqa.kv_repeat
         or src_gqa.kv_heads != dst_gqa.kv_heads
-    ):
+    )
+    if remap:
         k_arr = jnp.repeat(k_arr[:, :, :, :: src_gqa.kv_repeat], dst_gqa.kv_repeat, axis=3)
         v_arr = jnp.repeat(v_arr[:, :, :, :: src_gqa.kv_repeat], dst_gqa.kv_repeat, axis=3)
+        if quantized_src:
+            # scales ride the (L, H) head axis: same dedup + re-replication
+            k_scale = jnp.repeat(k_scale[:, :: src_gqa.kv_repeat], dst_gqa.kv_repeat, axis=1)
+            v_scale = jnp.repeat(v_scale[:, :: src_gqa.kv_repeat], dst_gqa.kv_repeat, axis=1)
     repl = NamedSharding(app.mesh, P())
     k_in = jax.device_put(k_arr, repl)
     v_in = jax.device_put(v_arr, repl)
-    k = cache.k.at[:, lines, :S].set(k_in.astype(cache.k.dtype))
-    v = cache.v.at[:, lines, :S].set(v_in.astype(cache.v.dtype))
+    if quantized_src:
+        k = QuantizedKV(
+            data=cache.k.data.at[:, lines, :S].set(k_in.astype(cache.k.data.dtype)),
+            scale=jnp.maximum(cache.k.scale, jax.device_put(k_scale, repl)),
+        )
+        v = QuantizedKV(
+            data=cache.v.data.at[:, lines, :S].set(v_in.astype(cache.v.data.dtype)),
+            scale=jnp.maximum(cache.v.scale, jax.device_put(v_scale, repl)),
+        )
+    else:
+        k = cache.k.at[:, lines, :S].set(k_in.astype(cache.k.dtype))
+        v = cache.v.at[:, lines, :S].set(v_in.astype(cache.v.dtype))
     app.kv_cache = type(cache)(k=k, v=v)
 
 

@@ -1,0 +1,221 @@
+"""Replica handle: one serving session behind the multi-replica router.
+
+A :class:`ReplicaHandle` wraps ONE :class:`~.serving.ServingSession` (or
+:class:`~.serving.SpeculativeServingSession`) running on its OWN mesh — on
+hardware that is one chip (or one model-parallel group); on the CPU harness
+each replica takes a partition of the virtual device set, so the whole
+router subsystem is testable without a TPU. The handle owns three things the
+router schedules by:
+
+- **Health state machine** ``HEALTHY -> DEGRADED -> DEAD``. Inputs: a
+  dispatch-retry exhaustion observed on this replica (the session's bounded
+  retry gave up and terminally failed in-flight rows) degrades it, a second
+  one kills it; a :class:`~.faults.WatchdogError` escaping ``step()`` kills
+  it immediately (caught here — the router never sees a raise); ``kill()``
+  is the operator/test switch. A DEGRADED replica recovers to HEALTHY after
+  ``recovery_steps`` consecutive clean steps; a DEAD replica is never
+  stepped or placed on again.
+- **Load signals** for telemetry-driven placement: live occupancy,
+  re-admission backlog, ``kv_free_bytes`` headroom (cache-dtype-aware, from
+  the session's pool accounting), and two EWMAs — step wall ms (the host
+  signal ``nxdi_step_host_ms`` exposes) and first-output wait ms (the
+  queue-wait signal), both computed at the router boundary with the
+  router's injectable clock so placement never depends on a metrics
+  registry being enabled.
+- **Harvest on death**: every non-terminal request rolls back to its
+  committed host state (in-flight device steps are discarded — greedy
+  decode regenerates the identical tokens after re-placement, the PR-7
+  re-admission argument) and is handed back to the router for failover.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from neuronx_distributed_inference_tpu.runtime.faults import WatchdogError
+
+HEALTH_HEALTHY = "healthy"
+HEALTH_DEGRADED = "degraded"
+HEALTH_DEAD = "dead"
+
+#: gauge encoding for nxdi_router_replica_health
+HEALTH_GAUGE = {HEALTH_HEALTHY: 2, HEALTH_DEGRADED: 1, HEALTH_DEAD: 0}
+
+#: EWMA smoothing for the step-time / queue-wait load signals
+EWMA_ALPHA = 0.2
+
+
+class ReplicaHandle:
+    def __init__(
+        self,
+        session,
+        replica_id: int,
+        clock: Optional[Callable[[], float]] = None,
+        dead_after_give_ups: int = 2,
+        recovery_steps: int = 32,
+    ):
+        """``session``: a serving session on this replica's mesh.
+        ``dead_after_give_ups``: dispatch-retry exhaustions before the
+        replica is declared DEAD (the first one only degrades it);
+        ``recovery_steps``: consecutive clean steps before DEGRADED recovers
+        to HEALTHY."""
+        self.session = session
+        self.replica_id = int(replica_id)
+        self._clock = clock if clock is not None else time.monotonic
+        self.dead_after_give_ups = int(dead_after_give_ups)
+        self.recovery_steps = int(recovery_steps)
+        self.health = HEALTH_HEALTHY
+        self.health_reason: Optional[str] = None
+        self.give_ups = 0  # dispatch-retry exhaustions observed
+        self._clean_steps = 0
+        self.steps = 0
+        # committed tokens this replica produced since it was wrapped:
+        # mirrors the session's monotone commit counter (NOT len(step
+        # results) — admission-time first tokens and multi-token
+        # speculation commits would undercount)
+        self._committed_base = int(getattr(session, "_committed_total", 0))
+        self.tokens_served = 0
+        self.watchdog_error: Optional[WatchdogError] = None
+        # router-owned requests living on this replica: session req id ->
+        # RouterRequest (the session id carries a failover suffix so a
+        # request's incarnations never alias inside one session)
+        self.owned: Dict[str, object] = {}
+        # placement time per session id — first-output wait (the queue-wait
+        # signal) is observed when the request's first token arrives
+        self._placed_t: Dict[str, float] = {}
+        self.ewma_step_ms = 0.0
+        self.ewma_queue_wait_ms = 0.0
+
+    # ---- health ----------------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        return self.health != HEALTH_DEAD
+
+    def kill(self, reason: str = "killed") -> None:
+        """Operator/test switch: declare this replica DEAD. The router
+        harvests and fails over its live requests on the next step."""
+        self._set_health(HEALTH_DEAD, reason)
+
+    def _set_health(self, state: str, reason: Optional[str]) -> None:
+        if self.health == HEALTH_DEAD:
+            return  # death is terminal
+        self.health = state
+        self.health_reason = reason
+
+    def note_give_up(self) -> None:
+        """The session's bounded dispatch retry exhausted on this replica
+        (observed by the router as terminally FAILED(dispatch_error) rows):
+        first occurrence degrades the replica, ``dead_after_give_ups``
+        occurrences kill it."""
+        self.give_ups += 1
+        self._clean_steps = 0
+        if self.give_ups >= self.dead_after_give_ups:
+            self._set_health(HEALTH_DEAD, "dispatch_error")
+        else:
+            self._set_health(HEALTH_DEGRADED, "dispatch_error")
+
+    # ---- stepping --------------------------------------------------------
+
+    def step(self) -> Dict[str, int]:
+        """Advance the wrapped session one step. A WatchdogError is caught
+        and converted to replica DEATH (never a router-wide raise); the
+        step's wall time and any first-output waits feed the EWMA load
+        signals."""
+        if not self.alive:
+            return {}
+        t0 = self._clock()
+        try:
+            results = self.session.step()
+        except WatchdogError as e:
+            self.watchdog_error = e
+            self._set_health(HEALTH_DEAD, "watchdog")
+            return {}
+        now = self._clock()
+        self.steps += 1
+        dt_ms = (now - t0) * 1e3
+        self.ewma_step_ms = (
+            dt_ms
+            if self.steps == 1
+            else EWMA_ALPHA * dt_ms + (1 - EWMA_ALPHA) * self.ewma_step_ms
+        )
+        for sid in results:
+            t_place = self._placed_t.pop(sid, None)
+            if t_place is None:
+                continue
+            qw_ms = (now - t_place) * 1e3
+            self.ewma_queue_wait_ms = (
+                qw_ms
+                if self.ewma_queue_wait_ms == 0.0
+                else EWMA_ALPHA * qw_ms
+                + (1 - EWMA_ALPHA) * self.ewma_queue_wait_ms
+            )
+        self.tokens_served = (
+            int(getattr(self.session, "_committed_total", 0))
+            - self._committed_base
+        )
+        if self.health == HEALTH_DEGRADED and self.give_ups < self.dead_after_give_ups:
+            self._clean_steps += 1
+            if self._clean_steps >= self.recovery_steps:
+                self.give_ups = 0
+                self._set_health(HEALTH_HEALTHY, None)
+        return results
+
+    # ---- load signals ----------------------------------------------------
+
+    @property
+    def occupancy(self) -> int:
+        return len(self.session.active)
+
+    @property
+    def queue_depth(self) -> int:
+        """Live rows plus the re-admission backlog — what a new placement
+        queues behind."""
+        return len(self.session.active) + len(self.session._readmit)
+
+    def load_score(self, latency_norm_ms: float) -> float:
+        """Telemetry-driven load score (lower = less loaded). Terms, in
+        dominance order: the re-admission backlog (each waiting evicted
+        request outweighs a full batch — placing more work on a replica
+        already preempting is the one unambiguous mistake), occupancy
+        fraction, KV-pool usage fraction (cache-dtype-aware headroom), and
+        the EWMA latency signals normalized by ``latency_norm_ms`` (the max
+        across candidates) so they stay a sub-unit tie-splitter."""
+        s = self.session
+        occ_frac = len(s.active) / max(1, s.num_slots)
+        backlog = len(s._readmit)
+        pool = s.kv_pool_bytes
+        kv_used_frac = (1.0 - s.kv_free_bytes / pool) if pool else occ_frac
+        latency = (
+            (self.ewma_step_ms + self.ewma_queue_wait_ms) / latency_norm_ms
+            if latency_norm_ms > 0
+            else 0.0
+        )
+        return 4.0 * backlog + occ_frac + kv_used_frac + latency
+
+    @property
+    def latency_signal_ms(self) -> float:
+        return self.ewma_step_ms + self.ewma_queue_wait_ms
+
+    # ---- failover --------------------------------------------------------
+
+    def harvest(self) -> List[Tuple[str, object, List[int]]]:
+        """Collect every non-terminal request off this (dead) replica as
+        ``(session_id, router_request, committed_tokens)`` — the host-state
+        rollback: in-flight device work is simply dropped (the device is
+        gone), and the committed prefix is what the request resumes from.
+        Clears this handle's ownership; the device-side session state is
+        abandoned with the replica."""
+        out: List[Tuple[str, object, List[int]]] = []
+        sess = self.session
+        for sid, rreq in list(self.owned.items()):
+            sreq = sess.requests.get(sid)
+            if sreq is None or sreq.finished:
+                continue  # terminal outcomes were synced by the router
+            sreq.finished = True  # abandoned with the replica
+            out.append((sid, rreq, list(sreq.generated)))
+        self.owned.clear()
+        self._placed_t.clear()
+        sess._readmit.clear()
+        return out

@@ -1,0 +1,575 @@
+"""Multi-replica serving front-end: a telemetry-driven router over N
+single-chip replicas.
+
+The serving gap after the ragged/async work is that one session serves one
+chip. :class:`ServingRouter` is the data-parallel scale-out layer above
+:class:`~.serving.ServingSession`: it owns N :class:`~.replica.ReplicaHandle`
+replicas (each a session on its OWN mesh — one chip on hardware, a partition
+of the virtual device set on the CPU harness) and gives them one front door:
+
+- **Admission + placement** — ``add_request`` returns the same typed
+  :class:`~.serving.AdmissionResult` the session uses. Malformed requests
+  (validated through the replica sessions' OWN admission checks, so the two
+  doors cannot drift) are terminal REJECTED at the router; well-formed ones
+  enter a global FIFO queue and are bound to a replica by the pluggable
+  placement policy (:data:`PLACEMENT_POLICIES`): ``round_robin`` cycles the
+  healthy set, ``least_loaded`` scores replicas from live telemetry signals
+  (re-admission backlog, occupancy, cache-dtype-aware ``kv_free_bytes``
+  headroom, EWMAs of step-host and queue-wait ms — the batch-admission-
+  off-the-queue-wait-signal item ROADMAP names), ``cache_aware`` is a
+  prefix-affinity stub (stable prompt-prefix hash picks the anchor replica
+  so shared prefixes co-locate with prefix caching; load still breaks ties).
+  Placement is head-of-line FIFO: if the queue head fits nowhere it WAITS
+  (aging) — later arrivals cannot starve it.
+- **Replica health + failover** — per-replica ``HEALTHY -> DEGRADED ->
+  DEAD`` (see :mod:`.replica`), fed by dispatch-retry exhaustion, watchdog
+  trips, and each replica's injectable
+  :class:`~.faults.FaultInjector`. On replica death its live requests roll
+  back to committed host state and re-queue AHEAD of new arrivals onto
+  surviving replicas, where greedy decode resumes byte-identically (the
+  PR-7 re-admission argument; pinned by tests/test_router.py). A request
+  that terminally FAILED(dispatch_error) on a still-alive replica fails
+  over the same way, bounded by ``max_failovers``. Rejections and
+  exhausted-failover requests surface as typed verdicts — the router never
+  raises for a replica-local failure.
+- **Drain / steady state** — ``step()`` advances every alive replica
+  (serially on the CPU harness; the per-replica state is independent, so a
+  thread-per-replica driver can call ``handle.step()`` concurrently later)
+  and ``run_to_completion`` drains the global queue; FIFO placement plus
+  every-replica stepping is the starvation-freedom argument.
+- **Observability** — the ``nxdi_router_*`` family (per-replica
+  occupancy/queue-depth/health gauges, placement counter by policy+reason,
+  failover counter by cause, occupancy-spread histogram), all host-side
+  (TPU107/TPU102-clean; the tpulint ``route-hot-path`` census bucket pins
+  that the placement loop performs zero blocking device fetches).
+
+See docs/SERVING.md "Multi-replica front-end".
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from neuronx_distributed_inference_tpu.config import ROUTER_POLICIES
+from neuronx_distributed_inference_tpu.runtime.replica import (
+    HEALTH_GAUGE,
+    HEALTH_HEALTHY,
+    ReplicaHandle,
+)
+from neuronx_distributed_inference_tpu.runtime.serving import (
+    ADMITTED,
+    AdmissionResult,
+    REJECTED_HISTORY_MAX,
+    Request,
+)
+from neuronx_distributed_inference_tpu.telemetry.tracing import default_session
+
+#: pluggable placement policies (TpuConfig.router_policy; the tuple lives in
+#: config.py so validation needs no runtime import)
+PLACEMENT_POLICIES = ROUTER_POLICIES
+
+#: capacity-refusal reasons a session may answer placement with — the router
+#: spills to the next candidate replica (anything else is a validation
+#: verdict and terminal)
+_CAPACITY_REASONS = frozenset({"no_slot", "kv_blocks", "backlog"})
+
+#: router-request statuses (terminal: finished / failed / rejected)
+RSTATUS_QUEUED = "queued"
+RSTATUS_PLACED = "placed"
+RSTATUS_FINISHED = "finished"
+RSTATUS_FAILED = "failed"
+RSTATUS_REJECTED = "rejected"
+
+
+@dataclass
+class RouterRequest:
+    """One request as the router sees it, across replica incarnations.
+    ``tokens`` accumulates the committed output over every placement; on
+    failover the effective prompt re-placed on the next replica is
+    ``input_ids + tokens`` with the remaining budget — exactly the serving
+    session's own re-admission fold, one level up."""
+
+    req_id: str
+    input_ids: np.ndarray  # ORIGINAL prompt (never mutated)
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    deadline_s: Optional[float] = None
+    status: str = RSTATUS_QUEUED
+    fail_reason: Optional[str] = None
+    tokens: List[int] = field(default_factory=list)
+    replica: Optional[int] = None  # current/last placement
+    placements: int = 0
+    failovers: int = 0
+    t_submit: float = 0.0
+
+    @property
+    def finished(self) -> bool:
+        return self.status in (RSTATUS_FINISHED, RSTATUS_FAILED, RSTATUS_REJECTED)
+
+    @property
+    def remaining_budget(self) -> int:
+        return self.max_new_tokens - len(self.tokens)
+
+    def effective_prompt(self) -> np.ndarray:
+        if not self.tokens:
+            return self.input_ids
+        return np.concatenate(
+            [self.input_ids, np.asarray(self.tokens, np.int32)]
+        )
+
+    def session_id(self) -> str:
+        """Session-side request id for the CURRENT incarnation: failover
+        suffixes keep incarnations from aliasing inside one session's
+        request table."""
+        if self.placements <= 1:
+            return self.req_id
+        return f"{self.req_id}~f{self.placements - 1}"
+
+
+class ServingRouter:
+    def __init__(
+        self,
+        replicas: Sequence,
+        policy: Optional[str] = None,
+        telemetry=None,
+        clock: Optional[Callable[[], float]] = None,
+        max_failovers: int = 3,
+    ):
+        """``replicas``: ReplicaHandles, or bare serving sessions (wrapped
+        with sequential ids). ``policy`` defaults to the first replica's
+        ``TpuConfig.router_policy``. ``max_failovers`` bounds how many times
+        one request may fail over before it is terminally FAILED (a request
+        that kills every replica it lands on must not cycle forever)."""
+        if not replicas:
+            raise ValueError("ServingRouter needs at least one replica")
+        self.replicas: List[ReplicaHandle] = [
+            h if isinstance(h, ReplicaHandle) else ReplicaHandle(h, i)
+            for i, h in enumerate(replicas)
+        ]
+        ids = [h.replica_id for h in self.replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        tc = self.replicas[0].session.app.config.tpu_config
+        self.policy = policy if policy is not None else getattr(
+            tc, "router_policy", "least_loaded"
+        )
+        if self.policy not in PLACEMENT_POLICIES:
+            raise ValueError(
+                f"unknown router policy {self.policy!r}; known: "
+                f"{PLACEMENT_POLICIES}"
+            )
+        self.tel = telemetry if telemetry is not None else default_session()
+        self._clock = clock if clock is not None else time.monotonic
+        self.max_failovers = int(max_failovers)
+        self.admission_validation = bool(
+            getattr(tc, "admission_validation", True)
+        )
+        self.requests: Dict[str, RouterRequest] = {}
+        self.rejected: Dict[str, RouterRequest] = {}
+        self.pending: deque = deque()  # global FIFO placement queue
+        self._rr_next = 0  # round-robin cursor
+        self._step_index = 0
+        for h in self.replicas:
+            self.tel.router_replica_gauges(
+                h.replica_id, h.occupancy, h.queue_depth,
+                HEALTH_GAUGE[h.health],
+            )
+
+    # ---- admission -------------------------------------------------------
+
+    @property
+    def alive_replicas(self) -> List[ReplicaHandle]:
+        return [h for h in self.replicas if h.alive]
+
+    def add_request(
+        self,
+        req_id: str,
+        input_ids,
+        max_new_tokens: int = 64,
+        eos_token_id: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> AdmissionResult:
+        """The front door. Returns a truthy AdmissionResult when the request
+        was accepted (placed immediately when capacity exists, else queued —
+        FIFO, aged behind earlier arrivals); falsy with a ``reason`` for
+        typed rejects (malformed input, duplicate id, no alive replicas)."""
+        alive = self.alive_replicas
+        if not alive:
+            return AdmissionResult(False, "no_replicas")
+        if req_id in self.requests or req_id in self.rejected:
+            return AdmissionResult(False, "duplicate_req_id")
+        rreq = RouterRequest(
+            req_id=req_id,
+            input_ids=np.asarray(input_ids, np.int32).reshape(-1),
+            max_new_tokens=max_new_tokens,
+            eos_token_id=eos_token_id,
+            deadline_s=deadline_s,
+            t_submit=self._clock(),
+        )
+        if self.admission_validation:
+            # run the REPLICA SESSION's own admission checks (vocab range,
+            # empty/over-long prompt, budget) so the router door and the
+            # session door cannot drift
+            reason = alive[0].session._validate_request(
+                Request(
+                    req_id=req_id,
+                    input_ids=rreq.input_ids,
+                    max_new_tokens=max_new_tokens,
+                )
+            )
+            if reason is not None:
+                return self._reject(rreq, reason)
+        self.requests[req_id] = rreq
+        self.pending.append(rreq)
+        self._place_pending()
+        if rreq.status == RSTATUS_FAILED and rreq.fail_reason == "never_fits":
+            # permanent capacity refusal observed synchronously (every
+            # alive replica refused with nothing live to free): surface it
+            # like the session's kv_blocks drop — falsy and unrecorded, the
+            # caller may resubmit after reconfiguring
+            self.requests.pop(req_id, None)
+            return AdmissionResult(False, "never_fits")
+        return ADMITTED
+
+    def _reject(self, rreq: RouterRequest, reason: str) -> AdmissionResult:
+        rreq.status = RSTATUS_REJECTED
+        rreq.fail_reason = reason
+        self.rejected[rreq.req_id] = rreq
+        while len(self.rejected) > REJECTED_HISTORY_MAX:
+            self.rejected.pop(next(iter(self.rejected)))
+        self.tel.router_rejected(rreq.req_id, reason)
+        return AdmissionResult(False, reason)
+
+    # ---- placement -------------------------------------------------------
+
+    def _candidates(self, rreq: RouterRequest) -> List[ReplicaHandle]:
+        """Ordered placement candidates (best first) for ``rreq`` under the
+        active policy. DEAD replicas never appear; DEGRADED ones only when
+        no HEALTHY replica exists."""
+        alive = self.alive_replicas
+        healthy = [h for h in alive if h.health == HEALTH_HEALTHY]
+        pool = healthy if healthy else alive
+        if not pool:
+            return []
+        if self.policy == "round_robin":
+            n = len(pool)
+            start = self._rr_next % n
+            self._rr_next += 1
+            return [pool[(start + i) % n] for i in range(n)]
+        norm = max(h.latency_signal_ms for h in pool)
+        ordered = sorted(
+            pool, key=lambda h: (h.load_score(norm), h.replica_id)
+        )
+        if self.policy == "cache_aware":
+            # STUB prefix-affinity: a stable hash of the first block of
+            # prompt tokens anchors the request so shared prefixes co-locate
+            # (useful with prefix caching); the anchor is only promoted to
+            # the front — load order still decides everything behind it. A
+            # real implementation would query per-replica prefix-cache
+            # match indexes instead of hashing.
+            import zlib
+
+            bs = getattr(
+                self.replicas[0].session.allocator, "block_size", 16
+            ) or 16
+            prefix = rreq.input_ids[:bs].tobytes()
+            anchor_id = sorted(h.replica_id for h in pool)[
+                zlib.crc32(prefix) % len(pool)
+            ]
+            ordered = sorted(
+                ordered, key=lambda h: 0 if h.replica_id == anchor_id else 1
+            )
+        return ordered
+
+    def _place_pending(self) -> int:
+        """Bind queued requests to replicas, FIFO with head-of-line blocking
+        (the aging/starvation-freedom guarantee: a request the pool cannot
+        fit yet is never overtaken by later arrivals). Spills to the next
+        candidate replica on a capacity refusal. Returns placements made."""
+        placed = 0
+        while self.pending:
+            rreq = self.pending[0]
+            if rreq.finished:
+                self.pending.popleft()
+                continue
+            deadline_left = None
+            if rreq.deadline_s is not None:
+                deadline_left = rreq.deadline_s - (
+                    self._clock() - rreq.t_submit
+                )
+                if deadline_left <= 0:
+                    self.pending.popleft()
+                    self._terminal(rreq, RSTATUS_FAILED, "deadline_exceeded")
+                    continue
+            candidates = self._candidates(rreq)
+            bound = None
+            spilled = False
+            for h in candidates:
+                rreq.placements += 1
+                sid = rreq.session_id()
+                res = h.session.add_request(
+                    sid,
+                    rreq.effective_prompt(),
+                    max_new_tokens=rreq.remaining_budget,
+                    eos_token_id=rreq.eos_token_id,
+                    deadline_s=deadline_left,
+                )
+                if res:
+                    bound = h
+                    h.owned[sid] = rreq
+                    h._placed_t[sid] = self._clock()
+                    rreq.replica = h.replica_id
+                    rreq.status = RSTATUS_PLACED
+                    reason = (
+                        "failover" if rreq.failovers
+                        else "spill" if spilled
+                        else "fresh"
+                    )
+                    self.tel.router_placement(self.policy, reason)
+                    break
+                rreq.placements -= 1  # not bound: the id was never admitted
+                if res.reason in _CAPACITY_REASONS:
+                    spilled = True
+                    continue
+                # session-side validation verdict (possible with a stale
+                # health set or admission_validation off at the router):
+                # surface it typed, never raise
+                self.pending.popleft()
+                self._terminal(rreq, RSTATUS_REJECTED, res.reason)
+                bound = rreq  # handled; fall through to the outer loop
+                break
+            if bound is None:
+                # head-of-line waits for capacity (aging) — UNLESS nothing
+                # can ever free any: every alive replica refused AND none
+                # holds live work, so the refusal is permanent (a prompt
+                # over the pool on non-chunked paged replicas) and waiting
+                # would spin run_to_completion forever. The session's
+                # never-fits terminalization, one level up.
+                if candidates and not any(
+                    h.session.active or h.session._readmit
+                    for h in self.alive_replicas
+                ):
+                    self.pending.popleft()
+                    self._terminal(rreq, RSTATUS_FAILED, "never_fits")
+                    continue
+                break
+            if isinstance(bound, ReplicaHandle):
+                self.pending.popleft()
+                placed += 1
+        return placed
+
+    # ---- terminal bookkeeping --------------------------------------------
+
+    def _terminal(self, rreq: RouterRequest, status: str, reason: Optional[str]):
+        rreq.status = status
+        if status == RSTATUS_REJECTED:
+            rreq.fail_reason = reason
+            self.rejected[rreq.req_id] = self.requests.pop(
+                rreq.req_id, rreq
+            )
+            # same bound as the front-door path: rejection volume is
+            # attacker-controlled (this path serves session-side verdicts
+            # when admission_validation is off at the router)
+            while len(self.rejected) > REJECTED_HISTORY_MAX:
+                self.rejected.pop(next(iter(self.rejected)))
+            self.tel.router_rejected(rreq.req_id, reason or "rejected")
+        elif status == RSTATUS_FAILED:
+            rreq.fail_reason = reason
+
+    # ---- steady state ----------------------------------------------------
+
+    def step(self) -> Dict[str, int]:
+        """One router tick: place queued requests, advance every alive
+        replica, sync terminal outcomes (detecting dispatch give-ups),
+        harvest + fail over dead replicas, and publish the per-replica
+        gauges. Returns {req_id: token} for tokens produced this step across
+        all replicas."""
+        self._step_index += 1
+        results: Dict[str, int] = {}
+        self._place_pending()
+        for h in self.replicas:
+            if not h.alive:
+                if h.owned:
+                    # killed externally (operator kill()) since last step:
+                    # harvest + fail its live requests over now
+                    self._failover_replica(h, h.health_reason or "dead")
+                continue
+            step_results = h.step()  # WatchdogError -> DEAD inside
+            if not h.alive:
+                self._failover_replica(h, h.health_reason or "dead")
+                continue
+            for sid, tok in step_results.items():
+                rreq = h.owned.get(sid)
+                if rreq is not None:
+                    results[rreq.req_id] = tok
+            self._sync_terminals(h)
+            if not h.alive:
+                # a give-up observed in the sync crossed the death threshold
+                self._failover_replica(h, h.health_reason or "dead")
+        # requests failed over this step re-place immediately so they
+        # resume on the next device step, not one router tick later
+        self._place_pending()
+        self._publish_gauges()
+        return results
+
+    def _sync_terminals(self, h: ReplicaHandle) -> None:
+        """Fold this replica's terminal session outcomes into the router
+        records. FAILED(dispatch_error) is a health event AND (bounded) a
+        failover: at router level a dispatch-retry exhaustion is
+        non-terminal while other capacity survives — the request resumes
+        from its committed tokens elsewhere."""
+        for sid in list(h.owned):
+            # sids enter `owned` only after a truthy session admission, so
+            # the session record lives in `requests` (REJECTED is an
+            # add_request-only transition and never lands in `owned`)
+            sreq = h.session.requests.get(sid)
+            if sreq is None or not sreq.finished:
+                continue
+            rreq = h.owned.pop(sid)
+            h._placed_t.pop(sid, None)
+            rreq.tokens.extend(sreq.generated)
+            if sreq.status == "finished":
+                rreq.status = RSTATUS_FINISHED
+                continue
+            # FAILED(...)
+            if sreq.fail_reason == "dispatch_error":
+                h.note_give_up()
+                self._failover_request(rreq, "dispatch_error")
+            else:
+                # non_finite (quarantine — re-running poisoned input
+                # elsewhere would poison another replica), deadline_exceeded,
+                # terminal preempted: the session's verdict stands
+                self._terminal(rreq, RSTATUS_FAILED, sreq.fail_reason)
+
+    def _failover_request(self, rreq: RouterRequest, cause: str) -> None:
+        """Re-queue one request (committed tokens kept) ahead of new
+        arrivals, bounded by ``max_failovers``."""
+        if rreq.remaining_budget <= 0:
+            rreq.status = RSTATUS_FINISHED
+            return
+        if rreq.failovers >= self.max_failovers or not self.alive_replicas:
+            self._terminal(rreq, RSTATUS_FAILED, cause)
+            return
+        rreq.failovers += 1
+        rreq.status = RSTATUS_QUEUED
+        rreq.replica = None
+        self.pending.appendleft(rreq)
+        self.tel.router_failover(rreq.req_id, cause)
+
+    def _failover_replica(self, h: ReplicaHandle, cause: str) -> None:
+        """A replica died: sync what terminally finished there, then roll
+        every live request back to committed host state and re-queue it
+        (oldest first, AHEAD of new arrivals) for the survivors."""
+        self._sync_terminals(h)
+        self.tel.router_replica_gauges(
+            h.replica_id, 0, 0, HEALTH_GAUGE[h.health]
+        )
+        harvested = []
+        for _sid, rreq, committed in h.harvest():
+            rreq.tokens.extend(committed)
+            harvested.append(rreq)
+        # appendleft reverses, so feed it newest-first to keep FIFO order
+        for rreq in reversed(harvested):
+            self._failover_request(rreq, cause)
+
+    def _publish_gauges(self) -> None:
+        occs = []
+        for h in self.replicas:
+            # a dead replica's session is abandoned — its slot table still
+            # reads occupied, but reporting that would tell an operator the
+            # dead replica holds live work it does not
+            occ = h.occupancy if h.alive else 0
+            qd = h.queue_depth if h.alive else 0
+            self.tel.router_replica_gauges(
+                h.replica_id, occ, qd, HEALTH_GAUGE[h.health]
+            )
+            if h.alive:
+                occs.append(occ)
+        spread = (max(occs) - min(occs)) if occs else 0
+        self.tel.router_step_gauges(len(self.pending), spread)
+
+    @property
+    def has_live_work(self) -> bool:
+        # h.owned covers requests whose terminal SESSION outcome has not
+        # been synced into the router record yet (e.g. a request finishing
+        # at admission-time prefill, before any step ran) — and, on a DEAD
+        # replica, requests awaiting harvest + failover
+        return bool(self.pending) or any(
+            bool(h.owned)
+            or (h.alive and (h.session.active or h.session._readmit))
+            for h in self.replicas
+        )
+
+    def run_to_completion(self) -> Dict[str, List[int]]:
+        """Drain every queued and in-flight request. Replica failures along
+        the way fail over; only a TOTAL outage (every replica dead) fails
+        the remaining requests — as typed verdicts, never a raise. Returns
+        {req_id: committed tokens} for every request ever admitted."""
+        while self.has_live_work:
+            if not self.alive_replicas:
+                # total outage: harvest dead replicas' live requests (each
+                # terminalizes typed inside _failover_request — there is
+                # nowhere left to fail over to), then fail the queue
+                for h in self.replicas:
+                    if h.owned:
+                        self._failover_replica(h, h.health_reason or "dead")
+                for rreq in list(self.pending):
+                    self._terminal(rreq, RSTATUS_FAILED, "no_replicas")
+                self.pending.clear()
+                break
+            self.step()
+        return {rid: r.tokens for rid, r in self.requests.items()}
+
+    def diagnostic_snapshot(self) -> dict:
+        """Operator view: replica healths + load signals, queue, terminal
+        census."""
+        by_status: Dict[str, int] = {}
+        for r in self.requests.values():
+            by_status[r.status] = by_status.get(r.status, 0) + 1
+        return {
+            "step_index": self._step_index,
+            "policy": self.policy,
+            "queue_depth": len(self.pending),
+            "requests_by_status": by_status,
+            "rejected": len(self.rejected),
+            "replicas": [
+                {
+                    "replica_id": h.replica_id,
+                    "health": h.health,
+                    "health_reason": h.health_reason,
+                    "occupancy": h.occupancy,
+                    "queue_depth": h.queue_depth,
+                    "tokens_served": h.tokens_served,
+                    "ewma_step_ms": round(h.ewma_step_ms, 3),
+                    "ewma_queue_wait_ms": round(h.ewma_queue_wait_ms, 3),
+                    "kv_free_bytes": h.session.kv_free_bytes,
+                }
+                for h in self.replicas
+            ],
+        }
+
+
+def partition_devices(n_replicas: int, devices=None) -> List[list]:
+    """Split the device set into ``n_replicas`` per-replica device lists —
+    the CPU-harness (and single-host multi-chip) replica layout: replica i
+    builds its mesh over its own partition, so N sessions run side by side
+    with no shared device state. With fewer devices than replicas, replicas
+    share devices round-robin (correct — each session owns its own cache
+    arrays — but serialized on the shared chip)."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    if n_replicas < 1:
+        raise ValueError("n_replicas must be >= 1")
+    if len(devices) >= n_replicas:
+        per = len(devices) // n_replicas
+        return [
+            list(devices[i * per : (i + 1) * per]) for i in range(n_replicas)
+        ]
+    return [[devices[i % len(devices)]] for i in range(n_replicas)]

@@ -728,6 +728,19 @@ class ServingSession:
         self._prefilled_total += n
         self.tel.prefill_dispatch(req.req_id, n)
 
+    @staticmethod
+    def _start_fetch(tokens) -> None:
+        """Start the device->host token copy non-blocking AT DISPATCH (the
+        PR-8 decode-side pattern, extended to the legacy split path's
+        prefill fetches): by the time the consume below reads the array,
+        the transfer has overlapped the telemetry/pool bookkeeping in
+        between instead of hard-blocking on a cold fetch. Not a host sync —
+        the fetch-count parity pin (tests/test_router.py) proves the
+        consumed-fetch census is unchanged with this call present."""
+        start = getattr(tokens, "copy_to_host_async", None)
+        if start is not None:
+            start()
+
     def _commit_tokens(self, req: Request, n: int):
         """Every decode-token commit routes through here so the watchdog's
         progress counter cannot drift from what ``generated`` received."""
@@ -783,6 +796,7 @@ class ServingSession:
         out = self._guarded_dispatch("prefill", [req], dispatch)
         if out is None:
             return True  # terminal FAILED(dispatch_error); slot released
+        self._start_fetch(out.tokens)
         self.app.kv_cache = out.cache
         self.tel.step("prefill")
         self.tel.bucket_dispatch(cte.tag, cte.last_bucket)
@@ -882,6 +896,10 @@ class ServingSession:
             out = self._guarded_dispatch("prefill_windowed", [req], dispatch_chunk)
             if out is None:
                 return True  # terminal FAILED(dispatch_error); slot released
+            if end >= S:
+                # final chunk: its token is the ONE fetched below — start
+                # the copy now so it overlaps the chunk's bookkeeping
+                self._start_fetch(out.tokens)
             app.kv_cache = out.cache
             self.tel.step("prefill")
             self.tel.bucket_dispatch(
@@ -981,6 +999,7 @@ class ServingSession:
         out = self._guarded_dispatch("prefill_chunk", [r for r, _ in rows], dispatch)
         if out is None:
             return True  # in-flight rows terminally FAILED(dispatch_error)
+        self._start_fetch(out.tokens)
         self.app.kv_cache = out.cache
         self.tel.step("prefill")
         self.tel.bucket_dispatch(tkg.tag, tkg.last_bucket)

@@ -46,6 +46,11 @@ CHUNK_COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 MIXED_STEP_BUCKETS = (
     0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0, 1024.0,
 )
+# multi-replica router occupancy spread (max - min live rows across alive
+# replicas, observed once per router step): 0 == perfectly balanced
+ROUTER_SPREAD_BUCKETS = (
+    0.0, 1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0, 64.0,
+)
 
 
 def _fmt_labels(names: Tuple[str, ...], values: Tuple[str, ...], extra: str = "") -> str:

@@ -215,6 +215,44 @@ class TelemetrySession:
             "decode_rows / padded_slots / query_tokens per dispatch (each "
             "label's observation count == mixed dispatches)",
             labels=("kind",), buckets=metrics_mod.MIXED_STEP_BUCKETS)
+        # --- multi-replica router family (runtime/router.py) --------------
+        # all host-side router bookkeeping: placement decisions, failovers,
+        # per-replica load gauges, and the per-step occupancy-spread
+        # histogram the balance contract is judged by
+        self._router_placements = r.counter(
+            "nxdi_router_placements_total",
+            "router placement decisions by policy and reason (fresh / "
+            "failover / spill = first-choice replica refused capacity)",
+            labels=("policy", "reason"))
+        self._router_failovers = r.counter(
+            "nxdi_router_failovers_total",
+            "requests re-queued off a failed replica (they resume from "
+            "committed host state on a surviving replica)",
+            labels=("cause",))
+        self._router_rejected = r.counter(
+            "nxdi_router_rejected_total",
+            "requests refused by router front-door validation "
+            "(terminal REJECTED, never placed on a replica)",
+            labels=("reason",))
+        self._router_queue = r.gauge(
+            "nxdi_router_queue_depth",
+            "requests waiting in the router's global placement queue")
+        self._router_occ = r.gauge(
+            "nxdi_router_replica_occupancy",
+            "live rows on this replica", labels=("replica",))
+        self._router_qd = r.gauge(
+            "nxdi_router_replica_queue_depth",
+            "active + re-admission-waiting requests on this replica",
+            labels=("replica",))
+        self._router_health = r.gauge(
+            "nxdi_router_replica_health",
+            "replica health state (2 = healthy, 1 = degraded, 0 = dead)",
+            labels=("replica",))
+        self._router_spread = r.histogram(
+            "nxdi_router_occupancy_spread",
+            "max - min live rows across alive replicas per router step "
+            "(0 == perfectly balanced; the rebalance signal)",
+            buckets=metrics_mod.ROUTER_SPREAD_BUCKETS)
         self._jit_traces = r.counter(
             "nxdi_jit_traces_total", "jit traces observed (compiles)",
             labels=("tag",))
@@ -503,6 +541,48 @@ class TelemetrySession:
         self._mixed.child(("decode_rows",)).observe(decode_rows)
         self._mixed.child(("padded_slots",)).observe(padded_slots)
         self._mixed.child(("query_tokens",)).observe(query_tokens)
+
+    # ---- multi-replica router (runtime/router.py) ------------------------
+
+    def router_placement(self, policy: str, reason: str) -> None:
+        """One placement decision: a request was bound to a replica under
+        ``policy`` (``reason``: fresh / failover / spill)."""
+        if not self.enabled:
+            return
+        self._router_placements.child((policy, reason)).inc()
+        self.event("router_placement", policy=policy, reason=reason)
+
+    def router_failover(self, req_id: str, cause: str) -> None:
+        """One request re-queued off a failed replica; it resumes from its
+        committed host state on a surviving replica (byte-identical greedy)."""
+        if not self.enabled:
+            return
+        self._router_failovers.child((cause,)).inc()
+        self.event("router_failover", req_id=req_id, cause=cause)
+
+    def router_rejected(self, req_id: str, reason: str) -> None:
+        if not self.enabled:
+            return
+        self._router_rejected.child((reason,)).inc()
+        self.event("router_rejected", req_id=req_id, reason=reason)
+
+    def router_replica_gauges(
+        self, replica_id: int, occupancy: int, queue_depth: int, health: int
+    ) -> None:
+        if not self.enabled:
+            return
+        lab = (str(int(replica_id)),)
+        self._router_occ.child(lab).set(occupancy)
+        self._router_qd.child(lab).set(queue_depth)
+        self._router_health.child(lab).set(health)
+
+    def router_step_gauges(self, queue_depth: int, spread: int) -> None:
+        """Once per router step: global placement-queue depth and the
+        occupancy spread (max - min live rows) across alive replicas."""
+        if not self.enabled:
+            return
+        self._router_queue.set(queue_depth)
+        self._router_spread.observe(spread)
 
     def spec_accept(self, committed: int) -> None:
         """One speculation round committed ``committed`` tokens for one

@@ -34,6 +34,10 @@ echo
 echo "== robustness (serving fault-containment) pytest subset =="
 python -m pytest tests -q -m robustness -p no:cacheprovider || rc=$?
 
+echo
+echo "== router (multi-replica serving front-end) pytest subset =="
+python -m pytest tests/test_router.py -q -m 'not slow' -p no:cacheprovider || rc=$?
+
 if [ "$rc" -ne 0 ]; then
   echo "ci_check: FAILED (rc=$rc)" >&2
 else

@@ -25,11 +25,13 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 ALL_POINTS = {
     "bf16_1b_bs1", "bf16_1b_bs4", "int8_1b_bs1", "serving_1b_int8",
-    "serving_1b_int8_ragged", "serving_1b_int8_ragged_async", "int8_8b_bs1",
+    "serving_1b_int8_ragged", "serving_1b_int8_ragged_async",
+    "serving_1b_int8_router", "int8_8b_bs1",
     "bf16_1b_8k", "bf16_1b_8k_kvq8", "bf16_1b_16k", "bf16_1b_16k_kvq8",
 }
 SERVING_POINTS = {
     "serving_1b_int8", "serving_1b_int8_ragged", "serving_1b_int8_ragged_async",
+    "serving_1b_int8_router",
 }
 
 
@@ -63,6 +65,16 @@ def test_bench_suite_tiny(monkeypatch):
     ragged_async = points["serving_1b_int8_ragged_async"]
     assert ragged_async["ttft_ms"] > 0 and ragged_async["itl_ms"] is not None
     assert 0.0 < ragged_async["host_frac"] <= 1.0
+    # ISSUE 10: the multi-replica router row — 2 replicas on partitioned
+    # CPU devices, SAME mix. Clean traffic MUST report 0 failovers and 0
+    # rejects (per-run deltas, PR 7 convention), and balance_frac (min
+    # replica tokens / even share) must show BOTH replicas served
+    router = points["serving_1b_int8_router"]
+    assert router["n_replicas"] == 2
+    assert router["failover"] == 0 and router["rejected"] == 0
+    assert 0.0 < router["balance_frac"] <= 1.0
+    assert len(router["tokens_per_replica"]) == 2
+    assert all(t > 0 for t in router["tokens_per_replica"])
     # emit fired after EVERY point (the incremental-summary contract) and
     # every snapshot produces a valid summary line
     assert len(emitted) == len(ALL_POINTS)
@@ -104,6 +116,9 @@ def test_bench_suite_tiny(monkeypatch):
     assert final["serving_rejected"] == 0
     assert final["serving_quarantined"] == 0
     assert final["serving_preempted"] == 0
+    assert final["router_tok_s"] > 0
+    assert final["router_failover"] == 0
+    assert 0.0 < final["router_balance_frac"] <= 1.0
     # --metrics-out: the tiny suite ran the serving point in-process, so the
     # process-default registry must hold the full serving metric set
     import tempfile

@@ -99,6 +99,34 @@ def test_serving_ragged_async_rejected_without_ragged(kwargs):
         TpuConfig(**kwargs)
 
 
+def test_router_knob_defaults_and_roundtrip():
+    """ISSUE 10: the multi-replica router knobs exist, default to a single
+    session with telemetry-driven placement, and round-trip to_dict."""
+    tc = TpuConfig()
+    assert tc.serving_replicas == 1
+    assert tc.router_policy == "least_loaded"
+    tc2 = TpuConfig.from_dict(tc.to_dict())
+    assert tc2.serving_replicas == 1
+    assert tc2.router_policy == "least_loaded"
+    ok = TpuConfig(is_continuous_batching=True, serving_replicas=2,
+                   router_policy="round_robin")
+    assert ok.serving_replicas == 2
+
+
+@pytest.mark.parametrize(
+    "kwargs, match",
+    [
+        (dict(serving_replicas=0), "serving_replicas"),
+        (dict(serving_replicas=-2), "serving_replicas"),
+        (dict(router_policy="fastest"), "router_policy"),
+        (dict(serving_replicas=2), "is_continuous_batching"),
+    ],
+)
+def test_router_knob_validation(kwargs, match):
+    with pytest.raises(ValueError, match=match):
+        TpuConfig(**kwargs)
+
+
 def test_json_round_trip(tmp_path, tiny_config):
     tiny_config.tpu_config.on_device_sampling_config = OnDeviceSamplingConfig(
         do_sample=True, top_k=5

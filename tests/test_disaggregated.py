@@ -52,6 +52,75 @@ def test_stage_validation():
         DisaggregatedPipeline(apps["mono"], apps["dec"])
 
 
+@pytest.mark.parametrize("kv_dtype", ["int8", "float8_e4m3"])
+def test_disaggregated_quantized_kv_handoff(kv_dtype):
+    """ISSUE 10 satellite: quantized caches hand over RAW codes plus the
+    per-(layer, head) running-absmax scales — pinned byte-identical to the
+    single-app quantized run (the fresh decode stage adopts the prefill
+    stage's scales exactly via the monotone max-fold). The decode stage
+    runs a WIDER tp degree, so the head-replication remap covers the scale
+    axis too."""
+    sd = None
+    cfgs = {
+        "mono": dict(is_prefill_stage=None, tp_degree=1,
+                     kv_cache_dtype=kv_dtype),
+        "pre": dict(is_prefill_stage=True, tp_degree=1,
+                    kv_cache_dtype=kv_dtype),
+        "dec": dict(is_prefill_stage=False, tp_degree=4,
+                    kv_cache_dtype=kv_dtype),
+    }
+    apps = {}
+    for name, tpu in cfgs.items():
+        cfg = make_tiny_config(tpu=tpu)
+        if sd is None:
+            sd = make_random_hf_state_dict(cfg)
+        apps[name] = TpuModelForCausalLM(None, cfg)
+        apps[name].load(state_dict=sd)
+    ref = apps["mono"].generate(PROMPTS, MASK, max_new_tokens=10).sequences
+    out = DisaggregatedPipeline(apps["pre"], apps["dec"]).generate(
+        PROMPTS, MASK, max_new_tokens=10
+    )
+    np.testing.assert_array_equal(out.sequences, ref)
+    # the scales actually moved: the decode stage's running absmax is
+    # non-trivial and matches the prefill stage's (fresh stage -> adopt)
+    pre_scale = np.asarray(apps["pre"].kv_cache.k.scale)
+    dec_scale = np.asarray(apps["dec"].kv_cache.k.scale)
+    assert pre_scale.max() > 0
+    src_rep = apps["pre"].builder.gqa.kv_repeat
+    dst_rep = apps["dec"].builder.gqa.kv_repeat
+    expanded = np.repeat(pre_scale[:, ::src_rep], dst_rep, axis=1)
+    # decode writes can only GROW the running max past the handed scales
+    assert (dec_scale >= expanded - 1e-7).all()
+
+
+def test_disaggregated_quantized_format_mismatch_is_loud():
+    """One stage quantized, the other plain: the hand-off must refuse
+    loudly (codes are meaningless without their scales) instead of
+    silently injecting garbage."""
+    from neuronx_distributed_inference_tpu.runtime.disaggregated import (
+        extract_request_kv,
+        inject_request_kv,
+    )
+
+    sd = None
+    apps = {}
+    for name, tpu in (
+        ("pre", dict(is_prefill_stage=True, tp_degree=1,
+                     kv_cache_dtype="int8")),
+        ("dec", dict(is_prefill_stage=False, tp_degree=1)),
+    ):
+        cfg = make_tiny_config(tpu=tpu)
+        if sd is None:
+            sd = make_random_hf_state_dict(cfg)
+        apps[name] = TpuModelForCausalLM(None, cfg)
+        apps[name].load(state_dict=sd)
+    seq_ids = np.arange(2, dtype=np.int32)
+    kv = extract_request_kv(apps["pre"], seq_ids, upto=8)
+    assert kv["quantized"] and "k_scale" in kv
+    with pytest.raises(ValueError, match="same cache format"):
+        inject_request_kv(apps["dec"], seq_ids, kv)
+
+
 def test_disaggregated_attention_dp_decode_stage():
     """Decode stage under attention-DP: the hand-off must honor the
     interleaved per-shard garbage lines of the DP cache layout."""

@@ -1,0 +1,596 @@
+"""Multi-replica serving front-end (ISSUE 10): telemetry-driven router over
+N replica sessions with failover.
+
+The acceptance pins:
+- an N-replica router drain is BYTE-IDENTICAL (greedy) to a single session
+  serving the same request set — including with one replica killed
+  mid-drain (its requests fail over to the survivor and resume from their
+  committed tokens);
+- replica health: dispatch-retry exhaustion degrades-then-kills, a
+  WatchdogError kills (caught — never a router-wide raise), and the
+  injectable per-replica FaultInjector drives both, against ServingSession
+  AND SpeculativeServingSession replicas;
+- `least_loaded` placement actually balances a skewed mix (occupancy
+  spread), FIFO placement is starvation-free under pool-exhaustion churn;
+- the `nxdi_router_*` metric family is recorded host-side;
+- satellite: the legacy split path's prefill fetches start
+  `copy_to_host_async` at dispatch with UNCHANGED consumed-fetch counts
+  and byte-identical outputs (fetch parity).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tests.conftest import make_tiny_config, make_random_hf_state_dict
+
+from neuronx_distributed_inference_tpu.config import ChunkedPrefillConfig
+from neuronx_distributed_inference_tpu.parallel.mesh import mesh_from_config
+from neuronx_distributed_inference_tpu.runtime.application import TpuModelForCausalLM
+from neuronx_distributed_inference_tpu.runtime.faults import FaultInjector
+from neuronx_distributed_inference_tpu.runtime.replica import (
+    HEALTH_DEAD,
+    HEALTH_DEGRADED,
+    HEALTH_HEALTHY,
+    ReplicaHandle,
+)
+from neuronx_distributed_inference_tpu.runtime.router import (
+    PLACEMENT_POLICIES,
+    ServingRouter,
+    partition_devices,
+)
+from neuronx_distributed_inference_tpu.runtime.serving import (
+    AdmissionResult,
+    ServingSession,
+    SpeculativeServingSession,
+)
+from neuronx_distributed_inference_tpu.telemetry import TelemetrySession
+
+pytestmark = pytest.mark.router
+
+#: the standard request set: mixed prompt lengths (r2 prefills over several
+#: chunks), one request with an EOS it actually hits
+REQS = {
+    "r1": dict(ids=[5, 17, 92, 41], gen=6),
+    "r2": dict(ids=list(range(30, 52)), gen=6),
+    "r3": dict(ids=[7, 7, 7], gen=5),
+    "r4": dict(ids=[11, 23, 5, 99, 100, 3], gen=6),
+    "r5": dict(ids=[64, 2, 90, 14], gen=5),
+    "r6": dict(ids=[33, 88, 2], gen=6),
+}
+
+
+def _paged_cfg(**extra):
+    tpu = dict(
+        is_continuous_batching=True, batch_size=4, ctx_batch_size=1,
+        is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=24,
+        is_chunked_prefill=True,
+        chunked_prefill_config=ChunkedPrefillConfig(
+            max_num_seqs=2, kernel_q_tile_size=16
+        ),
+        seq_len=64,
+    )
+    tpu.update(extra)
+    return make_tiny_config(tpu=tpu)
+
+
+@pytest.fixture(scope="module")
+def state_dict():
+    return make_random_hf_state_dict(_paged_cfg())
+
+
+@pytest.fixture(scope="module")
+def replica_apps(state_dict):
+    """Two replica apps on PARTITIONED virtual devices (the CPU-harness
+    replica layout: each session owns its own mesh + cache arrays)."""
+    parts = partition_devices(2)
+    assert parts[0][0] is not parts[1][0]  # genuinely disjoint partitions
+    apps = []
+    for i in range(2):
+        cfg = _paged_cfg()
+        app = TpuModelForCausalLM(
+            None, cfg, mesh=mesh_from_config(cfg.tpu_config, devices=parts[i])
+        )
+        apps.append(app.load(state_dict=state_dict))
+    return apps
+
+
+def _single_session_drain(app, reqs, make_session=ServingSession):
+    """Reference: ONE session serving the whole request set (queuing at the
+    front when slots run out)."""
+    app.init_kv_cache()
+    sess = make_session(app)
+    items = list(reqs.items())
+    i = 0
+    guard = 0
+    while i < len(items):
+        rid, spec = items[i]
+        if sess.add_request(rid, spec["ids"], max_new_tokens=spec["gen"],
+                            eos_token_id=spec.get("eos")):
+            i += 1
+        else:
+            sess.step()
+        guard += 1
+        assert guard < 500
+    sess.run_to_completion()
+    return {rid: list(sess.requests[rid].generated) for rid, _ in items}
+
+
+def _make_router(apps, reqs, policy="least_loaded", telemetry=None,
+                 injectors=None, make_session=ServingSession, **router_kw):
+    for app in apps:
+        app.init_kv_cache()
+    sessions = [
+        make_session(
+            app,
+            fault_injector=injectors[i] if injectors else None,
+            telemetry=telemetry,
+        )
+        for i, app in enumerate(apps)
+    ]
+    router = ServingRouter(sessions, policy=policy, telemetry=telemetry,
+                           **router_kw)
+    for rid, spec in reqs.items():
+        assert router.add_request(rid, spec["ids"],
+                                  max_new_tokens=spec["gen"],
+                                  eos_token_id=spec.get("eos"))
+    return router
+
+
+@pytest.fixture(scope="module")
+def reference(replica_apps):
+    return _single_session_drain(replica_apps[0], REQS)
+
+
+# ---------------------------------------------------------------------------
+# byte-identity: N replicas == 1 session, with and without a mid-drain death
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("policy", ["least_loaded", "round_robin"])
+def test_router_drain_byte_identical_to_single_session(
+    replica_apps, reference, policy
+):
+    router = _make_router(replica_apps, REQS, policy=policy)
+    out = router.run_to_completion()
+    assert out == reference
+    # every request finished, both replicas actually served work
+    assert all(r.status == "finished" for r in router.requests.values())
+    assert all(h.tokens_served > 0 for h in router.replicas)
+
+
+def test_replica_death_mid_drain_failover_byte_identical(
+    replica_apps, reference
+):
+    """Kill replica 0 mid-drain: its in-flight requests re-queue AHEAD of
+    new arrivals onto the survivor and resume from their committed tokens —
+    the drained outputs stay byte-identical to the single-session run."""
+    with TelemetrySession() as tel:
+        router = _make_router(replica_apps, REQS, telemetry=tel)
+        for _ in range(3):
+            router.step()
+        victim = router.replicas[0]
+        in_flight = [rreq.req_id for rreq in victim.owned.values()]
+        assert in_flight  # the kill interrupts real work
+        victim.kill()
+        out = router.run_to_completion()
+    assert out == reference
+    assert victim.health == HEALTH_DEAD
+    assert router.replicas[1].health == HEALTH_HEALTHY
+    moved = [r for r in router.requests.values() if r.failovers]
+    assert moved  # at least the in-flight requests failed over
+    snap = tel.registry.snapshot()
+    fo = sum(
+        s["value"] for s in snap["nxdi_router_failovers_total"]["samples"]
+    )
+    assert fo == sum(r.failovers for r in router.requests.values()) > 0
+    healths = {
+        s["labels"]["replica"]: s["value"]
+        for s in snap["nxdi_router_replica_health"]["samples"]
+    }
+    assert healths["0"] == 0 and healths["1"] == 2
+
+
+def test_watchdog_death_fails_over_not_raises(replica_apps, reference):
+    """A WatchdogError on one replica (stall-injected) is caught, kills
+    ONLY that replica, and its requests fail over byte-identically — never
+    a router-wide raise."""
+    inj = FaultInjector().stall(*range(1, 40))
+    cfg_steps = 2
+    for app in replica_apps:
+        app.config.tpu_config.watchdog_no_progress_steps = cfg_steps
+    try:
+        router = _make_router(replica_apps, REQS,
+                              injectors=[inj, None], policy="least_loaded")
+        out = router.run_to_completion()
+    finally:
+        for app in replica_apps:
+            app.config.tpu_config.watchdog_no_progress_steps = 256
+    assert router.replicas[0].health == HEALTH_DEAD
+    assert router.replicas[0].health_reason == "watchdog"
+    assert router.replicas[0].watchdog_error is not None
+    assert out == reference
+
+
+# ---------------------------------------------------------------------------
+# health machine driven by dispatch-retry exhaustion, both session classes
+# ---------------------------------------------------------------------------
+
+
+def _spec_replicas(n=2):
+    mk = lambda: make_tiny_config(
+        tpu=dict(is_continuous_batching=True, batch_size=2, ctx_batch_size=1,
+                 dispatch_max_retries=0)
+    )
+    sd_t = make_random_hf_state_dict(mk(), seed=0)
+    sd_d = make_random_hf_state_dict(mk(), seed=7)
+    parts = partition_devices(n)
+    apps = []
+    for i in range(n):
+        cfg_t, cfg_d = mk(), mk()
+        target = TpuModelForCausalLM(
+            None, cfg_t,
+            mesh=mesh_from_config(cfg_t.tpu_config, devices=parts[i]),
+        ).load(state_dict=sd_t)
+        draft = TpuModelForCausalLM(
+            None, cfg_d,
+            mesh=mesh_from_config(cfg_d.tpu_config, devices=parts[i]),
+        ).load(state_dict=sd_d)
+        apps.append((target, draft))
+    return apps
+
+
+SPEC_REQS = {
+    "s1": dict(ids=[5, 17, 92, 41], gen=6),
+    "s2": dict(ids=[7, 7, 7], gen=5),
+    "s3": dict(ids=[64, 2, 90, 14], gen=6),
+}
+
+
+@pytest.mark.parametrize("session_kind", ["serving", "speculative"])
+def test_dispatch_exhaustion_failover_both_session_classes(
+    replica_apps, reference, session_kind
+):
+    """An injected dispatch-retry exhaustion on replica 0 terminally fails
+    its in-flight rows AT THE SESSION — the router degrades the replica and
+    fails the requests over, so the drained outputs stay byte-identical.
+    Parametrized over both session classes (the FaultInjector hooks are
+    session-class-agnostic)."""
+    inj = FaultInjector().dispatch_error(3, attempts=5)
+    if session_kind == "serving":
+        apps, reqs, ref = replica_apps, REQS, reference
+        make_session = ServingSession
+        # dispatch_max_retries=2 default: 5 armed attempt-failures exhaust it
+        router = _make_router(apps, reqs, injectors=[inj, None],
+                              make_session=make_session)
+    else:
+        pairs = _spec_replicas(2)
+        reqs = SPEC_REQS
+        ref = _single_session_drain(
+            pairs[0][0], reqs,
+            make_session=lambda app, **kw: SpeculativeServingSession(
+                app, pairs[0][1], speculation_length=3, **kw
+            ),
+        )
+        for t, d in pairs:
+            t.init_kv_cache()
+            d.init_kv_cache()
+        sessions = [
+            SpeculativeServingSession(
+                t, d, speculation_length=3,
+                fault_injector=inj if i == 0 else None,
+            )
+            for i, (t, d) in enumerate(pairs)
+        ]
+        router = ServingRouter(sessions, policy="least_loaded")
+        for rid, spec in reqs.items():
+            assert router.add_request(rid, spec["ids"],
+                                      max_new_tokens=spec["gen"])
+    out = router.run_to_completion()
+    assert out == ref
+    assert inj.log  # the fault actually fired
+    # one give-up degrades; the replica survives and the router keeps it
+    assert router.replicas[0].health in (HEALTH_DEGRADED, HEALTH_DEAD)
+    assert any(r.failovers for r in router.requests.values())
+    assert all(r.status == "finished" for r in router.requests.values())
+
+
+def test_second_give_up_kills_replica(replica_apps, reference):
+    inj = FaultInjector().dispatch_error(2, attempts=5).dispatch_error(
+        6, attempts=5
+    )
+    router = _make_router(replica_apps, REQS, injectors=[inj, None])
+    out = router.run_to_completion()
+    assert out == reference
+    assert router.replicas[0].health == HEALTH_DEAD
+    assert router.replicas[0].health_reason == "dispatch_error"
+
+
+def test_degraded_replica_recovers_after_clean_steps(replica_apps):
+    """DEGRADED -> HEALTHY after `recovery_steps` consecutive clean steps;
+    DEGRADED replicas are only placed on when no HEALTHY replica exists."""
+    for app in replica_apps:
+        app.init_kv_cache()
+    sessions = [ServingSession(app) for app in replica_apps]
+    handles = [
+        ReplicaHandle(s, i, recovery_steps=2) for i, s in enumerate(sessions)
+    ]
+    router = ServingRouter(handles)
+    handles[0].note_give_up()
+    assert handles[0].health == HEALTH_DEGRADED
+    assert router.add_request("a", [5, 6, 7], max_new_tokens=3)
+    assert router.requests["a"].replica == 1  # healthy replica preferred
+    router.run_to_completion()
+    assert handles[0].health == HEALTH_HEALTHY  # idle clean steps recovered
+
+
+# ---------------------------------------------------------------------------
+# placement policies
+# ---------------------------------------------------------------------------
+
+
+def test_least_loaded_balances_skewed_mix(replica_apps):
+    """Pre-load replica 0, then route fresh requests: least_loaded must
+    send them to replica 1 until the load evens out (occupancy spread <= 1
+    at placement time)."""
+    for app in replica_apps:
+        app.init_kv_cache()
+    sessions = [ServingSession(app) for app in replica_apps]
+    for i in range(3):
+        assert sessions[0].add_request(f"bg{i}", [9, 9, 9, 9],
+                                       max_new_tokens=12)
+    router = ServingRouter(sessions, policy="least_loaded")
+    for i in range(3):
+        assert router.add_request(f"fresh{i}", [4 + i, 5, 6],
+                                  max_new_tokens=4)
+    placed_on = [router.requests[f"fresh{i}"].replica for i in range(3)]
+    assert placed_on == [1, 1, 1], placed_on
+    occ = [h.occupancy for h in router.replicas]
+    assert max(occ) - min(occ) <= 1, occ  # the skew was evened out
+    router.run_to_completion()
+
+
+def test_round_robin_cycles_replicas(replica_apps):
+    for app in replica_apps:
+        app.init_kv_cache()
+    router = ServingRouter(
+        [ServingSession(app) for app in replica_apps], policy="round_robin"
+    )
+    for i in range(4):
+        assert router.add_request(f"p{i}", [3 + i, 4, 5], max_new_tokens=2)
+    placed_on = [router.requests[f"p{i}"].replica for i in range(4)]
+    assert placed_on == [0, 1, 0, 1]
+    router.run_to_completion()
+
+
+def test_cache_aware_stub_colocates_shared_prefixes(replica_apps):
+    """The cache_aware stub anchors requests by prompt-prefix hash: two
+    requests sharing a prefix land on the SAME replica (prefix-cache
+    affinity), deterministically."""
+    for app in replica_apps:
+        app.init_kv_cache()
+    router = ServingRouter(
+        [ServingSession(app) for app in replica_apps], policy="cache_aware"
+    )
+    shared = list(range(40, 56))  # one full block of shared prefix
+    assert router.add_request("c1", shared + [1], max_new_tokens=2)
+    assert router.add_request("c2", shared + [2], max_new_tokens=2)
+    assert (
+        router.requests["c1"].replica == router.requests["c2"].replica
+    )
+    router.run_to_completion()
+
+
+def test_policy_registry_and_validation(replica_apps):
+    assert set(PLACEMENT_POLICIES) == {
+        "round_robin", "least_loaded", "cache_aware"
+    }
+    with pytest.raises(ValueError, match="unknown router policy"):
+        ServingRouter([ServingSession(replica_apps[0])], policy="bogus")
+    with pytest.raises(ValueError, match="at least one replica"):
+        ServingRouter([])
+
+
+# ---------------------------------------------------------------------------
+# starvation freedom under churn
+# ---------------------------------------------------------------------------
+
+
+def test_starvation_freedom_under_pool_churn(replica_apps, reference):
+    """Random pool-exhaustion churn on BOTH replicas: every request still
+    reaches a terminal state with byte-identical outputs (preempted
+    requests re-queue ahead of new arrivals and resume exactly — the PR 7
+    aging guarantee, surviving the router layer)."""
+    injectors = [
+        FaultInjector(seed=1).random_schedule(30, 0.3, kinds=("exhaust_pool",)),
+        FaultInjector(seed=2).random_schedule(30, 0.3, kinds=("exhaust_pool",)),
+    ]
+    router = _make_router(replica_apps, REQS, injectors=injectors)
+    out = router.run_to_completion()
+    assert out == reference
+    assert all(r.status == "finished" for r in router.requests.values())
+    assert any(i.log for i in injectors)  # churn actually happened
+
+
+# ---------------------------------------------------------------------------
+# admission: typed verdicts at the front door
+# ---------------------------------------------------------------------------
+
+
+def test_router_admission_typed_verdicts(replica_apps):
+    for app in replica_apps:
+        app.init_kv_cache()
+    router = ServingRouter([ServingSession(app) for app in replica_apps])
+    vocab = replica_apps[0].config.vocab_size
+    res = router.add_request("bad_id", [1, vocab + 5], max_new_tokens=4)
+    assert isinstance(res, AdmissionResult)
+    assert not res and res.reason == "token_id_out_of_range"
+    assert not router.add_request("empty", [], max_new_tokens=4)
+    assert router.add_request("neg", [3], max_new_tokens=0).reason == (
+        "invalid_max_new_tokens"
+    )
+    long_prompt = [1] * 200  # past seq_len=64
+    assert router.add_request("long", long_prompt).reason == "prompt_too_long"
+    # typed rejects recorded, never placed, never raised
+    assert set(router.rejected) == {"bad_id", "empty", "neg", "long"}
+    assert not router.requests
+    assert router.add_request("ok", [5, 6], max_new_tokens=2)
+    assert not router.add_request("ok", [5, 6]).admitted  # duplicate
+    assert router.add_request("ok2", [5, 6]).reason is None
+    router.run_to_completion()
+    # total outage: typed refusal, not a raise
+    for h in router.replicas:
+        h.kill()
+    assert router.add_request("late", [5, 6]).reason == "no_replicas"
+
+
+def test_never_fits_request_fails_typed_not_wedged():
+    """A prompt that passes validation but can NEVER get KV blocks on any
+    replica (non-chunked paged admission, pool smaller than the prompt)
+    must become a typed refusal/terminal — not a head-of-line wedge that
+    spins run_to_completion forever and starves later arrivals."""
+    cfg = make_tiny_config(
+        tpu=dict(is_continuous_batching=True, batch_size=2, ctx_batch_size=1,
+                 is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=3,
+                 seq_len=64)
+    )
+    app = TpuModelForCausalLM(None, cfg).load(
+        state_dict=make_random_hf_state_dict(cfg)
+    )
+    router = ServingRouter([ServingSession(app)])
+    # pool = 3 usable blocks = 48 positions; a 50-token prompt passes
+    # prompt_too_long (< the 63 pos limit) but can never allocate
+    big = [1] * 50
+    res = router.add_request("big", big, max_new_tokens=4)
+    assert not res and res.reason == "never_fits"
+    assert "big" not in router.requests  # unrecorded, like a session drop
+    # queued BEHIND live work: waits (capacity might free), then resolves
+    # terminal once the pool is provably never going to fit it
+    assert router.add_request("ok", [5, 6, 7], max_new_tokens=3)
+    assert router.add_request("big2", big, max_new_tokens=4)  # queued
+    out = router.run_to_completion()  # must terminate
+    assert router.requests["ok"].status == "finished"
+    assert len(out["ok"]) == 3
+    big2 = router.requests["big2"]
+    assert big2.status == "failed" and big2.fail_reason == "never_fits"
+
+
+def test_total_outage_fails_queued_requests_typed(replica_apps):
+    router = _make_router(replica_apps, REQS)
+    router.step()
+    for h in router.replicas:
+        h.kill()
+    out = router.run_to_completion()  # no raise
+    assert all(r.finished for r in router.requests.values())
+    failed = [r for r in router.requests.values() if r.status == "failed"]
+    assert failed  # the outage surfaced as typed FAILED verdicts
+    assert {r.fail_reason for r in failed} <= {"no_replicas", "killed",
+                                               "dispatch_error"}
+    assert isinstance(out, dict)
+
+
+# ---------------------------------------------------------------------------
+# observability: the nxdi_router_* family
+# ---------------------------------------------------------------------------
+
+
+def test_router_metric_family(replica_apps, reference):
+    with TelemetrySession() as tel:
+        router = _make_router(replica_apps, REQS, telemetry=tel)
+        out = router.run_to_completion()
+    assert out == reference
+    snap = tel.registry.snapshot()
+    placements = {
+        (s["labels"]["policy"], s["labels"]["reason"]): s["value"]
+        for s in snap["nxdi_router_placements_total"]["samples"]
+    }
+    total_placements = sum(placements.values())
+    assert total_placements == sum(
+        r.placements for r in router.requests.values()
+    )
+    assert all(pol == "least_loaded" for pol, _ in placements)
+    # per-replica gauges labelled by replica id, healthy throughout
+    for fam in ("nxdi_router_replica_occupancy",
+                "nxdi_router_replica_queue_depth",
+                "nxdi_router_replica_health"):
+        labels = {s["labels"]["replica"] for s in snap[fam]["samples"]}
+        assert labels == {"0", "1"}, (fam, labels)
+    healths = {s["labels"]["replica"]: s["value"]
+               for s in snap["nxdi_router_replica_health"]["samples"]}
+    assert healths == {"0": 2, "1": 2}
+    # the spread histogram observed once per router step
+    spread = snap["nxdi_router_occupancy_spread"]["samples"][0]
+    assert spread["count"] == router._step_index > 0
+    # clean traffic: zero failovers
+    assert "nxdi_router_failovers_total" not in snap or sum(
+        s["value"] for s in snap["nxdi_router_failovers_total"]["samples"]
+    ) == 0
+
+
+def test_diagnostic_snapshot_shape(replica_apps):
+    router = _make_router(replica_apps, {"d1": dict(ids=[5, 6, 7], gen=3)})
+    router.step()
+    snap = router.diagnostic_snapshot()
+    assert snap["policy"] == "least_loaded"
+    assert len(snap["replicas"]) == 2
+    for r in snap["replicas"]:
+        assert {"replica_id", "health", "occupancy", "tokens_served",
+                "ewma_step_ms", "kv_free_bytes"} <= set(r)
+    router.run_to_completion()
+
+
+# ---------------------------------------------------------------------------
+# satellite: legacy split-path prefill fetch starts async at dispatch
+# ---------------------------------------------------------------------------
+
+
+def test_legacy_prefill_fetch_async_start_parity(replica_apps):
+    """The legacy split path now starts its prefill token fetches with
+    copy_to_host_async at dispatch. Pin: (a) the async start actually runs,
+    (b) the CONSUMED device-fetch count over a full drain is IDENTICAL with
+    the async start disabled, (c) outputs are byte-identical."""
+    app = replica_apps[0]
+
+    def drain():
+        return _single_session_drain(app, REQS)
+
+    starts = {"n": 0}
+    real_start = ServingSession._start_fetch  # staticmethod -> plain fn
+
+    def counting_start(tokens):
+        starts["n"] += 1
+        return real_start(tokens)
+
+    counter = {"n": 0}
+    real_asarray = np.asarray
+    real_device_get = jax.device_get
+
+    def counting_asarray(a, *args, **kwargs):
+        if isinstance(a, jax.Array):
+            counter["n"] += 1
+        return real_asarray(a, *args, **kwargs)
+
+    def counting_device_get(x, *args, **kwargs):
+        counter["n"] += 1
+        return real_device_get(x, *args, **kwargs)
+
+    golden = drain()  # warm every program
+    np.asarray = counting_asarray
+    jax.device_get = counting_device_get
+    try:
+        ServingSession._start_fetch = staticmethod(counting_start)
+        counter["n"] = 0
+        out_async = drain()
+        fetches_async = counter["n"]
+        assert starts["n"] > 0  # the async start fired on prefill fetches
+        ServingSession._start_fetch = staticmethod(lambda tokens: None)
+        counter["n"] = 0
+        out_blocking = drain()
+        fetches_blocking = counter["n"]
+    finally:
+        ServingSession._start_fetch = staticmethod(real_start)
+        np.asarray = real_asarray
+        jax.device_get = real_device_get
+    assert out_async == out_blocking == golden
+    assert fetches_async == fetches_blocking > 0  # fetch-count parity

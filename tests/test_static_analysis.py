@@ -1056,3 +1056,78 @@ def test_rule_step_hot_path_stale_name_is_loud(tmp_path):
     assert len(stale) == 1
     assert stale[0].severity == "error"
     assert "_consume_ragged" in stale[0].message
+
+
+def _router_hot_snippet(omit=()):
+    """A fixture router.py defining every ROUTER_HOT_PATH function (minus
+    ``omit``), with a hot-path fetch in _place_pending and an
+    admission-path fetch in add_request."""
+    from neuronx_distributed_inference_tpu.analysis.tpulint import (
+        ROUTER_HOT_PATH,
+    )
+
+    stubs = "\n".join(
+        f"    def {name}(self):\n        pass"
+        for name in sorted(ROUTER_HOT_PATH - {"_place_pending"} - set(omit))
+    )
+    return textwrap.dedent(
+        """
+        import jax
+
+        class ServingRouter:
+            def _place_pending(self, scores):
+                return jax.device_get(scores)  # BUG: fetch in placement loop
+
+            def add_request(self, ids):
+                return jax.device_get(ids)     # admission: file bucket only
+        """
+    ) + "\n" + stubs + "\n"
+
+
+def _lint_router_snippet(tmp_path, source):
+    pkg = tmp_path / "neuronx_distributed_inference_tpu" / "runtime"
+    pkg.mkdir(parents=True, exist_ok=True)
+    f = pkg / "router.py"
+    f.write_text(source)
+    return lint_paths([f], tmp_path)
+
+
+def test_rule_route_hot_path_census(tmp_path):
+    """ISSUE 10: a blocking `jax.device_get` inside a ServingRouter
+    placement/failover function earns a SECOND TPU102 finding in the
+    separately-pinned `runtime/router.py::route-hot-path` bucket (pinned
+    at ZERO entries — ANY blocking fetch in the router loop fails lint);
+    the same call on the admission path stays in the file-level census."""
+    findings = _lint_router_snippet(tmp_path, _router_hot_snippet())
+    census = [x for x in findings if x.rule == "TPU102"]
+    hot = [x for x in census if x.key.endswith("::route-hot-path")]
+    assert len(hot) == 1
+    assert "router.py" in hot[0].key
+    assert len([x for x in census if not x.key.endswith("::route-hot-path")]) == 2
+
+
+def test_rule_route_hot_path_stale_name_is_loud(tmp_path):
+    """A renamed router hot-path function is a loud non-baselined error —
+    the route-hot-path bucket must not silently disarm."""
+    findings = _lint_router_snippet(
+        tmp_path, _router_hot_snippet(omit=("_sync_terminals",))
+    )
+    stale = [
+        x for x in findings
+        if x.rule == "TPU102" and x.key.endswith("::route-hot-path-stale")
+    ]
+    assert len(stale) == 1
+    assert stale[0].severity == "error"
+    assert "_sync_terminals" in stale[0].message
+
+
+def test_router_tree_route_hot_path_is_clean():
+    """The REAL runtime/router.py carries ZERO route-hot-path census
+    entries (and zero file-level host syncs): the router is host
+    bookkeeping only, by contract."""
+    findings = tpulint.run()
+    router = [
+        f for f in findings
+        if f.rule == "TPU102" and "runtime/router.py" in f.key
+    ]
+    assert router == [], router
