@@ -53,36 +53,12 @@ import os
 import sys
 import time
 
-LLAMA_1B = dict(
-    model_type="llama",
-    hidden_size=2048,
-    intermediate_size=8192,
-    num_attention_heads=32,
-    num_key_value_heads=8,
-    num_hidden_layers=16,
-    vocab_size=128256,
-    rms_norm_eps=1e-5,
-    rope_theta=500000.0,
-    max_position_embeddings=2048,
-    hidden_act="silu",
-    tie_word_embeddings=True,
-    head_dim=64,
-)
-
-LLAMA_8B = dict(
-    model_type="llama",
-    hidden_size=4096,
-    intermediate_size=14336,
-    num_attention_heads=32,
-    num_key_value_heads=8,
-    num_hidden_layers=32,
-    vocab_size=128256,
-    rms_norm_eps=1e-5,
-    rope_theta=500000.0,
-    max_position_embeddings=2048,
-    hidden_act="silu",
-    tie_word_embeddings=False,
-    head_dim=128,
+# model shapes live in the device/cost model (the single source of truth the
+# static roofline projections are computed from — ISSUE 11); bench rows and
+# projections can therefore never disagree about the shape they describe
+from neuronx_distributed_inference_tpu.analysis.device_model import (  # noqa: E402
+    LLAMA_1B,
+    LLAMA_8B,
 )
 
 TINY = dict(  # smoke-test model (CPU suite)
@@ -694,6 +670,46 @@ def _suite_params(tiny):
     }
 
 
+def _attach_projection(res, attrs, *, batch, kv_width, quantized, extra_tpu,
+                       scale=1):
+    """Static roofline projection beside the measured row (ISSUE 11):
+    ``projected_tok_s`` is the device-model lower-bound ceiling for this
+    row's shape on the RESOLVED chip (falls back to the registry default on
+    an unresolvable device, e.g. the CPU harness), and ``model_error_frac``
+    = measured/projected - 1 — null when the device didn't resolve, since
+    an error against a chip the run never touched means nothing.
+
+    ``scale``: aggregate multiplier for multi-mesh rows (the router point
+    passes the count of NON-overlapping replica meshes — replicas sharing
+    one chip split its HBM stream and add no ceiling). Applied only when
+    the device RESOLVES to a registry chip: the CPU harness's virtual
+    partitions share one host, so its projection stays the committed
+    single-chip number (`device_model.BENCH_ROW_MODELS` / --compare)."""
+    import jax
+
+    from neuronx_distributed_inference_tpu.analysis import device_model
+
+    spec = device_model.resolve_device(
+        getattr(jax.devices()[0], "device_kind", "") or str(jax.devices()[0])
+    )
+    proj = device_model.decode_projection(
+        attrs,
+        batch=batch,
+        kv_width=kv_width,
+        weight_dtype="int8" if quantized else "bfloat16",
+        kv_dtype=(extra_tpu or {}).get("kv_cache_dtype", "bfloat16"),
+        device=spec,  # None -> DEFAULT_DEVICE inside
+    )
+    projected = proj["tok_s"] * (scale if spec is not None else 1)
+    res["projected_tok_s"] = round(projected, 2)
+    res["model_error_frac"] = (
+        round(res["decode_tok_s"] / projected - 1.0, 4)
+        if spec is not None and res.get("decode_tok_s")
+        else None
+    )
+    return res
+
+
 def run_point(name, tiny=False):
     """Build + measure one benchmark point in THIS process."""
     import jax
@@ -722,6 +738,18 @@ def run_point(name, tiny=False):
             apps, n_requests=r["n_requests"], prompt_len=s["prompt"],
             gen_len=s["gen"], policy=r["policy"],
         )
+        # router ceiling: each replica serves its share of the mix and
+        # streams its OWN weight copy, so the aggregate scales with the
+        # number of non-overlapping replica meshes (1 on a shared chip,
+        # = replicas when each replica has its own chip/partition)
+        distinct = len({d.id for part in parts for d in part})
+        meshes = max(1, distinct // max(1, len(parts[0])))
+        rows_per_replica = max(1, r["n_requests"] // r["replicas"])
+        _attach_projection(
+            res, p["attrs"], batch=rows_per_replica, kv_width=s["seq"],
+            quantized=p["quantized"], extra_tpu=p.get("extra_tpu"),
+            scale=min(meshes, r["replicas"]),
+        )
     elif "serving" in p:
         s = p["serving"]
         app = build_app(
@@ -736,6 +764,11 @@ def run_point(name, tiny=False):
             app, n_requests=s["n_requests"], prompt_len=s["prompt"],
             gen_len=s["gen"],
         )
+        # aggregate decode ceiling at the full slot count / serving bucket
+        _attach_projection(
+            res, p["attrs"], batch=s["max_seqs"], kv_width=s["seq"],
+            quantized=p["quantized"], extra_tpu=p.get("extra_tpu"),
+        )
     else:
         app = build_app(
             p["attrs"], batch=p["batch"], seq_len=p["seq"], ce_buckets=p["ce"],
@@ -745,6 +778,13 @@ def run_point(name, tiny=False):
         res = measure_point(
             app, batch=p["batch"], prompt_len=p["prompt"], gen_len=p["gen"],
             long_prompt=p["long_prompt"],
+        )
+        # the measured decode runs at the bucket covering prompt+gen
+        ctx = p["prompt"] + p["gen"]
+        kv_w = min([b for b in p["tkg"] if b >= ctx] or [max(p["tkg"])])
+        _attach_projection(
+            res, p["attrs"], batch=p["batch"], kv_width=kv_w,
+            quantized=p["quantized"], extra_tpu=p.get("extra_tpu"),
         )
     res["device"] = str(jax.devices()[0])
     return res
@@ -765,12 +805,23 @@ def summary_line(points):
         "vs_baseline": (
             round(headline / BASELINE_1B, 4) if headline else None
         ),
+        # static roofline projection (ISSUE 11): the device-model ceiling
+        # for the headline row and its measured error — model_error_frac is
+        # null on a host whose device doesn't resolve to a registry spec
+        # (the CPU harness) and populated on hardware
+        "projected_tok_s": g("bf16_1b_bs1", "projected_tok_s"),
+        "model_error_frac": g("bf16_1b_bs1", "model_error_frac"),
         "ttft_ms": g("bf16_1b_bs1", "ttft_ms"),
         "prefill_tok_s": g("bf16_1b_bs1", "prefill_tok_s"),
         "decode_bs4_tok_s": g("bf16_1b_bs4", "decode_tok_s"),
         "int8_1b_tok_s": g("int8_1b_bs1", "decode_tok_s"),
         "int8_1b_ttft_ms": g("int8_1b_bs1", "ttft_ms"),
         "serving_tok_s": g("serving_1b_int8", "decode_tok_s"),
+        # the serving rows' aggregate device ceiling + measured error: the
+        # measured-vs-predicted pair hardware session zero closes on (the
+        # CPU harness carries the projection with a null error)
+        "serving_projected_tok_s": g("serving_1b_int8", "projected_tok_s"),
+        "serving_model_error_frac": g("serving_1b_int8", "model_error_frac"),
         # TTFT/ITL sourced from the runtime telemetry traces (not bench
         # stopwatches): the numbers production serving would report
         "serving_ttft_p50_ms": g("serving_1b_int8", "ttft_ms"),
@@ -808,6 +859,10 @@ def summary_line(points):
         # (min-replica tokens / even share) is the placement-policy quality
         # number the first multi-chip session compares policies by
         "router_tok_s": g("serving_1b_int8_router", "decode_tok_s"),
+        # the router row's projection carries its mesh-count scaling, which
+        # the static --compare table cannot know — recorded here so the
+        # offline report uses the run's own ceiling
+        "router_projected_tok_s": g("serving_1b_int8_router", "projected_tok_s"),
         "router_failover": g("serving_1b_int8_router", "failover"),
         "router_balance_frac": g("serving_1b_int8_router", "balance_frac"),
         "int8_8b_tok_s": g("int8_8b_bs1", "decode_tok_s"),

@@ -32,7 +32,7 @@ from neuronx_distributed_inference_tpu.analysis.findings import Baseline, Findin
 _ANALYSIS_DIR = os.path.dirname(__file__)
 TPULINT_BASELINE = os.path.join(_ANALYSIS_DIR, "tpulint_baseline.json")
 
-ALL_SUITES = ("lint", "flags", "graph", "shard", "memory")
+ALL_SUITES = ("lint", "flags", "graph", "shard", "memory", "cost")
 
 #: every committed baseline file --write-baseline may rewrite (diffed after)
 BASELINE_FILES = (
@@ -40,6 +40,7 @@ BASELINE_FILES = (
     "graph_baseline.json",
     "shard_baseline.json",
     "memory_baseline.json",
+    "cost_baseline.json",
 )
 
 
@@ -63,10 +64,20 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m neuronx_distributed_inference_tpu.analysis",
         description=(
             "Static-analysis gate: tpulint + flag audit + graph audit + "
-            "shard audit + memory audit"
+            "shard audit + memory audit + cost audit"
         ),
     )
     parser.add_argument("--json", action="store_true", help="JSON report")
+    parser.add_argument(
+        "--compare",
+        metavar="BENCH_JSON",
+        default=None,
+        help=(
+            "offline measured-vs-projected report over a committed bench "
+            "summary (BENCH_rNN.json); prints per-row error and exits 0 — "
+            "informational, no gate"
+        ),
+    )
     parser.add_argument(
         "--suites",
         default=",".join(ALL_SUITES),
@@ -116,7 +127,7 @@ def run_suites(
         from neuronx_distributed_inference_tpu.analysis import flag_audit
 
         unbaselined.extend(flag_audit.run())
-    traced_suites = [s for s in ("graph", "shard", "memory") if s in suites]
+    traced_suites = [s for s in ("graph", "shard", "memory", "cost") if s in suites]
     if traced_suites:
         _prepare_jax_cpu()
     if "graph" in suites:
@@ -132,6 +143,11 @@ def run_suites(
 
         unbaselined.extend(memory_audit.run(write_baseline=write_baseline))
         extras["memory"] = memory_audit.last_report()
+    if "cost" in suites:
+        from neuronx_distributed_inference_tpu.analysis import cost_audit
+
+        unbaselined.extend(cost_audit.run(write_baseline=write_baseline))
+        extras["cost"] = cost_audit.last_report()
 
     all_findings = baselined + unbaselined
     if write_baseline and "lint" in suites:
@@ -175,16 +191,40 @@ def baseline_diffs(before: Dict[str, str], after: Dict[str, str]) -> str:
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
+    if args.compare:
+        # measured-vs-projected over a committed bench summary: hardware
+        # session zero's comparison tool — informational, exit 0 on any
+        # readable summary (an unreadable file is a usage error, like an
+        # unknown --suites name). Standalone: silently ignoring a combined
+        # --json/--suites/--write-baseline would look like the gate ran.
+        if args.json or args.write_baseline or args.suites != ",".join(ALL_SUITES):
+            parser.error(
+                "--compare is a standalone report; it cannot be combined "
+                "with --json, --suites or --write-baseline"
+            )
+        from neuronx_distributed_inference_tpu.analysis import device_model
+
+        try:
+            report = device_model.compare_report(args.compare)
+        except (OSError, ValueError) as e:
+            parser.error(f"--compare {args.compare}: {e}")
+        print(report)
+        return 0
     suites = parse_suites(parser, args.suites)
 
     before = _read_baselines() if args.write_baseline else None
     all_findings, new, extras = run_suites(suites, write_baseline=args.write_baseline)
 
-    extras_text = None
+    extras_chunks = []
     if "memory" in extras:
         from neuronx_distributed_inference_tpu.analysis import memory_audit
 
-        extras_text = memory_audit.render_breakdown(extras["memory"])
+        extras_chunks.append(memory_audit.render_breakdown(extras["memory"]))
+    if "cost" in extras:
+        from neuronx_distributed_inference_tpu.analysis import cost_audit
+
+        extras_chunks.append(cost_audit.render_breakdown(extras["cost"]))
+    extras_text = "\n".join(c for c in extras_chunks if c) or None
     print(
         findings_mod.render_report(
             all_findings, new, as_json=args.json, suites=suites,

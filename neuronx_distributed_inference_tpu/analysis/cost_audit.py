@@ -1,0 +1,699 @@
+"""Static roofline cost auditor: per-(family, bucket) FLOPs / HBM bytes /
+collective bytes, pinned with regression gates.
+
+Every committed program's compute cost is derived STATICALLY from the same
+:class:`~.programs.ProgramRecord` harness the graph/shard/memory audits
+ride — no TPU in the container, same GSPMD path hardware takes:
+
+- **dot/conv FLOPs** — a jaxpr walk over ``dot_general`` /
+  ``conv_general_dilated`` equations, scan bodies multiplied by their trip
+  count (the layer scan), cond branches taken at their max. This is the
+  arithmetic the MXU must execute per dispatch.
+- **HBM traffic** (lower bound, per device, TRUE dtypes) — weight bytes
+  (realized shard shapes: int8 codes count 1 byte), cache bytes touched
+  (per-token cost = leaf bytes / capacity tokens, read at the bucket's kv
+  width, written at the dispatch's query tokens — the same narrow-dtype
+  math the serving pool accounting uses), and activation bytes (the
+  residual stream: q_tokens × hidden × 2 per layer, read+write, plus the
+  fp32 logits row). Cross-checked against
+  ``compiled.memory_analysis()``: the model's RESIDENT weight + cache
+  bytes (full shard-shape true-dtype sizes, the accounting the traffic
+  model is derived from) must not exceed what XLA's own buffer assignment
+  says all the arguments occupy.
+- **collective bytes** — the existing collective census
+  (:func:`programs.census`) extended with bytes: each collective's result
+  buffer size in the compiled HLO, summed per op.
+
+From those, a lower-bound step time and tok/s per program are projected
+against the :mod:`.device_model` registry (nameplate peak FLOPs by dtype,
+HBM GB/s, ICI GB/s) — the measured-vs-predicted baseline hardware session
+zero validates.
+
+Rules (all errors, MEM402-style baseline workflow with ``--write-baseline``
+unified diffs against ``analysis/cost_baseline.json``):
+
+- **COST501 cost census** — flops / weights / cache-read / cache-write /
+  activation / collective bytes per (tag, bucket) within ``tolerance_pct``
+  of the committed baseline. A dequant that materializes a cache-sized
+  f32 tensor, a new collective, an attention change that doubles FLOPs —
+  all land here as a reviewable diff instead of prose.
+- **COST502 bucket-scaling sanity** — decode-phase FLOPs and bytes must
+  scale (sub-)linearly in the kv/bucket axis:
+  ``f(W2) <= f(W1) · (W2/W1) · margin``. An accidental O(T²) term in a
+  TKG/mixed program (e.g. decode attending (W, W) instead of (1, W))
+  trips the gate.
+- **COST503 mixed-step ragged efficiency** — the packing contract of the
+  mixed family (q tile, slot count, per-bucket all-decode compute/useful
+  ratio) is pinned; a RAGGED_Q_TILE or row-capacity change that degrades
+  packing efficiency needs a reviewed baseline change.
+- **COST504 arithmetic-intensity classification** — each program's
+  compute- vs bandwidth-bound regime (FLOPs/byte vs the device ridge) is
+  pinned; a dequant/layout change that flips a program's regime needs a
+  reviewed baseline change.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+from typing import Dict, List, Optional, Tuple
+
+from neuronx_distributed_inference_tpu.analysis import device_model, programs
+from neuronx_distributed_inference_tpu.analysis.findings import (
+    Finding,
+    SEV_ERROR,
+)
+
+BASELINE_PATH = pathlib.Path(__file__).resolve().parent / "cost_baseline.json"
+
+COST_AUDIT_TAGS = programs.ALL_TAGS
+
+#: allowed relative drift per census component before COST501 fires; the
+#: committed baseline may override (``tolerance_pct`` key)
+DEFAULT_TOLERANCE_PCT = 5.0
+
+#: COST502 superlinearity margin: decode cost may grow at most ~linearly in
+#: the bucket axis (the constant weight term makes true decode sublinear)
+SCALING_MARGIN = 1.05
+
+_COMPONENTS = (
+    "flops",
+    "weights_bytes",
+    "cache_read_bytes",
+    "cache_write_bytes",
+    "act_bytes",
+    "collective_bytes",
+)
+
+#: set by :func:`run` — the per-bucket cost breakdown the CLI embeds under
+#: ``"cost"`` in --json and renders as the text table
+_LAST_REPORT: Dict = {}
+
+
+# ---------------------------------------------------------------------------
+# FLOPs: jaxpr walk
+# ---------------------------------------------------------------------------
+
+
+def _prod(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _dot_flops(eqn) -> int:
+    """2 · |output| · |contracting dims| for one dot_general equation."""
+    (lhs_contract, _), _ = eqn.params["dimension_numbers"]
+    lhs_shape = eqn.invars[0].aval.shape
+    k = 1
+    for d in lhs_contract:
+        k *= int(lhs_shape[d])
+    return 2 * _prod(eqn.outvars[0].aval.shape) * k
+
+
+def _conv_flops(eqn) -> int:
+    """2 · |output| · (kernel spatial · in-channels / groups)."""
+    rhs_shape = eqn.invars[1].aval.shape
+    # the product over every rhs dim except the output-feature dim is
+    # exactly kernel-spatial × in-channels/groups — grouping is already
+    # accounted for by the rhs shape
+    dn = eqn.params["dimension_numbers"]
+    out_feature_dim = dn.rhs_spec[0] if hasattr(dn, "rhs_spec") else 0
+    k = _prod(rhs_shape) // max(1, int(rhs_shape[out_feature_dim]))
+    return 2 * _prod(eqn.outvars[0].aval.shape) * k
+
+
+def _sub_jaxprs(params) -> List[Tuple[object, bool]]:
+    """(closed-or-open jaxpr, is_branch) pairs nested in an eqn's params —
+    covers scan/pjit/while (``jaxpr``-valued params) and cond branch
+    tuples."""
+    out = []
+    for v in params.values():
+        if getattr(v, "jaxpr", None) is not None or hasattr(v, "eqns"):
+            out.append((v, False))
+        elif isinstance(v, (tuple, list)):
+            branches = [b for b in v if getattr(b, "jaxpr", None) is not None]
+            out.extend((b, True) for b in branches)
+    return out
+
+
+def _open(j):
+    return j.jaxpr if getattr(j, "jaxpr", None) is not None else j
+
+
+def jaxpr_flops(jaxpr, multiplier: int = 1) -> int:
+    """Total dot/conv FLOPs of a (closed) jaxpr, scan bodies multiplied by
+    their static trip count, cond branches counted at their max (the
+    executed-path upper bound among branches, a lower bound stays exact
+    when branches match)."""
+    jaxpr = _open(jaxpr)
+    total = 0
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if name == "dot_general":
+            total += multiplier * _dot_flops(eqn)
+            continue
+        if name == "conv_general_dilated":
+            total += multiplier * _conv_flops(eqn)
+            continue
+        inner_mult = multiplier
+        if name == "scan":
+            inner_mult = multiplier * int(eqn.params.get("length", 1))
+        branch_flops = []
+        for sub, is_branch in _sub_jaxprs(eqn.params):
+            f = jaxpr_flops(sub, inner_mult)
+            if is_branch:
+                branch_flops.append(f)
+            else:
+                total += f
+        if branch_flops:
+            total += max(branch_flops)
+    return total
+
+
+# ---------------------------------------------------------------------------
+# bytes: HBM traffic model
+# ---------------------------------------------------------------------------
+
+
+def _shard_bytes(leaf, sharding) -> int:
+    # ONE implementation of shard-shape × true-dtype byte accounting across
+    # the memory and cost suites (memory_audit._sharded_bytes takes trees;
+    # single-leaf lists are trees)
+    from neuronx_distributed_inference_tpu.analysis.memory_audit import (
+        _sharded_bytes,
+    )
+
+    return _sharded_bytes([leaf], [sharding])
+
+
+def weights_bytes(rec) -> int:
+    """Per-device weight bytes in TRUE dtype (int8 codes count 1 byte) —
+    the weight stream a decode step reads once. Same per-leaf math as the
+    memory audit's MEM402 accounting, by construction."""
+    from neuronx_distributed_inference_tpu.analysis.memory_audit import (
+        _sharded_bytes,
+    )
+
+    return _sharded_bytes(rec.params, rec.realized_param_shardings)
+
+
+def cache_traffic(rec) -> Tuple[int, int]:
+    """(read, write) cache bytes per dispatch, per device, TRUE dtype.
+
+    Data leaves (ndim >= 4) are priced per token slot — leaf shard bytes /
+    capacity tokens — read at rows × kv_width and written at q_tokens,
+    clamped to the leaf itself. Scale leaves ((L, H) floats) are read
+    whole: they are noise next to the code stream but belong in the model.
+    """
+    import jax.tree_util as jtu
+
+    meta = rec.shape_meta
+    read = write = 0.0
+    for leaf, sh in zip(
+        jtu.tree_leaves(rec.cache), jtu.tree_leaves(rec.realized_cache_shardings)
+    ):
+        nbytes = _shard_bytes(leaf, sh)
+        if getattr(leaf, "ndim", 0) >= 4 and meta.cache_capacity_tokens:
+            per_token = nbytes / meta.cache_capacity_tokens
+            read += min(nbytes, per_token * meta.rows * meta.kv_width)
+            write += min(nbytes, per_token * meta.q_tokens)
+        else:
+            read += nbytes
+    return int(read), int(write)
+
+
+def act_bytes(rec) -> int:
+    """Residual-stream traffic model: the (q_tokens, hidden) bf16 hidden
+    state crosses HBM twice per layer (read + write at the layer boundary;
+    everything inside a layer is XLA-fused), plus the fp32 logits row per
+    batch row. A lower bound — attention intermediates never materialize
+    on the kernel paths."""
+    meta = rec.shape_meta
+    return int(
+        meta.q_tokens * meta.hidden * 2 * meta.layers * 2
+        + meta.rows * meta.vocab * 4
+    )
+
+
+# ---------------------------------------------------------------------------
+# collective bytes (rides the census)
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_DEF_RE = {
+    op: re.compile(r"%?" + op + r"(?:-start)?(?:\.\d+)? = ")
+    for op in programs.COLLECTIVE_OPS
+}
+
+
+def collective_bytes(hlo_text: str) -> Dict[str, int]:
+    """Bytes per collective op in a compiled module: the largest buffer in
+    each collective's RESULT type (for ``-start`` tuples that is the
+    gathered output), summed per op — the existing census with bytes
+    attached."""
+    from neuronx_distributed_inference_tpu.analysis.shard_audit import (
+        _max_buffer_bytes,
+    )
+
+    out = {op: 0 for op in programs.COLLECTIVE_OPS}
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        if " = " not in s:
+            continue
+        for op in programs.COLLECTIVE_OPS:
+            if f" {op}(" not in s and f" {op}-start(" not in s:
+                continue
+            if not _COLLECTIVE_DEF_RE[op].search(s):
+                continue
+            rhs = s.split(" = ", 1)[1]
+            result_part = rhs.split(op, 1)[0]
+            out[op] += _max_buffer_bytes(result_part)
+            break
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the census + projection for one record
+# ---------------------------------------------------------------------------
+
+
+def _model_group(rec) -> int:
+    from neuronx_distributed_inference_tpu.analysis.shard_audit import (
+        _model_group_size,
+    )
+
+    return _model_group_size(rec.mesh)
+
+
+def _hlo_argument_bytes(rec) -> Optional[int]:
+    try:
+        ma = rec.compiled.memory_analysis()
+        v = getattr(ma, "argument_size_in_bytes", None)
+        return int(v) if v is not None else None
+    except Exception:
+        return None
+
+
+def cost_census(rec) -> Dict:
+    """The full static cost record for one (tag, bucket) program."""
+    meta = rec.shape_meta
+    if meta is None:
+        raise ValueError(f"{rec.tag}/{rec.bucket}: ProgramRecord has no shape_meta")
+    group = _model_group(rec)
+    from neuronx_distributed_inference_tpu.analysis.memory_audit import (
+        _sharded_bytes,
+    )
+
+    flops = jaxpr_flops(rec.jaxpr)
+    w = weights_bytes(rec)
+    cache_resident = _sharded_bytes(rec.cache, rec.realized_cache_shardings)
+    cr, cw = cache_traffic(rec)
+    act = act_bytes(rec)
+    coll = collective_bytes(rec.compiled_text)
+    hbm = w + cr + cw + act
+    flops_dev = flops // max(1, group)
+    spec = device_model.get_device()
+    t_flops = flops_dev / spec.peak("bfloat16")
+    t_hbm = hbm / spec.hbm_bw
+    t_ici = sum(coll.values()) / spec.ici_bw
+    t_step = max(t_flops, t_hbm, t_ici)
+    # tok_s_ub is an UPPER bound: CTE processes its whole prompt, decode
+    # commits one token per row, and a fused-speculation step commits up to
+    # spec_len+1 tokens per row at full acceptance
+    useful = (
+        meta.q_tokens
+        if rec.phase == programs.PHASE_CTE
+        else meta.rows * (meta.spec_len + 1)
+    )
+    intensity = flops_dev / max(1, hbm)
+    return {
+        "flops": int(flops),
+        "flops_per_device": int(flops_dev),
+        "weights_bytes": int(w),
+        "cache_read_bytes": int(cr),
+        "cache_write_bytes": int(cw),
+        "act_bytes": int(act),
+        "hbm_bytes": int(hbm),
+        "cache_resident_bytes": int(cache_resident),
+        "collective_bytes": int(sum(coll.values())),
+        "collective_bytes_by_op": {k: v for k, v in coll.items() if v},
+        "hlo_argument_bytes": _hlo_argument_bytes(rec),
+        "intensity_flops_per_byte": round(intensity, 3),
+        "classification": (
+            "compute" if intensity >= spec.ridge_flops_per_byte else "bandwidth"
+        ),
+        "projection": {
+            "device": spec.name,
+            "t_flops_us": round(t_flops * 1e6, 3),
+            "t_hbm_us": round(t_hbm * 1e6, 3),
+            "t_ici_us": round(t_ici * 1e6, 3),
+            "t_step_lb_us": round(t_step * 1e6, 3),
+            "tok_s_ub": round(useful / t_step, 1) if t_step else None,
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# COST502: bucket-scaling sanity (pure, for the proven-detector test)
+# ---------------------------------------------------------------------------
+
+
+def scaling_findings(
+    tag: str,
+    per_bucket: Dict[int, Dict[str, int]],
+    margin: float = SCALING_MARGIN,
+) -> List[Finding]:
+    """Decode-phase cost must scale (sub-)linearly in the bucket axis:
+    for consecutive buckets W1 < W2, f(W2) <= f(W1) · (W2/W1) · margin for
+    FLOPs and every byte component. The constant weight term makes real
+    decode strictly sublinear; an O(T²) term (decode attending (W, W))
+    makes it superlinear and trips."""
+    findings: List[Finding] = []
+    buckets = sorted(per_bucket)
+    for w1, w2 in zip(buckets, buckets[1:]):
+        ratio = w2 / w1
+        for comp in ("flops", "cache_read_bytes", "act_bytes"):
+            f1 = per_bucket[w1].get(comp, 0)
+            f2 = per_bucket[w2].get(comp, 0)
+            if f1 <= 0:
+                continue
+            if f2 > f1 * ratio * margin:
+                findings.append(
+                    Finding(
+                        rule="COST502",
+                        severity=SEV_ERROR,
+                        location=f"{tag}/{w2}",
+                        message=(
+                            f"{comp} scales SUPERLINEARLY in the bucket axis: "
+                            f"{f1} @ {w1} -> {f2} @ {w2} "
+                            f"(x{f2 / f1:.2f} for a x{ratio:.1f} bucket; "
+                            f"linear bound {int(f1 * ratio * margin)}) — a "
+                            f"decode-phase program grew an O(T^2) term "
+                            f"(attention over (W, W) instead of (q, W)?)"
+                        ),
+                        key=tag,
+                    )
+                )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# COST503: mixed-step packing efficiency
+# ---------------------------------------------------------------------------
+
+
+def observed_packing(mixed_records: Dict[int, object]) -> Dict:
+    """The mixed family's committed packing contract: q tile, slot count,
+    and per-bucket ALL-DECODE efficiency — useful tokens (one per active
+    row, rows bounded by bucket // q_tile) over compute tokens (the
+    bucket). The worst-case steady-state serving step; prefill chunks only
+    improve it."""
+    any_rec = next(iter(mixed_records.values()))
+    meta = any_rec.shape_meta
+    eff = {}
+    for bucket in sorted(mixed_records):
+        active = min(meta.rows, bucket // max(1, meta.q_tile))
+        eff[str(bucket)] = round(active / bucket, 6)
+    return {"q_tile": meta.q_tile, "num_rows": meta.rows, "efficiency": eff}
+
+
+def packing_findings(observed: Dict, expected: Optional[Dict]) -> List[Finding]:
+    """COST503 comparator (standalone for the proven-detector test)."""
+    tag = programs.TAG_MIXED_STEP
+    if not expected:
+        return [
+            Finding(
+                rule="COST503",
+                severity=SEV_ERROR,
+                location=tag,
+                message=(
+                    "no committed mixed-step packing contract in "
+                    "cost_baseline.json — run --write-baseline and review"
+                ),
+                key=tag,
+            )
+        ]
+    findings: List[Finding] = []
+    for field in ("q_tile", "num_rows"):
+        if observed.get(field) != expected.get(field):
+            findings.append(
+                Finding(
+                    rule="COST503",
+                    severity=SEV_ERROR,
+                    location=tag,
+                    message=(
+                        f"mixed-step packing contract drifted: {field} "
+                        f"{expected.get(field)} -> {observed.get(field)} — a "
+                        f"packing-granule change moves the padded-token "
+                        f"fraction of every serving step; regenerate the "
+                        f"baseline only after reviewing the efficiency table"
+                    ),
+                    key=tag,
+                )
+            )
+    exp_eff = expected.get("efficiency", {})
+    for bucket, eff in observed.get("efficiency", {}).items():
+        exp = exp_eff.get(bucket)
+        if exp is None:
+            findings.append(
+                Finding(
+                    rule="COST503",
+                    severity=SEV_ERROR,
+                    location=f"{tag}/{bucket}",
+                    message=(
+                        f"no committed all-decode efficiency for mixed bucket "
+                        f"{bucket} — the bucket ladder changed; regenerate "
+                        f"and review"
+                    ),
+                    key=tag,
+                )
+            )
+        elif eff < exp - 1e-9:
+            findings.append(
+                Finding(
+                    rule="COST503",
+                    severity=SEV_ERROR,
+                    location=f"{tag}/{bucket}",
+                    message=(
+                        f"mixed-step all-decode efficiency REGRESSED at "
+                        f"bucket {bucket}: {exp} -> {eff} (useful/compute "
+                        f"tokens) — more of every serving dispatch is "
+                        f"padding; review before re-pinning"
+                    ),
+                    key=tag,
+                )
+            )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+
+def load_cost_baseline(path: Optional[pathlib.Path] = None) -> Dict:
+    p = path or BASELINE_PATH
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except FileNotFoundError:
+        return {}
+
+
+def save_cost_baseline(data: Dict, path: Optional[pathlib.Path] = None):
+    p = path or BASELINE_PATH
+    with open(p, "w") as f:
+        json.dump(data, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def _baseline_row(census: Dict) -> Dict:
+    row = {comp: census[comp] for comp in _COMPONENTS}
+    row["classification"] = census["classification"]
+    return row
+
+
+def last_report() -> Dict:
+    """Per-bucket cost breakdown of the most recent :func:`run` (the CLI's
+    ``"cost"`` JSON section / text table)."""
+    return dict(_LAST_REPORT)
+
+
+def render_breakdown(report: Optional[Dict] = None) -> str:
+    """Human-readable per-(tag, bucket) cost + projection table."""
+    report = report if report is not None else last_report()
+    progs = report.get("programs") if report else None
+    if not progs:
+        return ""
+    lines = [
+        "per-(phase, bucket) static cost model "
+        "(per-device bytes; projection vs "
+        f"{device_model.DEFAULT_DEVICE} nameplate):",
+        f"  {'program':<28} {'bucket':>6} {'MFLOPs':>8} {'hbm_KB':>8} "
+        f"{'coll_KB':>8} {'bound':>10} {'t_lb_us':>8}",
+    ]
+    for tag in sorted(progs):
+        for bucket in sorted(progs[tag], key=int):
+            row = progs[tag][bucket]
+            lines.append(
+                f"  {tag:<28} {bucket:>6} "
+                f"{row['flops'] / 1e6:>8.2f} {row['hbm_bytes'] / 1e3:>8.1f} "
+                f"{row['collective_bytes'] / 1e3:>8.1f} "
+                f"{row['classification']:>10} "
+                f"{row['projection']['t_step_lb_us']:>8.1f}"
+            )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+
+def run(
+    write_baseline: bool = False,
+    baseline_path: Optional[pathlib.Path] = None,
+    tags: Tuple[str, ...] = COST_AUDIT_TAGS,
+    tolerance_pct: Optional[float] = None,
+) -> List[Finding]:
+    """Run the cost audit over the requested tags; return findings."""
+    global _LAST_REPORT
+    findings: List[Finding] = []
+    results = programs.collect_programs(tuple(tags))
+    baseline = load_cost_baseline(baseline_path)
+    tol = (
+        tolerance_pct
+        if tolerance_pct is not None
+        else float(baseline.get("tolerance_pct", DEFAULT_TOLERANCE_PCT))
+    )
+    base_programs = baseline.get("programs", {})
+    observed: Dict[str, Dict[str, Dict]] = {}
+
+    for tag, per_bucket in results.items():
+        observed[tag] = {}
+        for bucket in sorted(per_bucket):
+            rec = per_bucket[bucket]
+            census = cost_census(rec)
+            observed[tag][str(bucket)] = census
+            # -- validity cross-check vs XLA's own accounting --------------
+            args_bytes = census["hlo_argument_bytes"]
+            resident = census["weights_bytes"] + census["cache_resident_bytes"]
+            if args_bytes is not None and resident > args_bytes * 1.05:
+                findings.append(
+                    Finding(
+                        rule="COST501",
+                        severity=SEV_ERROR,
+                        location=f"{tag}/{bucket}",
+                        message=(
+                            f"cost model claims {resident} resident "
+                            f"weight+cache bytes but the compiled "
+                            f"executable's memory_analysis puts ALL "
+                            f"arguments at {args_bytes} — the byte model "
+                            f"diverged from the program it describes"
+                        ),
+                        key=tag,
+                    )
+                )
+            if write_baseline:
+                continue
+            # -- COST501 census gate ---------------------------------------
+            expected = base_programs.get(tag, {}).get(str(bucket))
+            if expected is None:
+                findings.append(
+                    Finding(
+                        rule="COST501",
+                        severity=SEV_ERROR,
+                        location=f"{tag}/{bucket}",
+                        message=(
+                            f"no committed cost census for ({tag}, {bucket}) "
+                            f"— run --write-baseline and review/commit "
+                            f"cost_baseline.json"
+                        ),
+                        key=tag,
+                    )
+                )
+            else:
+                for comp in _COMPONENTS:
+                    old = int(expected.get(comp, 0))
+                    new = int(census[comp])
+                    if old == new:
+                        continue
+                    pct = abs(new - old) / max(old, 1) * 100.0
+                    if pct <= tol:
+                        continue
+                    direction = "grew" if new > old else "shrank"
+                    findings.append(
+                        Finding(
+                            rule="COST501",
+                            severity=SEV_ERROR,
+                            location=f"{tag}/{bucket}",
+                            message=(
+                                f"cost census {comp} {direction} {pct:.1f}% "
+                                f"vs baseline ({old} -> {new}, tolerance "
+                                f"{tol}%) — an intentional cost change must "
+                                f"regenerate cost_baseline.json "
+                                f"(--write-baseline) with the diff reviewed; "
+                                f"an unintentional one is the compute/"
+                                f"bandwidth regression this gate exists for"
+                            ),
+                            key=tag,
+                        )
+                    )
+                # -- COST504 regime pin ------------------------------------
+                exp_class = expected.get("classification")
+                if exp_class and exp_class != census["classification"]:
+                    findings.append(
+                        Finding(
+                            rule="COST504",
+                            severity=SEV_ERROR,
+                            location=f"{tag}/{bucket}",
+                            message=(
+                                f"arithmetic-intensity regime FLIPPED: "
+                                f"{exp_class} -> {census['classification']} "
+                                f"({census['intensity_flops_per_byte']} "
+                                f"FLOP/byte vs ridge "
+                                f"{device_model.get_device().ridge_flops_per_byte:.0f}) "
+                                f"— a dequant/layout change moved this "
+                                f"program across the roofline; review and "
+                                f"regenerate the baseline if intentional"
+                            ),
+                            key=tag,
+                        )
+                    )
+        # -- COST502 bucket scaling (decode-phase families) ----------------
+        any_rec = next(iter(per_bucket.values()))
+        if any_rec.phase != programs.PHASE_CTE and len(per_bucket) >= 2:
+            findings.extend(
+                scaling_findings(
+                    tag, {b: observed[tag][str(b)] for b in per_bucket}
+                )
+            )
+
+    # -- COST503 mixed packing ---------------------------------------------
+    packing = None
+    if programs.TAG_MIXED_STEP in results:
+        packing = observed_packing(results[programs.TAG_MIXED_STEP])
+        if not write_baseline:
+            findings.extend(
+                packing_findings(packing, baseline.get("mixed_packing"))
+            )
+
+    _LAST_REPORT = {"programs": observed}
+    if packing is not None:
+        _LAST_REPORT["mixed_packing"] = packing
+
+    if write_baseline:
+        merged = dict(load_cost_baseline(baseline_path))
+        merged.setdefault("programs", {})
+        for tag, per_bucket in observed.items():
+            merged["programs"][tag] = {
+                b: _baseline_row(c) for b, c in per_bucket.items()
+            }
+        if packing is not None:
+            merged["mixed_packing"] = packing
+        merged["tolerance_pct"] = tol
+        merged["device"] = device_model.DEFAULT_DEVICE
+        save_cost_baseline(merged, baseline_path)
+    return findings

@@ -1,0 +1,484 @@
+"""TPU device-spec registry + the analytic roofline projection model.
+
+One table of nameplate numbers (peak FLOP/s by dtype, HBM GB/s, ICI GB/s)
+and one set of closed-form llama-shaped cost formulas, consumed by THREE
+places so the repo has a single source of truth for "how fast should this
+be":
+
+- :mod:`.cost_audit` projects a lower-bound step time / tok/s for every
+  audited (family, bucket) program from its HLO-derived FLOPs/bytes census;
+- ``bench.py`` emits ``projected_tok_s`` / ``model_error_frac`` beside every
+  measured row (the measured-vs-predicted hook hardware session zero
+  validates);
+- ``python -m neuronx_distributed_inference_tpu.analysis.device_model``
+  prints the markdown projection tables committed in PERF.md — the
+  hand-written estimates those tables replace are gone; regenerate, don't
+  re-type.
+
+The registry numbers are NAMEPLATE (vendor peak). Measured efficiency on
+this stack is ~67–92% of nameplate depending on op mix (PERF.md rounds
+2–5); projections here are therefore LOWER BOUNDS on time (upper bounds on
+tok/s), which is exactly what a regression gate wants: a measured number
+can approach the bound but a model change that moves the bound itself must
+be reviewed.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+# ---------------------------------------------------------------------------
+# device registry
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class DeviceSpec:
+    """Nameplate per-chip numbers. ``peak_flops`` is keyed by compute dtype
+    (matmul operand dtype); fp32 on v5e-class chips runs the bf16x3 path at
+    ~1/3 the bf16 rate (PERF.md round 6)."""
+
+    name: str
+    peak_flops: Dict[str, float]  # dtype -> FLOP/s
+    hbm_bw: float  # bytes/s
+    ici_bw: float  # bytes/s per chip (one direction)
+    hbm_capacity: int  # bytes
+
+    def peak(self, dtype: str) -> float:
+        return self.peak_flops.get(_canon_dtype(dtype), self.peak_flops["bfloat16"])
+
+    @property
+    def ridge_flops_per_byte(self) -> float:
+        """bf16 arithmetic-intensity ridge point: programs above it are
+        compute-bound, below it bandwidth-bound (COST504)."""
+        return self.peak_flops["bfloat16"] / self.hbm_bw
+
+
+def _canon_dtype(dtype: str) -> str:
+    d = str(dtype).lower()
+    if d in ("bf16", "bfloat16"):
+        return "bfloat16"
+    if d in ("f32", "float32"):
+        return "float32"
+    if d.startswith("int8") or d.startswith("fp8") or d.startswith("float8"):
+        return "int8"
+    return d
+
+
+#: per-chip nameplate specs. v5e matches the numbers every PERF.md roofline
+#: already uses (197 TFLOP/s bf16, 819 GB/s HBM); the others are the public
+#: vendor peaks — correct them from measurements if a hardware session
+#: disagrees (the cost baselines pin FLOPs/bytes, not these constants).
+DEVICE_REGISTRY: Dict[str, DeviceSpec] = {
+    "v5e": DeviceSpec(
+        name="v5e",
+        peak_flops={"bfloat16": 197e12, "int8": 394e12, "float32": 197e12 / 3},
+        hbm_bw=819e9,
+        ici_bw=200e9,  # 1600 Gbps
+        hbm_capacity=16 * 1024**3,
+    ),
+    "v5p": DeviceSpec(
+        name="v5p",
+        peak_flops={"bfloat16": 459e12, "int8": 918e12, "float32": 459e12 / 3},
+        hbm_bw=2765e9,
+        ici_bw=600e9,  # 4800 Gbps
+        hbm_capacity=95 * 1024**3,
+    ),
+    "v6e": DeviceSpec(
+        name="v6e",
+        peak_flops={"bfloat16": 918e12, "int8": 1836e12, "float32": 918e12 / 3},
+        hbm_bw=1640e9,
+        ici_bw=448e9,  # 3584 Gbps
+        hbm_capacity=32 * 1024**3,
+    ),
+    "v4": DeviceSpec(
+        name="v4",
+        peak_flops={"bfloat16": 275e12, "int8": 275e12, "float32": 275e12 / 3},
+        hbm_bw=1228e9,
+        ici_bw=300e9,  # 2400 Gbps
+        hbm_capacity=32 * 1024**3,
+    ),
+}
+
+#: the bench's target chip — projections on a host with no resolvable TPU
+#: (the CPU harness) are computed against this spec with model_error_frac
+#: left null (bench contract, tests/test_bench_smoke.py)
+DEFAULT_DEVICE = "v5e"
+
+_KIND_PATTERNS = (
+    # substrings of jax's device_kind / str(device), most specific first
+    ("v5 lite", "v5e"),
+    ("v5e", "v5e"),
+    ("v6 lite", "v6e"),
+    ("v6e", "v6e"),
+    ("v5p", "v5p"),
+    ("v5", "v5p"),  # bare "TPU v5" is the p variant; lite matched above
+    ("v4", "v4"),
+)
+
+
+def resolve_device(device_kind: str) -> Optional[DeviceSpec]:
+    """Map a jax ``device_kind``/``str(device)`` (e.g. ``"TPU v5 lite0"``)
+    to a registry spec; None for CPU/unknown devices (the caller then
+    projects against :data:`DEFAULT_DEVICE` and reports no model error)."""
+    kind = (device_kind or "").lower()
+    if "tpu" not in kind and not kind.startswith("v"):
+        return None
+    for pat, name in _KIND_PATTERNS:
+        if pat in kind:
+            return DEVICE_REGISTRY[name]
+    return None
+
+
+def get_device(name: str = DEFAULT_DEVICE) -> DeviceSpec:
+    return DEVICE_REGISTRY[name]
+
+
+# ---------------------------------------------------------------------------
+# model shapes (bench.py imports these — one definition)
+# ---------------------------------------------------------------------------
+
+LLAMA_1B = dict(
+    model_type="llama",
+    hidden_size=2048,
+    intermediate_size=8192,
+    num_attention_heads=32,
+    num_key_value_heads=8,
+    num_hidden_layers=16,
+    vocab_size=128256,
+    rms_norm_eps=1e-5,
+    rope_theta=500000.0,
+    max_position_embeddings=2048,
+    hidden_act="silu",
+    tie_word_embeddings=True,
+    head_dim=64,
+)
+
+LLAMA_8B = dict(
+    model_type="llama",
+    hidden_size=4096,
+    intermediate_size=14336,
+    num_attention_heads=32,
+    num_key_value_heads=8,
+    num_hidden_layers=32,
+    vocab_size=128256,
+    rms_norm_eps=1e-5,
+    rope_theta=500000.0,
+    max_position_embeddings=2048,
+    hidden_act="silu",
+    tie_word_embeddings=False,
+    head_dim=128,
+)
+
+
+def _itemsize(dtype: str) -> float:
+    return {"bfloat16": 2, "int8": 1, "float32": 4}[_canon_dtype(dtype)]
+
+
+def matmul_params(attrs: dict) -> Dict[str, int]:
+    """Matmul-weight element counts of a llama-shaped model — the weights a
+    decode step must stream from HBM (embedding is a gather, not a stream;
+    tied-embedding models materialize a separate (H, V) lm_head at load, so
+    lm_head always streams)."""
+    H = attrs["hidden_size"]
+    I = attrs["intermediate_size"]
+    nq = attrs["num_attention_heads"]
+    nkv = attrs["num_key_value_heads"]
+    D = attrs.get("head_dim") or H // nq
+    L = attrs["num_hidden_layers"]
+    V = attrs["vocab_size"]
+    per_layer = H * nq * D + 2 * H * nkv * D + nq * D * H + 3 * H * I
+    return {
+        "per_layer": per_layer,
+        "layers_total": per_layer * L,
+        "lm_head": H * V,
+        "total": per_layer * L + H * V,
+    }
+
+
+def kv_bytes_per_token(attrs: dict, kv_dtype: str = "bfloat16") -> float:
+    """Cache bytes one token occupies across all layers (K + V), codes only
+    — the per-(layer, head) scales of a quantized cache are O(L·H) floats,
+    noise next to the code stream."""
+    nkv = attrs["num_key_value_heads"]
+    D = attrs.get("head_dim") or attrs["hidden_size"] // attrs["num_attention_heads"]
+    L = attrs["num_hidden_layers"]
+    return 2 * L * nkv * D * _itemsize(kv_dtype)
+
+
+def decode_projection(
+    attrs: dict,
+    *,
+    batch: int,
+    kv_width: int,
+    weight_dtype: str = "bfloat16",
+    kv_dtype: str = "bfloat16",
+    device: Optional[DeviceSpec] = None,
+    tp: int = 1,
+) -> Dict[str, float]:
+    """Lower-bound decode step time / tok/s on one chip (``tp`` > 1 divides
+    both streams across chips; ICI cost of the per-layer all-reduce is the
+    cost census' job, not this closed form's).
+
+    t_step >= max(weight+KV bytes / HBM bw, matmul+attention FLOPs / peak).
+    Decode on every committed shape is HBM-bound; the FLOPs term exists so
+    large-batch projections stay honest.
+    """
+    spec = device or get_device()
+    mm = matmul_params(attrs)
+    nq = attrs["num_attention_heads"]
+    D = attrs.get("head_dim") or attrs["hidden_size"] // nq
+    L = attrs["num_hidden_layers"]
+
+    weight_bytes = mm["total"] * _itemsize(weight_dtype)
+    kv_read = batch * kv_width * kv_bytes_per_token(attrs, kv_dtype)
+    hbm_bytes = (weight_bytes + kv_read) / tp
+    # per token: every matmul weight once (2 FLOPs/param) + QK^T and PV at
+    # the live kv width (2 + 2 FLOPs per (head, pos, dim) slot)
+    flops = batch * (2 * mm["total"] + 4 * L * nq * D * kv_width) / tp
+
+    t_hbm = hbm_bytes / spec.hbm_bw
+    t_flops = flops / spec.peak("bfloat16")  # matmuls compute in bf16
+    t_step = max(t_hbm, t_flops)
+    return {
+        "t_step_s": t_step,
+        "t_hbm_s": t_hbm,
+        "t_flops_s": t_flops,
+        "tok_s": batch / t_step,
+        "bound": "hbm" if t_hbm >= t_flops else "flops",
+        "weight_bytes": int(weight_bytes),
+        "kv_read_bytes": int(kv_read),
+        "device": spec.name,
+    }
+
+
+def prefill_projection(
+    attrs: dict,
+    *,
+    batch: int,
+    seq: int,
+    weight_dtype: str = "bfloat16",
+    device: Optional[DeviceSpec] = None,
+    tp: int = 1,
+) -> Dict[str, float]:
+    """Lower-bound prefill (context-encoding) pass: matmul FLOPs over S
+    tokens + causal attention FLOPs (S²/2), against peak; plus the one
+    weight stream against HBM."""
+    spec = device or get_device()
+    mm = matmul_params(attrs)
+    nq = attrs["num_attention_heads"]
+    D = attrs.get("head_dim") or attrs["hidden_size"] // nq
+    L = attrs["num_hidden_layers"]
+
+    flops = batch * (2 * mm["total"] * seq + 4 * L * nq * D * seq * seq / 2) / tp
+    hbm_bytes = mm["total"] * _itemsize(weight_dtype) / tp
+    t_flops = flops / spec.peak("bfloat16")
+    t_hbm = hbm_bytes / spec.hbm_bw
+    t_pass = max(t_flops, t_hbm)
+    return {
+        "t_pass_s": t_pass,
+        "tok_s": batch * seq / t_pass,
+        "bound": "flops" if t_flops >= t_hbm else "hbm",
+        "flops": int(flops),
+        "device": spec.name,
+    }
+
+
+# ---------------------------------------------------------------------------
+# bench-row projection table (the non-tiny bench.py suite shapes)
+# ---------------------------------------------------------------------------
+
+#: each measured bench row's analytic shape — kv_width is the TKG bucket the
+#: measured decode actually runs at (bench._suite_params non-tiny values);
+#: kind "serving" projects the aggregate device ceiling at the slot count.
+BENCH_ROW_MODELS: Dict[str, dict] = {
+    "bf16_1b_bs1": dict(model=LLAMA_1B, kind="decode", batch=1, kv_width=512,
+                        weight_dtype="bfloat16", kv_dtype="bfloat16"),
+    "bf16_1b_bs4": dict(model=LLAMA_1B, kind="decode", batch=4, kv_width=512,
+                        weight_dtype="bfloat16", kv_dtype="bfloat16"),
+    "int8_1b_bs1": dict(model=LLAMA_1B, kind="decode", batch=1, kv_width=512,
+                        weight_dtype="int8", kv_dtype="bfloat16"),
+    "serving_1b_int8": dict(model=LLAMA_1B, kind="serving", batch=8,
+                            kv_width=1024, weight_dtype="int8",
+                            kv_dtype="bfloat16"),
+    "serving_1b_int8_ragged": dict(model=LLAMA_1B, kind="serving", batch=8,
+                                   kv_width=1024, weight_dtype="int8",
+                                   kv_dtype="bfloat16"),
+    "serving_1b_int8_ragged_async": dict(model=LLAMA_1B, kind="serving",
+                                         batch=8, kv_width=1024,
+                                         weight_dtype="int8",
+                                         kv_dtype="bfloat16"),
+    # router row, as committed: 2 replicas SHARING one chip, 8-request mix
+    # -> each replica streams its own weight copy for its 4-request share,
+    # so the aggregate ceiling is the batch-4 single-chip projection (NOT
+    # batch-8: two weight streams halve the per-replica bandwidth). On
+    # scale-out hardware bench.py multiplies by the count of
+    # non-overlapping replica meshes instead.
+    "serving_1b_int8_router": dict(model=LLAMA_1B, kind="serving", batch=4,
+                                   kv_width=1024, weight_dtype="int8",
+                                   kv_dtype="bfloat16"),
+    "int8_8b_bs1": dict(model=LLAMA_8B, kind="decode", batch=1, kv_width=512,
+                        weight_dtype="int8", kv_dtype="bfloat16"),
+    "bf16_1b_8k": dict(model=LLAMA_1B, kind="decode", batch=1, kv_width=8704,
+                       weight_dtype="bfloat16", kv_dtype="bfloat16"),
+    "bf16_1b_8k_kvq8": dict(model=LLAMA_1B, kind="decode", batch=1,
+                            kv_width=8704, weight_dtype="bfloat16",
+                            kv_dtype="int8"),
+    "bf16_1b_16k": dict(model=LLAMA_1B, kind="decode", batch=1,
+                        kv_width=16896, weight_dtype="bfloat16",
+                        kv_dtype="bfloat16"),
+    "bf16_1b_16k_kvq8": dict(model=LLAMA_1B, kind="decode", batch=1,
+                             kv_width=16896, weight_dtype="bfloat16",
+                             kv_dtype="int8"),
+}
+
+
+def project_bench_row(name: str, device: Optional[DeviceSpec] = None) -> Optional[dict]:
+    """Projected decode tok/s (device ceiling) for one bench row name; None
+    for rows the table doesn't model."""
+    row = BENCH_ROW_MODELS.get(name)
+    if row is None:
+        return None
+    return decode_projection(
+        row["model"], batch=row["batch"], kv_width=row["kv_width"],
+        weight_dtype=row["weight_dtype"], kv_dtype=row["kv_dtype"],
+        device=device,
+    )
+
+
+#: bench summary-line key -> (row whose projection it compares against,
+#: summary key holding the run's OWN recorded projection or None). A
+#: recorded projection wins over the static table: the run knows things
+#: the table cannot (e.g. the router row's count of non-overlapping
+#: replica meshes on multi-chip hardware), so the bench row and the
+#: --compare report can never disagree about the same run.
+COMPARE_KEYS = (
+    ("value", "bf16_1b_bs1", "projected_tok_s"),
+    ("decode_bs4_tok_s", "bf16_1b_bs4", None),
+    ("int8_1b_tok_s", "int8_1b_bs1", None),
+    ("serving_tok_s", "serving_1b_int8", "serving_projected_tok_s"),
+    ("ragged_tok_s", "serving_1b_int8_ragged", None),
+    ("ragged_async_tok_s", "serving_1b_int8_ragged_async", None),
+    ("router_tok_s", "serving_1b_int8_router", "router_projected_tok_s"),
+    ("int8_8b_tok_s", "int8_8b_bs1", None),
+    ("ctx8k_tok_s", "bf16_1b_8k", None),
+    ("kvq8_8k_tok_s", "bf16_1b_8k_kvq8", None),
+    ("long_ctx_tok_s", "bf16_1b_16k", None),
+    ("kvq8_16k_tok_s", "bf16_1b_16k_kvq8", None),
+)
+
+
+def compare_report(path: str) -> str:
+    """Offline measured-vs-projected report over a committed bench summary
+    (``BENCH_rNN.json`` — either the raw summary line or the driver wrapper
+    with the summary under ``"parsed"``). Informational: per-row error
+    fractions, no gate — hardware session zero's comparison tool."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict):
+        raise ValueError(
+            f"bench summary must be a JSON object, got {type(data).__name__}"
+        )
+    if isinstance(data.get("parsed"), dict):
+        data = data["parsed"]
+    device_str = str(data.get("device") or "")
+    spec = resolve_device(device_str)
+    resolved = spec is not None
+    spec = spec or get_device()
+    note = "" if resolved else (
+        f", UNRESOLVED: projecting {DEFAULT_DEVICE} — errors are not meaningful"
+    )
+    lines = [
+        f"measured-vs-projected (device {device_str or '<none>'} -> "
+        f"{spec.name} spec{note})",
+        f"  {'row':<30} {'measured':>10} {'projected':>10} {'err':>8}  bound",
+    ]
+    n = 0
+    for key, row_name, recorded_key in COMPARE_KEYS:
+        measured = data.get(key)
+        if measured is None:
+            continue
+        proj = project_bench_row(row_name, spec)
+        if proj is None:
+            continue
+        recorded = data.get(recorded_key) if recorded_key else None
+        projected = recorded if recorded else proj["tok_s"]
+        err = measured / projected - 1.0
+        lines.append(
+            f"  {row_name:<30} {measured:>10.1f} {projected:>10.1f} "
+            f"{err:>+7.1%}  {proj['bound']}"
+            f"{' (recorded)' if recorded else ''}"
+        )
+        n += 1
+    if n == 0:
+        lines.append("  (no comparable tok/s keys found in the summary)")
+    lines.append(
+        "projections are nameplate lower bounds on time: measured/projected"
+        " - 1 near 0 means device-limited; strongly negative means host/"
+        "relay gap or model error — see PERF.md 'Static roofline cost model'"
+    )
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# PERF.md table renderer
+# ---------------------------------------------------------------------------
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit, div in (("GB", 1e9), ("MB", 1e6), ("KB", 1e3)):
+        if n >= div:
+            return f"{n / div:.1f} {unit}"
+    return f"{n:.0f} B"
+
+
+def render_projection_tables(device: str = DEFAULT_DEVICE) -> str:
+    """The markdown tables PERF.md commits (regenerate with
+    ``python -m neuronx_distributed_inference_tpu.analysis.device_model``)."""
+    spec = get_device(device)
+    out = [
+        f"<!-- generated by python -m neuronx_distributed_inference_tpu."
+        f"analysis.device_model ({spec.name}) — edit the model, not the "
+        f"table -->",
+        "",
+        f"Device: {spec.name} — bf16 peak "
+        f"{spec.peak_flops['bfloat16'] / 1e12:.0f} TFLOP/s, int8 "
+        f"{spec.peak_flops['int8'] / 1e12:.0f}, HBM "
+        f"{spec.hbm_bw / 1e9:.0f} GB/s, ICI {spec.ici_bw / 1e9:.0f} GB/s, "
+        f"ridge {spec.ridge_flops_per_byte:.0f} FLOP/byte.",
+        "",
+        "| bench row | weights | KV read/step | bound | projected tok/s |",
+        "|---|---|---|---|---|",
+    ]
+    for name, row in BENCH_ROW_MODELS.items():
+        p = project_bench_row(name, spec)
+        out.append(
+            f"| {name} (bs={row['batch']}, kv {row['kv_width']}) | "
+            f"{_fmt_bytes(p['weight_bytes'])} | "
+            f"{_fmt_bytes(p['kv_read_bytes'])} | {p['bound']} | "
+            f"{p['tok_s']:.0f} |"
+        )
+    out += [
+        "",
+        "| prefill | prompt | lower-bound wall | prefill tok/s ceiling |",
+        "|---|---|---|---|",
+    ]
+    for name, attrs, seq in (
+        ("1B bf16", LLAMA_1B, 512),
+        ("1B bf16", LLAMA_1B, 2048),
+        ("1B bf16", LLAMA_1B, 8192),
+        ("1B bf16", LLAMA_1B, 16384),
+        ("8B int8", LLAMA_8B, 512),
+    ):
+        p = prefill_projection(attrs, batch=1, seq=seq, device=spec)
+        out.append(
+            f"| {name} | {seq} | {p['t_pass_s'] * 1e3:.0f} ms | "
+            f"{p['tok_s'] / 1e3:.1f}k |"
+        )
+    return "\n".join(out)
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via PERF.md regen
+    print(render_projection_tables())

@@ -49,6 +49,7 @@ from neuronx_distributed_inference_tpu.analysis.programs import (  # noqa: F401
     TAG_CONTEXT_ENCODING,
     TAG_CONTEXT_ENCODING_KVQ8,
     TAG_FUSED_SPECULATION,
+    TAG_FUSED_SPECULATION_KVQ8,
     TAG_TOKEN_GENERATION,
     TAG_TOKEN_GENERATION_KVQ8,
     tiny_config as _tiny_config,
@@ -246,6 +247,7 @@ def run(
             TAG_TOKEN_GENERATION,
             TAG_FUSED_SPECULATION,
             TAG_TOKEN_GENERATION_KVQ8,
+            TAG_FUSED_SPECULATION_KVQ8,
             programs.TAG_MIXED_STEP,
         ):
             hits: List[Tuple[str, Optional[str]]] = []

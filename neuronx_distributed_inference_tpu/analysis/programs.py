@@ -19,7 +19,9 @@ Program families:
 
 - the committed tags the graph audit covers —
   ``context_encoding`` / ``token_generation`` / ``fused_speculation``, the
-  ``*_kvq8`` quantized-cache pair (contiguous cache), and ``mixed_step``
+  ``*_kvq8`` quantized-cache variants (contiguous cache; the
+  ``fused_speculation_kvq8`` variant quantizes BOTH the draft and target
+  caches — the spec-decode path the cost model covers), and ``mixed_step``
   (the ragged mixed prefill+decode serving program on the int8 paged
   cache, bucketed by TOTAL packed query tokens), and
 - two cache-VARIANT decode programs for the memory audit's donation proof:
@@ -40,6 +42,10 @@ TAG_TOKEN_GENERATION = "token_generation"
 TAG_FUSED_SPECULATION = "fused_speculation"
 TAG_CONTEXT_ENCODING_KVQ8 = "context_encoding_kvq8"
 TAG_TOKEN_GENERATION_KVQ8 = "token_generation_kvq8"
+# fused-speculation TKG on the int8 contiguous cache (draft AND target
+# quantized): the spec-decode path ROADMAP item 2 optimizes — committed so
+# the graph/shard/memory/cost audits cover it like the plain kvq8 pair
+TAG_FUSED_SPECULATION_KVQ8 = "fused_speculation_kvq8"
 TAG_TOKEN_GENERATION_RING = "token_generation_ring"
 TAG_TOKEN_GENERATION_PAGED = "token_generation_paged"
 # ragged mixed prefill+decode serving step (serving_ragged): int8 PAGED
@@ -55,6 +61,7 @@ COMMITTED_TAGS = (
     TAG_FUSED_SPECULATION,
     TAG_CONTEXT_ENCODING_KVQ8,
     TAG_TOKEN_GENERATION_KVQ8,
+    TAG_FUSED_SPECULATION_KVQ8,
     TAG_MIXED_STEP,
 )
 #: cache-variant decode programs (memory audit: donation across variants)
@@ -110,6 +117,35 @@ def donation_count(lowered_text: str) -> int:
     )
 
 
+@dataclass(frozen=True)
+class ShapeMeta:
+    """FLOP-relevant shape metadata of one (tag, bucket) program — recorded
+    at build time, where the config is in hand, so the cost audit
+    (:mod:`.cost_audit`) can turn graph-derived FLOP counts into an HBM
+    traffic model without re-deriving the cache layout:
+
+    - ``rows``: batch rows the step serves (serving slots for mixed_step);
+    - ``q_tokens``: query tokens processed per dispatch (CTE: B·S, TKG: B,
+      fused: B·(spec_len+1) verify positions, mixed: the packed bucket);
+    - ``kv_width``: cache positions attention READS per row this bucket
+      (0 for CTE — prefill K/V are activations, not cache reads);
+    - ``cache_capacity_tokens``: total token slots of the cache pool (per
+      cache stream), so per-token cache bytes = leaf bytes / capacity;
+    - ``q_tile``/``spec_len``: the mixed-step packing granule and the
+      fused-speculation draft length (COST503's packing contract).
+    """
+
+    rows: int
+    q_tokens: int
+    kv_width: int
+    cache_capacity_tokens: int
+    hidden: int
+    layers: int
+    vocab: int
+    q_tile: int = 0
+    spec_len: int = 0
+
+
 @dataclass
 class ProgramRecord:
     """One committed (tag, bucket) program plus its audit views."""
@@ -132,6 +168,7 @@ class ProgramRecord:
     mesh: object
     n_param_leaves: int
     cache_param_range: Tuple[int, int]  # flat HLO param numbers of cache leaves
+    shape_meta: Optional[ShapeMeta] = None  # cost-audit metadata
     _compiled_text: Optional[str] = field(default=None, repr=False)
 
     @property
@@ -212,6 +249,18 @@ def _output_cache_shardings(compiled, attr: str = "cache"):
         return None
 
 
+def _cache_capacity(cache, paged: bool) -> int:
+    """Total token slots of a cache pool: rows × positions for the
+    contiguous/ring layout (L, rows, S, H, D), blocks × block_size for the
+    paged layout (L, blocks, H, block_size, D)."""
+    import jax
+
+    for leaf in jax.tree.leaves(cache):
+        if getattr(leaf, "ndim", 0) >= 4:
+            return int(leaf.shape[1] * (leaf.shape[3] if paged else leaf.shape[2]))
+    return 0
+
+
 def _record_from_runner(
     tag: str,
     phase: str,
@@ -220,6 +269,7 @@ def _record_from_runner(
     bucket: int,
     declared_pp,
     declared_cp,
+    shape_meta: Optional[ShapeMeta] = None,
 ) -> ProgramRecord:
     import jax
 
@@ -251,6 +301,7 @@ def _record_from_runner(
         mesh=app.mesh,
         n_param_leaves=n_p,
         cache_param_range=(n_p, n_p + n_c),
+        shape_meta=shape_meta,
         _compiled_text=compiled_text,
     )
 
@@ -314,21 +365,51 @@ def _build_causal(
             (TAG_CONTEXT_ENCODING, PHASE_CTE, app.context_encoding_model),
             (TAG_TOKEN_GENERATION, PHASE_TKG, app.token_generation_model),
         ]
+    window = overrides.get("sliding_window", 0)
+    capacity = _cache_capacity(
+        app.kv_cache, paged=variant in ("paged", "mixed")
+    )
+    B = cfg.tpu_config.batch_size
+
+    def meta(tag, phase, runner, bucket) -> ShapeMeta:
+        base = dict(
+            cache_capacity_tokens=capacity,
+            hidden=cfg.hidden_size,
+            layers=cfg.num_hidden_layers,
+            vocab=cfg.vocab_size,
+        )
+        if tag == TAG_MIXED_STEP:
+            # packed bucket = query tokens; decode rows read the widest
+            # committed kv bucket (the width example_inputs compiles at)
+            return ShapeMeta(
+                rows=runner.num_rows, q_tokens=bucket,
+                kv_width=runner.kv_buckets[-1], q_tile=runner.q_tile, **base
+            )
+        if phase == PHASE_CTE:
+            return ShapeMeta(rows=B, q_tokens=B * bucket, kv_width=0, **base)
+        return ShapeMeta(
+            rows=B, q_tokens=B,
+            kv_width=min(bucket, window) if window else bucket, **base
+        )
+
     out: Dict[str, Dict[int, ProgramRecord]] = {}
     for tag, phase, runner in pairs:
         out[tag] = {
             bucket: _record_from_runner(
-                tag, phase, runner, app, bucket, declared_pp, declared_cp
+                tag, phase, runner, app, bucket, declared_pp, declared_cp,
+                shape_meta=meta(tag, phase, runner, bucket),
             )
             for bucket in runner.buckets
         }
     return out
 
 
-def _build_fused() -> Dict[str, Dict[int, ProgramRecord]]:
+def _build_fused(kv_quant: bool = False) -> Dict[str, Dict[int, ProgramRecord]]:
     """The fused-speculation decode program across ≥2 TKG bucket widths
     (draft chain + target verify in ONE graph). Params/caches/specs are
-    keyed ``{"draft": ..., "target": ...}`` in the program's arg order."""
+    keyed ``{"draft": ..., "target": ...}`` in the program's arg order.
+    ``kv_quant``: both caches on kv_cache_dtype="int8" (the spec-decode
+    path the cost model must cover — ROADMAP item 2)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -345,14 +426,18 @@ def _build_fused() -> Dict[str, Dict[int, ProgramRecord]]:
         TpuFusedSpecModelForCausalLM,
     )
 
+    spec_len = 3
+    overrides = {"kv_cache_dtype": "int8"} if kv_quant else {}
     cfg = tiny_config(
-        speculation_length=3,
+        speculation_length=spec_len,
         enable_fused_speculation=True,
         on_device_sampling_config=OnDeviceSamplingConfig(do_sample=False),
+        **overrides,
     )
     cfg.fused_spec_config = FusedSpecConfig(
-        draft_model_name="tiny-draft", draft_config=tiny_config()
+        draft_model_name="tiny-draft", draft_config=tiny_config(**overrides)
     )
+    tag = TAG_FUSED_SPECULATION_KVQ8 if kv_quant else TAG_FUSED_SPECULATION
     app = TpuFusedSpecModelForCausalLM(None, cfg)
     app.load(random_weights=True)
     declared_pp, declared_cp = app.declared_pspecs()
@@ -363,6 +448,7 @@ def _build_fused() -> Dict[str, Dict[int, ProgramRecord]]:
     cache = {"draft": app.draft_cache, "target": app.target_cache}
     n_p = len(jax.tree.leaves(params))
     n_c = len(jax.tree.leaves(cache))
+    capacity = _cache_capacity(app.target_cache, paged=False)
     per_bucket: Dict[int, ProgramRecord] = {}
     for bucket in app.tkg_buckets:
         inputs = StepInputs(
@@ -377,7 +463,7 @@ def _build_fused() -> Dict[str, Dict[int, ProgramRecord]]:
         compiled_text = compiled.as_text()
         ish = _input_shardings(compiled)
         per_bucket[bucket] = ProgramRecord(
-            tag=TAG_FUSED_SPECULATION,
+            tag=tag,
             phase=PHASE_TKG,
             bucket=bucket,
             jaxpr=traced.jaxpr,
@@ -395,9 +481,19 @@ def _build_fused() -> Dict[str, Dict[int, ProgramRecord]]:
             mesh=app.mesh,
             n_param_leaves=n_p,
             cache_param_range=(n_p, n_p + n_c),
+            shape_meta=ShapeMeta(
+                rows=B,
+                q_tokens=B * (spec_len + 1),
+                kv_width=bucket,
+                cache_capacity_tokens=capacity,
+                hidden=cfg.hidden_size,
+                layers=cfg.num_hidden_layers,
+                vocab=cfg.vocab_size,
+                spec_len=spec_len,
+            ),
             _compiled_text=compiled_text,
         )
-    return {TAG_FUSED_SPECULATION: per_bucket}
+    return {tag: per_bucket}
 
 
 # ---------------------------------------------------------------------------
@@ -414,6 +510,7 @@ _BUILDERS = (
         lambda: _build_causal(kv_quant=True),
     ),
     ((TAG_FUSED_SPECULATION,), _build_fused),
+    ((TAG_FUSED_SPECULATION_KVQ8,), lambda: _build_fused(kv_quant=True)),
     ((TAG_MIXED_STEP,), lambda: _build_causal(variant="mixed")),
     ((TAG_TOKEN_GENERATION_RING,), lambda: _build_causal(variant="ring")),
     ((TAG_TOKEN_GENERATION_PAGED,), lambda: _build_causal(variant="paged")),

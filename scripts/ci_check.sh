@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
 # The pre-PR check: the FULL static-analysis gate (tpulint + flag audit +
-# graph/shard/memory audits) plus the static_analysis pytest subset, as one
-# command with a nonzero exit on ANY finding or test failure.
+# graph/shard/memory audits + the roofline cost audit, COST501-504) plus the
+# static_analysis pytest subset, as one command with a nonzero exit on ANY
+# finding or test failure.
 #
 #   bash scripts/ci_check.sh            # text reports
 #   bash scripts/ci_check.sh --json     # gate report as JSON
@@ -23,7 +24,7 @@ esac
 
 rc=0
 
-echo "== static-analysis gate (lint, flags, graph, shard, memory) =="
+echo "== static-analysis gate (lint, flags, graph, shard, memory, cost) =="
 python scripts/run_static_analysis.py "$@" || rc=$?
 
 echo

@@ -113,6 +113,17 @@ def test_bench_suite_tiny(monkeypatch):
         assert points[p]["rejected"] == 0, points[p]
         assert points[p]["quarantined"] == 0, points[p]
         assert points[p]["preempted"] == 0, points[p]
+    # ISSUE 11 satellite: every row (serving rows included) carries the
+    # static roofline projection; model_error_frac is null on the CPU
+    # harness (no resolvable TPU spec) and populated on hardware
+    for p in ALL_POINTS:
+        assert points[p]["projected_tok_s"] > 0, points[p]
+        assert points[p]["model_error_frac"] is None, points[p]
+    assert final["projected_tok_s"] > 0
+    assert final["model_error_frac"] is None
+    assert final["serving_projected_tok_s"] > 0
+    assert final["serving_model_error_frac"] is None
+    assert final["router_projected_tok_s"] > 0
     assert final["serving_rejected"] == 0
     assert final["serving_quarantined"] == 0
     assert final["serving_preempted"] == 0

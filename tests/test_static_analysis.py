@@ -363,13 +363,15 @@ def test_graph_audit_clean_and_covers_tags():
     assert findings == [], "\n".join(f.render() for f in findings)
     # coverage floor: the audited tag set is the acceptance-criteria set
     # (+ the quantized-cache program set, ISSUE 3; + the ragged mixed-step
-    # serving family, ISSUE 6)
+    # serving family, ISSUE 6; + the fused-speculation int8 variant,
+    # ISSUE 11 — the spec-decode path the cost model covers)
     assert set(graph_audit.AUDIT_TAGS) == {
         "context_encoding",
         "token_generation",
         "fused_speculation",
         "context_encoding_kvq8",
         "token_generation_kvq8",
+        "fused_speculation_kvq8",
         "mixed_step",
     }
     baseline = graph_audit.load_census_baseline()
@@ -378,8 +380,10 @@ def test_graph_audit_clean_and_covers_tags():
     # zeros) would mean the auditor is looking at the wrong HLO
     assert baseline["token_generation"]["all-reduce"] > 0
     # kv-quant must not change the communication pattern: the int8-cache
-    # decode census matches the bf16 one (the scale math is shard-local)
+    # decode census matches the bf16 one (the scale math is shard-local),
+    # for the plain AND the fused-speculation decode programs
     assert baseline["token_generation_kvq8"] == baseline["token_generation"]
+    assert baseline["fused_speculation_kvq8"] == baseline["fused_speculation"]
 
 
 def test_graph_audit_flags_census_drift(tmp_path):
@@ -593,7 +597,8 @@ def test_write_baseline_diff_rendering():
 def test_cli_full_json_schema(capsys):
     """--json over ALL suites: machine-readable report with suite list,
     finding records (rule/severity/location with file:line or tag/bucket),
-    and the memory suite's per-bucket HBM breakdown."""
+    the memory suite's per-bucket HBM breakdown, and the cost suite's
+    per-bucket FLOPs/bytes/projection section."""
     from neuronx_distributed_inference_tpu.analysis.__main__ import main
 
     rc = main(["--json"])
@@ -602,12 +607,12 @@ def test_cli_full_json_schema(capsys):
     import json
 
     report = json.loads(out)
-    assert report["suites"] == ["lint", "flags", "graph", "shard", "memory"]
+    assert report["suites"] == ["lint", "flags", "graph", "shard", "memory", "cost"]
     assert report["new"] == 0
-    assert {"total", "findings", "new_findings", "memory"} <= set(report)
+    assert {"total", "findings", "new_findings", "memory", "cost"} <= set(report)
     for f in report["findings"]:
         assert {"rule", "severity", "location", "message", "key"} <= set(f)
-        assert f["rule"][:3] in ("TPU", "GRA", "MEM", "FLA")
+        assert f["rule"][:3] in ("TPU", "GRA", "MEM", "FLA", "COS")
         # file:line for source rules, tag/bucket for graph rules
         assert (":" in f["location"]) or ("/" in f["location"])
     mem = report["memory"]
@@ -619,6 +624,26 @@ def test_cli_full_json_schema(capsys):
             assert row["total_bytes"] == (
                 row["weights_bytes"] + row["cache_bytes"] + row["temp_bytes"]
             )
+    # the cost section: every audited program carries the full census and a
+    # device projection; the mixed packing contract rides beside it
+    cost = report["cost"]
+    assert {"programs", "mixed_packing"} <= set(cost)
+    for tag in ("token_generation", "fused_speculation_kvq8", "mixed_step"):
+        assert tag in cost["programs"], tag
+        for bucket, row in cost["programs"][tag].items():
+            assert int(bucket) > 0
+            assert row["flops"] > 0
+            assert row["hbm_bytes"] == (
+                row["weights_bytes"] + row["cache_read_bytes"]
+                + row["cache_write_bytes"] + row["act_bytes"]
+            )
+            assert row["classification"] in ("compute", "bandwidth")
+            proj = row["projection"]
+            assert proj["t_step_lb_us"] > 0 and proj["tok_s_ub"] > 0
+            assert proj["t_step_lb_us"] >= max(
+                proj["t_flops_us"], proj["t_hbm_us"], proj["t_ici_us"]
+            )
+    assert cost["mixed_packing"]["q_tile"] > 0
 
 
 # ---------------------------------------------------------------------------
@@ -683,6 +708,7 @@ def test_shard_audit_clean_and_covers_committed_tags():
         "fused_speculation",
         "context_encoding_kvq8",
         "token_generation_kvq8",
+        "fused_speculation_kvq8",
         "mixed_step",
     }
     records = programs.collect_programs(shard_audit.SHARD_AUDIT_TAGS)
@@ -874,6 +900,7 @@ def test_memory_audit_clean_and_covers_cache_variants():
         "fused_speculation",
         "context_encoding_kvq8",
         "token_generation_kvq8",
+        "fused_speculation_kvq8",
         "mixed_step",
         "token_generation_ring",
         "token_generation_paged",
@@ -891,6 +918,16 @@ def test_memory_audit_clean_and_covers_cache_variants():
         aliased = memory_audit.aliased_param_numbers(rec.compiled_text)
         lo, hi = rec.cache_param_range
         assert set(range(lo, hi)) <= aliased, tag
+    # the fused-speculation int8 variant donates BOTH quantized caches:
+    # draft + target × k/v × data/scale = 8 aliased leaves
+    rec = next(iter(records["fused_speculation_kvq8"].values()))
+    assert rec.n_cache_leaves == 8
+    paths = set(memory_audit.cache_leaf_paths(rec))
+    assert {"draft/k/data", "draft/k/scale", "target/v/data",
+            "target/v/scale"} <= paths
+    aliased = memory_audit.aliased_param_numbers(rec.compiled_text)
+    lo, hi = rec.cache_param_range
+    assert set(range(lo, hi)) <= aliased
     report = memory_audit.last_report()
     # the quantized cache halves the bf16 cache bytes (plus small scales)
     bf16 = report["token_generation"]["64"]["cache_bytes"]
@@ -991,6 +1028,296 @@ def test_mem402_detects_footprint_regression(tmp_path):
         baseline_path=tmp_path / "missing.json", tags=("token_generation",)
     )
     assert any(f.rule == "MEM402" and "no committed" in f.message for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# cost audit (COST50x) + device model
+# ---------------------------------------------------------------------------
+
+
+def test_cost_audit_clean_and_census_sane():
+    """The roofline cost auditor over the real programs: zero findings on
+    the committed baseline, every family covered (incl. the fused-spec int8
+    variant), and the census behaves: FLOPs grow with the bucket, decode
+    FLOPs grow SUBlinearly (constant weight term + linear attention), and
+    the quantized cache halves the decode read traffic."""
+    from neuronx_distributed_inference_tpu.analysis import cost_audit, programs
+
+    findings = cost_audit.run()
+    assert findings == [], "\n".join(f.render() for f in findings)
+    assert set(cost_audit.COST_AUDIT_TAGS) == set(programs.ALL_TAGS)
+    report = cost_audit.last_report()
+    progs = report["programs"]
+    assert set(progs) == set(programs.ALL_TAGS)
+    tg = progs["token_generation"]
+    f64, f128 = tg["64"]["flops"], tg["128"]["flops"]
+    assert f64 > 0 and f128 > f64
+    assert f128 < 2 * f64  # sublinear: weights dominate the tiny decode
+    # int8 cache: decode read traffic ~halves vs bf16 (+ tiny scales)
+    q8 = progs["token_generation_kvq8"]
+    assert q8["128"]["cache_read_bytes"] < 0.6 * tg["128"]["cache_read_bytes"]
+    # weights stream identically (cache dtype doesn't touch weights)
+    assert q8["128"]["weights_bytes"] == tg["128"]["weights_bytes"]
+    # CTE flops scale superlinearly in S (causal attention) — and that is
+    # fine: COST502 gates only decode-phase families
+    cte = progs["context_encoding"]
+    assert cte["128"]["flops"] > 2 * cte["64"]["flops"]
+    # the fused-spec int8 variant is costed (ROADMAP item 2's path)
+    assert progs["fused_speculation_kvq8"]["128"]["flops"] > 0
+    # collective bytes ride the census: the tp=2 decode program moves bytes
+    assert tg["128"]["collective_bytes"] > 0
+
+
+def test_jaxpr_flops_counts_scan_multiplied_dots():
+    """The FLOPs walk: a dot inside a scan body counts once per iteration;
+    the closed-form count is exact."""
+    import jax
+    import jax.numpy as jnp
+
+    from neuronx_distributed_inference_tpu.analysis.cost_audit import jaxpr_flops
+
+    W = jnp.ones((4, 16, 16))
+    x = jnp.ones((8, 16))
+
+    def step(x, W):
+        def body(carry, w):
+            return carry @ w, None
+
+        y, _ = jax.lax.scan(body, x, W)
+        return y
+
+    jaxpr = jax.make_jaxpr(step)(x, W)
+    # 4 scan iterations × (8×16 output × 16 contraction × 2)
+    assert jaxpr_flops(jaxpr) == 4 * 2 * 8 * 16 * 16
+
+    def plain(x):
+        return x @ x.T
+
+    assert jaxpr_flops(jax.make_jaxpr(plain)(x)) == 2 * 8 * 8 * 16
+
+
+def test_cost501_detects_census_drift(tmp_path):
+    """Proven detector: a doctored baseline (committed FLOPs 50% below what
+    the tree compiles) must produce COST501 with the component and
+    percentage; a 1% nudge inside the 5% tolerance stays green; a missing
+    bucket is a finding, not silence."""
+    import json
+
+    from neuronx_distributed_inference_tpu.analysis import cost_audit
+
+    good = cost_audit.load_cost_baseline()
+    doctored = json.loads(json.dumps(good))
+    row = doctored["programs"]["token_generation"]["64"]
+    row["flops"] = int(row["flops"] * 0.5)
+    p = tmp_path / "cost_baseline.json"
+    cost_audit.save_cost_baseline(doctored, p)
+    findings = cost_audit.run(baseline_path=p, tags=("token_generation",))
+    c501 = [f for f in findings if f.rule == "COST501"]
+    assert c501, "2x FLOPs over baseline must trip the gate"
+    assert any("flops" in f.message and "grew" in f.message for f in c501)
+    # within tolerance: 1% drift passes with the default 5% gate
+    nudged = json.loads(json.dumps(good))
+    row = nudged["programs"]["token_generation"]["64"]
+    row["act_bytes"] = int(row["act_bytes"] * 1.01)
+    cost_audit.save_cost_baseline(nudged, p)
+    findings = cost_audit.run(baseline_path=p, tags=("token_generation",))
+    assert [f for f in findings if f.rule == "COST501"] == []
+    # missing bucket: loud
+    findings = cost_audit.run(
+        baseline_path=tmp_path / "missing.json", tags=("token_generation",)
+    )
+    assert any(
+        f.rule == "COST501" and "no committed" in f.message for f in findings
+    )
+
+
+def test_cost502_detects_superlinear_scaling():
+    """Proven detector: synthetic per-bucket censuses — an O(T²) FLOPs term
+    trips, linear-plus-constant (real decode) passes."""
+    from neuronx_distributed_inference_tpu.analysis.cost_audit import (
+        scaling_findings,
+    )
+
+    # real decode shape: constant weights + linear attention
+    linear = {
+        64: dict(flops=1000 + 64 * 10, cache_read_bytes=64 * 8, act_bytes=50),
+        128: dict(flops=1000 + 128 * 10, cache_read_bytes=128 * 8, act_bytes=50),
+    }
+    assert scaling_findings("toy", linear) == []
+    # quadratic attention: decode attending (W, W) instead of (1, W)
+    quad = {
+        64: dict(flops=1000 + 64 * 64, cache_read_bytes=64 * 8, act_bytes=50),
+        128: dict(flops=1000 + 128 * 128, cache_read_bytes=128 * 8, act_bytes=50),
+    }
+    findings = scaling_findings("toy", quad)
+    assert len(findings) == 1
+    assert findings[0].rule == "COST502"
+    assert "SUPERLINEARLY" in findings[0].message
+    assert "flops" in findings[0].message
+
+
+def test_cost503_detects_packing_drift(tmp_path):
+    """Proven detector: a doctored packing contract (committed q_tile
+    smaller than the tree's — i.e. the tree regressed to a coarser granule)
+    must produce COST503; a doctored efficiency above the observed one
+    reports the regression; an absent contract is loud."""
+    import json
+
+    from neuronx_distributed_inference_tpu.analysis import cost_audit
+
+    good = cost_audit.load_cost_baseline()
+    doctored = json.loads(json.dumps(good))
+    doctored["mixed_packing"]["q_tile"] = 8
+    p = tmp_path / "cost_baseline.json"
+    cost_audit.save_cost_baseline(doctored, p)
+    findings = cost_audit.run(baseline_path=p, tags=("mixed_step",))
+    c503 = [f for f in findings if f.rule == "COST503"]
+    assert any("q_tile" in f.message for f in c503)
+    # efficiency regression direction (pure comparator)
+    observed = dict(q_tile=16, num_rows=2, efficiency={"32": 0.03125})
+    expected = dict(q_tile=16, num_rows=2, efficiency={"32": 0.0625})
+    findings = cost_audit.packing_findings(observed, expected)
+    assert any("REGRESSED" in f.message for f in findings)
+    # observed == expected: clean
+    assert cost_audit.packing_findings(expected, expected) == []
+    # absent contract: loud
+    assert any(
+        "no committed" in f.message
+        for f in cost_audit.packing_findings(expected, None)
+    )
+
+
+def test_cost504_detects_regime_flip(tmp_path):
+    """Proven detector: a baseline that pins a program compute-bound while
+    the tree compiles it bandwidth-bound must produce COST504 (the
+    dequant/layout-flip gate)."""
+    import json
+
+    from neuronx_distributed_inference_tpu.analysis import cost_audit
+
+    good = cost_audit.load_cost_baseline()
+    doctored = json.loads(json.dumps(good))
+    doctored["programs"]["token_generation"]["64"]["classification"] = "compute"
+    p = tmp_path / "cost_baseline.json"
+    cost_audit.save_cost_baseline(doctored, p)
+    findings = cost_audit.run(baseline_path=p, tags=("token_generation",))
+    c504 = [f for f in findings if f.rule == "COST504"]
+    assert len(c504) == 1
+    assert "FLIPPED" in c504[0].message
+    assert "compute -> bandwidth" in c504[0].message
+
+
+def test_device_model_projections():
+    """The analytic roofline: registry resolution, the committed 1B/8B
+    numbers PERF.md cites, and the dtype/width monotonicities the bench
+    rows rely on."""
+    from neuronx_distributed_inference_tpu.analysis import device_model as dm
+
+    # device_kind resolution (the bench's device strings)
+    assert dm.resolve_device("TPU v5 lite0").name == "v5e"
+    assert dm.resolve_device("TPU v4").name == "v4"
+    assert dm.resolve_device("cpu") is None
+    assert dm.resolve_device("") is None
+
+    # the committed v5e numbers: 1B bf16 ≈ 330 tok/s, 8B int8 ≈ 110
+    p1 = dm.decode_projection(dm.LLAMA_1B, batch=1, kv_width=512)
+    assert 320 < p1["tok_s"] < 340 and p1["bound"] == "hbm"
+    assert abs(p1["weight_bytes"] - 2.47e9) < 0.05e9
+    p8 = dm.decode_projection(dm.LLAMA_8B, batch=1, kv_width=512,
+                              weight_dtype="int8")
+    assert 100 < p8["tok_s"] < 120
+    # int8 weights project faster than bf16; 16k kv slower than 8k
+    assert dm.decode_projection(dm.LLAMA_1B, batch=1, kv_width=512,
+                                weight_dtype="int8")["tok_s"] > p1["tok_s"]
+    t8k = dm.decode_projection(dm.LLAMA_1B, batch=1, kv_width=8704)["tok_s"]
+    t16k = dm.decode_projection(dm.LLAMA_1B, batch=1, kv_width=16896)["tok_s"]
+    assert t16k < t8k < p1["tok_s"]
+    # quantizing the cache recovers throughput at long context
+    assert dm.decode_projection(dm.LLAMA_1B, batch=1, kv_width=16896,
+                                kv_dtype="int8")["tok_s"] > t16k
+    # prefill: compute-bound at real sequence lengths
+    pf = dm.prefill_projection(dm.LLAMA_1B, batch=1, seq=8192)
+    assert pf["bound"] == "flops" and pf["t_pass_s"] > 0
+    # every bench row the suite measures has a projection model, and the
+    # model's shape (batch / kv bucket / dtypes) matches what run_point's
+    # live projection derives from the SAME suite params — the two
+    # projected_tok_s sources (bench rows vs --compare/PERF tables) can
+    # never silently diverge
+    import bench
+
+    params = bench._suite_params(tiny=False)
+    assert set(dm.BENCH_ROW_MODELS) == set(params)
+    for name, row in dm.BENCH_ROW_MODELS.items():
+        p = params[name]
+        if "serving" in p:
+            s = p["serving"]
+            if "router" in p:
+                exp_batch = max(
+                    1, p["router"]["n_requests"] // p["router"]["replicas"]
+                )
+            else:
+                exp_batch = s["max_seqs"]
+            exp_kv = s["seq"]
+        else:
+            ctx = p["prompt"] + p["gen"]
+            exp_kv = min([b for b in p["tkg"] if b >= ctx] or [max(p["tkg"])])
+            exp_batch = p["batch"]
+        assert row["batch"] == exp_batch, name
+        assert row["kv_width"] == exp_kv, name
+        assert row["weight_dtype"] == (
+            "int8" if p["quantized"] else "bfloat16"
+        ), name
+        assert row["kv_dtype"] == (p.get("extra_tpu") or {}).get(
+            "kv_cache_dtype", "bfloat16"
+        ), name
+    for key, row_name, _recorded in dm.COMPARE_KEYS:
+        assert row_name in dm.BENCH_ROW_MODELS
+
+
+def test_cli_compare_report_exits_zero(tmp_path, capsys):
+    """--compare: the offline measured-vs-projected report over a bench
+    summary file — per-row error lines, exit 0 (informational), both the
+    raw summary and the driver-wrapper ({"parsed": ...}) formats."""
+    import json
+
+    from neuronx_distributed_inference_tpu.analysis.__main__ import main
+
+    summary = {
+        "value": 248.8, "int8_1b_tok_s": 410.1, "serving_tok_s": 113.8,
+        "device": "TPU v5 lite0",
+    }
+    p = tmp_path / "BENCH_r99.json"
+    p.write_text(json.dumps({"rc": 0, "parsed": summary}))
+    rc = main(["--compare", str(p)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "bf16_1b_bs1" in out and "serving_1b_int8" in out
+    assert "v5e" in out
+    # measured 248.8 vs the 329 ceiling: ~-24%
+    assert "-24" in out
+    # raw-summary format parses identically
+    p2 = tmp_path / "raw.json"
+    p2.write_text(json.dumps(summary))
+    assert main(["--compare", str(p2)]) == 0
+    capsys.readouterr()
+    # a summary that RECORDS its own projection (the router row's
+    # mesh-scaled ceiling) wins over the static table — the bench row and
+    # the offline report can never disagree about one run
+    p3 = tmp_path / "recorded.json"
+    p3.write_text(json.dumps({
+        "router_tok_s": 4000.0, "router_projected_tok_s": 4782.0,
+        "device": "TPU v5 lite0",
+    }))
+    assert main(["--compare", str(p3)]) == 0
+    out = capsys.readouterr().out
+    assert "4782.0" in out and "(recorded)" in out
+    assert "-16" in out  # 4000/4782 - 1, not an impossible +67% vs 2391
+    # --compare is standalone: combining it with gate flags must error
+    # (exit 2), never silently skip the gate
+    with pytest.raises(SystemExit) as exc:
+        main(["--compare", str(p2), "--json"])
+    assert exc.value.code not in (0, None)
+    assert "standalone" in capsys.readouterr().err
 
 
 def _hot_path_snippet(omit=()):
