@@ -286,6 +286,87 @@ def prefill_projection(
     }
 
 
+#: the bench spec-serving draft shape: a 1B-width, 4-layer truncation (the
+#: EAGLE-class "few-layer draft over the target's width" regime; bench.py's
+#: spec-ragged row builds its random-weight draft from the same dict so the
+#: projection and the measurement share one shape definition)
+LLAMA_1B_DRAFT4 = dict(LLAMA_1B, num_hidden_layers=4)
+
+
+def expected_accept_tokens(acceptance: float, draft_len: int) -> float:
+    """Expected tokens committed per speculation round under greedy
+    contiguous-match verification with per-draft acceptance probability
+    ``acceptance`` and ``draft_len`` drafted tokens: the leading-match
+    length of a geometric chain, 1 + a + a² + … + a^L (PERF.md
+    "acceptance-vs-tok/s"). At a = 0.8, L = 3 that is 2.95 tokens/round."""
+    a = float(acceptance)
+    L = int(draft_len)
+    if a >= 1.0:
+        return L + 1.0
+    return (1.0 - a ** (L + 1)) / (1.0 - a)
+
+
+def spec_decode_projection(
+    attrs: dict,
+    *,
+    batch: int,
+    kv_width: int,
+    acceptance: float,
+    draft_len: int,
+    draft_attrs: Optional[dict] = None,
+    weight_dtype: str = "bfloat16",
+    kv_dtype: str = "bfloat16",
+    device: Optional[DeviceSpec] = None,
+    tp: int = 1,
+) -> Dict[str, float]:
+    """Draft-assisted decode ceiling at a given ACCEPTANCE RATE — the
+    acceptance-parameterized projection the spec-serving bench row and
+    ``--compare`` consume.
+
+    One round = one packed verify pass over ``draft_len + 1`` query tokens
+    per row (HBM cost == a plain decode step: weights stream once, the KV
+    read is the same cache walk; FLOPs scale by the extra query tokens —
+    still far under the ridge at serving widths) + ``draft_len`` sequential
+    draft decode steps on ``draft_attrs`` (default :data:`LLAMA_1B_DRAFT4`).
+    Expected committed tokens/round follow the geometric acceptance chain
+    (:func:`expected_accept_tokens`), so::
+
+        tok_s = batch * E[tokens/round] / (t_verify + draft_len * t_draft)
+
+    At acceptance 1.0 with a free draft this recovers (draft_len+1)× the
+    plain decode ceiling; at acceptance 0 it degrades to plain decode taxed
+    by the draft — the model PERF r5's ">500 tok/s at int8+EAGLE
+    (acceptance 0.8)" figure comes from."""
+    spec = device or get_device()
+    verify = decode_projection(
+        attrs, batch=batch, kv_width=kv_width, weight_dtype=weight_dtype,
+        kv_dtype=kv_dtype, device=spec, tp=tp,
+    )
+    # the verify pass computes draft_len+1 query positions per row: same
+    # HBM traffic, (draft_len+1)x the matmul/attention FLOPs
+    t_verify = max(verify["t_hbm_s"], verify["t_flops_s"] * (draft_len + 1))
+    d_attrs = draft_attrs if draft_attrs is not None else LLAMA_1B_DRAFT4
+    draft_step = decode_projection(
+        d_attrs, batch=batch, kv_width=kv_width, weight_dtype=weight_dtype,
+        kv_dtype=kv_dtype, device=spec, tp=tp,
+    )
+    t_round = t_verify + draft_len * draft_step["t_step_s"]
+    e_tokens = expected_accept_tokens(acceptance, draft_len)
+    return {
+        "t_round_s": t_round,
+        "t_verify_s": t_verify,
+        "t_draft_s": draft_len * draft_step["t_step_s"],
+        "expected_tokens_per_round": e_tokens,
+        "acceptance": float(acceptance),
+        "draft_len": int(draft_len),
+        "tok_s": batch * e_tokens / t_round,
+        "bound": verify["bound"],
+        "weight_bytes": verify["weight_bytes"],
+        "kv_read_bytes": verify["kv_read_bytes"],
+        "device": spec.name,
+    }
+
+
 # ---------------------------------------------------------------------------
 # bench-row projection table (the non-tiny bench.py suite shapes)
 # ---------------------------------------------------------------------------
@@ -310,6 +391,17 @@ BENCH_ROW_MODELS: Dict[str, dict] = {
                                          batch=8, kv_width=1024,
                                          weight_dtype="int8",
                                          kv_dtype="bfloat16"),
+    # spec-serving row (serving_spec_ragged): the acceptance-parameterized
+    # projection — PERF r5's committed operating point is acceptance 0.8
+    # with a k=4 program (3 drafts); bench.py records the MEASURED
+    # acceptance beside it (spec_ragged_acceptance) so hardware session
+    # zero can re-project at the observed rate before judging the error
+    "serving_1b_int8_spec_ragged": dict(model=LLAMA_1B, kind="serving_spec",
+                                        batch=8, kv_width=1024,
+                                        weight_dtype="int8",
+                                        kv_dtype="bfloat16",
+                                        acceptance=0.8, draft_len=3,
+                                        draft=LLAMA_1B_DRAFT4),
     # router row, as committed: 2 replicas SHARING one chip, 8-request mix
     # -> each replica streams its own weight copy for its 4-request share,
     # so the aggregate ceiling is the batch-4 single-chip projection (NOT
@@ -337,10 +429,19 @@ BENCH_ROW_MODELS: Dict[str, dict] = {
 
 def project_bench_row(name: str, device: Optional[DeviceSpec] = None) -> Optional[dict]:
     """Projected decode tok/s (device ceiling) for one bench row name; None
-    for rows the table doesn't model."""
+    for rows the table doesn't model. ``serving_spec`` rows project through
+    the acceptance-parameterized speculative model."""
     row = BENCH_ROW_MODELS.get(name)
     if row is None:
         return None
+    if row.get("kind") == "serving_spec":
+        return spec_decode_projection(
+            row["model"], batch=row["batch"], kv_width=row["kv_width"],
+            acceptance=row["acceptance"], draft_len=row["draft_len"],
+            draft_attrs=row.get("draft"),
+            weight_dtype=row["weight_dtype"], kv_dtype=row["kv_dtype"],
+            device=device,
+        )
     return decode_projection(
         row["model"], batch=row["batch"], kv_width=row["kv_width"],
         weight_dtype=row["weight_dtype"], kv_dtype=row["kv_dtype"],
@@ -361,6 +462,10 @@ COMPARE_KEYS = (
     ("serving_tok_s", "serving_1b_int8", "serving_projected_tok_s"),
     ("ragged_tok_s", "serving_1b_int8_ragged", None),
     ("ragged_async_tok_s", "serving_1b_int8_ragged_async", None),
+    # the spec row records its own projection: the bench re-projects at the
+    # MEASURED acceptance rate, which the static table cannot know
+    ("spec_ragged_tok_s", "serving_1b_int8_spec_ragged",
+     "spec_ragged_projected_tok_s"),
     ("router_tok_s", "serving_1b_int8_router", "router_projected_tok_s"),
     ("int8_8b_tok_s", "int8_8b_bs1", None),
     ("ctx8k_tok_s", "bf16_1b_8k", None),

@@ -53,6 +53,12 @@ TAG_TOKEN_GENERATION_PAGED = "token_generation_paged"
 # MixedStepRunner) — committed so the graph/shard/memory audits cover the
 # one-dispatch serving program family from day one
 TAG_MIXED_STEP = "mixed_step"
+# the SPEC-VERIFY variant of the mixed family (serving_spec_ragged,
+# spec_width = speculation_length): spec rows pack draft tokens as extra
+# query positions, the program gathers per-row verify windows and computes
+# the greedy acceptance count on device — committed so the GRAPH/SHARD/MEM/
+# COST audits see the speculative serving program the same day it ships
+TAG_MIXED_STEP_SPEC = "mixed_step_spec"
 
 #: the committed program set (graph + shard audits)
 COMMITTED_TAGS = (
@@ -63,6 +69,7 @@ COMMITTED_TAGS = (
     TAG_TOKEN_GENERATION_KVQ8,
     TAG_FUSED_SPECULATION_KVQ8,
     TAG_MIXED_STEP,
+    TAG_MIXED_STEP_SPEC,
 )
 #: cache-variant decode programs (memory audit: donation across variants)
 CACHE_VARIANT_TAGS = (
@@ -330,7 +337,7 @@ def _build_causal(
         overrides.update(
             is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=18
         )
-    elif variant == "mixed":
+    elif variant in ("mixed", "mixed_spec"):
         from neuronx_distributed_inference_tpu.config import ChunkedPrefillConfig
 
         overrides.update(
@@ -344,6 +351,10 @@ def _build_causal(
             ),
             serving_ragged=True,
         )
+        if variant == "mixed_spec":
+            overrides.update(
+                serving_spec_ragged=True, speculation_length=_SPEC_WIDTH
+            )
     cfg = tiny_config(**overrides)
     app = TpuModelForCausalLM(None, cfg)
     app.load(random_weights=True)
@@ -355,6 +366,8 @@ def _build_causal(
         pairs = [(TAG_TOKEN_GENERATION_PAGED, PHASE_TKG, app.token_generation_model)]
     elif variant == "mixed":
         pairs = [(TAG_MIXED_STEP, PHASE_TKG, app.mixed_step_model)]
+    elif variant == "mixed_spec":
+        pairs = [(TAG_MIXED_STEP_SPEC, PHASE_TKG, app.mixed_step_model)]
     elif kv_quant:
         pairs = [
             (TAG_CONTEXT_ENCODING_KVQ8, PHASE_CTE, app.context_encoding_model),
@@ -367,7 +380,7 @@ def _build_causal(
         ]
     window = overrides.get("sliding_window", 0)
     capacity = _cache_capacity(
-        app.kv_cache, paged=variant in ("paged", "mixed")
+        app.kv_cache, paged=variant in ("paged", "mixed", "mixed_spec")
     )
     B = cfg.tpu_config.batch_size
 
@@ -378,12 +391,16 @@ def _build_causal(
             layers=cfg.num_hidden_layers,
             vocab=cfg.vocab_size,
         )
-        if tag == TAG_MIXED_STEP:
+        if tag in (TAG_MIXED_STEP, TAG_MIXED_STEP_SPEC):
             # packed bucket = query tokens; decode rows read the widest
-            # committed kv bucket (the width example_inputs compiles at)
+            # committed kv bucket (the width example_inputs compiles at);
+            # the spec variant records its draft length (spec_width - 1) so
+            # the cost audit's tok_s upper bound counts the up-to-spec_width
+            # tokens a fully-accepted verify row commits
             return ShapeMeta(
                 rows=runner.num_rows, q_tokens=bucket,
-                kv_width=runner.kv_buckets[-1], q_tile=runner.q_tile, **base
+                kv_width=runner.kv_buckets[-1], q_tile=runner.q_tile,
+                spec_len=getattr(runner, "spec_width", 1) - 1, **base
             )
         if phase == PHASE_CTE:
             return ShapeMeta(rows=B, q_tokens=B * bucket, kv_width=0, **base)
@@ -502,6 +519,9 @@ def _build_fused(kv_quant: bool = False) -> Dict[str, Dict[int, ProgramRecord]]:
 
 _MEMO: Dict[str, Dict[int, ProgramRecord]] = {}
 
+#: spec width of the committed mixed_step_spec program (speculation_length)
+_SPEC_WIDTH = 4
+
 _BUILDERS = (
     # (tags produced together, builder thunk)
     ((TAG_CONTEXT_ENCODING, TAG_TOKEN_GENERATION), lambda: _build_causal()),
@@ -512,6 +532,7 @@ _BUILDERS = (
     ((TAG_FUSED_SPECULATION,), _build_fused),
     ((TAG_FUSED_SPECULATION_KVQ8,), lambda: _build_fused(kv_quant=True)),
     ((TAG_MIXED_STEP,), lambda: _build_causal(variant="mixed")),
+    ((TAG_MIXED_STEP_SPEC,), lambda: _build_causal(variant="mixed_spec")),
     ((TAG_TOKEN_GENERATION_RING,), lambda: _build_causal(variant="ring")),
     ((TAG_TOKEN_GENERATION_PAGED,), lambda: _build_causal(variant="paged")),
 )

@@ -360,6 +360,17 @@ class TpuConfig:
     # debugging). Greedy outputs are byte-identical across sync/async
     # (pinned). Requires serving_ragged.
     serving_ragged_async: Optional[bool] = None
+    # speculative verification INSIDE the ragged mixed step
+    # (runtime/serving.SpeculativeServingSession over the mixed_step_spec
+    # program family): spec rows carry their draft tokens as extra query
+    # positions on the packed axis, one mixed dispatch per step serves
+    # prefill chunks + plain decode + spec-verify rows, accept/rollback
+    # commits against the paged cache, and draft length adapts per request
+    # off the acceptance EWMA. Requires serving_ragged (paged cache +
+    # continuous batching) + chunked prefill + 2 <= speculation_length <= 16
+    # (a spec segment must fit one RAGGED_Q_TILE); greedy-only (the packed
+    # verify computes contiguous-match acceptance on device).
+    serving_spec_ragged: bool = False
     # multi-replica serving front-end (runtime/router.py): how many
     # single-chip replica sessions the ServingRouter runs the demo/bench
     # serving traffic over (1 = no router layer), and the placement policy
@@ -657,6 +668,35 @@ class TpuConfig:
                 "dispatch: set serving_ragged=True (the legacy split path "
                 "already pipelines via async_mode)"
             )
+        if self.serving_spec_ragged:
+            if not self.serving_ragged:
+                raise ValueError(
+                    "serving_spec_ragged packs spec-verify rows into the "
+                    "ragged mixed step: set serving_ragged=True (paged "
+                    "cache + continuous batching)"
+                )
+            if not self.is_chunked_prefill:
+                raise ValueError(
+                    "serving_spec_ragged requires is_chunked_prefill=True: "
+                    "prompt chunks must ride the same mixed dispatch as the "
+                    "spec-verify rows (one program identity per step)"
+                )
+            # 16 == ops/ragged_paged_attention.RAGGED_Q_TILE (kept literal:
+            # config validation must not import kernel modules)
+            if not 2 <= self.speculation_length <= 16:
+                raise ValueError(
+                    "serving_spec_ragged needs 2 <= speculation_length <= "
+                    "16: a spec-verify segment (last token + drafts) must "
+                    "fit one ragged q tile"
+                )
+            ods = self.on_device_sampling_config
+            if ods is not None and getattr(ods, "do_sample", False):
+                raise NotImplementedError(
+                    "serving_spec_ragged is greedy-only: the packed verify "
+                    "computes contiguous-match acceptance on device "
+                    "(sampled accept/reject stays on the split "
+                    "SpeculativeServingSession path)"
+                )
         if (
             self.is_block_kv_layout
             and self.pa_num_blocks is None

@@ -154,6 +154,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="force dispatch+fetch-per-step on the ragged path "
         "(step-accurate debugging)",
     )
+    run.add_argument(
+        "--serving-spec-ragged", action="store_true",
+        help="speculative verification inside the ragged mixed step "
+        "(serving-session config consumed by drivers like bench.py's "
+        "spec-ragged row — the demo itself runs one generate() session): "
+        "spec rows carry draft tokens as extra packed query positions, one "
+        "mixed dispatch per step serves prefill + decode + spec-verify rows "
+        "(requires --serving-ragged, --is-chunked-prefill and "
+        "2 <= --speculation-length <= 16; docs/SERVING.md)",
+    )
     from neuronx_distributed_inference_tpu.config import ROUTER_POLICIES
 
     run.add_argument(
@@ -410,6 +420,7 @@ def create_tpu_config(args) -> TpuConfig:
         chunked_prefill_config=cpc,
         serving_ragged=args.serving_ragged,
         serving_ragged_async=args.serving_ragged_async,
+        serving_spec_ragged=args.serving_spec_ragged,
         serving_replicas=args.serving_replicas,
         router_policy=args.router_policy,
         admission_validation=args.admission_validation,

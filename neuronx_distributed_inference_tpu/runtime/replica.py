@@ -174,14 +174,30 @@ class ReplicaHandle:
         queues behind."""
         return len(self.session.active) + len(self.session._readmit)
 
+    #: weight of the speculation acceptance-EWMA placement term: sub-unit
+    #: (a bonus, never outweighing backlog/occupancy) but large enough that
+    #: between otherwise-equal replicas, spec traffic concentrates where
+    #: drafts are paying
+    ACCEPTANCE_WEIGHT = 0.5
+
+    @property
+    def acceptance_signal(self) -> Optional[float]:
+        """The wrapped session's speculation acceptance-rate EWMA (None for
+        non-speculative sessions, or before any spec round ran)."""
+        return getattr(self.session, "acceptance_ewma", None)
+
     def load_score(self, latency_norm_ms: float) -> float:
         """Telemetry-driven load score (lower = less loaded). Terms, in
         dominance order: the re-admission backlog (each waiting evicted
         request outweighs a full batch — placing more work on a replica
         already preempting is the one unambiguous mistake), occupancy
-        fraction, KV-pool usage fraction (cache-dtype-aware headroom), and
-        the EWMA latency signals normalized by ``latency_norm_ms`` (the max
-        across candidates) so they stay a sub-unit tie-splitter."""
+        fraction, KV-pool usage fraction (cache-dtype-aware headroom), the
+        EWMA latency signals normalized by ``latency_norm_ms`` (the max
+        across candidates) so they stay a sub-unit tie-splitter, MINUS an
+        acceptance-EWMA bonus for speculative replicas whose drafts are
+        paying (spec-friendly traffic — e.g. prose vs code — concentrates
+        where each accepted draft is a free decode token; pinned by the
+        skewed placement test in tests/test_router.py)."""
         s = self.session
         occ_frac = len(s.active) / max(1, s.num_slots)
         backlog = len(s._readmit)
@@ -192,7 +208,11 @@ class ReplicaHandle:
             if latency_norm_ms > 0
             else 0.0
         )
-        return 4.0 * backlog + occ_frac + kv_used_frac + latency
+        accept = self.acceptance_signal or 0.0
+        return (
+            4.0 * backlog + occ_frac + kv_used_frac + latency
+            - self.ACCEPTANCE_WEIGHT * accept
+        )
 
     @property
     def latency_signal_ms(self) -> float:

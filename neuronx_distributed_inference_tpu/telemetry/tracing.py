@@ -190,6 +190,16 @@ class TelemetrySession:
             "nxdi_spec_accept_len",
             "tokens committed per speculation round (sums to committed "
             "decode tokens)", buckets=metrics_mod.ACCEPT_LEN_BUCKETS)
+        self._spec_draft_len = r.histogram(
+            "nxdi_spec_draft_len",
+            "adaptive draft length chosen per spec-ragged round (snapped to "
+            "the session's fixed choice ladder; shrinks when acceptance "
+            "drops)", buckets=metrics_mod.DRAFT_LEN_BUCKETS)
+        self._spec_ewma = r.histogram(
+            "nxdi_spec_accept_ewma",
+            "per-request draft-acceptance-rate EWMA observed after each "
+            "spec-ragged round (the adaptive-draft policy's steering "
+            "signal)", buckets=metrics_mod.SPEC_EWMA_BUCKETS)
         self._step_host_ms = r.histogram(
             "nxdi_step_host_ms",
             "host-side bookkeeping per serving step (scheduling, descriptor "
@@ -528,9 +538,11 @@ class TelemetrySession:
         decode_rows: int,
         padded_slots: int,
         query_tokens: int,
+        spec_rows: int = 0,
     ) -> None:
         """Composition of ONE ragged mixed dispatch (serving_ragged): rows
-        serving prefill chunks, rows serving decode, padded packed slots and
+        serving prefill chunks, rows serving decode, rows serving packed
+        SPEC-VERIFY segments (serving_spec_ragged), padded packed slots and
         real query tokens in the dispatched total-token bucket. Each label's
         observation COUNT equals the number of mixed dispatches (pinned by
         test); padded_slots/(padded_slots+query_tokens) is the padded-token
@@ -541,6 +553,7 @@ class TelemetrySession:
         self._mixed.child(("decode_rows",)).observe(decode_rows)
         self._mixed.child(("padded_slots",)).observe(padded_slots)
         self._mixed.child(("query_tokens",)).observe(query_tokens)
+        self._mixed.child(("spec_rows",)).observe(spec_rows)
 
     # ---- multi-replica router (runtime/router.py) ------------------------
 
@@ -591,6 +604,15 @@ class TelemetrySession:
         if not self.enabled or committed <= 0:
             return
         self._accept.observe(committed)
+
+    def spec_round(self, draft_len: int, accept_ewma: float) -> None:
+        """Adaptive-draft policy signals of one spec-ragged round: the
+        request's NEXT snapped draft length and its acceptance-rate EWMA
+        after the update (docs/OBSERVABILITY.md)."""
+        if not self.enabled:
+            return
+        self._spec_draft_len.observe(draft_len)
+        self._spec_ewma.observe(accept_ewma)
 
     # ---- retrace-guard bridge --------------------------------------------
 

@@ -26,12 +26,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 ALL_POINTS = {
     "bf16_1b_bs1", "bf16_1b_bs4", "int8_1b_bs1", "serving_1b_int8",
     "serving_1b_int8_ragged", "serving_1b_int8_ragged_async",
-    "serving_1b_int8_router", "int8_8b_bs1",
+    "serving_1b_int8_spec_ragged", "serving_1b_int8_router", "int8_8b_bs1",
     "bf16_1b_8k", "bf16_1b_8k_kvq8", "bf16_1b_16k", "bf16_1b_16k_kvq8",
 }
 SERVING_POINTS = {
     "serving_1b_int8", "serving_1b_int8_ragged", "serving_1b_int8_ragged_async",
-    "serving_1b_int8_router",
+    "serving_1b_int8_spec_ragged", "serving_1b_int8_router",
 }
 
 
@@ -65,6 +65,17 @@ def test_bench_suite_tiny(monkeypatch):
     ragged_async = points["serving_1b_int8_ragged_async"]
     assert ragged_async["ttft_ms"] > 0 and ragged_async["itl_ms"] is not None
     assert 0.0 < ragged_async["host_frac"] <= 1.0
+    # ISSUE 12: the spec-ragged row — SAME mix with verification inside
+    # the mixed dispatch; the measured acceptance rate and the acceptance-
+    # parameterized projection ride the row (clean traffic: 0 containment
+    # events, and the random-weight draft pins acceptance near zero — the
+    # overhead-bound regime)
+    spec = points["serving_1b_int8_spec_ragged"]
+    assert spec["ttft_ms"] > 0 and spec["itl_ms"] is not None
+    assert spec["spec_rounds"] > 0
+    assert spec["spec_acceptance"] is not None and 0.0 <= spec["spec_acceptance"] <= 1.0
+    assert spec["projected_tok_s"] > 0
+    assert spec["rejected"] == 0 and spec["quarantined"] == 0
     # ISSUE 10: the multi-replica router row — 2 replicas on partitioned
     # CPU devices, SAME mix. Clean traffic MUST report 0 failovers and 0
     # rejects (per-run deltas, PR 7 convention), and balance_frac (min

@@ -99,6 +99,68 @@ def test_serving_ragged_async_rejected_without_ragged(kwargs):
         TpuConfig(**kwargs)
 
 
+def test_serving_spec_ragged_knob():
+    """ISSUE 12: serving_spec_ragged defaults off, round-trips, and accepts
+    the full valid stack (serving_ragged + paged + continuous + chunked +
+    2 <= speculation_length <= 16)."""
+    from neuronx_distributed_inference_tpu.config import ChunkedPrefillConfig
+
+    tc = TpuConfig()
+    assert tc.serving_spec_ragged is False
+    assert TpuConfig.from_dict(tc.to_dict()).serving_spec_ragged is False
+    ok = TpuConfig(
+        is_continuous_batching=True, is_block_kv_layout=True,
+        is_chunked_prefill=True,
+        chunked_prefill_config=ChunkedPrefillConfig(
+            max_num_seqs=2, kernel_q_tile_size=16
+        ),
+        serving_ragged=True, serving_spec_ragged=True, speculation_length=4,
+    )
+    assert ok.serving_spec_ragged is True
+
+
+@pytest.mark.parametrize(
+    "kwargs, match",
+    [
+        # no serving_ragged at all
+        (dict(serving_spec_ragged=True, speculation_length=4),
+         "serving_spec_ragged"),
+        # ragged but no chunked prefill: prompt chunks must ride the mixed
+        # dispatch (one program identity per step)
+        (dict(serving_spec_ragged=True, speculation_length=4,
+              serving_ragged=True, is_block_kv_layout=True,
+              is_continuous_batching=True),
+         "is_chunked_prefill"),
+        # speculation_length out of the q-tile range
+        (dict(serving_spec_ragged=True, speculation_length=0,
+              serving_ragged=True, is_block_kv_layout=True,
+              is_continuous_batching=True, is_chunked_prefill=True),
+         "speculation_length"),
+        (dict(serving_spec_ragged=True, speculation_length=17,
+              serving_ragged=True, is_block_kv_layout=True,
+              is_continuous_batching=True, is_chunked_prefill=True),
+         "speculation_length"),
+    ],
+)
+def test_serving_spec_ragged_fences(kwargs, match):
+    with pytest.raises(ValueError, match=match):
+        TpuConfig(**kwargs)
+
+
+def test_serving_spec_ragged_greedy_only():
+    from neuronx_distributed_inference_tpu.config import (
+        OnDeviceSamplingConfig,
+    )
+
+    with pytest.raises(NotImplementedError, match="greedy-only"):
+        TpuConfig(
+            is_continuous_batching=True, is_block_kv_layout=True,
+            is_chunked_prefill=True, serving_ragged=True,
+            serving_spec_ragged=True, speculation_length=4,
+            on_device_sampling_config=OnDeviceSamplingConfig(do_sample=True),
+        )
+
+
 def test_router_knob_defaults_and_roundtrip():
     """ISSUE 10: the multi-replica router knobs exist, default to a single
     session with telemetry-driven placement, and round-trip to_dict."""

@@ -351,6 +351,34 @@ def test_least_loaded_balances_skewed_mix(replica_apps):
     router.run_to_completion()
 
 
+def test_least_loaded_acceptance_ewma_concentrates_spec_traffic(replica_apps):
+    """ISSUE 12 satellite: `least_loaded` gains an acceptance-EWMA term —
+    between otherwise-equal replicas, spec-friendly traffic concentrates on
+    the replica whose drafts are paying. Modeled as the skewed
+    code-vs-prose regime: replica 0 has been serving CODE (drafts rejected,
+    low acceptance EWMA), replica 1 PROSE (high EWMA). The signal is the
+    session's ``acceptance_ewma`` attribute — the SpeculativeServingSession
+    maintains it per spec round; here it is set directly so the placement
+    contract is pinned without building draft apps. The term stays
+    sub-unit: a genuinely busier high-acceptance replica still loses."""
+    for app in replica_apps:
+        app.init_kv_cache()
+    sessions = [ServingSession(app) for app in replica_apps]
+    sessions[0].acceptance_ewma = 0.15  # code-ish: drafts mostly rejected
+    sessions[1].acceptance_ewma = 0.90  # prose-ish: drafts paying
+    router = ServingRouter(sessions, policy="least_loaded")
+    assert router.add_request("spec0", [4, 5, 6], max_new_tokens=4)
+    placed = router.requests["spec0"].replica
+    assert placed == 1, placed  # equal load: acceptance decides
+    # dominance order holds: pre-load the high-acceptance replica and the
+    # occupancy term overrides the acceptance bonus
+    for i in range(3):
+        assert sessions[1].add_request(f"busy{i}", [9, 9, 9], max_new_tokens=8)
+    assert router.add_request("spec1", [7, 5, 6], max_new_tokens=4)
+    assert router.requests["spec1"].replica == 0
+    router.run_to_completion()
+
+
 def test_round_robin_cycles_replicas(replica_apps):
     for app in replica_apps:
         app.init_kv_cache()

@@ -91,11 +91,32 @@ def sync_ragged_app(paged_apps):
     return TpuModelForCausalLM(None, cfg).load(state_dict=sd)
 
 
-def _paged_app(paged_apps, sync_ragged_app, mode):
+@pytest.fixture(scope="module")
+def spec_ragged_bundle(paged_apps):
+    """(target, draft) for the SPEC-RAGGED path (ISSUE 12): verification
+    packed into the mixed dispatch, SAME target weights as the other paged
+    apps (byte-identity pins compare against the same golden streams), a
+    wrong-weights draft so rejections exercise the accept/rollback path."""
+    sd = make_random_hf_state_dict(_paged_cfg(False))
+    target = TpuModelForCausalLM(
+        None,
+        _paged_cfg(True, serving_spec_ragged=True, speculation_length=4),
+    ).load(state_dict=sd)
+    draft_cfg = make_tiny_config(tpu=dict(
+        is_continuous_batching=True, batch_size=4, ctx_batch_size=1, seq_len=64,
+    ))
+    draft = TpuModelForCausalLM(None, draft_cfg).load(
+        state_dict=make_random_hf_state_dict(draft_cfg, seed=7)
+    )
+    return target, draft
+
+
+def _paged_app(paged_apps, sync_ragged_app, mode, spec_ragged_bundle=None):
     return {
         "legacy": paged_apps[0],
         "ragged": paged_apps[1],
         "ragged_sync": sync_ragged_app,
+        "spec_ragged": spec_ragged_bundle,
     }[mode]
 
 
@@ -133,10 +154,28 @@ def _drive(sess, max_steps=300):
     return {rid: list(r.generated) for rid, r in sess.requests.items()}
 
 
-def _mix(app, injector=None, telemetry=None, n_tokens=6):
-    """The standard 3-request mix, per-step driven, fresh cache."""
+def _fresh_session(app, **kw):
+    """A fresh session over freshly-initialized caches. ``app`` may be a
+    (target, draft) tuple — then the session is the SPEC-RAGGED
+    SpeculativeServingSession (ISSUE 12)."""
+    if isinstance(app, tuple):
+        target, draft = app
+        target.init_kv_cache()
+        draft.init_kv_cache()
+        return SpeculativeServingSession(
+            target, draft, speculation_length=4, **kw
+        )
     app.init_kv_cache()
-    sess = ServingSession(app, telemetry=telemetry, fault_injector=injector)
+    return ServingSession(app, **kw)
+
+
+def _mix(app, injector=None, telemetry=None, n_tokens=6):
+    """The standard 3-request mix, per-step driven, fresh cache. ``app``
+    may be a (target, draft) tuple — then the mix runs through the
+    SPEC-RAGGED SpeculativeServingSession (ISSUE 12) instead of a plain
+    session: every containment pin below applies verbatim to the packed
+    spec-verify path."""
+    sess = _fresh_session(app, telemetry=telemetry, fault_injector=injector)
     for rid, prompt in PROMPTS.items():
         assert sess.add_request(rid, prompt, max_new_tokens=n_tokens)
     out = _drive(sess)
@@ -213,16 +252,19 @@ def test_admission_validation_off_restores_legacy(plain_app):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("mode", ["legacy", "ragged", "ragged_sync"])
+@pytest.mark.parametrize(
+    "mode", ["legacy", "ragged", "ragged_sync", "spec_ragged"]
+)
 def test_nan_row_quarantined_cobatch_byte_identical(
-    paged_apps, sync_ragged_app, mode
+    paged_apps, sync_ragged_app, spec_ragged_bundle, mode
 ):
     """A NaN-poisoned row (device KV NaN -> non-finite logits -> sentinel
     token) fails ONLY that row: healthy co-batched rows are byte-identical
-    to a clean run on the legacy split AND the ragged dispatch paths, the
-    poisoned blocks are scrubbed before the pool recycles them, and a new
-    request reusing the freed capacity decodes byte-identically."""
-    app = _paged_app(paged_apps, sync_ragged_app, mode)
+    to a clean run on the legacy split, the ragged, AND the spec-ragged
+    (poisoned VERIFY row) dispatch paths, the poisoned blocks are scrubbed
+    before the pool recycles them, and a new request reusing the freed
+    capacity decodes byte-identically."""
+    app = _paged_app(paged_apps, sync_ragged_app, mode, spec_ragged_bundle)
     _, golden = _mix(app)
 
     inj = FaultInjector(seed=0).poison_kv_row(step=4, slot=1)  # r2's slot
@@ -256,8 +298,7 @@ def test_nan_row_quarantined_cobatch_byte_identical(
     # freed-capacity reuse: a new request over the scrubbed blocks decodes
     # byte-identically to an isolated clean run
     probe = [42, 10, 11]
-    app.init_kv_cache()
-    iso = ServingSession(app)
+    iso = _fresh_session(app)
     assert iso.add_request("iso", probe, max_new_tokens=4)
     golden_probe = _drive(iso)["iso"]
     assert sess.add_request("r4", probe, max_new_tokens=4)
@@ -265,15 +306,17 @@ def test_nan_row_quarantined_cobatch_byte_identical(
     assert out2["r4"] == golden_probe
 
 
-@pytest.mark.parametrize("mode", ["legacy", "ragged", "ragged_sync"])
+@pytest.mark.parametrize(
+    "mode", ["legacy", "ragged", "ragged_sync", "spec_ragged"]
+)
 def test_poisoned_garbage_block_cannot_couple_rows(
-    paged_apps, sync_ragged_app, mode
+    paged_apps, sync_ragged_app, spec_ragged_bundle, mode
 ):
     """NaN written straight into SHARED garbage block 0 (the
     post-propagation state of the legacy drain's surplus lockstep writes)
     changes NO healthy row by a byte: masked reads of the garbage block are
     scrubbed to exact zeros in the gather (0*NaN=NaN is dead)."""
-    app = _paged_app(paged_apps, sync_ragged_app, mode)
+    app = _paged_app(paged_apps, sync_ragged_app, mode, spec_ragged_bundle)
     _, golden = _mix(app)
     inj = FaultInjector().poison_garbage_block(step=2)
     _, out = _mix(app, injector=inj)
@@ -336,14 +379,17 @@ def test_sentinel_in_multistep_chunk_commits_finite_prefix(paged_apps):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("mode", ["legacy", "ragged", "ragged_sync"])
+@pytest.mark.parametrize(
+    "mode", ["legacy", "ragged", "ragged_sync", "spec_ragged"]
+)
 def test_injected_pool_exhaustion_resumes_byte_identical(
-    paged_apps, sync_ragged_app, mode
+    paged_apps, sync_ragged_app, spec_ragged_bundle, mode
 ):
     """exhaust_pool evicts every allocating row for one step; evictions
     re-queue, re-admit, and the final streams are byte-identical to a
-    fault-free run (rollback + greedy re-prefill regenerates exactly)."""
-    app = _paged_app(paged_apps, sync_ragged_app, mode)
+    fault-free run (rollback + greedy re-prefill regenerates exactly —
+    on the spec-ragged path the victim's DRAFT cache re-prefills too)."""
+    app = _paged_app(paged_apps, sync_ragged_app, mode, spec_ragged_bundle)
     _, golden = _mix(app)
     inj = FaultInjector().exhaust_pool(3)
     tel = TelemetrySession()
@@ -1042,6 +1088,80 @@ def test_async_ragged_deadline_expiry_mid_pipeline(paged_apps):
     for _ in range(4):
         sess.step()  # r1's next step is dispatched and UNCONSUMED here
     clock.t += 5.0  # r1 expires with a pending in-flight step
+    out = _drive(sess)
+    r1 = sess.requests["r1"]
+    assert r1.status == "failed" and r1.fail_reason == "deadline_exceeded"
+    assert out["r1"] == golden["r1"][: len(out["r1"])]
+    assert len(out["r1"]) < 8
+    assert out["r2"] == golden["r2"]
+    assert out["r3"] == golden["r3"]
+    assert len(sess.free_slots) == sess.num_slots
+
+
+# ---------------------------------------------------------------------------
+# spec-ragged path (ISSUE 12): retry + deadline containment on the packed
+# verify pipeline (NaN-quarantine / garbage-block / pool-exhaustion pins run
+# through the `spec_ragged` parametrization of the shared tests above)
+# ---------------------------------------------------------------------------
+
+
+def test_spec_ragged_dispatch_retry_recovers_byte_identical(spec_ragged_bundle):
+    """A transient dispatch fault inside the spec pipeline (whichever of
+    draft-chain / packed-verify / draft-CTE dispatches first at that step)
+    retries with bounded backoff and the drained streams stay
+    byte-identical to a fault-free run."""
+    _, golden = _mix(spec_ragged_bundle)
+    inj = FaultInjector().dispatch_error(step=4, attempts=1)
+    sess, out = _mix(spec_ragged_bundle, injector=inj)
+    assert any(f["kind"] == "dispatch_error" for f in inj.log)
+    assert out == golden
+
+
+def test_spec_ragged_retry_exhaustion_fails_rows_not_session(
+    spec_ragged_bundle,
+):
+    """Past the retry budget only the in-flight rows of the failing
+    dispatch terminally FAIL (a failing DRAFT-chain dispatch fails nobody —
+    speculation just skips a round); the session keeps serving and every
+    surviving request's stream is byte-identical to the clean run."""
+    _, golden = _mix(spec_ragged_bundle)
+    inj = FaultInjector().dispatch_error(step=5, attempts=10)  # > retries
+    sess, out = _mix(spec_ragged_bundle, injector=inj)
+    assert any(f["kind"] == "dispatch_error" for f in inj.log)
+    assert len(sess.free_slots) == sess.num_slots  # nothing leaked
+    for rid, r in sess.requests.items():
+        assert r.status in ("finished", "failed"), (rid, r.status)
+        if r.status == "failed":
+            assert r.fail_reason == "dispatch_error"
+        if r.status == "finished":
+            assert out[rid] == golden[rid], rid
+        else:
+            # failed rows keep their committed clean-run prefix
+            assert out[rid] == golden[rid][: len(out[rid])], rid
+    # the session is still alive: a fresh request completes
+    probe = [42, 10, 11]
+    iso = _fresh_session(spec_ragged_bundle)
+    assert iso.add_request("iso", probe, max_new_tokens=4)
+    golden_probe = _drive(iso)["iso"]
+    assert sess.add_request("after", probe, max_new_tokens=4)
+    assert _drive(sess)["after"] == golden_probe
+
+
+def test_spec_ragged_deadline_exceeded(spec_ragged_bundle):
+    """A wall-clock deadline expiring mid-speculation terminally fails only
+    that request (its in-flight verify/draft work is discarded); requests
+    without deadlines keep their full clean-run streams."""
+    _, golden = _mix(spec_ragged_bundle, n_tokens=8)
+    clock = FakeClock()
+    inj = FaultInjector().latency(step=4, seconds=10.0)
+    sess = _fresh_session(
+        spec_ragged_bundle, fault_injector=inj,
+        clock=clock, sleep_fn=clock.sleep,
+    )
+    assert sess.add_request("r1", PROMPTS["r1"], max_new_tokens=8,
+                            deadline_s=5.0)
+    assert sess.add_request("r2", PROMPTS["r2"], max_new_tokens=8)
+    assert sess.add_request("r3", PROMPTS["r3"], max_new_tokens=8)
     out = _drive(sess)
     r1 = sess.requests["r1"]
     assert r1.status == "failed" and r1.fail_reason == "deadline_exceeded"

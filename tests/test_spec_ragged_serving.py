@@ -1,0 +1,393 @@
+"""Spec-ragged serving (ISSUE 12): speculative verification INSIDE the
+ragged mixed step.
+
+The acceptance pins:
+- greedy outputs on the standard staggered mix are byte-identical between
+  the spec-ragged path, the existing split SpeculativeServingSession, and
+  plain (non-speculative) ragged serving — speculation must never change a
+  greedy stream, only its cost;
+- EXACTLY one compiled MIXED-program dispatch per step serving prefill
+  chunks + plain decode rows + spec-verify rows together (the target's
+  CTE/TKG programs never fire in steady state; the draft's propose/prefill
+  dispatches are the separate, explicitly-counted speculation cost);
+- zero steady-state recompiles with the mixed runner sealed and the
+  ADAPTIVE draft-length policy active (lengths move on the snapped ladder;
+  program/bucket identity never follows them);
+- the adaptive policy: a draft that stops paying shrinks its length, a
+  draft that pays keeps the maximum; acceptance EWMAs populate the session
+  signal the router places by.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tests.conftest import make_tiny_config, make_random_hf_state_dict
+
+from neuronx_distributed_inference_tpu.config import ChunkedPrefillConfig
+from neuronx_distributed_inference_tpu.runtime.application import TpuModelForCausalLM
+from neuronx_distributed_inference_tpu.runtime.serving import (
+    ServingSession,
+    SpeculativeServingSession,
+)
+from neuronx_distributed_inference_tpu.telemetry import TelemetrySession
+
+PROMPTS = {
+    "r1": [5, 17, 92, 41],
+    "r2": list(range(30, 52)),  # 22 tokens: chunks across several steps
+    "r3": [7, 7, 7],
+}
+K = 4
+
+
+def _cfg(spec=False, **extra):
+    tpu = dict(
+        is_continuous_batching=True, batch_size=4, ctx_batch_size=1,
+        is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=24,
+        is_chunked_prefill=True,
+        chunked_prefill_config=ChunkedPrefillConfig(
+            max_num_seqs=2, kernel_q_tile_size=16
+        ),
+        serving_ragged=True, seq_len=64,
+    )
+    if spec:
+        tpu.update(serving_spec_ragged=True, speculation_length=K)
+    tpu.update(extra)
+    return make_tiny_config(tpu=tpu)
+
+
+def _draft_cfg():
+    return make_tiny_config(tpu=dict(
+        is_continuous_batching=True, batch_size=4, ctx_batch_size=1, seq_len=64,
+    ))
+
+
+@pytest.fixture(scope="module")
+def state_dict():
+    return make_random_hf_state_dict(_cfg())
+
+
+@pytest.fixture(scope="module")
+def plain_ragged_app(state_dict):
+    return TpuModelForCausalLM(None, _cfg()).load(state_dict=state_dict)
+
+
+@pytest.fixture(scope="module")
+def spec_app(state_dict):
+    # serving_ragged_async defaults to async_mode (True): the module's spec
+    # app runs the PIPELINED path — every pin below covers pipelining ON
+    return TpuModelForCausalLM(None, _cfg(spec=True)).load(state_dict=state_dict)
+
+
+@pytest.fixture(scope="module")
+def sync_spec_app(state_dict):
+    return TpuModelForCausalLM(
+        None, _cfg(spec=True, serving_ragged_async=False)
+    ).load(state_dict=state_dict)
+
+
+@pytest.fixture(scope="module")
+def good_draft(state_dict):
+    # SAME weights as the target: proposals always match (acceptance 1.0)
+    return TpuModelForCausalLM(None, _draft_cfg()).load(state_dict=state_dict)
+
+
+@pytest.fixture(scope="module")
+def bad_draft():
+    # WRONG weights: forces rejections — the policy-shrink regime
+    return TpuModelForCausalLM(None, _draft_cfg()).load(
+        state_dict=make_random_hf_state_dict(_draft_cfg(), seed=7)
+    )
+
+
+def _standard_mix(sess_factory, telemetry=None):
+    sess = sess_factory(telemetry)
+    assert sess.add_request("r1", PROMPTS["r1"], max_new_tokens=6)
+    sess.step()
+    assert sess.add_request("r2", PROMPTS["r2"], max_new_tokens=6)
+    sess.step()
+    assert sess.add_request("r3", PROMPTS["r3"], max_new_tokens=5)
+    out = sess.run_to_completion()
+    return sess, out
+
+
+def _spec_mix(target, draft, telemetry=None):
+    target.init_kv_cache()
+    draft.init_kv_cache()
+    return _standard_mix(
+        lambda tel: SpeculativeServingSession(
+            target, draft, speculation_length=K, telemetry=tel
+        ),
+        telemetry,
+    )
+
+
+def test_spec_ragged_byte_identical_to_plain_and_split(
+    plain_ragged_app, spec_app, sync_spec_app, good_draft, bad_draft, state_dict
+):
+    """THE acceptance pin: the spec-ragged path (async AND sync, good AND
+    bad draft) emits byte-identical greedy streams to plain ragged serving
+    AND to the existing split-path SpeculativeServingSession on the same
+    staggered mix."""
+    plain_ragged_app.init_kv_cache()
+    _, golden = _standard_mix(
+        lambda tel: ServingSession(plain_ragged_app, telemetry=tel)
+    )
+    assert all(len(v) > 0 for v in golden.values())
+
+    # the split-path reference: contiguous target/draft, same weights
+    split_t = TpuModelForCausalLM(
+        None, _draft_cfg()
+    ).load(state_dict=state_dict)
+    split_d = TpuModelForCausalLM(
+        None, _draft_cfg()
+    ).load(state_dict=make_random_hf_state_dict(_draft_cfg(), seed=7))
+    _, out_split = _standard_mix(
+        lambda tel: SpeculativeServingSession(
+            split_t, split_d, speculation_length=K, telemetry=tel
+        )
+    )
+    assert out_split == golden
+
+    for app in (spec_app, sync_spec_app):
+        for draft in (good_draft, bad_draft):
+            _, out = _spec_mix(app, draft)
+            assert out == golden, (app.config.tpu_config.serving_ragged_async,)
+
+
+def test_exactly_one_mixed_dispatch_per_step(spec_app, good_draft):
+    """A step serving prefill chunks + decode + spec-verify rows runs as
+    EXACTLY one mixed-program dispatch; the target's CTE/TKG programs never
+    fire (the speculation cost is the draft's own dispatches, counted
+    separately)."""
+    from neuronx_distributed_inference_tpu.runtime.model_runner import (
+        MixedStepRunner,
+        SubModelRunner,
+    )
+
+    spec_app.init_kv_cache()
+    good_draft.init_kv_cache()
+    sess = SpeculativeServingSession(
+        spec_app, good_draft, speculation_length=K
+    )
+    assert sess.add_request("d1", PROMPTS["r1"], max_new_tokens=12)
+    sess.step()
+    sess.step()  # d1 decoding (draft prefilled, proposals in flight)
+    assert sess.add_request("p1", PROMPTS["r2"], max_new_tokens=8)
+    sess.step()  # p1 chunk 1 of 2
+    assert sess.prefilling and sess.decoding  # genuinely mixed now
+    assert sess._draft_prop is not None  # spec rows will pack this step
+
+    mixed = {"n": 0}
+    target_sub = {"n": 0}
+    draft_sub = {"n": 0}
+    target_runners = (
+        spec_app.context_encoding_model, spec_app.token_generation_model
+    )
+    orig_sub = SubModelRunner.__call__
+    orig_mixed = MixedStepRunner.__call__
+
+    def counting_sub(self, *a, **kw):
+        if self in target_runners:
+            target_sub["n"] += 1
+        else:
+            draft_sub["n"] += 1
+        return orig_sub(self, *a, **kw)
+
+    def counting_mixed(self, *a, **kw):
+        mixed["n"] += 1
+        return orig_mixed(self, *a, **kw)
+
+    SubModelRunner.__call__ = counting_sub
+    MixedStepRunner.__call__ = counting_mixed
+    try:
+        sess.step()
+    finally:
+        SubModelRunner.__call__ = orig_sub
+        MixedStepRunner.__call__ = orig_mixed
+    assert mixed["n"] == 1, mixed
+    assert target_sub["n"] == 0, "the target's split programs must not fire"
+    sess.run_to_completion()
+
+
+def test_zero_steady_state_recompiles_with_adaptive_drafts(
+    spec_app, bad_draft
+):
+    """With the mix warmed and the mixed runner sealed, a full drain with
+    the ADAPTIVE draft policy active (bad draft: lengths shrink mid-run)
+    observes zero steady-state recompiles — draft-length moves are data,
+    never program identity."""
+    from neuronx_distributed_inference_tpu.analysis import RetraceGuard
+
+    _, golden = _spec_mix(spec_app, bad_draft)  # warm every program
+
+    spec_app.mixed_step_model.seal()
+    try:
+        with RetraceGuard() as guard:
+            sess, out = _spec_mix(spec_app, bad_draft)
+    finally:
+        spec_app.mixed_step_model._sealed = False
+    assert out == golden
+    assert guard.traces == []  # zero steady-state recompiles, sealed
+    # the policy really moved (rejections shrank somebody's draft)
+    lens = {r.draft_len for r in sess.requests.values()}
+    assert min(lens) < K - 1, lens
+
+
+def test_spec_telemetry_and_adaptive_policy(spec_app, good_draft, bad_draft):
+    """spec_rows joins the mixed-step composition histogram (observation
+    count == mixed dispatches), the draft-len/acceptance-EWMA histograms
+    populate, the acceptance histogram's sum equals the committed decode
+    tokens, and the policy's direction matches the draft's quality."""
+    with TelemetrySession() as tel:
+        sess, out = _spec_mix(spec_app, good_draft, telemetry=tel)
+    assert sess.acceptance_ewma is not None and sess.acceptance_ewma > 0.9
+    assert all(
+        r.draft_len == K - 1 for r in sess.requests.values()
+    ), "a paying draft keeps the maximum length"
+    snap = tel.registry.snapshot()
+    mixed_steps = [
+        s for s in snap["nxdi_steps_total"]["samples"]
+        if s["labels"]["kind"] == "mixed"
+    ]
+    n_dispatch = int(mixed_steps[0]["value"])
+    hist = {
+        s["labels"]["kind"]: s
+        for s in snap["nxdi_mixed_step_rows"]["samples"]
+    }
+    assert hist["spec_rows"]["count"] == n_dispatch
+    assert hist["spec_rows"]["sum"] > 0  # spec rows genuinely packed
+    # acceptance histogram conservation: sum == decode tokens committed
+    # (every request's first token comes from its final prefill chunk)
+    total = sum(len(v) for v in out.values())
+    acc = snap["nxdi_spec_accept_len"]["samples"][0]
+    assert acc["sum"] == total - len(out)
+    assert snap["nxdi_spec_draft_len"]["samples"][0]["count"] > 0
+    assert snap["nxdi_spec_accept_ewma"]["samples"][0]["count"] > 0
+    # bucket census labels carry the SPEC mixed family tag
+    models = {s["labels"]["model"] for s in
+              snap["nxdi_bucket_dispatch_total"]["samples"]}
+    assert "mixed_step_spec_model" in models
+
+    # the shrink direction: a rejecting draft drives lengths down
+    sess_bad, _ = _spec_mix(spec_app, bad_draft)
+    assert sess_bad.acceptance_ewma is not None
+    assert sess_bad.acceptance_ewma < 0.5
+    assert min(r.draft_len for r in sess_bad.requests.values()) < K - 1
+
+
+def test_spec_ragged_eos_stops_early(plain_ragged_app, spec_app, good_draft):
+    plain_ragged_app.init_kv_cache()
+    s0 = ServingSession(plain_ragged_app)
+    assert s0.add_request("e", [5, 6, 7], max_new_tokens=8)
+    golden = s0.run_to_completion()["e"]
+    eos = golden[2]
+
+    spec_app.init_kv_cache()
+    good_draft.init_kv_cache()
+    sess = SpeculativeServingSession(spec_app, good_draft, speculation_length=K)
+    assert sess.add_request("e", [5, 6, 7], max_new_tokens=8, eos_token_id=eos)
+    assert sess.run_to_completion()["e"] == golden[:3]
+    assert len(sess.free_slots) == 4
+
+
+def test_spec_ragged_slot_reuse(plain_ragged_app, spec_app, good_draft):
+    """Freed slots accept new requests; the new request's stream matches an
+    isolated run byte-for-byte (draft cache line reuse included)."""
+    plain_ragged_app.init_kv_cache()
+    s0 = ServingSession(plain_ragged_app)
+    assert s0.add_request("probe", [42, 10, 11], max_new_tokens=4)
+    golden = s0.run_to_completion()["probe"]
+
+    spec_app.init_kv_cache()
+    good_draft.init_kv_cache()
+    sess = SpeculativeServingSession(spec_app, good_draft, speculation_length=K)
+    for i in range(4):
+        assert sess.add_request(f"w{i}", [1 + i, 2, 3], max_new_tokens=3)
+    sess.run_to_completion()
+    assert len(sess.free_slots) == 4
+    assert sess.add_request("probe", [42, 10, 11], max_new_tokens=4)
+    assert sess.run_to_completion()["probe"] == golden
+
+
+def test_spec_ragged_construction_fences(spec_app, good_draft, state_dict):
+    """A plain session on a spec app, a k mismatch, and a paged draft all
+    fail loudly at construction."""
+    spec_app.init_kv_cache()
+    with pytest.raises(ValueError, match="SpeculativeServingSession"):
+        ServingSession(spec_app)
+    with pytest.raises(ValueError, match="mixed_step_spec width"):
+        SpeculativeServingSession(spec_app, good_draft, speculation_length=3)
+    paged_draft = TpuModelForCausalLM(None, _cfg()).load(state_dict=state_dict)
+    with pytest.raises(NotImplementedError, match="contiguous"):
+        SpeculativeServingSession(spec_app, paged_draft, speculation_length=K)
+
+
+def test_spec_ragged_async_one_fetch_per_step(spec_app, good_draft):
+    """Pipelining ON: a steady spec step performs exactly one consumed
+    token fetch (the (R, k+1) verify output, started non-blocking at
+    dispatch) and one mixed dispatch; tokens surface one step LATE."""
+    from neuronx_distributed_inference_tpu.runtime.model_runner import (
+        MixedStepRunner,
+    )
+
+    spec_app.init_kv_cache()
+    good_draft.init_kv_cache()
+    sess = SpeculativeServingSession(spec_app, good_draft, speculation_length=K)
+    assert sess.ragged_async
+    assert sess.add_request("a", PROMPTS["r1"], max_new_tokens=14)
+    assert sess.add_request("b", PROMPTS["r3"], max_new_tokens=14)
+    for _ in range(4):  # past prefill, into the pipelined spec regime
+        sess.step()
+    assert sess._pending is not None
+
+    fetches = {"n": 0}
+    dispatches = {"n": 0}
+    real_asarray = np.asarray
+    orig_call = MixedStepRunner.__call__
+
+    def counting_asarray(a, *args, **kwargs):
+        if isinstance(a, jax.Array):
+            fetches["n"] += 1
+        return real_asarray(a, *args, **kwargs)
+
+    def counting_call(self, *a, **kw):
+        dispatches["n"] += 1
+        return orig_call(self, *a, **kw)
+
+    np.asarray = counting_asarray
+    MixedStepRunner.__call__ = counting_call
+    try:
+        before = (fetches["n"], dispatches["n"])
+        out = sess.step()
+        assert out, "steady-state step must deliver tokens"
+        assert fetches["n"] == before[0] + 1, "exactly one consumed fetch"
+        assert dispatches["n"] == before[1] + 1, "exactly one mixed dispatch"
+    finally:
+        np.asarray = real_asarray
+        MixedStepRunner.__call__ = orig_call
+    sess.run_to_completion()
+
+
+def test_spec_ragged_near_position_limit_matches_plain(
+    plain_ragged_app, spec_app, good_draft
+):
+    """A request decoding up to the position bound must keep emitting the
+    plain session's tokens: near the limit the chained draft propose (whose
+    worst case would overrun the draft's bucket/position reach) drops out
+    and the rows fall back to plain decode — the split path's near-limit
+    single-step fallback, one pipeline stage earlier. Regression for the
+    review-found ValueError escape (`length 66 exceeds max bucket 64`)."""
+    plain_ragged_app.init_kv_cache()
+    g = ServingSession(plain_ragged_app)
+    assert g.add_request("x", [5, 17, 92, 41], max_new_tokens=60)
+    golden = g.run_to_completion()["x"]
+    assert len(golden) == 60  # runs right up to the seq_len=64 bound
+
+    spec_app.init_kv_cache()
+    good_draft.init_kv_cache()
+    sess = SpeculativeServingSession(spec_app, good_draft, speculation_length=K)
+    assert sess.add_request("x", [5, 17, 92, 41], max_new_tokens=60)
+    assert sess.run_to_completion()["x"] == golden
+    assert len(sess.free_slots) == 4

@@ -373,6 +373,7 @@ def test_graph_audit_clean_and_covers_tags():
         "token_generation_kvq8",
         "fused_speculation_kvq8",
         "mixed_step",
+        "mixed_step_spec",
     }
     baseline = graph_audit.load_census_baseline()
     assert set(baseline) == set(graph_audit.AUDIT_TAGS)
@@ -710,6 +711,7 @@ def test_shard_audit_clean_and_covers_committed_tags():
         "token_generation_kvq8",
         "fused_speculation_kvq8",
         "mixed_step",
+        "mixed_step_spec",
     }
     records = programs.collect_programs(shard_audit.SHARD_AUDIT_TAGS)
     for tag, per_bucket in records.items():
@@ -902,6 +904,7 @@ def test_memory_audit_clean_and_covers_cache_variants():
         "token_generation_kvq8",
         "fused_speculation_kvq8",
         "mixed_step",
+        "mixed_step_spec",
         "token_generation_ring",
         "token_generation_paged",
     }
