@@ -593,33 +593,41 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy):
         for app in apps:
             app.init_kv_cache()  # fresh block pool per replica between runs
         with TelemetrySession(registry=registry) as tel:
-            router = ServingRouter(
+            # threaded stepping follows TpuConfig.router_threading on the
+            # replica apps (the *_router_threaded row sets it); the context
+            # manager joins the worker pool even if the drain raises
+            # (no-op when sequential)
+            with ServingRouter(
                 [ServingSession(app, telemetry=tel) for app in apps],
                 policy=policy, telemetry=tel,
-            )
-            t_start = time.time()
-            next_idx = 0
-            for _ in range(2):
-                router.add_request(str(next_idx), prompts[next_idx],
-                                   max_new_tokens=gen_len)
-                next_idx += 1
-            while True:
-                router.step()
-                if next_idx < n_requests:
+            ) as router:
+                t_start = time.time()
+                next_idx = 0
+                for _ in range(2):
                     router.add_request(str(next_idx), prompts[next_idx],
                                        max_new_tokens=gen_len)
                     next_idx += 1
-                    continue
-                if not router.has_live_work:
-                    break
-            total_s = time.time() - t_start
-            counts = {rid: len(r.tokens) for rid, r in router.requests.items()}
-            per_replica = [h.tokens_served for h in router.replicas]
-        return tel, counts, per_replica, total_s
+                while True:
+                    router.step()
+                    if next_idx < n_requests:
+                        router.add_request(str(next_idx), prompts[next_idx],
+                                           max_new_tokens=gen_len)
+                        next_idx += 1
+                        continue
+                    if not router.has_live_work:
+                        break
+                total_s = time.time() - t_start
+                counts = {
+                    rid: len(r.tokens)
+                    for rid, r in router.requests.items()
+                }
+                per_replica = [h.tokens_served for h in router.replicas]
+                threaded = router.threaded
+        return tel, counts, per_replica, total_s, threaded
 
     run_once()  # warmup / compile pass over every replica's programs
     base_snap = default_registry().snapshot()
-    tel, counts, per_replica, total_s = run_once(default_registry())
+    tel, counts, per_replica, total_s, threaded = run_once(default_registry())
     total_tokens = sum(counts.values())
     snap = tel.registry.snapshot()
 
@@ -632,6 +640,27 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy):
 
         return total(snap) - total(base_snap)
 
+    def _hist_sum(name):
+        def total(s):
+            fam = s.get(name)
+            if not fam:
+                return 0.0
+            return float(sum(smp["sum"] for smp in fam["samples"]))
+
+        return total(snap) - total(base_snap)
+
+    # per-step overlap (ISSUE 13): 1 - stepping-phase wall / sum of the
+    # per-replica step walls, per-run deltas over the nxdi_replica_step_ms
+    # histograms + the router-step span — ~0 when replicas host-serialize
+    # (sequential stepping), up to (N-1)/N when the thread-per-replica
+    # pool overlaps them fully
+    replica_ms = _hist_sum("nxdi_replica_step_ms")
+    phase_ms = _hist_sum("nxdi_router_step_ms")
+    overlap = (
+        round(max(0.0, 1.0 - phase_ms / replica_ms), 4)
+        if replica_ms > 0 else None
+    )
+
     n = len(apps)
     even_share = total_tokens / n if n else 0
     res = {
@@ -643,6 +672,8 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy):
         "balance_frac": (
             round(min(per_replica) / even_share, 4) if even_share else None
         ),
+        "router_threading": threaded,
+        "overlap_frac": overlap,
         # containment deltas (PR 7 convention): clean traffic MUST report
         # 0 failovers — the pre-flip check for any failover-policy knob
         "rejected": _ctr("nxdi_router_rejected_total")
@@ -764,6 +795,24 @@ def _suite_params(tiny):
             router=dict(replicas=2, policy="least_loaded",
                         n_requests=4 if tiny else 8),
             cache_key="int8_1b" if not tiny else None,
+        ),
+        # SAME routed mix with THREAD-PER-REPLICA stepping (ISSUE 13,
+        # TpuConfig.router_threading): every alive replica's step()
+        # dispatches from a persistent worker pool behind a per-step
+        # barrier, so replica device steps overlap instead of
+        # host-serializing. Beside the sequential router row this pair is
+        # the threading win: router_threaded_tok_s vs router_tok_s, and
+        # router_step_overlap_frac (from the nxdi_replica_step_ms
+        # histograms + the router-step span) measures how much of the
+        # per-replica step wall actually overlapped (0 = serialized,
+        # 0.5 = two replicas fully concurrent). Own artifact key:
+        # router_threading is part of the config fingerprint.
+        "serving_1b_int8_router_threaded": dict(
+            attrs=attrs_1b, quantized=True, serving=serving,
+            router=dict(replicas=2, policy="least_loaded",
+                        n_requests=4 if tiny else 8),
+            extra_tpu=dict(router_threading=True),
+            cache_key="int8_1b_router_threaded" if not tiny else None,
         ),
         # single-chip proxy for the BASELINE 8B north star: int8 8B fits 16G
         "int8_8b_bs1": dict(
@@ -1060,6 +1109,18 @@ def summary_line(points):
         "router_projected_tok_s": g("serving_1b_int8_router", "projected_tok_s"),
         "router_failover": g("serving_1b_int8_router", "failover"),
         "router_balance_frac": g("serving_1b_int8_router", "balance_frac"),
+        # thread-per-replica router row (ISSUE 13): same routed mix with
+        # router_threading on — compare router_threaded_tok_s against
+        # router_tok_s for the threading win, and router_step_overlap_frac
+        # (replica-step histograms vs the router-step span) for how much of
+        # the per-replica step wall actually ran concurrently. On a 1-chip
+        # host both replicas share the device, so the overlap a chip-per-
+        # replica deployment would convert to tok/s is the hardware
+        # session's number to confirm.
+        "router_threaded_tok_s": g("serving_1b_int8_router_threaded",
+                                   "decode_tok_s"),
+        "router_step_overlap_frac": g("serving_1b_int8_router_threaded",
+                                      "overlap_frac"),
         "int8_8b_tok_s": g("int8_8b_bs1", "decode_tok_s"),
         "int8_8b_ttft_ms": g("int8_8b_bs1", "ttft_ms"),
         # 16k long-context row: TTFT ~= the 16k prefill wall time

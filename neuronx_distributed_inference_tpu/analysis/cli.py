@@ -32,7 +32,7 @@ from neuronx_distributed_inference_tpu.analysis.findings import Baseline, Findin
 _ANALYSIS_DIR = os.path.dirname(__file__)
 TPULINT_BASELINE = os.path.join(_ANALYSIS_DIR, "tpulint_baseline.json")
 
-ALL_SUITES = ("lint", "flags", "graph", "shard", "memory", "cost")
+ALL_SUITES = ("lint", "flags", "graph", "shard", "memory", "cost", "conc")
 
 #: every committed baseline file --write-baseline may rewrite (diffed after)
 BASELINE_FILES = (
@@ -41,6 +41,7 @@ BASELINE_FILES = (
     "shard_baseline.json",
     "memory_baseline.json",
     "cost_baseline.json",
+    "conc_baseline.json",
 )
 
 
@@ -64,7 +65,7 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m neuronx_distributed_inference_tpu.analysis",
         description=(
             "Static-analysis gate: tpulint + flag audit + graph audit + "
-            "shard audit + memory audit + cost audit"
+            "shard audit + memory audit + cost audit + concurrency audit"
         ),
     )
     parser.add_argument("--json", action="store_true", help="JSON report")
@@ -148,6 +149,12 @@ def run_suites(
 
         unbaselined.extend(cost_audit.run(write_baseline=write_baseline))
         extras["cost"] = cost_audit.last_report()
+    if "conc" in suites:
+        # pure-AST like lint: no tracing, runs in milliseconds
+        from neuronx_distributed_inference_tpu.analysis import concurrency_audit
+
+        unbaselined.extend(concurrency_audit.run(write_baseline=write_baseline))
+        extras["concurrency"] = concurrency_audit.last_report()
 
     all_findings = baselined + unbaselined
     if write_baseline and "lint" in suites:
@@ -224,6 +231,12 @@ def main(argv=None) -> int:
         from neuronx_distributed_inference_tpu.analysis import cost_audit
 
         extras_chunks.append(cost_audit.render_breakdown(extras["cost"]))
+    if "concurrency" in extras:
+        from neuronx_distributed_inference_tpu.analysis import concurrency_audit
+
+        extras_chunks.append(
+            concurrency_audit.render_breakdown(extras["concurrency"])
+        )
     extras_text = "\n".join(c for c in extras_chunks if c) or None
     print(
         findings_mod.render_report(

@@ -29,6 +29,15 @@ from typing import Dict, List, Optional, Tuple
 SEV_ERROR = "error"
 SEV_WARNING = "warning"
 
+#: container-mutating method names — a call through one of these IS a write
+#: to the receiver. Shared by tpulint's TPU109 (module-level mutable state
+#: in runtime/) and the concurrency audit's CONC601 write-site census, so
+#: the two rules can never disagree about what counts as a write.
+CONTAINER_MUTATORS = frozenset({
+    "append", "extend", "appendleft", "popleft", "pop", "clear", "update",
+    "add", "remove", "discard", "insert", "setdefault", "sort",
+})
+
 
 @dataclass(frozen=True)
 class Finding:

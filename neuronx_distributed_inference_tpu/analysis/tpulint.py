@@ -43,6 +43,18 @@ The rules encode contracts the runtime relies on but Python cannot enforce:
   step's existing batched fetch already landed; this rule is the static
   half of the zero-device-round-trip telemetry contract
   (docs/OBSERVABILITY.md).
+- **TPU109 module-level-mutable-state** (warning, baselined — zero entries
+  expected): a dict/list/set (literal or ``dict()``/``list()``/``set()``/
+  ``deque()``/``defaultdict()`` call) assigned at module level in
+  ``runtime/`` that any function then WRITES (subscript assignment, a
+  mutating method call, or a ``global`` rebind). Import-time mutable state
+  written from functions is the classic hidden-shared-state smell the
+  concurrency audit's census rules (CONC601) key off: it has no owning
+  object, so no confinement argument covers it — under thread-per-replica
+  stepping it is a cross-replica race waiting to happen. Put the state on
+  an owning class (where the CONC601 ownership model classifies it) or
+  suppress with a written-down justification (e.g. a decoration-time-only
+  registry).
 - **TPU108 large-unsharded-constant** (warning, baselined — zero entries
   expected): a ``jnp.zeros/ones/full/arange/eye/...`` call with a
   STATICALLY-known element count ≥ 2**20 inside a jit-traced body, not
@@ -76,6 +88,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from neuronx_distributed_inference_tpu.analysis.findings import (
+    CONTAINER_MUTATORS,
     Finding,
     SEV_ERROR,
     SEV_WARNING,
@@ -101,6 +114,15 @@ JNP_ARRAY_CREATORS = {"zeros", "ones", "full", "empty", "arange", "eye", "linspa
 TPU108_ELEM_THRESHOLD = 1 << 20
 # wrappers that give the fresh array a placement, silencing TPU108
 SHARDING_WRAPPERS = {"with_sharding_constraint", "constrain", "device_put"}
+
+# TPU109: constructors whose module-level result is mutable shared state
+# (the write-counting mutator set is findings.CONTAINER_MUTATORS, shared
+# with the concurrency audit's CONC601 census), and the package subtree the
+# rule audits (the serving runtime — where the thread-per-replica router
+# makes hidden module state an actual race)
+MUTABLE_CONSTRUCTORS = {"dict", "list", "set", "deque", "defaultdict",
+                        "OrderedDict", "Counter"}
+TPU109_SCOPE_PREFIX = PACKAGE + "/runtime/"
 
 _PRAGMA_RE = re.compile(r"#\s*tpulint:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
 
@@ -149,6 +171,16 @@ ROUTER_HOT_PATH = {
     "_failover_replica",
     "_publish_gauges",
     "run_to_completion",
+    # thread-per-replica stepping (router_threading): the stepping phase +
+    # the worker protocol — router.py-side code here must stay fetch-free
+    # (the per-replica session's designated consume points live in
+    # serving.py's own bucket; a fetch in the worker loop or the barrier
+    # would re-serialize every replica behind one device)
+    "_step_replicas",
+    "run",
+    "dispatch",
+    "wait_done",
+    "join_step",
 }
 
 #: per-file hot-path census buckets: {relpath suffix: (bucket label,
@@ -761,6 +793,125 @@ class _Linter:
                     def_line=def_line,
                 )
 
+    def rule_module_mutable_state(self):
+        """TPU109: a module-level dict/list/set in runtime/ written from any
+        function in the module — shared state with no owning object, i.e.
+        nothing the concurrency audit's confinement census can classify."""
+        for mod in self.modules.values():
+            if not mod.relpath.startswith(TPU109_SCOPE_PREFIX):
+                continue
+            mutables: Set[str] = set()
+            for node in mod.tree.body:
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    targets = [node.target]
+                else:
+                    continue
+                v = node.value
+                is_mutable = isinstance(
+                    v, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                        ast.ListComp, ast.SetComp)
+                )
+                if isinstance(v, ast.Call):
+                    fn = v.func
+                    name = fn.id if isinstance(fn, ast.Name) else getattr(fn, "attr", None)
+                    is_mutable = is_mutable or name in MUTABLE_CONSTRUCTORS
+                if not is_mutable:
+                    continue
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        mutables.add(t.id)
+            if not mutables:
+                continue
+            for infos in mod.functions.values():
+                for info in infos:
+                    # names bound as PLAIN locals (params / bare-Name
+                    # assignments / loop targets). _local_bindings is the
+                    # wrong tool here: it walks subscript-assignment
+                    # targets too, so `REGISTRY[k] = v` would mark REGISTRY
+                    # itself local and hide exactly the write this rule
+                    # exists to catch.
+                    local: Set[str] = set()
+                    args = info.node.args
+                    for a in (
+                        list(args.posonlyargs) + list(args.args)
+                        + list(args.kwonlyargs)
+                        + ([args.vararg] if args.vararg else [])
+                        + ([args.kwarg] if args.kwarg else [])
+                    ):
+                        local.add(a.arg)
+                    declared_global: Set[str] = set()
+                    for n in self._body_nodes(info):
+                        if isinstance(n, ast.Global):
+                            declared_global.update(n.names)
+                        elif isinstance(n, ast.Assign):
+                            for t in n.targets:
+                                if isinstance(t, ast.Name):
+                                    local.add(t.id)
+                        elif isinstance(n, (ast.AnnAssign, ast.NamedExpr)):
+                            # `x: Dict = {}` / `(x := ...)` bind locals
+                            # exactly like a plain assignment
+                            if isinstance(n.target, ast.Name):
+                                local.add(n.target.id)
+                        elif isinstance(n, (ast.For, ast.comprehension)):
+                            for x in ast.walk(n.target):
+                                if isinstance(x, ast.Name):
+                                    local.add(x.id)
+                        elif isinstance(n, ast.withitem) and n.optional_vars:
+                            for x in ast.walk(n.optional_vars):
+                                if isinstance(x, ast.Name):
+                                    local.add(x.id)
+                    local -= declared_global
+
+                    def emit(n, name, how, info=info):
+                        self._emit(
+                            mod, n, "TPU109", SEV_WARNING,
+                            f"module-level mutable `{name}` (assigned at "
+                            f"import time) is written from `{info.name}` "
+                            f"({how}) — hidden shared state with no owning "
+                            f"object: no thread-confinement argument covers "
+                            f"it (CONC601 census), and under "
+                            f"thread-per-replica router stepping it is a "
+                            f"cross-replica race; move it onto an owning "
+                            f"class or suppress with a justification",
+                            def_line=info.node.lineno,
+                            key=f"{mod.relpath}::{name}",
+                        )
+
+                    for n in self._body_nodes(info):
+                        if isinstance(n, (ast.Assign, ast.AugAssign)):
+                            tgts = (
+                                n.targets if isinstance(n, ast.Assign)
+                                else [n.target]
+                            )
+                            for t in tgts:
+                                if (
+                                    isinstance(t, ast.Subscript)
+                                    and isinstance(t.value, ast.Name)
+                                    and t.value.id in mutables
+                                    and t.value.id not in local
+                                ):
+                                    emit(n, t.value.id, "subscript assignment")
+                                elif (
+                                    isinstance(t, ast.Name)
+                                    and t.id in mutables
+                                    and t.id in declared_global
+                                ):
+                                    emit(n, t.id, "global rebind")
+                        elif isinstance(n, ast.Call) and isinstance(
+                            n.func, ast.Attribute
+                        ):
+                            recv = n.func.value
+                            if (
+                                n.func.attr in CONTAINER_MUTATORS
+                                and isinstance(recv, ast.Name)
+                                and recv.id in mutables
+                                and recv.id not in local
+                            ):
+                                emit(n, recv.id, f".{n.func.attr}() call")
+
     def rule_pallas_interpret(self):
         for mod in self.modules.values():
             for n in ast.walk(mod.tree):
@@ -809,6 +960,7 @@ class _Linter:
         self.rule_host_sync_census()
         self.rule_pallas_interpret()
         self.rule_mutable_defaults()
+        self.rule_module_mutable_state()
         self.findings.sort(key=lambda f: (f.location, f.rule))
         return self.findings
 

@@ -380,6 +380,20 @@ class TpuConfig:
     # healthy set; `cache_aware` is a prefix-affinity stub.
     serving_replicas: int = 1
     router_policy: str = "least_loaded"
+    # thread-per-replica router stepping (runtime/router.py): ServingRouter
+    # dispatches every alive replica's step() from a persistent pool of one
+    # worker thread per replica and waits on a per-step barrier — dispatch
+    # and the non-blocking token fetches release the GIL, so N replicas'
+    # device steps overlap instead of host-serializing behind one Python
+    # loop. Placement, admission, failover harvesting, terminal sync and
+    # every telemetry gauge stay on the router thread; ONLY
+    # ReplicaHandle.step() runs on workers — the confinement model the
+    # concurrency audit (CONC601-604, analysis/concurrency_audit.py) proves
+    # statically. Default OFF until hardware-validated; threaded drains are
+    # pinned byte-identical to sequential stepping (tests/
+    # test_router_threaded.py). See docs/SERVING.md "Threaded replica
+    # stepping".
+    router_threading: bool = False
 
     # --- attention -------------------------------------------------------
     fused_qkv: bool = False

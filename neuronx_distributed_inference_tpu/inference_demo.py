@@ -181,6 +181,13 @@ def build_parser() -> argparse.ArgumentParser:
         "occupancy, kv_free_bytes, step/queue-wait EWMAs); cache_aware is "
         "a prefix-affinity stub",
     )
+    onoff("router-threading", False, dest="router_threading",
+          help="thread-per-replica router stepping (router config consumed "
+          "by serving drivers like bench.py's router rows): every alive "
+          "replica's step() dispatches from a persistent worker pool and "
+          "joins at a per-step barrier, so replica device steps overlap "
+          "instead of host-serializing; placement/failover/telemetry stay "
+          "on the router thread (docs/SERVING.md)")
     run.add_argument("--cp-max-num-seqs", type=int, default=8,
                      help="chunked prefill: max sequences per chunk batch")
     run.add_argument("--cp-kernel-q-tile-size", type=int, default=128)
@@ -423,6 +430,7 @@ def create_tpu_config(args) -> TpuConfig:
         serving_spec_ragged=args.serving_spec_ragged,
         serving_replicas=args.serving_replicas,
         router_policy=args.router_policy,
+        router_threading=args.router_threading,
         admission_validation=args.admission_validation,
         request_deadline_s=args.request_deadline_s,
         dispatch_max_retries=args.dispatch_max_retries,

@@ -33,7 +33,11 @@ def register_encoder(name: str):
     """Register a factory: (config) -> (encode_fn, convert_fn, spec)."""
 
     def deco(fn):
-        _ENCODER_REGISTRY[name] = fn
+        # decoration-time-only write: the registry is populated at import
+        # (module top level) and only READ afterwards, so no runtime thread
+        # ever mutates it — the TPU109 hidden-shared-state smell does not
+        # apply to a frozen-after-import registry
+        _ENCODER_REGISTRY[name] = fn  # tpulint: ignore[TPU109]
         return fn
 
     return deco

@@ -86,6 +86,12 @@ class ReplicaHandle:
         self._placed_t: Dict[str, float] = {}
         self.ewma_step_ms = 0.0
         self.ewma_queue_wait_ms = 0.0
+        # wall of the MOST RECENT step() — read by the router thread after
+        # the per-step barrier to feed nxdi_replica_step_ms and the
+        # stepping-phase overlap fraction (all telemetry recording stays on
+        # the router thread; this handle is stepped by at most one thread
+        # at a time, so the write is replica-confined)
+        self.last_step_ms = 0.0
 
     # ---- health ----------------------------------------------------------
 
@@ -131,10 +137,12 @@ class ReplicaHandle:
         except WatchdogError as e:
             self.watchdog_error = e
             self._set_health(HEALTH_DEAD, "watchdog")
+            self.last_step_ms = (self._clock() - t0) * 1e3
             return {}
         now = self._clock()
         self.steps += 1
         dt_ms = (now - t0) * 1e3
+        self.last_step_ms = dt_ms
         self.ewma_step_ms = (
             dt_ms
             if self.steps == 1

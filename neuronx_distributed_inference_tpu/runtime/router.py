@@ -32,11 +32,22 @@ of the virtual device set on the CPU harness) and gives them one front door:
   over the same way, bounded by ``max_failovers``. Rejections and
   exhausted-failover requests surface as typed verdicts — the router never
   raises for a replica-local failure.
-- **Drain / steady state** — ``step()`` advances every alive replica
-  (serially on the CPU harness; the per-replica state is independent, so a
-  thread-per-replica driver can call ``handle.step()`` concurrently later)
-  and ``run_to_completion`` drains the global queue; FIFO placement plus
-  every-replica stepping is the starvation-freedom argument.
+- **Drain / steady state** — ``step()`` advances every alive replica and
+  ``run_to_completion`` drains the global queue; FIFO placement plus
+  every-replica stepping is the starvation-freedom argument. With
+  ``TpuConfig.router_threading`` the replica-stepping phase dispatches every
+  alive replica's ``ReplicaHandle.step()`` from a persistent
+  one-thread-per-replica pool and waits on a per-step barrier: device
+  dispatch and the non-blocking token fetches release the GIL, so N
+  replicas' steps overlap instead of host-serializing. ONLY
+  ``ReplicaHandle.step()`` runs on worker threads — placement, admission,
+  failover harvesting, terminal sync and every gauge stay on the router
+  thread, whose phases never overlap the workers' (the router blocks on the
+  barrier). That confinement model is a statically audited contract:
+  ``analysis/concurrency_audit.py`` (CONC601-604) pins the shared-write
+  census, lock discipline, telemetry atomicity and the router→session touch
+  surface; threaded drains are pinned byte-identical to sequential stepping
+  (tests/test_router_threaded.py).
 - **Observability** — the ``nxdi_router_*`` family (per-replica
   occupancy/queue-depth/health gauges, placement counter by policy+reason,
   failover counter by cause, occupancy-spread histogram), all host-side
@@ -48,6 +59,7 @@ See docs/SERVING.md "Multi-replica front-end".
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -131,6 +143,71 @@ class RouterRequest:
         return f"{self.req_id}~f{self.placements - 1}"
 
 
+class _ReplicaStepWorker(threading.Thread):
+    """One persistent worker thread owning ONE replica's step dispatch —
+    the thread-per-replica pool behind ``TpuConfig.router_threading``.
+
+    Protocol (two events as the per-step barrier): the router thread calls
+    :meth:`dispatch` (arms the job) then :meth:`join_step` (waits for the
+    done event and takes the result / re-raises the worker's exception on
+    the router thread). Each worker has at most ONE outstanding job, and
+    the router only reads ``result``/``error`` after the done event — the
+    events' internal condition variables give the writes happens-before
+    visibility. The ONLY code a worker runs is ``ReplicaHandle.step()``
+    (the confinement set CONC601/CONC604 audit); WatchdogError is already
+    converted to replica death inside it, so an exception surfacing here is
+    a programming error, re-raised where the sequential path would have
+    raised it."""
+
+    def __init__(self, handle):
+        super().__init__(
+            daemon=True, name=f"nxdi-replica-step-{handle.replica_id}"
+        )
+        self.handle = handle
+        self._go = threading.Event()
+        self._done = threading.Event()
+        self._quit = False
+        self.result: Dict[str, int] = {}
+        self.error: Optional[BaseException] = None
+        self.start()
+
+    def run(self) -> None:  # the worker thread body
+        while True:
+            self._go.wait()
+            self._go.clear()
+            if self._quit:
+                return
+            try:
+                self.result = self.handle.step()
+            except BaseException as e:
+                self.error = e
+            self._done.set()
+
+    def dispatch(self) -> None:  # router thread
+        self.result = {}
+        self.error = None
+        self._done.clear()
+        self._go.set()
+
+    def wait_done(self) -> None:  # router thread
+        """Block until this worker parks (job finished, success OR error) —
+        the barrier half of the protocol, raise-free so the router can
+        complete the barrier for EVERY worker before any error re-raises."""
+        self._done.wait()
+
+    def join_step(self) -> Dict[str, int]:  # router thread
+        self._done.wait()
+        if self.error is not None:
+            err, self.error = self.error, None
+            raise err
+        return self.result
+
+    def shutdown(self) -> None:  # router thread (router.close())
+        self._quit = True
+        self._go.set()
+        self.join()
+
+
 class ServingRouter:
     def __init__(
         self,
@@ -139,12 +216,18 @@ class ServingRouter:
         telemetry=None,
         clock: Optional[Callable[[], float]] = None,
         max_failovers: int = 3,
+        threaded: Optional[bool] = None,
     ):
         """``replicas``: ReplicaHandles, or bare serving sessions (wrapped
         with sequential ids). ``policy`` defaults to the first replica's
         ``TpuConfig.router_policy``. ``max_failovers`` bounds how many times
         one request may fail over before it is terminally FAILED (a request
-        that kills every replica it lands on must not cycle forever)."""
+        that kills every replica it lands on must not cycle forever).
+        ``threaded`` overrides ``TpuConfig.router_threading`` (None =
+        follow the config): when on, a persistent one-thread-per-replica
+        pool steps the replicas concurrently behind a per-step barrier —
+        call :meth:`close` when done so the pool joins (the sequential
+        router's close() is a no-op)."""
         if not replicas:
             raise ValueError("ServingRouter needs at least one replica")
         self.replicas: List[ReplicaHandle] = [
@@ -174,6 +257,17 @@ class ServingRouter:
         self.pending: deque = deque()  # global FIFO placement queue
         self._rr_next = 0  # round-robin cursor
         self._step_index = 0
+        self.threaded = bool(
+            getattr(tc, "router_threading", False)
+            if threaded is None else threaded
+        )
+        # the persistent thread-per-replica stepping pool (empty =
+        # sequential stepping); workers outlive every step and are joined
+        # by close()
+        self._workers: Dict[int, _ReplicaStepWorker] = {}
+        if self.threaded:
+            for h in self.replicas:
+                self._workers[h.replica_id] = _ReplicaStepWorker(h)
         for h in self.replicas:
             self.tel.router_replica_gauges(
                 h.replica_id, h.occupancy, h.queue_depth,
@@ -384,23 +478,29 @@ class ServingRouter:
     # ---- steady state ----------------------------------------------------
 
     def step(self) -> Dict[str, int]:
-        """One router tick: place queued requests, advance every alive
-        replica, sync terminal outcomes (detecting dispatch give-ups),
-        harvest + fail over dead replicas, and publish the per-replica
-        gauges. Returns {req_id: token} for tokens produced this step across
-        all replicas."""
+        """One router tick, in phases that never overlap the worker
+        threads' (the threaded pool runs ONLY the stepping phase; the
+        router thread blocks on its barrier): place queued requests,
+        harvest externally-killed replicas, advance every alive replica
+        (concurrently under ``router_threading``), then — back on the
+        router thread, replica by replica in id order — merge results, sync
+        terminal outcomes (detecting dispatch give-ups), fail over dead
+        replicas, and publish the per-replica gauges. Returns
+        {req_id: token} for tokens produced this step across all replicas.
+        Per-replica sessions are independent, so the threaded and
+        sequential phase orders commit identical state (pinned byte-
+        identical by tests/test_router_threaded.py)."""
         self._step_index += 1
         results: Dict[str, int] = {}
         self._place_pending()
         for h in self.replicas:
-            if not h.alive:
-                if h.owned:
-                    # killed externally (operator kill()) since last step:
-                    # harvest + fail its live requests over now
-                    self._failover_replica(h, h.health_reason or "dead")
-                continue
-            step_results = h.step()  # WatchdogError -> DEAD inside
-            if not h.alive:
+            if not h.alive and h.owned:
+                # killed externally (operator kill()) since last step:
+                # harvest + fail its live requests over before stepping, so
+                # they re-place with everyone else's below
+                self._failover_replica(h, h.health_reason or "dead")
+        for h, step_results in self._step_replicas(self.alive_replicas):
+            if not h.alive:  # WatchdogError -> DEAD inside handle.step
                 self._failover_replica(h, h.health_reason or "dead")
                 continue
             for sid, tok in step_results.items():
@@ -416,6 +516,61 @@ class ServingRouter:
         self._place_pending()
         self._publish_gauges()
         return results
+
+    def _step_replicas(self, alive: List[ReplicaHandle]):
+        """The replica-stepping phase: advance every alive replica one
+        step and return [(handle, step results), ...] in replica order.
+        Sequential without the pool; with ``router_threading`` each
+        handle's step() runs on its own persistent worker and the loop of
+        ``join_step`` calls is the per-step barrier (a worker exception
+        re-raises HERE, on the router thread). Telemetry — per-replica
+        step wall + the phase span feeding the overlap fraction — is
+        recorded after the barrier, on the router thread, through the
+        identical path in both modes."""
+        t0 = self.tel.clock()
+        if self._workers:
+            workers = [self._workers[h.replica_id] for h in alive]
+            for w in workers:
+                w.dispatch()
+            # complete the barrier for EVERY worker BEFORE any error can
+            # re-raise: bailing on the first failed join would leave a
+            # sibling's job outstanding, and the next step()'s dispatch
+            # would pair step N's result with step N+1's join while the
+            # worker still runs — overlapping the router phase with a live
+            # worker, exactly what the barrier exists to forbid. (A worker
+            # exception is a programming error — WatchdogError never
+            # escapes handle.step — so the siblings' already-committed
+            # session state simply waits for the next sync, like the
+            # sequential path's not-yet-stepped replicas.)
+            for w in workers:
+                w.wait_done()
+            stepped = [(h, w.join_step()) for h, w in zip(alive, workers)]
+        else:
+            stepped = [(h, h.step()) for h in alive]
+        if alive:
+            wall_ms = (self.tel.clock() - t0) * 1e3
+            for h in alive:
+                self.tel.replica_step(h.replica_id, h.last_step_ms)
+            self.tel.router_step_timing(
+                wall_ms, sum(h.last_step_ms for h in alive)
+            )
+        return stepped
+
+    def close(self) -> None:
+        """Join the thread-per-replica stepping pool (idempotent; no-op for
+        a sequential router). After close() the router still steps — it
+        falls back to sequential stepping — but the usual lifecycle is
+        drain, then close. The thread-leak pin: no worker thread survives
+        this call."""
+        workers, self._workers = self._workers, {}
+        for w in workers.values():
+            w.shutdown()
+
+    def __enter__(self) -> "ServingRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
     def _sync_terminals(self, h: ReplicaHandle) -> None:
         """Fold this replica's terminal session outcomes into the router

@@ -23,6 +23,20 @@ Histograms use FIXED bucket bounds chosen at registration (cumulative
 adds — no per-observation allocation, no quantile sketch on the hot path.
 Exact ``sum``/``count`` are kept so tests can pin conservation laws
 (e.g. the speculation acceptance histogram sums to committed tokens).
+
+Thread safety (the CONC603 contract, docs/STATIC_ANALYSIS.md): with the
+thread-per-replica router (``TpuConfig.router_threading``) every replica's
+step thread records into ONE shared registry, so the instrument mutators are
+the atomicity boundary — ``inc``/``set``/``observe`` take a per-instrument
+lock (``+=`` on a Python float is a read-modify-write across bytecodes, NOT
+atomic under the GIL), ``_Family.child`` mints children under a per-family
+lock (two threads asking for the same new label must get the SAME child, not
+two — the check-then-act race), and exposition copies each family's child
+table under that same family lock before iterating (a scrape thread walking
+``children`` while a worker mints a new label would otherwise die
+mid-iteration). Call sites must never touch
+``.value``/``.sum``/``.count``/bucket internals directly — the concurrency
+audit (CONC603) proves that statically.
 """
 
 from __future__ import annotations
@@ -71,29 +85,35 @@ def _fmt_value(v: float) -> str:
 
 
 class Counter:
-    """Monotone counter. ``inc`` is the ONLY mutator."""
+    """Monotone counter. ``inc`` is the ONLY mutator — and the atomic
+    section: replica step threads share instruments, and a bare ``+=``
+    loses increments under interleaving."""
 
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lock")
 
     def __init__(self):
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def inc(self, n: float = 1.0) -> None:
         if n < 0:
             raise ValueError(f"counter increments must be >= 0, got {n}")
-        self.value += n
+        with self._lock:
+            self.value += n
 
 
 class Gauge:
     """Last-value gauge (pool occupancy, bytes free, batch fill)."""
 
-    __slots__ = ("value",)
+    __slots__ = ("value", "_lock")
 
     def __init__(self):
         self.value = 0.0
+        self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
-        self.value = float(v)
+        with self._lock:
+            self.value = float(v)
 
 
 class Histogram:
@@ -102,9 +122,13 @@ class Histogram:
     ``bounds`` are the finite upper bounds; an implicit +Inf bucket catches
     the tail. ``counts[i]`` is NON-cumulative per bucket (cumulated only at
     exposition) so ``observe`` stays O(log n_buckets).
+
+    ``observe`` updates bucket + sum + count as ONE atomic section: an
+    unlocked interleaving could commit a bucket increment without its sum
+    (or vice versa) and break the exact-conservation pins the tests rely on.
     """
 
-    __slots__ = ("bounds", "counts", "sum", "count")
+    __slots__ = ("bounds", "counts", "sum", "count", "_lock")
 
     def __init__(self, bounds: Sequence[float]):
         b = tuple(float(x) for x in bounds)
@@ -114,11 +138,13 @@ class Histogram:
         self.counts = [0] * (len(b) + 1)  # last = +Inf
         self.sum = 0.0
         self.count = 0
+        self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
-        self.counts[bisect.bisect_left(self.bounds, float(v))] += 1
-        self.sum += float(v)
-        self.count += 1
+        with self._lock:
+            self.counts[bisect.bisect_left(self.bounds, float(v))] += 1
+            self.sum += float(v)
+            self.count += 1
 
     def cumulative(self) -> List[int]:
         out, acc = [], 0
@@ -152,7 +178,8 @@ class _Family:
     keyed by label-value tuples. Unlabelled metrics have a single child at
     the empty key."""
 
-    __slots__ = ("name", "kind", "help", "label_names", "buckets", "children")
+    __slots__ = ("name", "kind", "help", "label_names", "buckets", "children",
+                 "_lock")
 
     def __init__(self, name, kind, help_text, label_names, buckets=None):
         self.name = name
@@ -161,8 +188,15 @@ class _Family:
         self.label_names = tuple(label_names)
         self.buckets = tuple(buckets) if buckets else None
         self.children: Dict[Tuple[str, ...], object] = {}
+        self._lock = threading.Lock()
 
     def child(self, label_values: Tuple[str, ...]):
+        # fast path: an existing child is immutable membership (children are
+        # never removed), so the lock-free read is safe; the MINT must hold
+        # the family lock — two replica threads asking for the same new
+        # label concurrently would otherwise each construct a child and one
+        # thread's observations would land in an orphan the exposition
+        # never sees (the check-then-act race CONC603 flags)
         c = self.children.get(label_values)
         if c is None:
             if len(label_values) != len(self.label_names):
@@ -170,12 +204,15 @@ class _Family:
                     f"{self.name}: expected labels {self.label_names}, "
                     f"got {label_values}"
                 )
-            c = (
-                Histogram(self.buckets)
-                if self.kind == "histogram"
-                else _KINDS[self.kind]()
-            )
-            self.children[label_values] = c
+            with self._lock:
+                c = self.children.get(label_values)
+                if c is None:
+                    c = (
+                        Histogram(self.buckets)
+                        if self.kind == "histogram"
+                        else _KINDS[self.kind]()
+                    )
+                    self.children[label_values] = c
         return c
 
 
@@ -232,7 +269,13 @@ class MetricsRegistry:
         with self._lock:
             for name, fam in sorted(self._families.items()):
                 samples = []
-                for lv, child in sorted(fam.children.items()):
+                # copy under the FAMILY lock: minting happens there, not
+                # under the registry lock — iterating the live dict while a
+                # replica thread mints a new label child would raise
+                # mid-scrape
+                with fam._lock:
+                    children = sorted(fam.children.items())
+                for lv, child in children:
                     labels = dict(zip(fam.label_names, lv))
                     if fam.kind == "histogram":
                         samples.append(
@@ -264,7 +307,9 @@ class MetricsRegistry:
                 if fam.help:
                     lines.append(f"# HELP {name} {fam.help}")
                 lines.append(f"# TYPE {name} {fam.kind}")
-                for lv, child in sorted(fam.children.items()):
+                with fam._lock:  # same copy-before-iterate as snapshot()
+                    children = sorted(fam.children.items())
+                for lv, child in children:
                     if fam.kind == "histogram":
                         cum = child.cumulative()
                         for i, c in enumerate(cum):

@@ -38,6 +38,7 @@ operable counter instead of only an assertion.
 from __future__ import annotations
 
 import json
+import threading
 import time
 from collections import deque
 from contextlib import contextmanager
@@ -95,6 +96,18 @@ class TelemetrySession:
     ``enabled=False`` builds an inert session: every record method returns
     immediately, no instruments are created, no retrace listener installs —
     the disabled path is a handful of attribute loads per call.
+
+    Thread safety (the CONC601 contract): one TelemetrySession is shared by
+    every replica of a thread-per-replica router
+    (``TpuConfig.router_threading``), so record methods that mutate session
+    state (the trace table, the completed/event deques, the cumulative
+    timing sums, the JSONL stream) take ``self._lock`` — an RLock, because
+    locked methods log through :meth:`event` which locks again on the same
+    thread. Instrument mutations (``inc``/``set``/``observe``) are atomic
+    inside :mod:`.metrics` (per-instrument locks, acquired strictly INSIDE
+    this one — the router → replica → telemetry-session → instrument lock
+    order CONC602 checks). Methods that only touch instruments take no
+    session lock.
     """
 
     def __init__(
@@ -109,6 +122,7 @@ class TelemetrySession:
         self.enabled = bool(enabled)
         self.registry = registry if registry is not None else metrics_mod.MetricsRegistry()
         self.clock = clock
+        self._lock = threading.RLock()
         self.traces: Dict[str, RequestTrace] = {}
         # exact traces are for percentiles and tests; the fleet metrics live
         # in the (bounded) histograms — cap retention so a long-lived
@@ -263,6 +277,27 @@ class TelemetrySession:
             "max - min live rows across alive replicas per router step "
             "(0 == perfectly balanced; the rebalance signal)",
             buckets=metrics_mod.ROUTER_SPREAD_BUCKETS)
+        # --- thread-per-replica stepping (TpuConfig.router_threading) -----
+        # per-replica step wall time + the router's replica-stepping-phase
+        # span: overlap_frac = 1 - phase_wall / sum(replica walls) is the
+        # measured concurrency win (0 == host-serialized sequential
+        # stepping; (N-1)/N == N replicas perfectly overlapped)
+        self._replica_step_ms = r.histogram(
+            "nxdi_replica_step_ms",
+            "one replica's session.step() wall time (host clock; recorded "
+            "by the router thread after the per-step barrier)",
+            labels=("replica",), buckets=metrics_mod.LATENCY_MS_BUCKETS)
+        self._router_step_ms = r.histogram(
+            "nxdi_router_step_ms",
+            "wall time of the router step's replica-stepping phase (all "
+            "replicas dispatched, barrier waited)",
+            buckets=metrics_mod.LATENCY_MS_BUCKETS)
+        self._router_overlap = r.gauge(
+            "nxdi_router_step_overlap_frac",
+            "cumulative 1 - stepping-phase wall / sum of per-replica step "
+            "walls: ~0 = sequential, (N-1)/N = N replicas fully overlapped")
+        self._router_step_wall_ms_sum = 0.0
+        self._replica_step_ms_sum = 0.0
         self._jit_traces = r.counter(
             "nxdi_jit_traces_total", "jit traces observed (compiles)",
             labels=("tag",))
@@ -278,12 +313,13 @@ class TelemetrySession:
     # ---- lifecycle of the session itself ---------------------------------
 
     def close(self) -> None:
-        if self._listener is not None:
-            retrace_guard.remove_trace_listener(self._listener)
-            self._listener = None
-        if self._jsonl_file is not None:
-            self._jsonl_file.close()
-            self._jsonl_file = None
+        with self._lock:
+            if self._listener is not None:
+                retrace_guard.remove_trace_listener(self._listener)
+                self._listener = None
+            if self._jsonl_file is not None:
+                self._jsonl_file.close()
+                self._jsonl_file = None
 
     def __enter__(self) -> "TelemetrySession":
         return self
@@ -297,10 +333,13 @@ class TelemetrySession:
         if not self.enabled:
             return
         rec = {"ts": self.clock(), "type": etype, **fields}
-        self.events.append(rec)
-        if self._jsonl_file is not None:
-            self._jsonl_file.write(json.dumps(rec) + "\n")
-            self._jsonl_file.flush()
+        with self._lock:
+            self.events.append(rec)
+            if self._jsonl_file is not None:
+                # under the lock so concurrent replica threads cannot
+                # interleave half-written JSONL lines
+                self._jsonl_file.write(json.dumps(rec) + "\n")
+                self._jsonl_file.flush()
 
     @contextmanager
     def span(self, name: str, **fields):
@@ -322,26 +361,30 @@ class TelemetrySession:
         if not self.enabled:
             return
         self._submitted.inc()
-        self.traces[req_id] = RequestTrace(req_id=req_id, t_submit=self.clock())
+        with self._lock:
+            self.traces[req_id] = RequestTrace(
+                req_id=req_id, t_submit=self.clock()
+            )
         self.event("request_submitted", req_id=req_id)
 
     def request_admitted(self, req_id: str, cached_prefix_tokens: int = 0) -> None:
         if not self.enabled:
             return
-        tr = self.traces.get(req_id)
-        if tr is not None and tr.t_admit is not None:
-            # RE-admission after a pool-exhaustion eviction: the request
-            # already holds its admission accounting (t_admit, the admitted
-            # counter) — re-counting would make admitted > submitted and
-            # shift queue-wait/TTFT baselines. Only the event log records
-            # the resumption.
-            self.event("request_readmitted", req_id=req_id,
-                       cached_prefix_tokens=cached_prefix_tokens)
-            return
-        self._admitted.inc()
-        if tr is not None:
-            tr.t_admit = self.clock()
-            tr.cached_prefix_tokens = cached_prefix_tokens
+        with self._lock:
+            tr = self.traces.get(req_id)
+            if tr is not None and tr.t_admit is not None:
+                # RE-admission after a pool-exhaustion eviction: the request
+                # already holds its admission accounting (t_admit, the
+                # admitted counter) — re-counting would make admitted >
+                # submitted and shift queue-wait/TTFT baselines. Only the
+                # event log records the resumption.
+                self.event("request_readmitted", req_id=req_id,
+                           cached_prefix_tokens=cached_prefix_tokens)
+                return
+            self._admitted.inc()
+            if tr is not None:
+                tr.t_admit = self.clock()
+                tr.cached_prefix_tokens = cached_prefix_tokens
         self.event("request_admitted", req_id=req_id,
                    cached_prefix_tokens=cached_prefix_tokens)
 
@@ -349,11 +392,12 @@ class TelemetrySession:
         if not self.enabled:
             return
         self._dropped.child((reason,)).inc()
-        tr = self.traces.pop(req_id, None)
-        if tr is not None:
-            tr.finish_reason = "dropped"
-            tr.t_finish = self.clock()
-            self.completed.append(tr)
+        with self._lock:
+            tr = self.traces.pop(req_id, None)
+            if tr is not None:
+                tr.finish_reason = "dropped"
+                tr.t_finish = self.clock()
+                self.completed.append(tr)
         self.event("request_dropped", req_id=req_id, reason=reason)
 
     def request_rejected(self, req_id: str, reason: str) -> None:
@@ -363,11 +407,12 @@ class TelemetrySession:
         if not self.enabled:
             return
         self._rejected.child((reason,)).inc()
-        tr = self.traces.pop(req_id, None)
-        if tr is not None:
-            tr.finish_reason = "rejected"
-            tr.t_finish = self.clock()
-            self.completed.append(tr)
+        with self._lock:
+            tr = self.traces.pop(req_id, None)
+            if tr is not None:
+                tr.finish_reason = "rejected"
+                tr.t_finish = self.clock()
+                self.completed.append(tr)
         self.event("request_rejected", req_id=req_id, reason=reason)
 
     def request_preempted(self, req_id: str) -> None:
@@ -422,37 +467,42 @@ class TelemetrySession:
         if not self.enabled:
             return
         self._prefill_tokens.inc(n_tokens)
-        tr = self.traces.get(req_id)
-        if tr is not None:
-            tr.prefill_chunks += 1
-            if tr.t_first_dispatch is None:
-                tr.t_first_dispatch = self.clock()
-                self._queue_wait.observe((tr.t_first_dispatch - tr.t_submit) * 1e3)
+        with self._lock:
+            tr = self.traces.get(req_id)
+            if tr is not None:
+                tr.prefill_chunks += 1
+                if tr.t_first_dispatch is None:
+                    tr.t_first_dispatch = self.clock()
+                    self._queue_wait.observe(
+                        (tr.t_first_dispatch - tr.t_submit) * 1e3
+                    )
 
     def request_first_token(self, req_id: str) -> None:
         if not self.enabled:
             return
-        tr = self.traces.get(req_id)
-        if tr is not None and tr.t_first_token is not None:
-            # the resumed prefill of a RE-admitted request emits a token the
-            # same way a fresh admission does, but the request's first token
-            # happened before its eviction: record a regular token
-            # observation (its "ITL" spans the preempted gap — the latency
-            # the user actually saw) and leave t_first_token/TTFT alone, so
-            # "TTFT count == finished requests" holds under preemption.
-            self.request_tokens(req_id, 1)
-            return
-        now = self.clock()
-        self._tokens.inc()
-        if tr is not None:
-            if tr.t_first_dispatch is None:
-                # non-chunked admission: prefill dispatch == first dispatch
-                tr.t_first_dispatch = now
-                self._queue_wait.observe((now - tr.t_submit) * 1e3)
-            tr.t_first_token = tr.t_last_token = now
-            tr.tokens += 1
-            self._ttft.observe((now - tr.t_submit) * 1e3)
-            self._chunks_per_req.observe(max(1, tr.prefill_chunks))
+        with self._lock:
+            tr = self.traces.get(req_id)
+            if tr is not None and tr.t_first_token is not None:
+                # the resumed prefill of a RE-admitted request emits a token
+                # the same way a fresh admission does, but the request's
+                # first token happened before its eviction: record a regular
+                # token observation (its "ITL" spans the preempted gap — the
+                # latency the user actually saw) and leave t_first_token/
+                # TTFT alone, so "TTFT count == finished requests" holds
+                # under preemption.
+                self.request_tokens(req_id, 1)
+                return
+            now = self.clock()
+            self._tokens.inc()
+            if tr is not None:
+                if tr.t_first_dispatch is None:
+                    # non-chunked admission: prefill dispatch == first one
+                    tr.t_first_dispatch = now
+                    self._queue_wait.observe((now - tr.t_submit) * 1e3)
+                tr.t_first_token = tr.t_last_token = now
+                tr.tokens += 1
+                self._ttft.observe((now - tr.t_submit) * 1e3)
+                self._chunks_per_req.observe(max(1, tr.prefill_chunks))
         self.event("first_token", req_id=req_id)
 
     def request_tokens(self, req_id: str, n: int) -> None:
@@ -462,14 +512,15 @@ class TelemetrySession:
             return
         now = self.clock()
         self._tokens.inc(n)
-        tr = self.traces.get(req_id)
-        if tr is not None and tr.t_last_token is not None:
-            per_tok = (now - tr.t_last_token) / n
-            for _ in range(n):
-                self._itl.observe(per_tok * 1e3)
-                tr.itl_s.append(per_tok)
-            tr.t_last_token = now
-            tr.tokens += n
+        with self._lock:
+            tr = self.traces.get(req_id)
+            if tr is not None and tr.t_last_token is not None:
+                per_tok = (now - tr.t_last_token) / n
+                for _ in range(n):
+                    self._itl.observe(per_tok * 1e3)
+                    tr.itl_s.append(per_tok)
+                tr.t_last_token = now
+                tr.tokens += n
 
     def tokens_generated(self, n: int) -> None:
         """Bare token count for host loops with no request identity
@@ -485,11 +536,12 @@ class TelemetrySession:
         if not self.enabled:
             return
         self._finished.child((reason,)).inc()
-        tr = self.traces.pop(req_id, None)
-        if tr is not None:
-            tr.finish_reason = reason
-            tr.t_finish = self.clock()
-            self.completed.append(tr)
+        with self._lock:
+            tr = self.traces.pop(req_id, None)
+            if tr is not None:
+                tr.finish_reason = reason
+                tr.t_finish = self.clock()
+                self.completed.append(tr)
         self.event("request_finished", req_id=req_id, reason=reason)
 
     # ---- step-level ------------------------------------------------------
@@ -523,11 +575,15 @@ class TelemetrySession:
             return
         self._step_host_ms.observe(host_ms)
         self._step_fetch_wait_ms.observe(fetch_wait_ms)
-        self._host_ms_sum += max(0.0, host_ms)
-        self._fetch_wait_ms_sum += max(0.0, fetch_wait_ms)
-        denom = self._host_ms_sum + self._fetch_wait_ms_sum
-        if denom > 0:
-            self._host_frac.set(self._host_ms_sum / denom)
+        with self._lock:
+            # the cumulative sums are plain floats shared by every replica
+            # step thread: += is a read-modify-write, locked like the
+            # instrument internals (CONC601/CONC603)
+            self._host_ms_sum += max(0.0, host_ms)
+            self._fetch_wait_ms_sum += max(0.0, fetch_wait_ms)
+            denom = self._host_ms_sum + self._fetch_wait_ms_sum
+            if denom > 0:
+                self._host_frac.set(self._host_ms_sum / denom)
         self.event(
             "step_timing", host_ms=host_ms, fetch_wait_ms=fetch_wait_ms
         )
@@ -596,6 +652,33 @@ class TelemetrySession:
             return
         self._router_queue.set(queue_depth)
         self._router_spread.observe(spread)
+
+    def replica_step(self, replica_id: int, step_ms: float) -> None:
+        """One replica's session.step() wall time (recorded on the ROUTER
+        thread after the per-step barrier, so threaded and sequential
+        stepping record through the identical path)."""
+        if not self.enabled:
+            return
+        self._replica_step_ms.child((str(int(replica_id)),)).observe(step_ms)
+
+    def router_step_timing(self, phase_wall_ms: float, replica_ms_sum: float) -> None:
+        """Wall time of one router step's replica-stepping phase beside the
+        sum of its per-replica step walls. The cumulative overlap gauge is
+        ``1 - wall / sum``: ~0 when replicas step host-serialized, up to
+        (N-1)/N when thread-per-replica stepping overlaps them fully — the
+        bench row's ``router_step_overlap_frac`` source."""
+        if not self.enabled:
+            return
+        self._router_step_ms.observe(phase_wall_ms)
+        with self._lock:
+            self._router_step_wall_ms_sum += max(0.0, phase_wall_ms)
+            self._replica_step_ms_sum += max(0.0, replica_ms_sum)
+            if self._replica_step_ms_sum > 0:
+                self._router_overlap.set(max(
+                    0.0,
+                    1.0 - self._router_step_wall_ms_sum
+                    / self._replica_step_ms_sum,
+                ))
 
     def spec_accept(self, committed: int) -> None:
         """One speculation round committed ``committed`` tokens for one

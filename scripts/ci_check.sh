@@ -1,8 +1,8 @@
 #!/usr/bin/env bash
 # The pre-PR check: the FULL static-analysis gate (tpulint + flag audit +
-# graph/shard/memory audits + the roofline cost audit, COST501-504) plus the
-# static_analysis pytest subset, as one command with a nonzero exit on ANY
-# finding or test failure.
+# graph/shard/memory audits + the roofline cost audit COST501-504 + the
+# concurrency audit CONC601-604) plus the static_analysis pytest subset, as
+# one command with a nonzero exit on ANY finding or test failure.
 #
 #   bash scripts/ci_check.sh            # text reports
 #   bash scripts/ci_check.sh --json     # gate report as JSON
@@ -24,7 +24,7 @@ esac
 
 rc=0
 
-echo "== static-analysis gate (lint, flags, graph, shard, memory, cost) =="
+echo "== static-analysis gate (lint, flags, graph, shard, memory, cost, conc) =="
 python scripts/run_static_analysis.py "$@" || rc=$?
 
 echo
@@ -36,8 +36,8 @@ echo "== robustness (serving fault-containment) pytest subset =="
 python -m pytest tests -q -m robustness -p no:cacheprovider || rc=$?
 
 echo
-echo "== router (multi-replica serving front-end) pytest subset =="
-python -m pytest tests/test_router.py -q -m 'not slow' -p no:cacheprovider || rc=$?
+echo "== router (multi-replica front-end + threaded stepping) pytest subset =="
+python -m pytest tests/test_router.py tests/test_router_threaded.py -q -m 'not slow' -p no:cacheprovider || rc=$?
 
 if [ "$rc" -ne 0 ]; then
   echo "ci_check: FAILED (rc=$rc)" >&2

@@ -26,12 +26,14 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 ALL_POINTS = {
     "bf16_1b_bs1", "bf16_1b_bs4", "int8_1b_bs1", "serving_1b_int8",
     "serving_1b_int8_ragged", "serving_1b_int8_ragged_async",
-    "serving_1b_int8_spec_ragged", "serving_1b_int8_router", "int8_8b_bs1",
+    "serving_1b_int8_spec_ragged", "serving_1b_int8_router",
+    "serving_1b_int8_router_threaded", "int8_8b_bs1",
     "bf16_1b_8k", "bf16_1b_8k_kvq8", "bf16_1b_16k", "bf16_1b_16k_kvq8",
 }
 SERVING_POINTS = {
     "serving_1b_int8", "serving_1b_int8_ragged", "serving_1b_int8_ragged_async",
     "serving_1b_int8_spec_ragged", "serving_1b_int8_router",
+    "serving_1b_int8_router_threaded",
 }
 
 
@@ -86,6 +88,18 @@ def test_bench_suite_tiny(monkeypatch):
     assert 0.0 < router["balance_frac"] <= 1.0
     assert len(router["tokens_per_replica"]) == 2
     assert all(t > 0 for t in router["tokens_per_replica"])
+    assert router["router_threading"] is False
+    # ISSUE 13: the thread-per-replica row — SAME routed mix with the
+    # worker pool on: byte-identical serving semantics (0 failovers, both
+    # replicas served), plus the measured per-step overlap fraction from
+    # the nxdi_replica_step_ms histograms + the router-step span
+    threaded = points["serving_1b_int8_router_threaded"]
+    assert threaded["router_threading"] is True
+    assert threaded["n_replicas"] == 2
+    assert threaded["failover"] == 0 and threaded["rejected"] == 0
+    assert all(t > 0 for t in threaded["tokens_per_replica"])
+    assert threaded["overlap_frac"] is not None
+    assert 0.0 <= threaded["overlap_frac"] < 1.0
     # emit fired after EVERY point (the incremental-summary contract) and
     # every snapshot produces a valid summary line
     assert len(emitted) == len(ALL_POINTS)
@@ -141,6 +155,9 @@ def test_bench_suite_tiny(monkeypatch):
     assert final["router_tok_s"] > 0
     assert final["router_failover"] == 0
     assert 0.0 < final["router_balance_frac"] <= 1.0
+    assert final["router_threaded_tok_s"] > 0
+    assert final["router_step_overlap_frac"] is not None
+    assert 0.0 <= final["router_step_overlap_frac"] < 1.0
     # --metrics-out: the tiny suite ran the serving point in-process, so the
     # process-default registry must hold the full serving metric set
     import tempfile

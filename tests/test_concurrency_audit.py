@@ -1,0 +1,549 @@
+"""Concurrency-contract analyzer (ISSUE 13): CONC601-604 proven detectors +
+clean-tree gate.
+
+Every rule must (a) FIRE on a synthetic violation fixture and (b) pass on
+the fixed form — an analyzer that never fires proves nothing. The clean-tree
+pins are the actual contract: the audited confinement model is what makes
+``TpuConfig.router_threading`` safe (tests/test_router_threaded.py pins the
+behavior side; this file pins the static side).
+"""
+
+import textwrap
+
+import pytest
+
+from neuronx_distributed_inference_tpu.analysis import concurrency_audit as ca
+from neuronx_distributed_inference_tpu.analysis.findings import Baseline
+
+pytestmark = pytest.mark.static_analysis
+
+
+def _audit(tmp_path, name, source):
+    f = tmp_path / name
+    f.parent.mkdir(parents=True, exist_ok=True)
+    f.write_text(textwrap.dedent(source))
+    return ca.audit_paths([f])
+
+
+def _errors(findings, rule=None):
+    return [
+        f for f in findings
+        if f.severity == "error" and (rule is None or f.rule == rule)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# CONC601: shared-mutable-state census
+# ---------------------------------------------------------------------------
+
+_SHARED_WRITE = """
+    import threading
+
+    class TelemetrySession:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self.sum_ms = 0.0
+
+        def record(self, ms):
+            {body}
+
+    class ServingSession:
+        def step(self):
+            self.tel.record(1.0)
+
+    class ReplicaHandle:
+        def step(self):
+            self.session.step()
+"""
+
+
+def test_conc601_unlocked_shared_write_from_worker_path_fires(tmp_path):
+    findings = _audit(
+        tmp_path, "serving.py",
+        _SHARED_WRITE.format(body="self.sum_ms += ms"),
+    )
+    errs = _errors(findings, "CONC601")
+    assert len(errs) == 1
+    assert "TelemetrySession.sum_ms" in errs[0].message
+    assert "worker-reachable path without a lock" in errs[0].message
+
+
+def test_conc601_locked_shared_write_classifies_clean(tmp_path):
+    findings = _audit(
+        tmp_path, "serving.py",
+        _SHARED_WRITE.format(
+            body="with self._lock:\n                self.sum_ms += ms"
+        ),
+    )
+    assert _errors(findings) == []
+    census = {
+        f.key for f in findings
+        if f.rule == "CONC601" and "sum_ms" in f.key
+    }
+    assert census == {
+        "runtime/serving.py::TelemetrySession.sum_ms::init-confined",
+        "runtime/serving.py::TelemetrySession.sum_ms::lock-protected",
+    }
+
+
+def test_conc601_router_state_written_on_worker_path_fires(tmp_path):
+    findings = _audit(
+        tmp_path, "router.py",
+        """
+        class ServingRouter:
+            pass
+
+        class ReplicaHandle:
+            def step(self, router: ServingRouter):
+                router.pending.append(1)   # BUG: router state on a worker
+        """,
+    )
+    errs = _errors(findings, "CONC601")
+    assert len(errs) == 1
+    assert "router-thread-owned state" in errs[0].message
+    assert errs[0].key.endswith("ServingRouter.pending::unclassified")
+
+
+def test_conc601_module_global_written_on_worker_path_fires(tmp_path):
+    findings = _audit(
+        tmp_path, "serving.py",
+        """
+        _CACHE = {}
+
+        class ReplicaHandle:
+            def step(self):
+                _CACHE["k"] = 1   # BUG: module global on the worker path
+        """,
+    )
+    errs = _errors(findings, "CONC601")
+    assert len(errs) == 1
+    assert "module-global" in errs[0].message
+    # the fixed form: same write from a router-thread-only function
+    fixed = _audit(
+        tmp_path / "fixed", "serving.py",
+        """
+        _CACHE = {}
+
+        class ServingRouter:
+            def configure(self):
+                _CACHE["k"] = 1   # driver-thread setup: census, no error
+        """,
+    )
+    assert _errors(fixed) == []
+    assert any(
+        f.key.endswith("<module>._CACHE::router-thread") for f in fixed
+    )
+
+
+def test_conc601_replica_owned_writes_classify_confined(tmp_path):
+    findings = _audit(
+        tmp_path, "serving.py",
+        """
+        class Request:
+            pass
+
+        class ServingSession:
+            def __init__(self):
+                self.slots = []
+
+            def step(self):
+                for r in self.slots:
+                    r.pos = 1          # replica-owned: confined
+
+            def add_request(self, req: Request):
+                req.pos = 0            # router-phase admission
+
+        class ReplicaHandle:
+            def step(self):
+                self.session.step()
+        """,
+    )
+    assert _errors(findings) == []
+    keys = {f.key for f in findings if "Request.pos" in f.key}
+    assert keys == {
+        "runtime/serving.py::Request.pos::replica-step-confined",
+        "runtime/serving.py::Request.pos::router-thread",
+    }
+
+
+def test_conc601_pragma_suppresses(tmp_path):
+    findings = _audit(
+        tmp_path, "serving.py",
+        """
+        class TelemetrySession:
+            def record(self, ms):
+                self.sum_ms += ms  # conc: ignore[CONC601]
+
+        class ReplicaHandle:
+            def step(self):
+                self.tel.record(1.0)
+        """,
+    )
+    assert _errors(findings, "CONC601") == []
+
+
+def test_conc601_census_is_baseline_pinned(tmp_path):
+    """New shared state trips the gate: a census built from one tree flags
+    a write site added later (new key => zero budget => NEW finding)."""
+    base_findings = _audit(
+        tmp_path, "serving.py",
+        """
+        class ServingSession:
+            def step(self):
+                self.pos = 1
+
+        class ReplicaHandle:
+            def step(self):
+                self.session.step()
+        """,
+    )
+    baseline = Baseline.from_findings(
+        [f for f in base_findings if f.severity == "warning"]
+    )
+    assert baseline.filter_new(
+        [f for f in base_findings if f.severity == "warning"]
+    ) == []
+    grown = _audit(
+        tmp_path / "v2", "serving.py",
+        """
+        class ServingSession:
+            def step(self):
+                self.pos = 1
+                self.extra_state = 2   # NEW shared-mutable state
+
+        class ReplicaHandle:
+            def step(self):
+                self.session.step()
+        """,
+    )
+    new = baseline.filter_new([f for f in grown if f.severity == "warning"])
+    assert any("extra_state" in f.key for f in new)
+
+
+# ---------------------------------------------------------------------------
+# CONC602: lock discipline
+# ---------------------------------------------------------------------------
+
+
+def test_conc602_bare_acquire_release_fires(tmp_path):
+    findings = _audit(
+        tmp_path, "router.py",
+        """
+        import threading
+
+        class ServingRouter:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                self._lock.acquire()
+                self._lock.release()
+        """,
+    )
+    errs = _errors(findings, "CONC602")
+    assert len(errs) == 2
+    assert all("acquired only via `with`" in e.message for e in errs)
+
+
+def test_conc602_lock_order_violation_fires_and_correct_order_passes(tmp_path):
+    findings = _audit(
+        tmp_path, "router.py",
+        """
+        import threading
+
+        class TelemetrySession:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+        class ServingRouter:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def inverted(self, tel: TelemetrySession):
+                with tel._lock:        # level 2 held...
+                    self.grab()
+
+            def grab(self):
+                with self._lock:       # ...level 0 acquired: cycle risk
+                    pass
+        """,
+    )
+    errs = _errors(findings, "CONC602")
+    assert any("lock-order violation" in e.message for e in errs)
+    ok = _audit(
+        tmp_path / "ok", "router.py",
+        """
+        import threading
+
+        class TelemetrySession:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def record(self):
+                with self._lock:
+                    pass
+
+        class ServingRouter:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fine(self, tel: TelemetrySession):
+                with self._lock:       # level 0 -> level 2: increasing
+                    tel.record()
+        """,
+    )
+    assert not any(
+        "lock-order violation" in e.message for e in _errors(ok, "CONC602")
+    )
+
+
+def test_conc602_plain_lock_reentry_fires_rlock_passes(tmp_path):
+    src = """
+        import threading
+
+        class TelemetrySession:
+            def __init__(self):
+                self._lock = threading.{kind}()
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+    """
+    bad = _audit(tmp_path, "tracing.py", src.format(kind="Lock"))
+    assert any(
+        "re-entrant acquisition of non-reentrant lock" in e.message
+        for e in _errors(bad, "CONC602")
+    )
+    good = _audit(tmp_path / "ok", "tracing.py", src.format(kind="RLock"))
+    assert not any(
+        "re-entrant" in e.message for e in _errors(good, "CONC602")
+    )
+
+
+def test_conc602_blocking_under_router_lock_fires(tmp_path):
+    findings = _audit(
+        tmp_path, "router.py",
+        """
+        import threading
+        import time
+        import jax
+
+        class ServingRouter:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(0.1)
+                    jax.device_get(1)
+
+            def also_bad(self):
+                with self._lock:
+                    self.helper()
+
+            def helper(self):
+                time.sleep(0.5)
+        """,
+    )
+    errs = [
+        e for e in _errors(findings, "CONC602")
+        if "blocking call" in e.message
+    ]
+    assert len(errs) == 3  # sleep + device_get direct, sleep via call graph
+    ok = _audit(
+        tmp_path / "ok", "router.py",
+        """
+        import threading
+        import time
+
+        class ServingRouter:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fine(self):
+                with self._lock:
+                    self.counter = 1
+                time.sleep(0.1)   # outside the lock
+        """,
+    )
+    assert not any(
+        "blocking call" in e.message for e in _errors(ok, "CONC602")
+    )
+
+
+# ---------------------------------------------------------------------------
+# CONC603: telemetry atomicity
+# ---------------------------------------------------------------------------
+
+
+def test_conc603_instrument_internal_rmw_fires(tmp_path):
+    findings = _audit(
+        tmp_path, "tracing.py",
+        """
+        class TelemetrySession:
+            def record(self, ctr, hist):
+                ctr.value += 1              # BUG: bypasses inc()
+                hist.sum += 2.0             # BUG
+                hist.counts[0] += 1         # BUG: bucket internals
+        """,
+    )
+    errs = _errors(findings, "CONC603")
+    assert len(errs) == 3
+    assert all("atomic inc()/set()/observe()" in e.message for e in errs)
+
+
+def test_conc603_atomic_mutators_and_locked_instruments_pass(tmp_path):
+    findings = _audit(
+        tmp_path, "metrics.py",
+        """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self.value = 0.0
+                self._lock = threading.Lock()
+
+            def inc(self, n=1.0):
+                with self._lock:
+                    self.value += n
+
+        class TelemetrySession:
+            def record(self, ctr):
+                ctr.inc()
+        """,
+    )
+    assert _errors(findings, "CONC603") == []
+
+
+def test_conc603_unlocked_instrument_mutator_fires(tmp_path):
+    """The instrument's own mutator without its lock is exactly the
+    lost-update bug the satellite fixed — the rule must hold metrics.py to
+    its own contract."""
+    findings = _audit(
+        tmp_path, "metrics.py",
+        """
+        class Counter:
+            def __init__(self):
+                self.value = 0.0
+
+            def inc(self, n=1.0):
+                self.value += n     # BUG: no lock around the RMW
+        """,
+    )
+    assert len(_errors(findings, "CONC603")) == 1
+
+
+# ---------------------------------------------------------------------------
+# CONC604: router -> session touch census
+# ---------------------------------------------------------------------------
+
+
+def test_conc604_device_state_touch_fires_snapshot_is_census(tmp_path):
+    findings = _audit(
+        tmp_path, "router.py",
+        """
+        class ServingRouter:
+            def peek(self):
+                for h in self.replicas:
+                    cache = h.session.kv_cache        # BUG: device state
+                    free = h.session.kv_free_bytes    # snapshot: census
+                    w = h.session.app.params          # BUG: app != config
+                    tc = h.session.app.config         # snapshot: census
+        """,
+    )
+    errs = _errors(findings, "CONC604")
+    assert {e.key for e in errs} == {
+        "runtime/router.py::session.kv_cache::device-state",
+        "runtime/router.py::session.app::device-state",
+    }
+    census = {
+        f.key for f in findings
+        if f.rule == "CONC604" and f.severity == "warning"
+    }
+    assert census == {
+        "runtime/router.py::session.kv_free_bytes",
+        "runtime/router.py::session.app.config",
+    }
+
+
+def test_conc604_router_calling_session_step_directly_fires(tmp_path):
+    """Stepping belongs to the handle/worker: a router function driving
+    session.step() bypasses the health machine AND the thread boundary."""
+    findings = _audit(
+        tmp_path, "router.py",
+        """
+        class ServingRouter:
+            def sneaky(self):
+                for h in self.replicas:
+                    h.session.step()
+        """,
+    )
+    assert any(
+        e.key.endswith("session.step::device-state")
+        for e in _errors(findings, "CONC604")
+    )
+
+
+# ---------------------------------------------------------------------------
+# clean tree: the gate itself
+# ---------------------------------------------------------------------------
+
+
+def test_clean_tree_no_errors_and_census_matches_baseline():
+    new = ca.run(write_baseline=False)
+    assert new == [], [f.render() for f in new]
+    rep = ca.last_report()
+    assert rep["errors"] == 0
+    assert rep["write_sites"] > 300  # the census actually covers the tree
+    # the three unsafe-state disciplines all appear in the real tree
+    assert set(rep["classifications"]) == {
+        "init-confined", "lock-protected", "replica-step-confined",
+        "router-thread",
+    }
+
+
+def test_clean_tree_router_session_touch_allowlist():
+    """The router reads exactly this host-snapshot surface — a new touch
+    (or a device-state reach-through) must be a reviewed diff, not an
+    accident."""
+    ca.run(write_baseline=False)
+    touches = set(ca.last_report()["session_touches"])
+    assert touches == {
+        "runtime/router.py::session._readmit",
+        "runtime/router.py::session._validate_request",
+        "runtime/router.py::session.active",
+        "runtime/router.py::session.add_request",
+        "runtime/router.py::session.allocator",
+        "runtime/router.py::session.app.config",
+        "runtime/router.py::session.kv_free_bytes",
+        "runtime/router.py::session.requests",
+    }
+
+
+def test_cli_suites_conc_exits_zero(capsys):
+    """The acceptance-criterion invocation: `python -m ...analysis --suites
+    conc` exits 0 on the clean tree."""
+    from neuronx_distributed_inference_tpu.analysis.__main__ import main
+
+    rc = main(["--suites", "conc"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "concurrency write-site census" in out
+
+
+def test_worker_entry_set_matches_threaded_router():
+    """The analyzer's worker entries ARE the code the pool runs: if the
+    threaded router ever submits something else, this pin forces the
+    analyzer's model to follow."""
+    import inspect
+
+    from neuronx_distributed_inference_tpu.runtime import router as router_mod
+
+    src = inspect.getsource(router_mod._ReplicaStepWorker.run)
+    assert "self.handle.step()" in src
+    assert ("ReplicaHandle", "step") in ca.WORKER_ENTRIES
+    assert ("_ReplicaStepWorker", "run") in ca.WORKER_ENTRIES

@@ -1,0 +1,466 @@
+"""Thread-per-replica router stepping (ISSUE 13, `TpuConfig.router_threading`).
+
+The contract the concurrency audit licenses, pinned behaviorally:
+- a 2-replica THREADED drain is byte-identical to sequential stepping and
+  to a single session on the same request set — under clean traffic AND
+  under every fault mode the router already survives (kill-mid-drain,
+  stall-driven watchdog death, NaN-quarantine, pool-exhaustion churn,
+  dispatch-retry exhaustion failover);
+- zero steady-state recompiles with the pool on, and telemetry fetch
+  parity (identical consumed device fetches telemetry on/off, threaded ==
+  sequential);
+- the pool is persistent (one thread per replica, alive across steps) and
+  LEAK-FREE: router.close() joins every worker.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from tests.conftest import make_tiny_config, make_random_hf_state_dict
+
+from neuronx_distributed_inference_tpu.config import ChunkedPrefillConfig
+from neuronx_distributed_inference_tpu.parallel.mesh import mesh_from_config
+from neuronx_distributed_inference_tpu.runtime.application import TpuModelForCausalLM
+from neuronx_distributed_inference_tpu.runtime.faults import FaultInjector
+from neuronx_distributed_inference_tpu.runtime.router import (
+    ServingRouter,
+    partition_devices,
+)
+from neuronx_distributed_inference_tpu.runtime.serving import ServingSession
+from neuronx_distributed_inference_tpu.telemetry import TelemetrySession
+
+pytestmark = pytest.mark.router
+
+REQS = {
+    "r1": dict(ids=[5, 17, 92, 41], gen=6),
+    "r2": dict(ids=list(range(30, 52)), gen=6),
+    "r3": dict(ids=[7, 7, 7], gen=5),
+    "r4": dict(ids=[11, 23, 5, 99, 100, 3], gen=6),
+    "r5": dict(ids=[64, 2, 90, 14], gen=5),
+    "r6": dict(ids=[33, 88, 2], gen=6),
+}
+
+
+def _paged_cfg(**extra):
+    tpu = dict(
+        is_continuous_batching=True, batch_size=4, ctx_batch_size=1,
+        is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=24,
+        is_chunked_prefill=True,
+        chunked_prefill_config=ChunkedPrefillConfig(
+            max_num_seqs=2, kernel_q_tile_size=16
+        ),
+        seq_len=64,
+    )
+    tpu.update(extra)
+    return make_tiny_config(tpu=tpu)
+
+
+@pytest.fixture(scope="module")
+def replica_apps():
+    sd = make_random_hf_state_dict(_paged_cfg())
+    parts = partition_devices(2)
+    apps = []
+    for i in range(2):
+        cfg = _paged_cfg()
+        app = TpuModelForCausalLM(
+            None, cfg, mesh=mesh_from_config(cfg.tpu_config, devices=parts[i])
+        )
+        apps.append(app.load(state_dict=sd))
+    return apps
+
+
+def _drain(apps, threaded, reqs=REQS, injectors=None, telemetry=None,
+           **router_kw):
+    for app in apps:
+        app.init_kv_cache()
+    sessions = [
+        ServingSession(
+            app,
+            fault_injector=injectors[i] if injectors else None,
+            telemetry=telemetry,
+        )
+        for i, app in enumerate(apps)
+    ]
+    router = ServingRouter(sessions, telemetry=telemetry, threaded=threaded,
+                           **router_kw)
+    try:
+        for rid, spec in reqs.items():
+            assert router.add_request(rid, spec["ids"],
+                                      max_new_tokens=spec["gen"],
+                                      eos_token_id=spec.get("eos"))
+        out = router.run_to_completion()
+    finally:
+        router.close()
+    return out, router
+
+
+@pytest.fixture(scope="module")
+def sequential_reference(replica_apps):
+    out, _ = _drain(replica_apps, threaded=False)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# clean traffic: threaded == sequential == single session
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("policy", ["least_loaded", "round_robin"])
+def test_threaded_drain_byte_identical_to_sequential(
+    replica_apps, sequential_reference, policy
+):
+    seq, _ = _drain(replica_apps, threaded=False, policy=policy)
+    thr, router = _drain(replica_apps, threaded=True, policy=policy)
+    assert thr == seq
+    if policy == "least_loaded":
+        assert thr == sequential_reference
+
+
+def test_threaded_drain_byte_identical_to_single_session(
+    replica_apps, sequential_reference
+):
+    """Transitively with test_router.py's single-session pin, but prove it
+    directly here: one session serving the whole set == the threaded
+    2-replica drain."""
+    app = replica_apps[0]
+    app.init_kv_cache()
+    sess = ServingSession(app)
+    items = list(REQS.items())
+    i = 0
+    guard = 0
+    while i < len(items):
+        rid, spec = items[i]
+        if sess.add_request(rid, spec["ids"], max_new_tokens=spec["gen"]):
+            i += 1
+        else:
+            sess.step()
+        guard += 1
+        assert guard < 500
+    sess.run_to_completion()
+    single = {rid: list(sess.requests[rid].generated) for rid, _ in items}
+    thr, _ = _drain(replica_apps, threaded=True)
+    assert thr == single == sequential_reference
+
+
+def test_config_knob_builds_pool_and_default_is_off(replica_apps):
+    for app in replica_apps:
+        app.init_kv_cache()
+    router = ServingRouter([ServingSession(app) for app in replica_apps])
+    assert not router.threaded and not router._workers  # default OFF
+    router.close()  # no-op, never raises
+    tc = replica_apps[0].config.tpu_config
+    tc.router_threading = True
+    try:
+        router = ServingRouter([ServingSession(app) for app in replica_apps])
+        assert router.threaded
+        assert set(router._workers) == {0, 1}
+        assert all(w.is_alive() for w in router._workers.values())
+    finally:
+        tc.router_threading = False
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# fault modes: each byte-identical to the sequential router (robustness)
+# ---------------------------------------------------------------------------
+
+
+def test_replica_kill_mid_drain_threaded_byte_identical(
+    replica_apps, sequential_reference
+):
+    for app in replica_apps:
+        app.init_kv_cache()
+    with TelemetrySession() as tel:
+        router = ServingRouter(
+            [ServingSession(app, telemetry=tel) for app in replica_apps],
+            telemetry=tel, threaded=True,
+        )
+        try:
+            for rid, spec in REQS.items():
+                assert router.add_request(rid, spec["ids"],
+                                          max_new_tokens=spec["gen"])
+            for _ in range(3):
+                router.step()
+            victim = router.replicas[0]
+            assert victim.owned  # the kill interrupts real work
+            victim.kill()
+            out = router.run_to_completion()
+        finally:
+            router.close()
+    assert out == sequential_reference
+    assert victim.health == "dead"
+    assert any(r.failovers for r in router.requests.values())
+
+
+@pytest.mark.robustness
+def test_stall_watchdog_death_threaded_byte_identical(
+    replica_apps, sequential_reference
+):
+    """A stall-driven WatchdogError on a WORKER thread is converted to
+    replica death inside handle.step (never a raise escaping the barrier)
+    and the drain stays byte-identical."""
+    for app in replica_apps:
+        app.config.tpu_config.watchdog_no_progress_steps = 2
+    try:
+        inj = FaultInjector().stall(*range(1, 40))
+        out, router = _drain(replica_apps, threaded=True,
+                             injectors=[inj, None])
+    finally:
+        for app in replica_apps:
+            app.config.tpu_config.watchdog_no_progress_steps = 256
+    assert out == sequential_reference
+    assert router.replicas[0].health == "dead"
+    assert router.replicas[0].health_reason == "watchdog"
+    assert router.replicas[0].watchdog_error is not None
+
+
+@pytest.mark.robustness
+def test_nan_quarantine_threaded_byte_identical(replica_apps):
+    """nan_logits on one row: only that request fails; co-batched requests
+    and the OTHER replica are byte-identical between threaded and
+    sequential."""
+    def run(threaded):
+        inj = FaultInjector().nan_logits(4, 0)
+        out, router = _drain(replica_apps, threaded=threaded,
+                             injectors=[inj, None])
+        statuses = {
+            rid: r.status for rid, r in sorted(router.requests.items())
+        }
+        assert inj.log  # the fault actually fired
+        return out, statuses
+
+    seq_out, seq_status = run(False)
+    thr_out, thr_status = run(True)
+    assert thr_out == seq_out
+    assert thr_status == seq_status
+    assert "failed" in set(seq_status.values())  # somebody got quarantined
+
+
+@pytest.mark.robustness
+def test_pool_exhaustion_chaos_threaded_byte_identical(replica_apps):
+    """Seeded pool-exhaustion churn on BOTH replicas: preemption +
+    re-admission fairness survive the worker threads byte-identically."""
+    def run(threaded):
+        injectors = [
+            FaultInjector(seed=1).random_schedule(
+                30, 0.3, kinds=("exhaust_pool",)
+            ),
+            FaultInjector(seed=2).random_schedule(
+                30, 0.3, kinds=("exhaust_pool",)
+            ),
+        ]
+        out, router = _drain(replica_apps, threaded=threaded,
+                             injectors=injectors)
+        assert any(i.log for i in injectors)
+        assert all(r.status == "finished" for r in router.requests.values())
+        return out
+
+    assert run(True) == run(False)
+
+
+@pytest.mark.robustness
+def test_dispatch_exhaustion_failover_threaded_byte_identical(replica_apps):
+    """Dispatch-retry exhaustion on replica 0 (observed by the router as
+    terminal FAILED(dispatch_error) rows after the barrier): the replica
+    degrades, the requests fail over, outputs stay byte-identical."""
+    def run(threaded):
+        inj = FaultInjector().dispatch_error(3, attempts=5)
+        out, router = _drain(replica_apps, threaded=threaded,
+                             injectors=[inj, None])
+        assert inj.log
+        assert router.replicas[0].health in ("degraded", "dead")
+        assert any(r.failovers for r in router.requests.values())
+        assert all(r.status == "finished" for r in router.requests.values())
+        return out
+
+    assert run(True) == run(False)
+
+
+# ---------------------------------------------------------------------------
+# pool lifecycle: persistent, leak-free
+# ---------------------------------------------------------------------------
+
+
+def test_thread_pool_is_persistent_and_joins_on_close(replica_apps):
+    baseline_threads = threading.active_count()
+    for app in replica_apps:
+        app.init_kv_cache()
+    router = ServingRouter(
+        [ServingSession(app) for app in replica_apps], threaded=True
+    )
+    workers = list(router._workers.values())
+    assert len(workers) == 2
+    assert all(w.is_alive() for w in workers)
+    assert router.add_request("p1", [5, 6, 7], max_new_tokens=3)
+    router.step()
+    # persistent: the SAME threads survive across steps
+    assert list(router._workers.values()) == workers
+    assert all(w.is_alive() for w in workers)
+    router.run_to_completion()
+    router.close()
+    for w in workers:
+        w.join(timeout=5)
+        assert not w.is_alive()
+    assert threading.active_count() == baseline_threads
+    router.close()  # idempotent
+    # after close the router still steps (sequential fallback)
+    assert router.add_request("p2", [5, 6], max_new_tokens=2)
+    router.run_to_completion()
+    assert router.requests["p2"].status == "finished"
+
+
+def test_router_context_manager_closes_pool(replica_apps):
+    for app in replica_apps:
+        app.init_kv_cache()
+    with ServingRouter(
+        [ServingSession(app) for app in replica_apps], threaded=True
+    ) as router:
+        workers = list(router._workers.values())
+        assert all(w.is_alive() for w in workers)
+    assert all(not w.is_alive() for w in workers)
+
+
+# ---------------------------------------------------------------------------
+# zero steady-state recompiles + telemetry fetch parity, pool ON
+# ---------------------------------------------------------------------------
+
+
+def test_zero_steady_state_recompiles_and_fetch_parity_threaded(replica_apps):
+    from neuronx_distributed_inference_tpu.analysis import retrace_guard
+
+    _drain(replica_apps, threaded=True)  # warm every program
+
+    traces = []
+    lock = threading.Lock()
+
+    def on_trace(tag, sealed):
+        with lock:
+            traces.append(tag)
+
+    fetches = {"n": 0}
+    real_asarray = np.asarray
+    real_device_get = jax.device_get
+
+    def counting_asarray(a, *args, **kwargs):
+        if isinstance(a, jax.Array):
+            with lock:
+                fetches["n"] += 1
+        return real_asarray(a, *args, **kwargs)
+
+    def counting_device_get(x, *args, **kwargs):
+        with lock:
+            fetches["n"] += 1
+        return real_device_get(x, *args, **kwargs)
+
+    retrace_guard.add_trace_listener(on_trace)
+    np.asarray = counting_asarray
+    jax.device_get = counting_device_get
+    try:
+        with TelemetrySession() as tel:
+            fetches["n"] = 0
+            out_tel, _ = _drain(replica_apps, threaded=True, telemetry=tel)
+            n_tel = fetches["n"]
+        fetches["n"] = 0
+        out_plain, _ = _drain(replica_apps, threaded=True)
+        n_plain = fetches["n"]
+        fetches["n"] = 0
+        out_seq, _ = _drain(replica_apps, threaded=False)
+        n_seq = fetches["n"]
+    finally:
+        np.asarray = real_asarray
+        jax.device_get = real_device_get
+        retrace_guard.remove_trace_listener(on_trace)
+    assert traces == []  # zero steady-state recompiles with the pool on
+    assert out_tel == out_plain == out_seq
+    # telemetry fetch parity AND threaded/sequential fetch parity
+    assert n_tel == n_plain == n_seq > 0
+
+
+def test_threaded_overlap_telemetry_recorded(replica_apps):
+    """nxdi_replica_step_ms carries one family per replica, the router-step
+    histogram observes once per step, and the overlap gauge lands in
+    [0, 1) — the bench row's router_step_overlap_frac source."""
+    with TelemetrySession() as tel:
+        _, router = _drain(replica_apps, threaded=True, telemetry=tel)
+    snap = tel.registry.snapshot()
+    fams = {
+        s["labels"]["replica"]: s["count"]
+        for s in snap["nxdi_replica_step_ms"]["samples"]
+    }
+    assert set(fams) == {"0", "1"}
+    steps = snap["nxdi_router_step_ms"]["samples"][0]["count"]
+    assert steps == router._step_index > 0
+    overlap = snap["nxdi_router_step_overlap_frac"]["samples"][0]["value"]
+    assert 0.0 <= overlap < 1.0
+
+
+def test_worker_exception_completes_barrier_before_reraise(replica_apps):
+    """A worker exception (programming error past handle.step's catches)
+    must re-raise on the router thread ONLY after every sibling worker has
+    parked — bailing early would let the next step() re-dispatch a worker
+    still running job N, pairing job N's result with step N+1's join and
+    overlapping the router phase with a live worker (the review-found
+    barrier desync)."""
+    import time as _time
+
+    for app in replica_apps:
+        app.init_kv_cache()
+    router = ServingRouter(
+        [ServingSession(app) for app in replica_apps], threaded=True
+    )
+    try:
+        for rid, spec in list(REQS.items())[:4]:
+            assert router.add_request(rid, spec["ids"],
+                                      max_new_tokens=spec["gen"])
+        router.step()  # both replicas hold real work
+
+        class Boom(RuntimeError):
+            pass
+
+        h0 = router.replicas[0]
+        real_step = h0.step
+
+        def exploding_step():
+            raise Boom("injected programming error")
+
+        h0.step = exploding_step
+        slow_h1 = router.replicas[1]
+        real_h1_step = slow_h1.step
+
+        def slow_step():
+            _time.sleep(0.05)  # worker 1 is still running when 0 raises
+            return real_h1_step()
+
+        slow_h1.step = slow_step
+        try:
+            with pytest.raises(Boom):
+                router.step()
+        finally:
+            h0.step = real_step
+            slow_h1.step = real_h1_step
+        # the barrier completed: worker 1 is PARKED (done set, job taken),
+        # so the next step cannot cross-pair jobs
+        for w in router._workers.values():
+            assert w._done.is_set() or not w._go.is_set()
+        # committed progress (the sessions' monotone counters) advances on
+        # the very next step — no stale-job pairing, no wedged worker
+        before = sum(
+            h.session._committed_total for h in router.replicas
+        )
+        router.step()
+        after = sum(h.session._committed_total for h in router.replicas)
+        assert after > before
+        out = router.run_to_completion()
+        assert all(
+            r.status == "finished" for r in router.requests.values()
+        )
+        # per-request streams stay exactly their budgets: the exception
+        # step lost no tokens and duplicated none
+        for rid, spec in list(REQS.items())[:4]:
+            assert len(out[rid]) == spec["gen"], (rid, out[rid])
+    finally:
+        router.close()

@@ -608,12 +608,15 @@ def test_cli_full_json_schema(capsys):
     import json
 
     report = json.loads(out)
-    assert report["suites"] == ["lint", "flags", "graph", "shard", "memory", "cost"]
+    assert report["suites"] == [
+        "lint", "flags", "graph", "shard", "memory", "cost", "conc"
+    ]
     assert report["new"] == 0
-    assert {"total", "findings", "new_findings", "memory", "cost"} <= set(report)
+    assert {"total", "findings", "new_findings", "memory", "cost",
+            "concurrency"} <= set(report)
     for f in report["findings"]:
         assert {"rule", "severity", "location", "message", "key"} <= set(f)
-        assert f["rule"][:3] in ("TPU", "GRA", "MEM", "FLA", "COS")
+        assert f["rule"][:3] in ("TPU", "GRA", "MEM", "FLA", "COS", "CON")
         # file:line for source rules, tag/bucket for graph rules
         assert (":" in f["location"]) or ("/" in f["location"])
     mem = report["memory"]
@@ -645,6 +648,18 @@ def test_cli_full_json_schema(capsys):
                 proj["t_flops_us"], proj["t_hbm_us"], proj["t_ici_us"]
             )
     assert cost["mixed_packing"]["q_tile"] > 0
+    # the concurrency section (ISSUE 13): full classification breakdown of
+    # the write-site census plus the router->session touch allowlist
+    conc = report["concurrency"]
+    assert {"write_sites", "classifications", "census",
+            "session_touches", "worker_entries"} <= set(conc)
+    assert conc["write_sites"] == sum(conc["classifications"].values()) > 0
+    assert set(conc["classifications"]) <= {
+        "init-confined", "lock-protected", "replica-step-confined",
+        "router-thread",
+    }
+    assert conc["errors"] == 0
+    assert "ReplicaHandle.step" in conc["worker_entries"]
 
 
 # ---------------------------------------------------------------------------
@@ -1461,3 +1476,126 @@ def test_router_tree_route_hot_path_is_clean():
         if f.rule == "TPU102" and "runtime/router.py" in f.key
     ]
     assert router == [], router
+
+
+# ---------------------------------------------------------------------------
+# TPU109: module-level mutable state in runtime/ written from functions
+# (ISSUE 13 satellite; 0 baseline entries — the tree must stay clean)
+# ---------------------------------------------------------------------------
+
+
+def _lint_runtime_snippet(tmp_path, source: str):
+    pkg = tmp_path / "neuronx_distributed_inference_tpu" / "runtime"
+    pkg.mkdir(parents=True, exist_ok=True)
+    f = pkg / "snippet.py"
+    f.write_text(textwrap.dedent(source))
+    return lint_paths([f], tmp_path)
+
+
+def test_tpu109_module_mutable_written_from_function_fires(tmp_path):
+    findings = _lint_runtime_snippet(
+        tmp_path,
+        """
+        _CACHE = {}
+        _SEEN = []
+        _IDS = set()
+
+        def remember(key, value):
+            _CACHE[key] = value          # BUG: hidden shared state
+
+        def note(item):
+            _SEEN.append(item)           # BUG: mutator call
+
+        def tag(i):
+            _IDS.add(i)                  # BUG: mutator call
+        """,
+    )
+    hits = [f for f in findings if f.rule == "TPU109"]
+    assert {f.key.rsplit("::", 1)[-1] for f in hits} == {
+        "_CACHE", "_SEEN", "_IDS"
+    }
+    assert all(f.severity == "warning" for f in hits)
+
+
+def test_tpu109_global_rebind_and_constructor_calls_fire(tmp_path):
+    findings = _lint_runtime_snippet(
+        tmp_path,
+        """
+        from collections import deque
+
+        _QUEUE = deque()
+        _TABLE = dict()
+
+        def push(x):
+            _QUEUE.append(x)             # BUG
+
+        def reset():
+            global _TABLE
+            _TABLE = dict()              # BUG: global rebind
+        """,
+    )
+    hits = {f.key.rsplit("::", 1)[-1] for f in findings if f.rule == "TPU109"}
+    assert hits == {"_QUEUE", "_TABLE"}
+
+
+def test_tpu109_clean_forms_pass(tmp_path):
+    """The fixed forms: read-only module constants, state on an owning
+    class, locals shadowing a module name, and a pragma'd registry."""
+    findings = _lint_runtime_snippet(
+        tmp_path,
+        """
+        _LIMITS = {"max": 8}           # read-only: never written
+        _KINDS = ("a", "b")            # immutable anyway
+        _REGISTRY = {}
+
+        class Owner:
+            def __init__(self):
+                self.cache = {}
+
+            def remember(self, k, v):
+                self.cache[k] = v      # owned state, not module state
+
+        def local_shadow():
+            _CACHE = {}
+            _CACHE["k"] = 1            # a LOCAL, not the module global
+            return _CACHE
+
+        def annotated_local_shadow():
+            _REGISTRY: dict = {}
+            _REGISTRY["k"] = 1         # AnnAssign-bound LOCAL shadows too
+            return _REGISTRY
+
+        def register(name, fn):
+            _REGISTRY[name] = fn  # tpulint: ignore[TPU109]
+        """,
+    )
+    assert [f for f in findings if f.rule == "TPU109"] == []
+
+
+def test_tpu109_outside_runtime_not_in_scope(tmp_path):
+    """The rule audits runtime/ only (the serving layers the threaded
+    router makes concurrent) — a telemetry/ops module does not fire."""
+    pkg = tmp_path / "neuronx_distributed_inference_tpu" / "ops"
+    pkg.mkdir(parents=True, exist_ok=True)
+    f = pkg / "snippet.py"
+    f.write_text(
+        textwrap.dedent(
+            """
+            _TUNE = {}
+
+            def put(k, v):
+                _TUNE[k] = v
+            """
+        )
+    )
+    findings = lint_paths([f], tmp_path)
+    assert [x for x in findings if x.rule == "TPU109"] == []
+
+
+def test_tpu109_tree_is_clean():
+    """Zero TPU109 baseline entries: the real runtime/ tree carries no
+    unsuppressed module-level mutable state written from functions."""
+    from neuronx_distributed_inference_tpu.analysis import tpulint
+
+    hits = [f for f in tpulint.run() if f.rule == "TPU109"]
+    assert hits == [], [f.render() for f in hits]

@@ -638,3 +638,153 @@ def test_serving_host_frac_recorded_on_ragged_drain():
     assert wait["count"] == host["count"]
     frac = snap["nxdi_serving_host_frac"]["samples"][0]["value"]
     assert 0.0 < frac <= 1.0
+
+
+def test_metrics_registry_thread_safe_exact_counts():
+    """ISSUE 13 satellite: concurrent labels() calls cannot mint duplicate
+    children (the check-then-act race), and inc/observe from N threads lose
+    nothing — counts and histogram sum/count conservation stay EXACT (a bare
+    `+=` would lose updates under interleaving)."""
+    import threading
+
+    from neuronx_distributed_inference_tpu.telemetry.metrics import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    ctr_fam = reg.counter("t_ctr", "x", labels=("who",))
+    hist_fam = reg.histogram("t_hist", "x", buckets=(1.0, 10.0),
+                             labels=("who",))
+    gauge = reg.gauge("t_gauge", "x")
+
+    N_THREADS, N_OPS = 8, 2000
+    barrier = threading.Barrier(N_THREADS)
+    minted = []
+    minted_lock = threading.Lock()
+
+    def worker(i):
+        barrier.wait()  # maximize contention on the first-mint race
+        # every thread asks for the SAME new labels concurrently
+        c = ctr_fam.child(("shared",))
+        h = hist_fam.child(("shared",))
+        with minted_lock:
+            minted.append((id(c), id(h)))
+        for k in range(N_OPS):
+            c.inc()
+            h.observe(float(k % 20))
+            gauge.set(i)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(N_THREADS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # one child object per label tuple, no orphans
+    assert len({m[0] for m in minted}) == 1
+    assert len({m[1] for m in minted}) == 1
+    assert set(ctr_fam.children) == {("shared",)}
+    c = ctr_fam.child(("shared",))
+    h = hist_fam.child(("shared",))
+    assert c.value == N_THREADS * N_OPS  # exact: no lost increments
+    assert h.count == N_THREADS * N_OPS
+    # conservation: sum equals the deterministic per-thread contribution
+    per_thread = sum(float(k % 20) for k in range(N_OPS))
+    assert h.sum == pytest.approx(N_THREADS * per_thread)
+    # bucket totals equal count (cumulative +Inf bucket catches all)
+    assert h.cumulative()[-1] == h.count
+
+
+def test_telemetry_session_thread_safe_token_accounting():
+    """Concurrent per-replica record paths into ONE TelemetrySession (the
+    router_threading sharing shape): token totals stay exact and the
+    trace table stays consistent."""
+    import threading
+
+    from neuronx_distributed_inference_tpu.telemetry import TelemetrySession
+
+    with TelemetrySession() as tel:
+        N_THREADS, N_TOK = 6, 500
+        for i in range(N_THREADS):
+            tel.request_submitted(f"rq{i}")
+            tel.request_admitted(f"rq{i}")
+        barrier = threading.Barrier(N_THREADS)
+
+        def worker(i):
+            barrier.wait()
+            tel.request_first_token(f"rq{i}")
+            for _ in range(N_TOK - 1):
+                tel.request_tokens(f"rq{i}", 1)
+            tel.step_timing(1.0, 1.0)
+            tel.request_finished(f"rq{i}", "length")
+
+        threads = [
+            threading.Thread(target=worker, args=(i,))
+            for i in range(N_THREADS)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = tel.registry.snapshot()
+        total = snap["nxdi_tokens_generated_total"]["samples"][0]["value"]
+        assert total == N_THREADS * N_TOK  # exact under contention
+        fin = sum(
+            s["value"]
+            for s in snap["nxdi_requests_finished_total"]["samples"]
+        )
+        assert fin == N_THREADS
+        assert len(tel.completed) == N_THREADS
+        assert not tel.traces  # every trace moved to completed exactly once
+        # host-frac sums: N threads x (1.0 + 1.0) ms, no lost updates
+        assert tel._host_ms_sum == pytest.approx(N_THREADS * 1.0)
+        assert tel._fetch_wait_ms_sum == pytest.approx(N_THREADS * 1.0)
+
+
+def test_metrics_exposition_safe_during_concurrent_minting():
+    """Review-found race: snapshot()/prometheus_text() iterate a family's
+    children while replica threads mint NEW label children under the
+    family lock — exposition must copy under that same lock or a scrape
+    dies mid-iteration with 'dictionary changed size'."""
+    import threading
+
+    from neuronx_distributed_inference_tpu.telemetry.metrics import (
+        MetricsRegistry,
+    )
+
+    reg = MetricsRegistry()
+    fam = reg.counter("t_mint", "x", labels=("who",))
+    stop = threading.Event()
+    errors = []
+
+    def minter():
+        i = 0
+        while not stop.is_set():
+            fam.child((f"label{i}",)).inc()
+            i += 1
+
+    def scraper(render):
+        try:
+            while not stop.is_set():
+                render()
+        except RuntimeError as e:  # "dictionary changed size ..."
+            errors.append(e)
+
+    threads = [threading.Thread(target=minter)] + [
+        threading.Thread(target=scraper, args=(fn,))
+        for fn in (reg.snapshot, reg.prometheus_text)
+    ]
+    for t in threads:
+        t.start()
+    import time as _time
+
+    _time.sleep(0.5)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert errors == [], errors
+    # everything minted is visible to a final scrape
+    snap = reg.snapshot()
+    assert len(snap["t_mint"]["samples"]) == len(fam.children) > 0
