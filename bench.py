@@ -296,6 +296,28 @@ def measure_point(app, *, batch, prompt_len, gen_len, long_prompt=None):
     return res
 
 
+def _counter_delta(snap, base_snap, name, exclude_reasons=()):
+    """Per-run counter delta between two registry snapshots (the PR-7
+    containment-census convention). ``exclude_reasons`` drops samples whose
+    ``reason`` label matches — the clean-traffic 0/0/0 pin excludes
+    ``reason=backlog`` from the rejected count, because an open-loop
+    goodput run INTENDS backlog refusals (ISSUE 14 satellite): they are
+    workload pressure, not containment events, and they are reported under
+    their own ``backlog_*`` keys."""
+
+    def total(s):
+        fam = s.get(name)
+        if not fam:
+            return 0
+        return int(sum(
+            smp["value"]
+            for smp in fam["samples"]
+            if smp.get("labels", {}).get("reason") not in exclude_reasons
+        ))
+
+    return total(snap) - total(base_snap)
+
+
 def measure_serving(app, *, n_requests, prompt_len, gen_len):
     """Serving-under-load: concurrent requests with staggered arrivals through
     ServingSession (continuous batching + chunked prefill + paged cache).
@@ -390,18 +412,14 @@ def measure_serving(app, *, n_requests, prompt_len, gen_len):
     # cumulative process totals.
     snap = tel.registry.snapshot()
 
-    def _ctr(name):
-        def total(s):
-            fam = s.get(name)
-            if not fam:
-                return 0
-            return int(sum(smp["value"] for smp in fam["samples"]))
-
-        return total(snap) - total(base_snap)
-
-    res["rejected"] = _ctr("nxdi_requests_rejected_total")
-    res["quarantined"] = _ctr("nxdi_rows_quarantined_total")
-    res["preempted"] = _ctr("nxdi_requests_preempted_total")
+    res["rejected"] = _counter_delta(
+        snap, base_snap, "nxdi_requests_rejected_total",
+        exclude_reasons=("backlog",),
+    )
+    res["quarantined"] = _counter_delta(
+        snap, base_snap, "nxdi_rows_quarantined_total")
+    res["preempted"] = _counter_delta(
+        snap, base_snap, "nxdi_requests_preempted_total")
     # ragged mixed-step dispatch (serving_ragged): padded-token fraction of
     # the packed total-token buckets, from the mixed-step composition
     # histogram the session records per dispatch
@@ -548,18 +566,14 @@ def measure_serving_spec(target, draft, *, n_requests, prompt_len, gen_len, k):
         "spec_rounds": int(rounds),
     }
 
-    def _ctr(name):
-        def total(s):
-            fam = s.get(name)
-            if not fam:
-                return 0
-            return int(sum(smp["value"] for smp in fam["samples"]))
-
-        return total(snap) - total(base_snap)
-
-    res["rejected"] = _ctr("nxdi_requests_rejected_total")
-    res["quarantined"] = _ctr("nxdi_rows_quarantined_total")
-    res["preempted"] = _ctr("nxdi_requests_preempted_total")
+    res["rejected"] = _counter_delta(
+        snap, base_snap, "nxdi_requests_rejected_total",
+        exclude_reasons=("backlog",),
+    )
+    res["quarantined"] = _counter_delta(
+        snap, base_snap, "nxdi_rows_quarantined_total")
+    res["preempted"] = _counter_delta(
+        snap, base_snap, "nxdi_requests_preempted_total")
     return res
 
 
@@ -631,14 +645,9 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy):
     total_tokens = sum(counts.values())
     snap = tel.registry.snapshot()
 
-    def _ctr(name):
-        def total(s):
-            fam = s.get(name)
-            if not fam:
-                return 0
-            return int(sum(smp["value"] for smp in fam["samples"]))
-
-        return total(snap) - total(base_snap)
+    def _ctr(name, exclude_reasons=()):
+        return _counter_delta(snap, base_snap, name,
+                              exclude_reasons=exclude_reasons)
 
     def _hist_sum(name):
         def total(s):
@@ -677,7 +686,7 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy):
         # containment deltas (PR 7 convention): clean traffic MUST report
         # 0 failovers — the pre-flip check for any failover-policy knob
         "rejected": _ctr("nxdi_router_rejected_total")
-        + _ctr("nxdi_requests_rejected_total"),
+        + _ctr("nxdi_requests_rejected_total", exclude_reasons=("backlog",)),
         "failover": _ctr("nxdi_router_failovers_total"),
         # re-admissions = pool-exhaustion evictions that re-queued inside a
         # replica (aging); also exposed under PR 7's "preempted" name so
@@ -686,6 +695,126 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy):
         "preempted": _ctr("nxdi_requests_preempted_total"),
         "quarantined": _ctr("nxdi_rows_quarantined_total"),
     }
+    return res
+
+
+def measure_goodput(apps, *, workload, chaos_kill_step=None,
+                    policy="least_loaded", bucket_steps=4):
+    """Open-loop SLO goodput (ISSUE 14; docs/WORKLOADS.md): a seeded
+    workload trace (arrival process × heavy-tailed lengths × shared-prefix
+    tenant pools) drives the serving stack through the open-loop
+    WorkloadDriver on a VIRTUAL clock — requests are admitted no earlier
+    than their arrival step, refused arrivals retry from the backlog, and
+    every latency policy in the stack (deadlines, EWMAs, telemetry traces)
+    runs on deterministic virtual time. The scored number is **goodput**:
+    tokens from requests that met their TTFT/ITL SLOs (measured from
+    ARRIVAL, so backlog wait counts) per wall second, beside the raw
+    ``decode_tok_s`` the closed-loop rows report.
+
+    ``apps``: one app = single ServingSession; N apps = a ServingRouter
+    over N replica sessions. ``chaos_kill_step``: arm the standing chaos
+    row — a seeded replica kill mid-run, scored as goodput-dip depth +
+    recovery time off the time-bucketed goodput series (workload/slo.py
+    extract_dip). Containment deltas follow the PR-7 convention with
+    ``reason=backlog`` EXCLUDED from the rejected count: open-loop backlog
+    refusals are intended workload pressure, reported under
+    ``backlog_refusals`` instead."""
+    from neuronx_distributed_inference_tpu.runtime.replica import ReplicaHandle
+    from neuronx_distributed_inference_tpu.runtime.router import ServingRouter
+    from neuronx_distributed_inference_tpu.runtime.serving import ServingSession
+    from neuronx_distributed_inference_tpu.telemetry import (
+        TelemetrySession,
+        default_registry,
+    )
+    from neuronx_distributed_inference_tpu.workload import (
+        ChaosPlan,
+        VirtualClock,
+        WorkloadDriver,
+        generate,
+        score,
+        standard_spec,
+    )
+
+    trace = generate(standard_spec(
+        vocab_size=apps[0].config.vocab_size - 10, **workload
+    ))
+    chaos = (
+        ChaosPlan(kill_step=chaos_kill_step)
+        if chaos_kill_step is not None else None
+    )
+
+    def run_once(registry=None):
+        for app in apps:
+            app.init_kv_cache()
+        vc = VirtualClock()
+        with TelemetrySession(registry=registry, clock=vc.now) as tel:
+            sessions = [
+                ServingSession(app, telemetry=tel, clock=vc.now)
+                for app in apps
+            ]
+            t_start = time.time()
+            if len(apps) > 1:
+                handles = [
+                    ReplicaHandle(s, i, clock=vc.now)
+                    for i, s in enumerate(sessions)
+                ]
+                with ServingRouter(handles, policy=policy, telemetry=tel,
+                                   clock=vc.now) as router:
+                    drv = WorkloadDriver(router, trace, clock=vc,
+                                         telemetry=tel, chaos=chaos)
+                    result = drv.run()
+            else:
+                drv = WorkloadDriver(sessions[0], trace, clock=vc,
+                                     telemetry=tel)
+                result = drv.run()
+            total_s = time.time() - t_start
+            report = score(result, tel, bucket_steps=bucket_steps)
+        return result, report, total_s
+
+    run_once()  # warmup / compile pass over every program the trace touches
+    base_snap = default_registry().snapshot()
+    result, report, total_s = run_once(default_registry())
+    snap = default_registry().snapshot()
+    res = {
+        "decode_tok_s": round(report.total_tokens / total_s, 2),
+        "goodput_tok_s": round(report.slo_met_tokens / total_s, 2),
+        "slo_attainment": report.attainment,
+        "slo_attainment_by_tenant": report.attainment_by_tenant,
+        "slo_misses": report.misses_by_kind,
+        "slo_met_tokens": report.slo_met_tokens,
+        "total_tokens": report.total_tokens,
+        "n_requests": len(trace.arrivals),
+        "n_replicas": len(apps),
+        "virtual_steps": result.steps,
+        "backlog_refusals": result.backlog_refusals,
+        "goodput_series": report.series,
+        "workload_digest": trace.digest(),
+        # containment deltas (PR 7 convention), backlog EXCLUDED from
+        # rejected — the open-loop rows intend backlog refusals
+        "rejected": _counter_delta(
+            snap, base_snap, "nxdi_requests_rejected_total",
+            exclude_reasons=("backlog",),
+        ) + _counter_delta(snap, base_snap, "nxdi_router_rejected_total"),
+        "backlog_rejected": _counter_delta(
+            snap, base_snap, "nxdi_requests_rejected_total",
+        ) - _counter_delta(
+            snap, base_snap, "nxdi_requests_rejected_total",
+            exclude_reasons=("backlog",),
+        ),
+        "quarantined": _counter_delta(
+            snap, base_snap, "nxdi_rows_quarantined_total"),
+        "preempted": _counter_delta(
+            snap, base_snap, "nxdi_requests_preempted_total"),
+    }
+    if chaos is not None:
+        res["chaos"] = result.chaos
+        res["failover"] = _counter_delta(
+            snap, base_snap, "nxdi_router_failovers_total")
+        dip = report.dip
+        res["goodput_dip_frac"] = dip.dip_frac if dip else None
+        res["goodput_recovery_steps"] = (
+            dip.recovery_steps if dip else None
+        )
     return res
 
 
@@ -699,6 +828,24 @@ def _suite_params(tiny):
                        blocks=24, block_size=16, max_seqs=4, q_tile=16)
         lc = dict(prompt=48, gen=8, seq=64, ce=[48], tkg=[64])
         mc = dict(prompt=32, gen=8, seq=64, ce=[32], tkg=[64])
+        # open-loop goodput workloads (ISSUE 14): generous SLOs on the CPU
+        # harness — the clean row must pin slo_attainment == 1.0; the burst
+        # row's on/off arrivals overrun the 4 slots so backlog refusals
+        # actually happen; the chaos row needs sustained decode so the
+        # seeded replica kill lands mid-stream
+        wl = dict(seed=14, n_requests=8, rate=1.5, arrival_kind="poisson",
+                  shared_prefix_len=8, max_prompt_len=16,
+                  min_output_len=4, max_output_len=8,
+                  ttft_slo_s=1e4, itl_slo_s=1e3)
+        wl_burst = dict(seed=14, n_requests=10, rate=4.0,
+                        arrival_kind="onoff", shared_prefix_len=8,
+                        max_prompt_len=16, min_output_len=4,
+                        max_output_len=8, ttft_slo_s=1e4, itl_slo_s=1e3)
+        wl_chaos = dict(seed=14, n_requests=14, rate=1.0,
+                        arrival_kind="poisson", shared_prefix_len=8,
+                        max_prompt_len=16, min_output_len=12,
+                        max_output_len=16, ttft_slo_s=1e4, itl_slo_s=1e3)
+        chaos_kill = 8
     else:
         attrs_1b, attrs_8b = LLAMA_1B, LLAMA_8B
         prompt, gen, long_prompt = 128, 256, 512
@@ -717,6 +864,23 @@ def _suite_params(tiny):
         # native gather path for long-context decode).
         lc = dict(prompt=16384, gen=32, seq=16896, ce=[16384], tkg=[16896])
         mc = dict(prompt=8192, gen=32, seq=8704, ce=[8192], tkg=[8704])
+        # open-loop goodput workloads (ISSUE 14): hardware-scale traces.
+        # SLOs stay generous for the clean row's attainment==1.0 contract;
+        # SLO-sweep exploration (tight TTFT under burst) is an operator
+        # exercise over the same seeded traces (docs/WORKLOADS.md)
+        wl = dict(seed=14, n_requests=24, rate=2.0, arrival_kind="poisson",
+                  shared_prefix_len=32, max_prompt_len=128,
+                  min_output_len=32, max_output_len=128,
+                  ttft_slo_s=1e4, itl_slo_s=1e3)
+        wl_burst = dict(seed=14, n_requests=32, rate=8.0,
+                        arrival_kind="onoff", shared_prefix_len=32,
+                        max_prompt_len=128, min_output_len=32,
+                        max_output_len=128, ttft_slo_s=1e4, itl_slo_s=1e3)
+        wl_chaos = dict(seed=14, n_requests=32, rate=2.0,
+                        arrival_kind="poisson", shared_prefix_len=32,
+                        max_prompt_len=128, min_output_len=64,
+                        max_output_len=128, ttft_slo_s=1e4, itl_slo_s=1e3)
+        chaos_kill = 16
     return {
         # ORDER = budget priority: the headline first (its number is the
         # contract), then cheap points, the serving point, and the expensive
@@ -814,6 +978,33 @@ def _suite_params(tiny):
             extra_tpu=dict(router_threading=True),
             cache_key="int8_1b_router_threaded" if not tiny else None,
         ),
+        # Open-loop SLO goodput rows (ISSUE 14, docs/WORKLOADS.md): a seeded
+        # workload trace (Poisson / bursty arrivals, heavy-tailed lengths,
+        # shared-prefix tenants) drives the SAME serving config through the
+        # WorkloadDriver on a virtual clock, scored as goodput-under-SLO
+        # (tokens from TTFT/ITL-met requests) instead of drain tok/s. The
+        # clean row pins slo_attainment == 1.0 under generous SLOs; the
+        # burst row's on/off arrival bursts overrun the slot count, so the
+        # driver backlog (and its refusal census) actually engages; the
+        # chaos row routes over 2 replicas and kills one mid-run (seeded),
+        # scored as goodput-dip depth + recovery time off the time-bucketed
+        # goodput series. Shares the int8_1b serving artifact (identical
+        # model config — the workload layer sits above the session).
+        "serving_1b_int8_goodput": dict(
+            attrs=attrs_1b, quantized=True, serving=serving, workload=wl,
+            cache_key="int8_1b" if not tiny else None,
+        ),
+        "serving_1b_int8_goodput_burst": dict(
+            attrs=attrs_1b, quantized=True, serving=serving,
+            workload=wl_burst,
+            cache_key="int8_1b" if not tiny else None,
+        ),
+        "serving_1b_int8_goodput_chaos": dict(
+            attrs=attrs_1b, quantized=True, serving=serving,
+            workload=wl_chaos,
+            chaos=dict(replicas=2, kill_step=chaos_kill),
+            cache_key="int8_1b" if not tiny else None,
+        ),
         # single-chip proxy for the BASELINE 8B north star: int8 8B fits 16G
         "int8_8b_bs1": dict(
             attrs=attrs_8b, batch=1, seq=seq, ce=ce[:1], tkg=tkg[:1],
@@ -898,7 +1089,38 @@ def run_point(name, tiny=False):
     import jax
 
     p = _suite_params(tiny)[name]
-    if "router" in p:
+    if "workload" in p:
+        from neuronx_distributed_inference_tpu.runtime.router import (
+            partition_devices,
+        )
+
+        s = p["serving"]
+        ch = p.get("chaos")
+        n_apps = ch["replicas"] if ch else 1
+        parts = partition_devices(n_apps) if n_apps > 1 else [None]
+        apps = [
+            build_app(
+                p["attrs"], batch=s["max_seqs"], seq_len=s["seq"],
+                ce_buckets=[s["seq"]], tkg_buckets=[s["seq"]],
+                quantized=p["quantized"], cache_key=p.get("cache_key"),
+                block_kv=dict(num_blocks=s["blocks"],
+                              block_size=s["block_size"],
+                              max_seqs=s["max_seqs"]),
+                extra_tpu=p.get("extra_tpu"), devices=parts[i],
+            )
+            for i in range(n_apps)
+        ]
+        res = measure_goodput(
+            apps, workload=p["workload"],
+            chaos_kill_step=ch["kill_step"] if ch else None,
+        )
+        # same aggregate decode ceiling as the closed-loop serving rows:
+        # goodput <= throughput <= the device projection
+        _attach_projection(
+            res, p["attrs"], batch=s["max_seqs"], kv_width=s["seq"],
+            quantized=p["quantized"], extra_tpu=p.get("extra_tpu"),
+        )
+    elif "router" in p:
         from neuronx_distributed_inference_tpu.runtime.router import (
             partition_devices,
         )
@@ -1121,6 +1343,26 @@ def summary_line(points):
                                    "decode_tok_s"),
         "router_step_overlap_frac": g("serving_1b_int8_router_threaded",
                                       "overlap_frac"),
+        # open-loop SLO goodput rows (ISSUE 14, docs/WORKLOADS.md):
+        # goodput_tok_s counts ONLY tokens from requests that met their
+        # TTFT/ITL SLOs (measured from arrival — backlog wait counts);
+        # slo_attainment pins 1.0 on the clean generous-SLO row; the chaos
+        # row reads the seeded replica kill off the time-bucketed goodput
+        # series as dip depth + recovery steps
+        "goodput_tok_s": g("serving_1b_int8_goodput", "goodput_tok_s"),
+        "slo_attainment": g("serving_1b_int8_goodput", "slo_attainment"),
+        "goodput_burst_tok_s": g("serving_1b_int8_goodput_burst",
+                                 "goodput_tok_s"),
+        "goodput_burst_attainment": g("serving_1b_int8_goodput_burst",
+                                      "slo_attainment"),
+        "goodput_backlog_refusals": g("serving_1b_int8_goodput_burst",
+                                      "backlog_refusals"),
+        "goodput_chaos_tok_s": g("serving_1b_int8_goodput_chaos",
+                                 "goodput_tok_s"),
+        "goodput_dip_frac": g("serving_1b_int8_goodput_chaos",
+                              "goodput_dip_frac"),
+        "goodput_recovery_steps": g("serving_1b_int8_goodput_chaos",
+                                    "goodput_recovery_steps"),
         "int8_8b_tok_s": g("int8_8b_bs1", "decode_tok_s"),
         "int8_8b_ttft_ms": g("int8_8b_bs1", "ttft_ms"),
         # 16k long-context row: TTFT ~= the 16k prefill wall time

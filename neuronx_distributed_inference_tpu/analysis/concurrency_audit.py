@@ -99,6 +99,11 @@ SCOPE_SUFFIXES = (
     "telemetry/__init__.py",
     "telemetry/metrics.py",
     "telemetry/tracing.py",
+    # the open-loop workload driver (ISSUE 14): it steps the router — and
+    # under router_threading its spec accept-gate closure is CALLED from
+    # replica workers — so its write sites join the census like the
+    # router's own
+    "workload/driver.py",
 )
 
 # ---------------------------------------------------------------------------
@@ -117,8 +122,15 @@ REPLICA_OWNED = frozenset({
 })
 
 #: router-global objects: written ONLY by the router thread — a write
-#: reachable from a worker entry is an error, not a census entry
-ROUTER_OWNED = frozenset({"ServingRouter", "RouterRequest"})
+#: reachable from a worker entry is an error, not a census entry.
+#: WorkloadDriver/VirtualClock/WorkloadResult (workload/driver.py) run the
+#: open-loop admission/scoring loop on the SAME thread the router's
+#: placement phases run on (the driver calls router.step() between its own
+#: phases), so they carry the router-thread discipline.
+ROUTER_OWNED = frozenset({
+    "ServingRouter", "RouterRequest",
+    "WorkloadDriver", "VirtualClock", "WorkloadResult",
+})
 
 #: state shared ACROSS replicas: every worker thread records into one
 #: telemetry session / registry, so worker-reachable writes must be
@@ -151,6 +163,8 @@ ATTR_TYPES = {
     ("*", "app"): "TpuApplication",
     ("*", "draft"): "TpuApplication",
     ("_ReplicaStepWorker", "handle"): "ReplicaHandle",
+    ("WorkloadDriver", "result"): "WorkloadResult",
+    ("WorkloadDriver", "clock"): "VirtualClock",
 }
 
 #: (owner class or "*", container attribute) -> element/value class
@@ -187,6 +201,7 @@ VAR_NAME_HINTS = {
     "router": "ServingRouter",
     "w": "_ReplicaStepWorker",
     "app": "TpuApplication", "draft_app": "TpuApplication",
+    "drv": "WorkloadDriver", "vc": "VirtualClock",
 }
 
 #: container-mutating method names (a call through these IS a write) —
@@ -199,6 +214,7 @@ MUTATORS = CONTAINER_MUTATORS
 #: family holds its lock while minting a child instrument)
 LOCK_LEVELS = {
     "ServingRouter": 0, "RouterRequest": 0,
+    "WorkloadDriver": 0, "VirtualClock": 0, "WorkloadResult": 0,
     "ReplicaHandle": 1, "ServingSession": 1, "SpeculativeServingSession": 1,
     "Request": 1, "FaultInjector": 1, "_ReplicaStepWorker": 1,
     "TelemetrySession": 2,
@@ -208,6 +224,7 @@ LOCK_LEVELS = {
 }
 #: fallback lock level by scope file when the lock's owner class is unknown
 MODULE_LOCK_LEVELS = {
+    "workload/driver.py": 0,
     "runtime/router.py": 0,
     "runtime/replica.py": 1,
     "runtime/serving.py": 1,

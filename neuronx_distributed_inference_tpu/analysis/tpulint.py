@@ -183,6 +183,22 @@ ROUTER_HOT_PATH = {
     "join_step",
 }
 
+#: WorkloadDriver per-tick functions (workload/driver.py): the open-loop
+#: admission / chaos / commit-attribution loop wrapped around every router
+#: (or session) step. Pure host bookkeeping by contract — commit counts
+#: are read from host-side request records, never fetched — so its census
+#: bucket (`workload/driver.py::drive-hot-path`) is pinned at ZERO entries.
+DRIVER_HOT_PATH = {
+    "step",
+    "run",
+    "_admit_due",
+    "_maybe_kill",
+    "_record_step",
+    "_committed_of",
+    "_has_live_work",
+    "_backlog_depth",
+}
+
 #: per-file hot-path census buckets: {relpath suffix: (bucket label,
 #: function-name set, human description of why a fetch there is a bug)}
 HOT_PATH_BUCKETS = {
@@ -197,6 +213,12 @@ HOT_PATH_BUCKETS = {
         ROUTER_HOT_PATH,
         "a blocking fetch in the placement loop serializes every replica "
         "behind one device; the router is host bookkeeping only",
+    ),
+    "workload/driver.py": (
+        "drive-hot-path",
+        DRIVER_HOT_PATH,
+        "a blocking fetch in the open-loop driver would bill device waits "
+        "as workload time; the driver reads host-side commit records only",
     ),
 }
 

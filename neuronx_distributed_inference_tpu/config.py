@@ -377,7 +377,9 @@ class TpuConfig:
     # that binds requests to replicas. `least_loaded` scores replicas from
     # live telemetry signals (re-admission backlog, occupancy, kv_free_bytes
     # headroom, EWMAs of step-host/queue-wait ms); `round_robin` cycles the
-    # healthy set; `cache_aware` is a prefix-affinity stub.
+    # healthy set; `cache_aware` ranks candidates by each replica's REAL
+    # prefix-cache match index (longest cached block-chain of the prompt),
+    # load order breaking ties.
     serving_replicas: int = 1
     router_policy: str = "least_loaded"
     # thread-per-replica router stepping (runtime/router.py): ServingRouter

@@ -50,7 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
         run.add_argument(f"--no-{name}", dest=dest, action="store_false")
 
     # paths
-    run.add_argument("--model-path", required=True)
+    # required for every mode except --workload-trace-out (which loads no
+    # model) — enforced in main() so the trace generator runs standalone
+    run.add_argument("--model-path", default=None)
     run.add_argument("--compiled-model-path", default=None)
     run.add_argument("--compilation-cache-dir", default=None)
     run.add_argument("--random-weights", action="store_true",
@@ -178,8 +180,9 @@ def build_parser() -> argparse.ArgumentParser:
         choices=list(ROUTER_POLICIES),
         help="replica placement policy for the router config above: "
         "least_loaded scores replicas from live telemetry (backlog, "
-        "occupancy, kv_free_bytes, step/queue-wait EWMAs); cache_aware is "
-        "a prefix-affinity stub",
+        "occupancy, kv_free_bytes, step/queue-wait EWMAs); cache_aware "
+        "follows each replica's real prefix-cache match index (longest "
+        "cached prefix wins, load order breaks ties)",
     )
     onoff("router-threading", False, dest="router_threading",
           help="thread-per-replica router stepping (router config consumed "
@@ -213,6 +216,45 @@ def build_parser() -> argparse.ArgumentParser:
         help="serving steps with zero progress before the watchdog preempts "
         "the largest request (second window: loud WatchdogError); 0 disables",
     )
+
+    # workload engine (workload/generator.py; docs/WORKLOADS.md): seeded
+    # open-loop traffic generation. --workload-trace-out materializes the
+    # reproducible arrival trace as JSON and exits WITHOUT loading a model
+    # — the artifact replays through the WorkloadDriver / the bench
+    # goodput rows (same seed => byte-identical trace, pinned).
+    run.add_argument("--workload-seed", type=int, default=0,
+                     help="workload trace seed (same seed => byte-identical "
+                          "arrival trace)")
+    run.add_argument("--workload-requests", type=int, default=32,
+                     help="total arrivals in the generated trace")
+    run.add_argument("--workload-arrival", default="poisson",
+                     choices=["poisson", "onoff", "diurnal"],
+                     help="arrival process: steady Poisson, bursty on/off, "
+                          "or a diurnal rate envelope")
+    run.add_argument("--workload-rate", type=float, default=1.0,
+                     help="mean arrivals per virtual step (on-phase / peak "
+                          "rate for onoff / diurnal)")
+    run.add_argument("--workload-tenants", type=int, default=2,
+                     help="tenant pools (alternating prose-ish/code-ish "
+                          "spec-acceptance profiles, each with its own "
+                          "shared prompt prefix)")
+    run.add_argument("--workload-vocab", type=int, default=32000,
+                     help="token-id range for the generated prompts (match "
+                          "the serving model's vocab)")
+    run.add_argument("--workload-max-prompt", type=int, default=128,
+                     help="prompt-length upper bound (lognormal body is "
+                          "clipped here — keep within the serving buckets)")
+    run.add_argument("--workload-max-new-tokens", type=int, default=64,
+                     help="output-budget upper bound (Zipf tail clipped)")
+    run.add_argument("--workload-ttft-slo", type=float, default=None,
+                     help="per-request TTFT SLO in virtual seconds (None "
+                          "disables the TTFT term in goodput scoring)")
+    run.add_argument("--workload-itl-slo", type=float, default=None,
+                     help="per-request average-ITL SLO in virtual seconds")
+    run.add_argument("--workload-trace-out", default=None,
+                     help="write the generated arrival trace JSON here and "
+                          "exit (no model load; replay via "
+                          "workload.WorkloadTrace.loads + WorkloadDriver)")
 
     # sampling (reference on-device sampling flags)
     run.add_argument("--on-device-sampling", action="store_true")
@@ -785,8 +827,50 @@ def run_image_gen(args) -> int:
     return 0
 
 
+def run_workload_trace(args) -> int:
+    """--workload-trace-out: materialize the seeded arrival trace and write
+    it as JSON (no model load — trace generation is pure host data). The
+    artifact is the reproducibility handle: archive it beside a bench
+    goodput run and replay it bit-exactly later."""
+    from neuronx_distributed_inference_tpu.workload import (
+        generate,
+        standard_spec,
+    )
+
+    trace = generate(standard_spec(
+        seed=args.workload_seed,
+        n_requests=args.workload_requests,
+        vocab_size=args.workload_vocab,
+        arrival_kind=args.workload_arrival,
+        rate=args.workload_rate,
+        n_tenants=args.workload_tenants,
+        max_prompt_len=args.workload_max_prompt,
+        max_output_len=args.workload_max_new_tokens,
+        ttft_slo_s=args.workload_ttft_slo,
+        itl_slo_s=args.workload_itl_slo,
+        spec_profiles=True,
+    ))
+    with open(args.workload_trace_out, "w") as f:
+        f.write(trace.dumps())
+    print(
+        f"workload trace -> {args.workload_trace_out} "
+        f"({len(trace.arrivals)} arrivals, digest {trace.digest()[:16]})"
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    if args.workload_trace_out:
+        return run_workload_trace(args)
+    if args.model_path is None:
+        print(
+            "inference_demo: error: --model-path is required "
+            "(it may be omitted only with --workload-trace-out, which "
+            "loads no model)",
+            file=sys.stderr,
+        )
+        return 2
     if args.task_type == "image-gen":
         return run_image_gen(args)
     return run_inference(args)

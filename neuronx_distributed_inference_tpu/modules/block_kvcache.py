@@ -45,6 +45,24 @@ from neuronx_distributed_inference_tpu.modules.kvcache import (
 GARBAGE_BLOCK = 0  # block id 0 reserved for invalid-slot writes
 
 
+def prefix_chain_keys(tokens: np.ndarray, block_size: int) -> List[bytes]:
+    """Content-addressing keys for prefix caching: one running-sha1 key per
+    FULL block of ``tokens`` (a block matches only when its content AND
+    everything before it match). Module-level so callers that query SEVERAL
+    allocators with one prompt — the router's ``cache_aware`` placement —
+    hash the prompt once and reuse the key list per candidate."""
+    keys: List[bytes] = []
+    h = hashlib.sha1()
+    for i in range(len(tokens) // block_size):
+        h.update(
+            np.asarray(
+                tokens[i * block_size : (i + 1) * block_size], np.int32
+            ).tobytes()
+        )
+        keys.append(h.digest())
+    return keys
+
+
 @jax.tree_util.register_dataclass
 @dataclass
 class BlockKVCache:
@@ -304,13 +322,7 @@ class PrefixCachingAllocator(BlockAllocator):
 
     def _chain_keys(self, tokens: np.ndarray) -> List[bytes]:
         """One running-hash key per FULL block of ``tokens``."""
-        keys = []
-        h = hashlib.sha1()
-        bs = self.block_size
-        for i in range(len(tokens) // bs):
-            h.update(np.asarray(tokens[i * bs : (i + 1) * bs], np.int32).tobytes())
-            keys.append(h.digest())
-        return keys
+        return prefix_chain_keys(tokens, self.block_size)
 
     # --- allocation with eviction ---------------------------------------
 
@@ -354,6 +366,27 @@ class PrefixCachingAllocator(BlockAllocator):
             self.evictable.pop(b, None)
         self.seq_blocks[seq_id] = list(matched)
         return len(matched) * self.block_size
+
+    def match_index_blocks(self, tokens: np.ndarray) -> int:
+        """READ-ONLY match-index query: how many leading FULL blocks of
+        ``tokens`` this pool already holds (live or evictable — both are
+        attachable without recompute). No refcounts move, no sequence
+        attaches; this is the affinity score the router's ``cache_aware``
+        placement ranks replicas by (runtime/router.py), not an
+        allocation."""
+        return self.match_keys(self._chain_keys(tokens))
+
+    def match_keys(self, keys: List[bytes]) -> int:
+        """Longest-matching-prefix count over PRECOMPUTED chain keys
+        (:func:`prefix_chain_keys`) — the router computes one key list per
+        request and queries every candidate replica's index with it, so
+        the sha1 work is paid once, not once per replica."""
+        matched = 0
+        for key in keys:
+            if key not in self.block_by_hash:
+                break
+            matched += 1
+        return matched
 
     def commit_seq(self, seq_id: int, tokens: np.ndarray):
         """Register this sequence's full prompt blocks for future matching

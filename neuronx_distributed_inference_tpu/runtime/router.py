@@ -16,9 +16,12 @@ of the virtual device set on the CPU harness) and gives them one front door:
   healthy set, ``least_loaded`` scores replicas from live telemetry signals
   (re-admission backlog, occupancy, cache-dtype-aware ``kv_free_bytes``
   headroom, EWMAs of step-host and queue-wait ms — the batch-admission-
-  off-the-queue-wait-signal item ROADMAP names), ``cache_aware`` is a
-  prefix-affinity stub (stable prompt-prefix hash picks the anchor replica
-  so shared prefixes co-locate with prefix caching; load still breaks ties).
+  off-the-queue-wait-signal item ROADMAP names), ``cache_aware`` ranks
+  replicas by REAL prefix-cache affinity — each candidate's
+  ``PrefixCachingAllocator.match_index_blocks`` (longest cached block-chain
+  prefix of the effective prompt) decides first, load order breaks ties —
+  so shared-prefix tenant traffic (system prompts, multi-turn) lands where
+  its blocks already are.
   Placement is head-of-line FIFO: if the queue head fits nowhere it WAITS
   (aging) — later arrivals cannot starve it.
 - **Replica health + failover** — per-replica ``HEALTHY -> DEGRADED ->
@@ -118,6 +121,11 @@ class RouterRequest:
     placements: int = 0
     failovers: int = 0
     t_submit: float = 0.0
+    # cache_aware placement: prefix-chain keys of the effective prompt,
+    # keyed by (block_size, prompt_len) — the prompt only changes on
+    # failover (committed tokens fold in), so a queued request retrying
+    # placement hashes its prompt once, not once per candidate per step
+    prefix_keys: Dict[tuple, list] = field(default_factory=dict, repr=False)
 
     @property
     def finished(self) -> bool:
@@ -360,24 +368,40 @@ class ServingRouter:
             pool, key=lambda h: (h.load_score(norm), h.replica_id)
         )
         if self.policy == "cache_aware":
-            # STUB prefix-affinity: a stable hash of the first block of
-            # prompt tokens anchors the request so shared prefixes co-locate
-            # (useful with prefix caching); the anchor is only promoted to
-            # the front — load order still decides everything behind it. A
-            # real implementation would query per-replica prefix-cache
-            # match indexes instead of hashing.
-            import zlib
-
-            bs = getattr(
-                self.replicas[0].session.allocator, "block_size", 16
-            ) or 16
-            prefix = rreq.input_ids[:bs].tobytes()
-            anchor_id = sorted(h.replica_id for h in pool)[
-                zlib.crc32(prefix) % len(pool)
-            ]
-            ordered = sorted(
-                ordered, key=lambda h: 0 if h.replica_id == anchor_id else 1
+            # REAL prefix-cache affinity (retires the crc32 anchor stub):
+            # query every candidate replica's prefix-cache match index for
+            # the longest cached block-chain prefix of this request's
+            # effective prompt and send the request where the most of its
+            # prompt already lives. The chain keys are a pure function of
+            # (prompt, block_size), so they are hashed ONCE per request
+            # (cached on the RouterRequest; recomputed only when failover
+            # grows the prompt) and each candidate answers with a
+            # read-only dictionary walk (match_keys — no refcounts move).
+            # The sort is stable, so replicas with equal match counts —
+            # including the cold-start all-zero case — keep the load order
+            # computed above; replicas without a prefix index (plain
+            # BlockAllocator, contiguous caches) score 0.
+            from neuronx_distributed_inference_tpu.modules.block_kvcache import (
+                prefix_chain_keys,
             )
+
+            prompt = rreq.effective_prompt()
+
+            def cached_blocks(h: ReplicaHandle) -> int:
+                alloc = h.session.allocator
+                match = getattr(alloc, "match_keys", None)
+                if match is None:
+                    return 0
+                ck = (alloc.block_size, prompt.shape[0])
+                keys = rreq.prefix_keys.get(ck)
+                if keys is None:
+                    # bounded: prompt length changes only on failover
+                    # (max_failovers) and block sizes are per-replica config
+                    keys = prefix_chain_keys(prompt, alloc.block_size)
+                    rreq.prefix_keys[ck] = keys
+                return int(match(keys))
+
+            ordered = sorted(ordered, key=lambda h: -cached_blocks(h))
         return ordered
 
     def _place_pending(self) -> int:

@@ -2035,6 +2035,26 @@ class SpeculativeServingSession(ServingSession):
         #: session-wide acceptance-rate EWMA — the router's least_loaded
         #: placement signal (None until the first spec round)
         self.acceptance_ewma: Optional[float] = None
+        #: optional CPU-harness draft-agreement gate (the workload engine's
+        #: per-tenant spec-acceptance profiles, workload/generator.py
+        #: make_accept_gate): callable (req_id, drafted) -> max draft tokens
+        #: to accept this verify round, or None for no cap. Capping is
+        #: OUTPUT-INVARIANT — the accepted window holds the target's own
+        #: greedy tokens, so accepting fewer merely regenerates them in
+        #: later rounds; only measured acceptance (and with it the adaptive
+        #: draft-length policy and the router's acceptance signal) moves.
+        self.draft_accept_cap = None
+
+    def _capped_accept(self, req: Request, count: int, drafted: int) -> int:
+        """Apply the draft-agreement gate (if installed) to one verify
+        round's device-computed accepted count. ``count`` includes the
+        bonus token (in [1, drafted+1]); the gate speaks in DRAFT tokens."""
+        if self.draft_accept_cap is None or drafted <= 0:
+            return count
+        cap = self.draft_accept_cap(req.req_id, drafted)
+        if cap is None:
+            return count
+        return max(1, min(count, 1 + int(cap)))
 
     def _max_admissible_prompt(self) -> int:
         if self.spec_ragged:
@@ -2307,6 +2327,7 @@ class SpeculativeServingSession(ServingSession):
                 continue
             v = n  # verify-window width this row dispatched with
             count = max(1, min(int(tokens[slot, k]), v))
+            count = self._capped_accept(req, count, v - 1)
             window = tokens[slot, :count]
             if (window < 0).any():
                 # non-finite sentinel inside the accepted window: a poisoned
@@ -2572,6 +2593,7 @@ class SpeculativeServingSession(ServingSession):
         counts = np.cumprod(matches, axis=1).sum(axis=1) + 1  # in [1, k]
         for r in rows:
             s = r.slot
+            counts[s] = self._capped_accept(r, int(counts[s]), k - 1)
             if (greedy[s, : counts[s]] < 0).any():
                 # non-finite sentinel inside the accepted window: a poisoned
                 # TARGET row — quarantine it (a poisoned DRAFT merely
@@ -2589,6 +2611,15 @@ class SpeculativeServingSession(ServingSession):
             # truncation) tokens this round — the histogram's sum is exactly
             # the decode tokens speculation delivered for this session
             self.tel.spec_accept(len(row))
+            # acceptance EWMAs on the split path too: the per-request and
+            # session acceptance signals (the router's least_loaded
+            # placement bonus, the workload engine's tenant separation)
+            # must not depend on WHICH dispatch mode verified the drafts —
+            # split-path sessions previously never populated them. The
+            # draft-length snap inside is inert here (the split path
+            # always proposes k-1).
+            self._note_acceptance(r, accepted=int(counts[s]) - 1,
+                                  drafted=k - 1)
             self._commit_tokens(r, len(row))
             r.pos += len(row)
             if row:

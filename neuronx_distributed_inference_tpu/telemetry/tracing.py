@@ -298,6 +298,29 @@ class TelemetrySession:
             "walls: ~0 = sequential, (N-1)/N = N replicas fully overlapped")
         self._router_step_wall_ms_sum = 0.0
         self._replica_step_ms_sum = 0.0
+        # --- workload engine (workload/driver.py + workload/slo.py) -------
+        # open-loop traffic bookkeeping: the driver's arrival backlog and
+        # admission refusals, and the post-hoc SLO scorer's miss census —
+        # all host-side (recorded on the driver/router thread or offline
+        # after the run; TPU107-clean by construction)
+        self._slo_missed = r.counter(
+            "nxdi_slo_missed_total",
+            "requests that missed their SLO, by miss kind (ttft / itl / "
+            "failed / never_served) and tenant — recorded by the workload "
+            "SLO scorer; goodput counts only tokens from requests NOT in "
+            "this census",
+            labels=("kind", "tenant"))
+        self._wl_backlog = r.gauge(
+            "nxdi_workload_backlog_depth",
+            "arrivals waiting in the open-loop driver's retry backlog "
+            "(arrived, offered, refused for capacity — their SLO clocks "
+            "keep running)")
+        self._wl_refused = r.counter(
+            "nxdi_workload_refusals_total",
+            "open-loop admission attempts refused for capacity (the "
+            "arrival re-queues and retries; a terminal give-up records "
+            "nxdi_requests_rejected_total{reason=backlog} instead)",
+            labels=("reason",))
         self._jit_traces = r.counter(
             "nxdi_jit_traces_total", "jit traces observed (compiles)",
             labels=("tag",))
@@ -696,6 +719,32 @@ class TelemetrySession:
             return
         self._spec_draft_len.observe(draft_len)
         self._spec_ewma.observe(accept_ewma)
+
+    # ---- workload engine (workload/driver.py + workload/slo.py) ----------
+
+    def slo_missed(self, kind: str, tenant: str) -> None:
+        """One request missed its SLO (scored post-hoc by workload/slo.py):
+        ``kind`` is ttft / itl / failed / never_served."""
+        if not self.enabled:
+            return
+        self._slo_missed.child((kind, tenant)).inc()
+        self.event("slo_missed", kind=kind, tenant=tenant)
+
+    def workload_backlog(self, depth: int) -> None:
+        """Arrivals currently waiting in the open-loop driver's retry
+        backlog (observed once per driver step)."""
+        if not self.enabled:
+            return
+        self._wl_backlog.set(depth)
+
+    def workload_refused(self, reason: str) -> None:
+        """One open-loop admission attempt refused for capacity; the
+        arrival stays in the backlog and retries (NON-terminal — terminal
+        give-ups ride request_rejected(reason='backlog'))."""
+        if not self.enabled:
+            return
+        self._wl_refused.child((reason,)).inc()
+        self.event("workload_refused", reason=reason)
 
     # ---- retrace-guard bridge --------------------------------------------
 

@@ -39,6 +39,10 @@ echo
 echo "== router (multi-replica front-end + threaded stepping) pytest subset =="
 python -m pytest tests/test_router.py tests/test_router_threaded.py -q -m 'not slow' -p no:cacheprovider || rc=$?
 
+echo
+echo "== workload (open-loop traffic + SLO goodput) pytest subset =="
+python -m pytest tests/test_workload.py -q -m 'not slow' -p no:cacheprovider || rc=$?
+
 if [ "$rc" -ne 0 ]; then
   echo "ci_check: FAILED (rc=$rc)" >&2
 else

@@ -27,13 +27,17 @@ ALL_POINTS = {
     "bf16_1b_bs1", "bf16_1b_bs4", "int8_1b_bs1", "serving_1b_int8",
     "serving_1b_int8_ragged", "serving_1b_int8_ragged_async",
     "serving_1b_int8_spec_ragged", "serving_1b_int8_router",
-    "serving_1b_int8_router_threaded", "int8_8b_bs1",
+    "serving_1b_int8_router_threaded",
+    "serving_1b_int8_goodput", "serving_1b_int8_goodput_burst",
+    "serving_1b_int8_goodput_chaos", "int8_8b_bs1",
     "bf16_1b_8k", "bf16_1b_8k_kvq8", "bf16_1b_16k", "bf16_1b_16k_kvq8",
 }
 SERVING_POINTS = {
     "serving_1b_int8", "serving_1b_int8_ragged", "serving_1b_int8_ragged_async",
     "serving_1b_int8_spec_ragged", "serving_1b_int8_router",
     "serving_1b_int8_router_threaded",
+    "serving_1b_int8_goodput", "serving_1b_int8_goodput_burst",
+    "serving_1b_int8_goodput_chaos",
 }
 
 
@@ -100,6 +104,28 @@ def test_bench_suite_tiny(monkeypatch):
     assert all(t > 0 for t in threaded["tokens_per_replica"])
     assert threaded["overlap_frac"] is not None
     assert 0.0 <= threaded["overlap_frac"] < 1.0
+    # ISSUE 14: the open-loop goodput rows — the clean row pins PERFECT
+    # SLO attainment under generous SLOs (goodput == throughput there),
+    # the burst row's on/off arrivals actually engage the driver backlog
+    # (refused attempts retried, ZERO terminal containment events — the
+    # rejected key excludes reason=backlog by design), and the chaos row's
+    # seeded replica kill shows a NONZERO goodput dip with a FINITE
+    # recovery read off the time-bucketed goodput series
+    goodput = points["serving_1b_int8_goodput"]
+    assert goodput["slo_attainment"] == 1.0
+    assert goodput["goodput_tok_s"] == goodput["decode_tok_s"] > 0
+    assert goodput["slo_met_tokens"] == goodput["total_tokens"] > 0
+    burst = points["serving_1b_int8_goodput_burst"]
+    assert burst["backlog_refusals"] > 0
+    assert burst["rejected"] == 0 and burst["backlog_rejected"] == 0
+    assert 0.0 < burst["slo_attainment"] <= 1.0
+    chaos = points["serving_1b_int8_goodput_chaos"]
+    assert chaos["n_replicas"] == 2
+    assert chaos["chaos"]["step"] >= 0 and chaos["failover"] > 0
+    assert chaos["goodput_dip_frac"] is not None
+    assert chaos["goodput_dip_frac"] > 0.0
+    assert chaos["goodput_recovery_steps"] is not None  # finite recovery
+    assert chaos["goodput_recovery_steps"] >= 0
     # emit fired after EVERY point (the incremental-summary contract) and
     # every snapshot produces a valid summary line
     assert len(emitted) == len(ALL_POINTS)
@@ -158,6 +184,13 @@ def test_bench_suite_tiny(monkeypatch):
     assert final["router_threaded_tok_s"] > 0
     assert final["router_step_overlap_frac"] is not None
     assert 0.0 <= final["router_step_overlap_frac"] < 1.0
+    # goodput summary keys (ISSUE 14)
+    assert final["goodput_tok_s"] > 0
+    assert final["slo_attainment"] == 1.0
+    assert final["goodput_burst_tok_s"] > 0
+    assert final["goodput_backlog_refusals"] > 0
+    assert final["goodput_dip_frac"] > 0.0
+    assert final["goodput_recovery_steps"] is not None
     # --metrics-out: the tiny suite ran the serving point in-process, so the
     # process-default registry must hold the full serving metric set
     import tempfile

@@ -392,21 +392,69 @@ def test_round_robin_cycles_replicas(replica_apps):
     router.run_to_completion()
 
 
-def test_cache_aware_stub_colocates_shared_prefixes(replica_apps):
-    """The cache_aware stub anchors requests by prompt-prefix hash: two
-    requests sharing a prefix land on the SAME replica (prefix-cache
-    affinity), deterministically."""
-    for app in replica_apps:
+def test_match_index_blocks_is_read_only():
+    """The cache_aware policy's affinity score: a longest-chain query over
+    the prefix index that moves NO allocator state."""
+    from neuronx_distributed_inference_tpu.modules.block_kvcache import (
+        PrefixCachingAllocator,
+    )
+
+    alloc = PrefixCachingAllocator(8, 4)
+    tokens = np.arange(10, dtype=np.int32)  # 2 full blocks + a tail
+    alloc.alloc_seq(0, 10)
+    alloc.commit_seq(0, tokens)
+    before = (list(alloc.free), dict(alloc.refcount),
+              dict(alloc.seq_blocks))
+    assert alloc.match_index_blocks(tokens) == 2
+    assert alloc.match_index_blocks(tokens[:4]) == 1
+    assert alloc.match_index_blocks(np.asarray([9, 9, 9, 9])) == 0
+    # a longer probe sharing the 2-block prefix still matches 2
+    assert alloc.match_index_blocks(
+        np.concatenate([tokens[:8], np.asarray([7, 7, 7, 7])])
+    ) == 2
+    after = (list(alloc.free), dict(alloc.refcount), dict(alloc.seq_blocks))
+    assert after == before  # read-only: no refcounts, no attachments
+
+
+def test_cache_aware_real_prefix_affinity_colocates_tenants():
+    """ISSUE 14 satellite: cache_aware now queries each replica's REAL
+    prefix-cache match index (longest cached block-chain of the prompt)
+    instead of a crc32 anchor. Same-tenant requests co-locate with their
+    cached prefix even when load order prefers the other replica — and the
+    affinity is content-driven: it follows where the prefix was actually
+    served, not a hash."""
+    parts = partition_devices(2)
+    apps = []
+    for i in range(2):
+        cfg = _paged_cfg(is_prefix_caching=True)
+        app = TpuModelForCausalLM(
+            None, cfg, mesh=mesh_from_config(cfg.tpu_config, devices=parts[i])
+        )
+        apps.append(app.load(state_dict=make_random_hf_state_dict(_paged_cfg())))
+    for app in apps:
         app.init_kv_cache()
     router = ServingRouter(
-        [ServingSession(app) for app in replica_apps], policy="cache_aware"
+        [ServingSession(app) for app in apps], policy="cache_aware"
     )
-    shared = list(range(40, 56))  # one full block of shared prefix
-    assert router.add_request("c1", shared + [1], max_new_tokens=2)
-    assert router.add_request("c2", shared + [2], max_new_tokens=2)
-    assert (
-        router.requests["c1"].replica == router.requests["c2"].replica
-    )
+    shared = list(range(40, 72))  # two full blocks of tenant-shared prefix
+    assert router.add_request("c1", shared + [1, 2], max_new_tokens=2)
+    home = router.requests["c1"].replica
+    router.run_to_completion()  # c1's prefix blocks are now committed
+    # load order now prefers the OTHER replica (the home replica carries
+    # c1's latency EWMAs); the tenant's next request must follow its
+    # cached prefix anyway
+    assert router.add_request("c2", shared + [3], max_new_tokens=2)
+    assert router.requests["c2"].replica == home
+    # and keeps co-locating (the steady-state tenant-pool regime)
+    assert router.add_request("c3", shared + [4, 5], max_new_tokens=2)
+    assert router.requests["c3"].replica == home
+    occ = {h.replica_id: h.occupancy for h in router.replicas}
+    assert occ[home] > occ[1 - home]  # affinity genuinely beat load order
+    # a prefix the pool has never seen falls back to load order: the
+    # less-loaded replica takes it
+    assert router.add_request("cold", list(range(80, 112)) + [6],
+                              max_new_tokens=2)
+    assert router.requests["cold"].replica == 1 - home
     router.run_to_completion()
 
 
