@@ -52,6 +52,7 @@ import json
 import os
 import sys
 import time
+import warnings
 
 # model shapes live in the device/cost model (the single source of truth the
 # static roofline projections are computed from — ISSUE 11); bench rows and
@@ -577,7 +578,8 @@ def measure_serving_spec(target, draft, *, n_requests, prompt_len, gen_len, k):
     return res
 
 
-def measure_router(apps, *, n_requests, prompt_len, gen_len, policy):
+def measure_router(apps, *, n_requests, prompt_len, gen_len, policy,
+                   prefill_apps=None):
     """Scale-out serving: the SAME staggered request mix routed over N
     single-chip replica sessions by ServingRouter (ISSUE 10;
     docs/SERVING.md "Multi-replica front-end"). Aggregate tok/s across
@@ -586,10 +588,20 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy):
     ``balance_frac`` = min-replica tokens / even share (1.0 == the
     placement policy spread the mix perfectly).
 
+    ``prefill_apps`` (ISSUE 15): prefill-stage apps forming a disaggregated
+    PREFILL tier — every placement context-encodes there and hands KV over
+    to a decode replica. The row then additionally reports the hand-off
+    census: ``handoffs`` (MUST equal the request count on clean traffic),
+    ``handoff_failures`` and ``handoff_local_prefill`` (both MUST be 0 —
+    the tier's zero-containment-events proof).
+
     Containment census matches PR 7's convention: rejected / failover /
     re-admitted are PER-RUN deltas against a pre-run registry snapshot."""
     import numpy as np
 
+    from neuronx_distributed_inference_tpu.runtime.replica import (
+        PrefillReplicaHandle,
+    )
     from neuronx_distributed_inference_tpu.runtime.router import ServingRouter
     from neuronx_distributed_inference_tpu.runtime.serving import ServingSession
     from neuronx_distributed_inference_tpu.telemetry import (
@@ -606,6 +618,10 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy):
     def run_once(registry=None):
         for app in apps:
             app.init_kv_cache()  # fresh block pool per replica between runs
+        tier = []
+        for i, papp in enumerate(prefill_apps or ()):
+            papp.init_kv_cache()
+            tier.append(PrefillReplicaHandle(papp, i))
         with TelemetrySession(registry=registry) as tel:
             # threaded stepping follows TpuConfig.router_threading on the
             # replica apps (the *_router_threaded row sets it); the context
@@ -613,7 +629,7 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy):
             # (no-op when sequential)
             with ServingRouter(
                 [ServingSession(app, telemetry=tel) for app in apps],
-                policy=policy, telemetry=tel,
+                policy=policy, telemetry=tel, prefill_replicas=tier,
             ) as router:
                 t_start = time.time()
                 next_idx = 0
@@ -637,11 +653,14 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy):
                 }
                 per_replica = [h.tokens_served for h in router.replicas]
                 threaded = router.threaded
-        return tel, counts, per_replica, total_s, threaded
+                handoffs = sum(p.handoffs for p in router.prefill_replicas)
+        return tel, counts, per_replica, total_s, threaded, handoffs
 
     run_once()  # warmup / compile pass over every replica's programs
     base_snap = default_registry().snapshot()
-    tel, counts, per_replica, total_s, threaded = run_once(default_registry())
+    tel, counts, per_replica, total_s, threaded, handoffs = run_once(
+        default_registry()
+    )
     total_tokens = sum(counts.values())
     snap = tel.registry.snapshot()
 
@@ -695,11 +714,21 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy):
         "preempted": _ctr("nxdi_requests_preempted_total"),
         "quarantined": _ctr("nxdi_rows_quarantined_total"),
     }
+    if prefill_apps:
+        # disaggregated-tier census (ISSUE 15): on clean traffic every
+        # prompt hands off (handoffs == n_requests) with ZERO typed
+        # hand-off failures and ZERO local-prefill fallbacks
+        res["n_prefill_replicas"] = len(prefill_apps)
+        res["handoffs"] = handoffs
+        res["handoff_failures"] = _ctr("nxdi_handoff_failures_total")
+        res["handoff_local_prefill"] = _ctr("nxdi_handoff_local_prefill_total")
+        res["handoff_retries"] = _ctr("nxdi_handoff_retries_total")
     return res
 
 
 def measure_goodput(apps, *, workload, chaos_kill_step=None,
-                    policy="least_loaded", bucket_steps=4):
+                    policy="least_loaded", bucket_steps=4,
+                    prefill_apps=None, chaos_tier="decode"):
     """Open-loop SLO goodput (ISSUE 14; docs/WORKLOADS.md): a seeded
     workload trace (arrival process × heavy-tailed lengths × shared-prefix
     tenant pools) drives the serving stack through the open-loop
@@ -715,7 +744,12 @@ def measure_goodput(apps, *, workload, chaos_kill_step=None,
     over N replica sessions. ``chaos_kill_step``: arm the standing chaos
     row — a seeded replica kill mid-run, scored as goodput-dip depth +
     recovery time off the time-bucketed goodput series (workload/slo.py
-    extract_dip). Containment deltas follow the PR-7 convention with
+    extract_dip). ``prefill_apps`` (ISSUE 15): a disaggregated PREFILL
+    tier in front of the decode replicas; ``chaos_tier="prefill"`` aims
+    the kill at a tier member instead of a decode replica — decode
+    capacity survives, so the scorer's recovery target stays at the FULL
+    baseline (alive_frac 1.0) and the row's claim is containment (local-
+    prefill fallback, no wedge), not a capacity dip. Containment deltas follow the PR-7 convention with
     ``reason=backlog`` EXCLUDED from the rejected count: open-loop backlog
     refusals are intended workload pressure, reported under
     ``backlog_refusals`` instead."""
@@ -735,17 +769,25 @@ def measure_goodput(apps, *, workload, chaos_kill_step=None,
         standard_spec,
     )
 
+    from neuronx_distributed_inference_tpu.runtime.replica import (
+        PrefillReplicaHandle,
+    )
+
     trace = generate(standard_spec(
         vocab_size=apps[0].config.vocab_size - 10, **workload
     ))
     chaos = (
-        ChaosPlan(kill_step=chaos_kill_step)
+        ChaosPlan(kill_step=chaos_kill_step, tier=chaos_tier)
         if chaos_kill_step is not None else None
     )
 
     def run_once(registry=None):
         for app in apps:
             app.init_kv_cache()
+        tier = []
+        for i, papp in enumerate(prefill_apps or ()):
+            papp.init_kv_cache()
+            tier.append(PrefillReplicaHandle(papp, i))
         vc = VirtualClock()
         with TelemetrySession(registry=registry, clock=vc.now) as tel:
             sessions = [
@@ -759,10 +801,20 @@ def measure_goodput(apps, *, workload, chaos_kill_step=None,
                     for i, s in enumerate(sessions)
                 ]
                 with ServingRouter(handles, policy=policy, telemetry=tel,
-                                   clock=vc.now) as router:
+                                   clock=vc.now,
+                                   prefill_replicas=tier) as router:
                     drv = WorkloadDriver(router, trace, clock=vc,
                                          telemetry=tel, chaos=chaos)
-                    result = drv.run()
+                    with warnings.catch_warnings():
+                        # a chaos prefill-tier kill degrades to local
+                        # prefill LOUDLY (that one warning is the product
+                        # behavior under test, not an error); anything else
+                        # stays visible
+                        warnings.filterwarnings(
+                            "ignore",
+                            message="disaggregated prefill tier is DEAD",
+                        )
+                        result = drv.run()
             else:
                 drv = WorkloadDriver(sessions[0], trace, clock=vc,
                                      telemetry=tel)
@@ -806,6 +858,12 @@ def measure_goodput(apps, *, workload, chaos_kill_step=None,
         "preempted": _counter_delta(
             snap, base_snap, "nxdi_requests_preempted_total"),
     }
+    if prefill_apps:
+        res["n_prefill_replicas"] = len(prefill_apps)
+        res["handoff_failures"] = _counter_delta(
+            snap, base_snap, "nxdi_handoff_failures_total")
+        res["handoff_local_prefill"] = _counter_delta(
+            snap, base_snap, "nxdi_handoff_local_prefill_total")
     if chaos is not None:
         res["chaos"] = result.chaos
         res["failover"] = _counter_delta(
@@ -978,6 +1036,24 @@ def _suite_params(tiny):
             extra_tpu=dict(router_threading=True),
             cache_key="int8_1b_router_threaded" if not tiny else None,
         ),
+        # SAME routed mix with a DISAGGREGATED PREFILL TIER (ISSUE 15,
+        # TpuConfig.router_prefill_replicas): one dedicated prefill replica
+        # context-encodes every prompt and hands the populated KV over to
+        # the 2 decode replicas — no decode replica ever runs a prefill, so
+        # long-prompt bursts cannot stall co-located decode ITL. The
+        # hand-off needs the CONTIGUOUS cache (whole-line scatter), so this
+        # row runs the contiguous serving config; its containment deltas
+        # must be 0/0/0 on clean traffic AND handoffs == requests with
+        # ZERO hand-off failures / local-prefill fallbacks (the tier's
+        # zero-containment-events proof). Own artifact keys: the stage
+        # split is part of the config fingerprint.
+        "serving_1b_int8_disagg": dict(
+            attrs=attrs_1b, quantized=True, serving=serving,
+            router=dict(replicas=2, policy="least_loaded",
+                        n_requests=4 if tiny else 8),
+            disagg=dict(prefill_replicas=1),
+            cache_key="int8_1b_disagg" if not tiny else None,
+        ),
         # Open-loop SLO goodput rows (ISSUE 14, docs/WORKLOADS.md): a seeded
         # workload trace (Poisson / bursty arrivals, heavy-tailed lengths,
         # shared-prefix tenants) drives the SAME serving config through the
@@ -1004,6 +1080,20 @@ def _suite_params(tiny):
             workload=wl_chaos,
             chaos=dict(replicas=2, kill_step=chaos_kill),
             cache_key="int8_1b" if not tiny else None,
+        ),
+        # the standing DISAGGREGATED chaos row (ISSUE 15): the same seeded
+        # open-loop trace over 2 decode replicas + 1 prefill replica, with
+        # the chaos kill aimed at the PREFILL TIER mid-run. Decode capacity
+        # survives — placements degrade to local monolithic prefill (the
+        # loud nxdi_handoff_local_prefill_total census) — so the pinned
+        # claim is containment: attainment holds, goodput recovers finitely
+        # against the FULL baseline (alive_frac 1.0), nothing wedges.
+        "serving_1b_int8_disagg_chaos": dict(
+            attrs=attrs_1b, quantized=True, serving=serving,
+            workload=wl_chaos,
+            chaos=dict(replicas=2, kill_step=chaos_kill, tier="prefill"),
+            disagg=dict(prefill_replicas=1),
+            cache_key="int8_1b_disagg" if not tiny else None,
         ),
         # single-chip proxy for the BASELINE 8B north star: int8 8B fits 16G
         "int8_8b_bs1": dict(
@@ -1089,6 +1179,44 @@ def run_point(name, tiny=False):
     import jax
 
     p = _suite_params(tiny)[name]
+
+    def _disagg_fleet(s, n_decode):
+        """(decode apps, prefill apps) for a disaggregated-tier row: the
+        hand-off scatters whole cache lines, so BOTH stages run the
+        CONTIGUOUS cache (no block_kv); each replica gets its own device
+        partition, prefill replicas after the decode ones."""
+        from neuronx_distributed_inference_tpu.runtime.router import (
+            partition_devices,
+        )
+
+        n_pre = p["disagg"]["prefill_replicas"]
+        parts = partition_devices(n_decode + n_pre)
+        contiguous = dict(is_continuous_batching=True, ctx_batch_size=1)
+        ck = p.get("cache_key")
+        decode = [
+            build_app(
+                p["attrs"], batch=s["max_seqs"], seq_len=s["seq"],
+                ce_buckets=[s["seq"]], tkg_buckets=[s["seq"]],
+                quantized=p["quantized"], cache_key=ck,
+                extra_tpu={**contiguous, **(p.get("extra_tpu") or {})},
+                devices=parts[i],
+            )
+            for i in range(n_decode)
+        ]
+        prefill = [
+            build_app(
+                p["attrs"], batch=s["max_seqs"], seq_len=s["seq"],
+                ce_buckets=[s["seq"]], tkg_buckets=[s["seq"]],
+                quantized=p["quantized"],
+                cache_key=f"{ck}_pre" if ck else None,
+                extra_tpu={**contiguous, "is_prefill_stage": True,
+                           **(p.get("extra_tpu") or {})},
+                devices=parts[n_decode + i],
+            )
+            for i in range(n_pre)
+        ]
+        return decode, prefill
+
     if "workload" in p:
         from neuronx_distributed_inference_tpu.runtime.router import (
             partition_devices,
@@ -1097,22 +1225,28 @@ def run_point(name, tiny=False):
         s = p["serving"]
         ch = p.get("chaos")
         n_apps = ch["replicas"] if ch else 1
-        parts = partition_devices(n_apps) if n_apps > 1 else [None]
-        apps = [
-            build_app(
-                p["attrs"], batch=s["max_seqs"], seq_len=s["seq"],
-                ce_buckets=[s["seq"]], tkg_buckets=[s["seq"]],
-                quantized=p["quantized"], cache_key=p.get("cache_key"),
-                block_kv=dict(num_blocks=s["blocks"],
-                              block_size=s["block_size"],
-                              max_seqs=s["max_seqs"]),
-                extra_tpu=p.get("extra_tpu"), devices=parts[i],
-            )
-            for i in range(n_apps)
-        ]
+        if "disagg" in p:
+            apps, prefill_apps = _disagg_fleet(s, n_apps)
+        else:
+            prefill_apps = None
+            parts = partition_devices(n_apps) if n_apps > 1 else [None]
+            apps = [
+                build_app(
+                    p["attrs"], batch=s["max_seqs"], seq_len=s["seq"],
+                    ce_buckets=[s["seq"]], tkg_buckets=[s["seq"]],
+                    quantized=p["quantized"], cache_key=p.get("cache_key"),
+                    block_kv=dict(num_blocks=s["blocks"],
+                                  block_size=s["block_size"],
+                                  max_seqs=s["max_seqs"]),
+                    extra_tpu=p.get("extra_tpu"), devices=parts[i],
+                )
+                for i in range(n_apps)
+            ]
         res = measure_goodput(
             apps, workload=p["workload"],
             chaos_kill_step=ch["kill_step"] if ch else None,
+            chaos_tier=(ch or {}).get("tier", "decode"),
+            prefill_apps=prefill_apps,
         )
         # same aggregate decode ceiling as the closed-loop serving rows:
         # goodput <= throughput <= the device projection
@@ -1126,22 +1260,30 @@ def run_point(name, tiny=False):
         )
 
         s, r = p["serving"], p["router"]
-        parts = partition_devices(r["replicas"])
-        apps = [
-            build_app(
-                p["attrs"], batch=s["max_seqs"], seq_len=s["seq"],
-                ce_buckets=[s["seq"]], tkg_buckets=[s["seq"]],
-                quantized=p["quantized"], cache_key=p.get("cache_key"),
-                block_kv=dict(num_blocks=s["blocks"],
-                              block_size=s["block_size"],
-                              max_seqs=s["max_seqs"]),
-                extra_tpu=p.get("extra_tpu"), devices=parts[i],
-            )
-            for i in range(r["replicas"])
-        ]
+        if "disagg" in p:
+            apps, prefill_apps = _disagg_fleet(s, r["replicas"])
+            parts = partition_devices(
+                r["replicas"] + p["disagg"]["prefill_replicas"]
+            )[: r["replicas"]]
+        else:
+            prefill_apps = None
+            parts = partition_devices(r["replicas"])
+            apps = [
+                build_app(
+                    p["attrs"], batch=s["max_seqs"], seq_len=s["seq"],
+                    ce_buckets=[s["seq"]], tkg_buckets=[s["seq"]],
+                    quantized=p["quantized"], cache_key=p.get("cache_key"),
+                    block_kv=dict(num_blocks=s["blocks"],
+                                  block_size=s["block_size"],
+                                  max_seqs=s["max_seqs"]),
+                    extra_tpu=p.get("extra_tpu"), devices=parts[i],
+                )
+                for i in range(r["replicas"])
+            ]
         res = measure_router(
             apps, n_requests=r["n_requests"], prompt_len=s["prompt"],
             gen_len=s["gen"], policy=r["policy"],
+            prefill_apps=prefill_apps,
         )
         # router ceiling: each replica serves its share of the mix and
         # streams its OWN weight copy, so the aggregate scales with the
@@ -1339,6 +1481,27 @@ def summary_line(points):
         # host both replicas share the device, so the overlap a chip-per-
         # replica deployment would convert to tok/s is the hardware
         # session's number to confirm.
+        # disaggregated prefill tier (ISSUE 15): the routed mix with every
+        # prompt context-encoded on a dedicated prefill replica and handed
+        # over; clean traffic pins handoffs == requests and ZERO hand-off
+        # failures / local-prefill fallbacks, and the chaos row pins
+        # containment under a prefill-tier kill
+        "disagg_tok_s": g("serving_1b_int8_disagg", "decode_tok_s"),
+        "disagg_handoffs": g("serving_1b_int8_disagg", "handoffs"),
+        "disagg_handoff_failures": g("serving_1b_int8_disagg",
+                                     "handoff_failures"),
+        "disagg_local_prefill": g("serving_1b_int8_disagg",
+                                  "handoff_local_prefill"),
+        "disagg_chaos_goodput_tok_s": g("serving_1b_int8_disagg_chaos",
+                                        "goodput_tok_s"),
+        "disagg_chaos_attainment": g("serving_1b_int8_disagg_chaos",
+                                     "slo_attainment"),
+        "disagg_chaos_local_prefill": g("serving_1b_int8_disagg_chaos",
+                                        "handoff_local_prefill"),
+        "disagg_chaos_dip_frac": g("serving_1b_int8_disagg_chaos",
+                                   "goodput_dip_frac"),
+        "disagg_chaos_recovery_steps": g("serving_1b_int8_disagg_chaos",
+                                         "goodput_recovery_steps"),
         "router_threaded_tok_s": g("serving_1b_int8_router_threaded",
                                    "decode_tok_s"),
         "router_step_overlap_frac": g("serving_1b_int8_router_threaded",

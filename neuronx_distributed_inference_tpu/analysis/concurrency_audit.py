@@ -104,6 +104,11 @@ SCOPE_SUFFIXES = (
     # replica workers — so its write sites join the census like the
     # router's own
     "workload/driver.py",
+    # the disaggregated KV hand-off (ISSUE 15): extract/inject/validate run
+    # on the router thread during the placement phase, writing the prefill
+    # and decode apps' caches — their write sites join the census so a
+    # future worker-reachable hand-off cannot slip in unclassified
+    "runtime/disaggregated.py",
 )
 
 # ---------------------------------------------------------------------------
@@ -115,10 +120,16 @@ SCOPE_SUFFIXES = (
 #: barriers) touches them at a time. ``TpuApplication`` is the pseudo-class
 #: for ``session.app``/``session.draft`` (the per-replica model application
 #: holding params + the donated KV cache).
+#: PrefillReplicaHandle/DisaggregatedPipeline (ISSUE 15) carry the replica
+#: discipline: a tier member's app/health is touched by exactly one thread
+#: at a time — the router thread, synchronously, during the placement
+#: phase's hand-off (workers never run hand-offs; CONC604 keeps it so)
 REPLICA_OWNED = frozenset({
     "ServingSession", "SpeculativeServingSession", "ReplicaHandle",
     "Request", "FaultInjector", "RequestTrace", "TpuApplication",
     "_ReplicaStepWorker", "WatchdogError",
+    "PrefillReplicaHandle", "DisaggregatedPipeline",
+    "_HealthStateMachine",  # the shared health-machine base of both handles
 })
 
 #: router-global objects: written ONLY by the router thread — a write
@@ -165,12 +176,16 @@ ATTR_TYPES = {
     ("_ReplicaStepWorker", "handle"): "ReplicaHandle",
     ("WorkloadDriver", "result"): "WorkloadResult",
     ("WorkloadDriver", "clock"): "VirtualClock",
+    ("*", "prefill_app"): "TpuApplication",
+    ("*", "decode_app"): "TpuApplication",
 }
 
 #: (owner class or "*", container attribute) -> element/value class
 ELEM_TYPES = {
     ("ServingRouter", "replicas"): "ReplicaHandle",
     ("ServingRouter", "alive_replicas"): "ReplicaHandle",
+    ("ServingRouter", "prefill_replicas"): "PrefillReplicaHandle",
+    ("ServingRouter", "alive_prefill_replicas"): "PrefillReplicaHandle",
     ("ServingRouter", "requests"): "RouterRequest",
     ("ServingRouter", "rejected"): "RouterRequest",
     ("ServingRouter", "pending"): "RouterRequest",
@@ -202,6 +217,9 @@ VAR_NAME_HINTS = {
     "w": "_ReplicaStepWorker",
     "app": "TpuApplication", "draft_app": "TpuApplication",
     "drv": "WorkloadDriver", "vc": "VirtualClock",
+    "ph": "PrefillReplicaHandle",
+    "pre": "TpuApplication", "dec": "TpuApplication",
+    "pipe": "DisaggregatedPipeline",
 }
 
 #: container-mutating method names (a call through these IS a write) —
@@ -217,6 +235,8 @@ LOCK_LEVELS = {
     "WorkloadDriver": 0, "VirtualClock": 0, "WorkloadResult": 0,
     "ReplicaHandle": 1, "ServingSession": 1, "SpeculativeServingSession": 1,
     "Request": 1, "FaultInjector": 1, "_ReplicaStepWorker": 1,
+    "PrefillReplicaHandle": 1, "DisaggregatedPipeline": 1,
+    "_HealthStateMachine": 1,
     "TelemetrySession": 2,
     "MetricsRegistry": 3,
     "_Family": 4,
@@ -227,6 +247,7 @@ MODULE_LOCK_LEVELS = {
     "workload/driver.py": 0,
     "runtime/router.py": 0,
     "runtime/replica.py": 1,
+    "runtime/disaggregated.py": 1,
     "runtime/serving.py": 1,
     "runtime/faults.py": 1,
     "telemetry/tracing.py": 2,

@@ -419,6 +419,14 @@ BENCH_ROW_MODELS: Dict[str, dict] = {
     "serving_1b_int8_router_threaded": dict(
         model=LLAMA_1B, kind="serving", batch=4, kv_width=1024,
         weight_dtype="int8", kv_dtype="bfloat16"),
+    # disaggregated-prefill-tier row (ISSUE 15): the DEVICE ceiling is the
+    # router row's — the tier moves WHERE prefill runs (a dedicated
+    # replica), not what each decode chip streams per request; the row's
+    # own numbers (handoffs, hand-off failure census, local-prefill
+    # fallbacks) are containment metrics the device model does not project
+    "serving_1b_int8_disagg": dict(model=LLAMA_1B, kind="serving", batch=4,
+                                   kv_width=1024, weight_dtype="int8",
+                                   kv_dtype="bfloat16"),
     # open-loop goodput rows (ISSUE 14): the DEVICE ceiling is the same
     # full-slot serving projection — goodput (SLO-met tokens/s) is bounded
     # by throughput, which is bounded by this; the rows' own numbers
@@ -436,6 +444,13 @@ BENCH_ROW_MODELS: Dict[str, dict] = {
                                           batch=8, kv_width=1024,
                                           weight_dtype="int8",
                                           kv_dtype="bfloat16"),
+    # disaggregated chaos row (ISSUE 15): same full-slot serving ceiling —
+    # the prefill-tier kill is a containment scenario (decode capacity
+    # survives; placements degrade to local prefill), not a new ceiling
+    "serving_1b_int8_disagg_chaos": dict(model=LLAMA_1B, kind="serving",
+                                         batch=8, kv_width=1024,
+                                         weight_dtype="int8",
+                                         kv_dtype="bfloat16"),
     "int8_8b_bs1": dict(model=LLAMA_8B, kind="decode", batch=1, kv_width=512,
                         weight_dtype="int8", kv_dtype="bfloat16"),
     "bf16_1b_8k": dict(model=LLAMA_1B, kind="decode", batch=1, kv_width=8704,

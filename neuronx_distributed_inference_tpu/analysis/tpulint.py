@@ -199,26 +199,56 @@ DRIVER_HOT_PATH = {
     "_backlog_depth",
 }
 
-#: per-file hot-path census buckets: {relpath suffix: (bucket label,
-#: function-name set, human description of why a fetch there is a bug)}
+#: ServingRouter disaggregated hand-off functions (runtime/router.py): the
+#: prefill-tier placement path. The ONE designated hand-off sync (the
+#: payload finiteness reduce) lives in runtime/disaggregated.py's
+#: validate_handoff_payload — router.py-side hand-off code is pure host
+#: bookkeeping, so its census bucket
+#: (`runtime/router.py::handoff-hot-path`) is pinned at ZERO entries.
+ROUTER_HANDOFF_HOT_PATH = {
+    "_bind_replica",
+    "_handoff",
+    "_local_prefill",
+    "_pick_prefill",
+    "_publish_tier_gauges",
+}
+
+#: per-file hot-path census buckets: {relpath suffix: tuple of (bucket
+#: label, function-name set, human description of why a fetch there is a
+#: bug)} — a file may pin SEVERAL independent buckets (router.py pins the
+#: placement loop and the hand-off path separately)
 HOT_PATH_BUCKETS = {
     "runtime/serving.py": (
-        "step-hot-path",
-        SERVING_STEP_HOT_PATH,
-        "a blocking fetch here stalls the pipelined serving loop; "
-        "consume points only",
+        (
+            "step-hot-path",
+            SERVING_STEP_HOT_PATH,
+            "a blocking fetch here stalls the pipelined serving loop; "
+            "consume points only",
+        ),
     ),
     "runtime/router.py": (
-        "route-hot-path",
-        ROUTER_HOT_PATH,
-        "a blocking fetch in the placement loop serializes every replica "
-        "behind one device; the router is host bookkeeping only",
+        (
+            "route-hot-path",
+            ROUTER_HOT_PATH,
+            "a blocking fetch in the placement loop serializes every replica "
+            "behind one device; the router is host bookkeeping only",
+        ),
+        (
+            "handoff-hot-path",
+            ROUTER_HANDOFF_HOT_PATH,
+            "a blocking fetch in the hand-off path would stall every "
+            "placement behind one transfer; the designated hand-off sync "
+            "lives in disaggregated.validate_handoff_payload",
+        ),
     ),
     "workload/driver.py": (
-        "drive-hot-path",
-        DRIVER_HOT_PATH,
-        "a blocking fetch in the open-loop driver would bill device waits "
-        "as workload time; the driver reads host-side commit records only",
+        (
+            "drive-hot-path",
+            DRIVER_HOT_PATH,
+            "a blocking fetch in the open-loop driver would bill device "
+            "waits as workload time; the driver reads host-side commit "
+            "records only",
+        ),
     ),
 }
 
@@ -579,35 +609,40 @@ class _Linter:
 
     def rule_host_sync_census(self):
         for mod in self.modules.values():
-            hot_ranges = []
-            bucket = None
-            hot_note = ""
-            for suffix, (label, names, note) in HOT_PATH_BUCKETS.items():
+            # [(bucket label, note, [(line_lo, line_hi), ...]), ...] — a
+            # file may pin several independent buckets (router.py pins the
+            # placement loop AND the hand-off path)
+            hot_buckets = []
+            for suffix, buckets in HOT_PATH_BUCKETS.items():
                 if not mod.relpath.endswith(suffix):
                     continue
-                bucket, hot_note = label, note
-                for name, infos in mod.functions.items():
-                    if name not in names:
-                        continue
-                    for info in infos:
-                        node = info.node
-                        hot_ranges.append(
-                            (node.lineno, getattr(node, "end_lineno", node.lineno))
+                for label, names, note in buckets:
+                    ranges = []
+                    for name, infos in mod.functions.items():
+                        if name not in names:
+                            continue
+                        for info in infos:
+                            node = info.node
+                            ranges.append(
+                                (node.lineno,
+                                 getattr(node, "end_lineno", node.lineno))
+                            )
+                    hot_buckets.append((label, note, ranges))
+                    # a renamed/removed hot-path function must not silently
+                    # disarm the gate (the baseline only fails on count
+                    # INCREASES, so a bucket quietly dropping to 0 is
+                    # invisible) — a stale name is a loud, non-baselined
+                    # error instead
+                    for name in sorted(names - set(mod.functions)):
+                        self._emit(
+                            mod, mod.tree, "TPU102", SEV_ERROR,
+                            f"the {label} census names `{name}` but {suffix} "
+                            f"defines no such function — the hot-path census "
+                            f"is stale (a renamed per-step method would "
+                            f"silently escape the gate); update the set in "
+                            f"analysis/tpulint.py",
+                            key=f"{mod.relpath}::{label}-stale",
                         )
-                # a renamed/removed hot-path function must not silently
-                # disarm the gate (the baseline only fails on count
-                # INCREASES, so a bucket quietly dropping to 0 is invisible)
-                # — a stale name is a loud, non-baselined error instead
-                for name in sorted(names - set(mod.functions)):
-                    self._emit(
-                        mod, mod.tree, "TPU102", SEV_ERROR,
-                        f"the {label} census names `{name}` but {suffix} "
-                        f"defines no such function — the hot-path census is "
-                        f"stale (a renamed per-step method would silently "
-                        f"escape the gate); update the set in "
-                        f"analysis/tpulint.py",
-                        key=f"{mod.relpath}::{label}-stale",
-                    )
             for n in ast.walk(mod.tree):
                 if not isinstance(n, ast.Call):
                     continue
@@ -634,12 +669,14 @@ class _Linter:
                     f"device_get per step)",
                 )
                 line = getattr(n, "lineno", 0)
-                if any(a <= line <= b for a, b in hot_ranges):
+                for bucket, hot_note, ranges in hot_buckets:
+                    if not any(a <= line <= b for a, b in ranges):
+                        continue
                     # separately-pinned bucket per HOT_PATH_BUCKETS: a NEW
-                    # blocking fetch inside step/route-reachable code trips
-                    # this gate even if the per-file count is rebalanced
-                    # elsewhere in the file (ISSUE 8/10; the pipelined
-                    # ragged path consumes via np.asarray on an
+                    # blocking fetch inside step/route/handoff-reachable
+                    # code trips this gate even if the per-file count is
+                    # rebalanced elsewhere in the file (ISSUE 8/10/15; the
+                    # pipelined ragged path consumes via np.asarray on an
                     # async-copied array, deliberately NOT a census name).
                     self._emit(
                         mod, n, "TPU102", SEV_WARNING,

@@ -382,6 +382,32 @@ class TpuConfig:
     # load order breaking ties.
     serving_replicas: int = 1
     router_policy: str = "least_loaded"
+    # disaggregated prefill tier (runtime/router.py + runtime/disaggregated
+    # .py): carve this many of `serving_replicas` out as DEDICATED prefill
+    # replicas — they run context encoding + extract_request_kv only, and
+    # the remaining (serving_replicas - router_prefill_replicas) decode
+    # replicas inject the handed-over KV and serve decode. A 16k-prompt
+    # burst then never stalls a co-located decode row's ITL. The KV hand-off
+    # is a CONTAINED failure domain: payload validation at inject (a corrupt
+    # or truncated hand-off terminally fails ONE request with typed
+    # FAILED(handoff), destination KV scrubbed), bounded hand-off retry with
+    # capped backoff, and tier-wide graceful degradation (every prefill
+    # replica dead => decode replicas fall back to local monolithic prefill,
+    # loudly — nxdi_handoff_local_prefill_total). Requires the contiguous
+    # cache (the hand-off scatters whole cache lines; paged decode caches
+    # are not supported) under continuous batching. 0 = no tier (every
+    # replica prefills locally). See docs/SERVING.md "Disaggregated prefill
+    # tier".
+    router_prefill_replicas: int = 0
+    # hand-off containment knobs: transient hand-off failures (transit loss,
+    # timeout, a transient prefill dispatch error) retry up to
+    # handoff_max_retries times with capped backoff — exhaustion terminally
+    # fails ONLY the in-flight request (FAILED(handoff)) and degrades the
+    # prefill replica like a dispatch give-up. handoff_timeout_s bounds one
+    # hand-off attempt's wall clock (None = no timeout; an attempt observed
+    # past it counts as a failed attempt and retries).
+    handoff_max_retries: int = 2
+    handoff_timeout_s: Optional[float] = None
     # thread-per-replica router stepping (runtime/router.py): ServingRouter
     # dispatches every alive replica's step() from a persistent pool of one
     # worker thread per replica and waits on a per-step barrier — dispatch
@@ -605,6 +631,36 @@ class TpuConfig:
             raise ValueError(
                 "serving_replicas > 1 routes over serving sessions: set "
                 "is_continuous_batching=True"
+            )
+        if self.router_prefill_replicas < 0:
+            raise ValueError(
+                "router_prefill_replicas must be >= 0 (0 = no disaggregated "
+                "prefill tier; every replica prefills locally)"
+            )
+        if self.router_prefill_replicas > 0:
+            if self.router_prefill_replicas >= self.serving_replicas:
+                raise ValueError(
+                    "router_prefill_replicas is carved OUT OF "
+                    "serving_replicas: at least one decode replica must "
+                    f"remain ({self.router_prefill_replicas} prefill of "
+                    f"{self.serving_replicas} total leaves none)"
+                )
+            if self.is_block_kv_layout:
+                raise ValueError(
+                    "the disaggregated prefill tier hands KV over into "
+                    "contiguous cache lines: router_prefill_replicas > 0 "
+                    "does not support is_block_kv_layout (decode replicas "
+                    "need the plain contiguous cache)"
+                )
+        if self.handoff_max_retries < 0:
+            raise ValueError(
+                "handoff_max_retries must be >= 0 (0 = a hand-off fails its "
+                "in-flight request on the first transient failure)"
+            )
+        if self.handoff_timeout_s is not None and not self.handoff_timeout_s > 0:
+            raise ValueError(
+                "handoff_timeout_s must be > 0 seconds (None disables the "
+                "per-attempt hand-off timeout)"
             )
         if self.attention_dp_degree > 1 and not self.is_continuous_batching:
             raise ValueError("attention_dp_degree > 1 requires is_continuous_batching")

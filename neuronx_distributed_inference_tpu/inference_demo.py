@@ -184,6 +184,25 @@ def build_parser() -> argparse.ArgumentParser:
         "follows each replica's real prefix-cache match index (longest "
         "cached prefix wins, load order breaks ties)",
     )
+    run.add_argument(
+        "--router-prefill-replicas", type=int, default=0,
+        help="disaggregated prefill tier (router config consumed by serving "
+        "drivers like bench.py's disagg rows): carve this many of "
+        "--serving-replicas out as dedicated prefill replicas feeding "
+        "decode replicas over the contained KV hand-off; 0 = no tier "
+        "(requires the contiguous cache; docs/SERVING.md)",
+    )
+    run.add_argument(
+        "--handoff-max-retries", type=int, default=2,
+        help="transient KV hand-off failures retried with capped backoff "
+        "this many times; exhaustion fails only the in-flight request "
+        "(FAILED(handoff)) and degrades the prefill replica",
+    )
+    run.add_argument(
+        "--handoff-timeout-s", type=float, default=None,
+        help="wall-clock bound for ONE hand-off attempt; an attempt past it "
+        "counts as a failed attempt and retries (None disables)",
+    )
     onoff("router-threading", False, dest="router_threading",
           help="thread-per-replica router stepping (router config consumed "
           "by serving drivers like bench.py's router rows): every alive "
@@ -473,6 +492,9 @@ def create_tpu_config(args) -> TpuConfig:
         serving_replicas=args.serving_replicas,
         router_policy=args.router_policy,
         router_threading=args.router_threading,
+        router_prefill_replicas=args.router_prefill_replicas,
+        handoff_max_retries=args.handoff_max_retries,
+        handoff_timeout_s=args.handoff_timeout_s,
         admission_validation=args.admission_validation,
         request_deadline_s=args.request_deadline_s,
         dispatch_max_retries=args.dispatch_max_retries,

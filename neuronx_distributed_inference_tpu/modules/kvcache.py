@@ -334,7 +334,9 @@ def cache_spec(cp_enabled: bool = False, dp_enabled: bool = False, quantized: bo
     return KVCache(k=spec, v=spec)
 
 
-def slot_ids_from_seq_ids(seq_ids: jax.Array, batch_size: int, dp: int = 1) -> jax.Array:
+def slot_ids_from_seq_ids(
+    seq_ids: jax.Array, batch_size: int, dp: int = 1, xp=jnp
+) -> jax.Array:
     """Map seq_ids to cache lines; invalid ids (< 0 or >= B) go to a garbage
     line (reference padding-zone writes, kv_cache_manager.py:356-417).
 
@@ -342,16 +344,22 @@ def slot_ids_from_seq_ids(seq_ids: jax.Array, batch_size: int, dp: int = 1) -> j
     attention-DP layout — seq s lives at ``(s // sr) * (sr+1) + s % sr`` with
     ``sr = B // dp``, and an invalid row writes to ITS OWN shard's garbage
     line so the scatter never crosses dp shards (the garbage-slot remap of
-    the reference DP KV manager)."""
+    the reference DP KV manager).
+
+    ``xp``: the array namespace — ``jnp`` (default, traced in-graph) or
+    ``np`` for host-side callers (the disaggregated hand-off computes its
+    line indices in pure numpy so extract/inject stay fetch-free; ONE
+    formula serves both, so the DP layout cannot drift between the device
+    scatter and the host mirror)."""
     valid = (seq_ids >= 0) & (seq_ids < batch_size)
     if dp <= 1:
-        return jnp.where(valid, seq_ids, batch_size)
+        return xp.where(valid, seq_ids, batch_size)
     sr = batch_size // dp
-    rows = jnp.arange(seq_ids.shape[0], dtype=seq_ids.dtype)
-    shard_of_row = jnp.minimum(rows // sr, dp - 1)
+    rows = xp.arange(seq_ids.shape[0], dtype=seq_ids.dtype)
+    shard_of_row = xp.minimum(rows // sr, dp - 1)
     mapped = (seq_ids // sr) * (sr + 1) + seq_ids % sr
     garbage = shard_of_row * (sr + 1) + sr
-    return jnp.where(valid, mapped, garbage)
+    return xp.where(valid, mapped, garbage)
 
 
 def update_cache_at_layer(

@@ -57,20 +57,23 @@ def _plain_cache(app):
 
 def _host_lines(app, cache, seq_ids: np.ndarray) -> np.ndarray:
     """Cache line per request, honoring the attention-DP interleaved garbage
-    lines — the jnp slot mapping evaluated once and pulled to host so the
-    indices stay mesh-neutral (the two stages live on different meshes)."""
+    lines — ``slot_ids_from_seq_ids`` evaluated in PURE NUMPY (``xp=np``:
+    one formula serves the device scatter and this host mirror) so the
+    hand-off path performs zero device round-trips for index math; the
+    indices are mesh-neutral by construction (the two stages live on
+    different meshes)."""
     from neuronx_distributed_inference_tpu.modules.kvcache import (
         slot_ids_from_seq_ids,
     )
 
     tc = app.config.tpu_config
     shards = tc.attention_dp_degree * tc.data_parallel_degree
-    lines = slot_ids_from_seq_ids(
-        jnp.asarray(np.asarray(seq_ids), jnp.int32),
+    return np.asarray(slot_ids_from_seq_ids(
+        np.asarray(seq_ids, np.int64),
         kv_batch_size(cache, shards),
         dp=shards,
-    )
-    return np.asarray(jax.device_get(lines))
+        xp=np,
+    ))
 
 
 def extract_request_kv(
@@ -102,6 +105,16 @@ def extract_request_kv(
         )
     else:
         out.update(k=cache.k[:, lines, :S], v=cache.v[:, lines, :S])
+    # start the payload's device->host leg NON-BLOCKING at dispatch (the
+    # PR-8 pattern): by the time inject (or a cross-mesh device_put)
+    # consumes these arrays, the gather + transfer have overlapped the
+    # hand-off's host bookkeeping instead of hard-blocking cold. Not a host
+    # sync — fetch-count parity is pinned by tests/test_disaggregated.py.
+    for key in ("k", "v", "k_scale", "v_scale"):
+        arr = out.get(key)
+        start = getattr(arr, "copy_to_host_async", None)
+        if start is not None:
+            start()
     return out
 
 
@@ -178,6 +191,70 @@ def inject_request_kv(app: TpuModelForCausalLM, seq_ids: np.ndarray, kv: Dict) -
     app.kv_cache = type(cache)(k=k, v=v)
 
 
+def validate_handoff_payload(
+    app: TpuModelForCausalLM, kv, expected_requests: int, expected_tokens: int
+) -> Optional[str]:
+    """Inject-side validation of one hand-off payload against the decode
+    stage's cache contract — the containment check that turns a corrupt,
+    truncated or malformed hand-off into a typed per-request failure
+    instead of a poisoned batch. Returns a reason string (``handoff_*``) or
+    None when the payload is safe to inject.
+
+    Checks, in order: structural shape/rank, cache-format agreement
+    (quantized vs plain, code dtype), layer/request/head-dim agreement,
+    declared-length agreement (a truncated transfer shows up here), and
+    finiteness of every float leaf (codes-as-floats for fp8, the
+    running-absmax scales for any quantized payload — a NaN scale would
+    dequantize EVERY row of the destination cache to NaN, the one coupling
+    channel the per-line scrub cannot contain). The finiteness reduce runs
+    on device and fetches ONE scalar — the hand-off path's single designated
+    host sync (tpulint TPU102 census)."""
+    from neuronx_distributed_inference_tpu.modules.kvcache import QuantizedKV
+
+    cache = _plain_cache(app)
+    quantized_dst = isinstance(cache.k, QuantizedKV)
+    dst_codes = cache.k.data if quantized_dst else cache.k
+    if not isinstance(kv, dict) or "k" not in kv or "v" not in kv:
+        return "handoff_malformed"
+    k, v = kv["k"], kv["v"]
+    if getattr(k, "ndim", 0) != 5 or getattr(v, "ndim", 0) != 5:
+        return "handoff_malformed"
+    if k.shape != v.shape:
+        return "handoff_malformed"
+    if bool(kv.get("quantized")) != quantized_dst:
+        return "handoff_format"
+    if quantized_dst and k.dtype != dst_codes.dtype:
+        return "handoff_format"
+    if k.shape[0] != dst_codes.shape[0]:  # layers
+        return "handoff_shape"
+    if k.shape[1] != expected_requests:
+        return "handoff_shape"
+    if k.shape[4] != dst_codes.shape[-1]:  # head_dim survives any tp remap
+        return "handoff_shape"
+    if k.shape[2] != expected_tokens:
+        # the transfer delivered fewer (or more) positions than the prefill
+        # stage declared: a truncated hand-off must not inject a partial
+        # prompt that decodes plausibly-but-wrong
+        return "handoff_truncated"
+    finite_checks = []
+    if quantized_dst:
+        ks, vs = kv.get("k_scale"), kv.get("v_scale")
+        if ks is None or vs is None:
+            return "handoff_malformed"
+        if ks.shape != (k.shape[0], k.shape[3]) or vs.shape != ks.shape:
+            return "handoff_malformed"
+        finite_checks += [ks, vs]
+        if jnp.issubdtype(k.dtype, jnp.floating):  # fp8 codes carry NaN/Inf
+            finite_checks += [k.astype(jnp.float32), v.astype(jnp.float32)]
+    else:
+        finite_checks += [k, v]
+    flags = [jnp.isfinite(a).all() for a in finite_checks]
+    ok = bool(np.asarray(jax.device_get(jnp.stack(flags).all())))
+    if not ok:
+        return "handoff_corrupt"
+    return None
+
+
 class DisaggregatedPipeline:
     """Prefill-stage + decode-stage orchestration (one process; the two apps
     may live on different meshes). ``generate`` reproduces the monolithic
@@ -221,28 +298,40 @@ class DisaggregatedPipeline:
         validate_sampling_params(sp, tc.max_topk)
         ctx_lens = attention_mask.sum(axis=1).astype(np.int32)
 
-        # --- prefill stage: one CTE pass ---------------------------------
+        # --- prefill stage: one CTE pass, or windowed for long prompts ----
         if pre.validate_prefill_length(S_in):
-            raise NotImplementedError(
-                "disaggregated prefill of prompts longer than one context "
-                "program is not implemented; raise max_context_length to "
-                "cover the prompt (the monolithic application handles this "
-                "via windowed prefill)"
+            # windowed long-prompt disaggregated prefill: chunk 0 through
+            # the CTE program, later chunks as multi-token prior-KV passes
+            # on the prefill stage's cache (application._windowed_prefill —
+            # the same path the monolithic application takes), then the
+            # populated cache lines hand over exactly like the short-prompt
+            # path. This is the 16k-burst scenario the tier exists for.
+            tokens_dev, _ = pre._windowed_prefill(
+                input_ids, attention_mask, seq_ids, sp, None
             )
-        position_ids = np.tile(np.arange(S_in, dtype=np.int32), (B, 1))
-        inputs, _ = pre.context_encoding_model.prepare(
-            input_ids, attention_mask, position_ids, seq_ids, sp
-        )
-        out = pre.context_encoding_model(
-            pre.params, pre.kv_cache, inputs, pre._sample_key(0)
-        )
-        pre.kv_cache = out.cache
-        first = np.asarray(jax.device_get(out.tokens))[:B, -1]
+        else:
+            position_ids = np.tile(np.arange(S_in, dtype=np.int32), (B, 1))
+            inputs, _ = pre.context_encoding_model.prepare(
+                input_ids, attention_mask, position_ids, seq_ids, sp
+            )
+            out = pre.context_encoding_model(
+                pre.params, pre.kv_cache, inputs, pre._sample_key(0)
+            )
+            pre.kv_cache = out.cache
+            tokens_dev = out.tokens
+        # start the first-token fetch NON-BLOCKING now: the copy overlaps
+        # the KV hand-off below, and the np.asarray consume after it reads
+        # an already-landed array (PR-8 pattern; byte-identity + fetch
+        # parity pinned by tests/test_disaggregated.py)
+        start_copy = getattr(tokens_dev, "copy_to_host_async", None)
+        if start_copy is not None:
+            start_copy()
 
         # --- KV hand-off ---------------------------------------------------
         inject_request_kv(
             dec, seq_ids, extract_request_kv(pre, seq_ids, upto=S_in)
         )
+        first = np.asarray(tokens_dev)[:B, -1]
 
         # --- decode stage: the monolithic application's EOS-path loop
         # (application.generate) so outputs match it column-for-column -------

@@ -56,6 +56,15 @@ class WatchdogError(RuntimeError):
         self.snapshot = snapshot or {}
 
 
+class HandoffTransitError(RuntimeError):
+    """One KV hand-off attempt failed IN TRANSIT (payload lost, transfer
+    timed out, transfer stalled past the operator timeout): the payload
+    never reached the decode replica, so the attempt is RETRYABLE — the
+    router's bounded hand-off retry re-extracts and re-sends. Contrast with
+    a corrupt/truncated payload, which DID arrive and is caught by the
+    decode side's inject validation as a terminal FAILED(handoff)."""
+
+
 def _retryable_classes() -> Tuple[type, ...]:
     """Transient dispatch exception classes: the injector's typed error plus
     the XLA runtime error jax raises for device-side failures (absent on
@@ -88,6 +97,21 @@ FAULT_KINDS = (
     "stall",
 )
 
+#: KV hand-off fault modes (disaggregated prefill tier, runtime/router.py):
+#: armed by HAND-OFF INDEX (the router's monotone hand-off counter, passed
+#: into the transit/corrupt hooks), not session step — hand-offs happen at
+#: placement time, outside any session step. drop/latency/stall are
+#: TRANSIT faults (retryable, bounded by handoff_max_retries);
+#: corrupt/truncate mutate the delivered payload so the decode side's
+#: inject validation terminally fails ONE request (FAILED(handoff)).
+HANDOFF_FAULT_KINDS = (
+    "handoff_drop",
+    "handoff_corrupt",
+    "handoff_truncate",
+    "handoff_latency",
+    "handoff_stall",
+)
+
 
 class FaultInjector:
     """Deterministic, seedable fault source for serving sessions.
@@ -111,6 +135,14 @@ class FaultInjector:
         self._nan_tokens: Dict[int, List[int]] = {}
         self._poison_rows: Dict[int, List[int]] = {}
         self._poison_garbage: Set[int] = set()
+        # KV hand-off faults, keyed by the router's hand-off index
+        # (handoff #n is the n-th hand-off the router performs, attempts of
+        # one hand-off share the index)
+        self._handoff_drop: Dict[int, int] = {}  # index -> attempts to drop
+        self._handoff_latency: Dict[int, float] = {}
+        self._handoff_stall: Set[int] = set()
+        self._handoff_corrupt: Set[int] = set()
+        self._handoff_truncate: Set[int] = set()
 
     # ---- arming ----------------------------------------------------------
 
@@ -161,6 +193,49 @@ class FaultInjector:
         post-propagation state of the garbage-block coupling bug; with the
         read scrub in place no healthy row may change by a byte."""
         self._poison_garbage.add(int(step))
+        return self
+
+    # ---- KV hand-off faults (disaggregated prefill tier) -----------------
+
+    def handoff_drop(self, handoff: int, attempts: int = 1) -> "FaultInjector":
+        """Lose hand-off ``handoff``'s payload in transit for its first
+        ``attempts`` attempts (:class:`HandoffTransitError`) — n <=
+        handoff_max_retries means the bounded retry recovers, n > means the
+        in-flight request terminally fails FAILED(handoff)."""
+        self._handoff_drop[int(handoff)] = int(attempts)
+        return self
+
+    def handoff_latency(self, handoff: int, seconds: float) -> "FaultInjector":
+        """Sleep ``seconds`` (via the router's injectable sleep) inside the
+        FIRST attempt of hand-off ``handoff`` (a one-shot hiccup — the
+        fault retires once fired, so the retry runs latency-free). With
+        ``handoff_timeout_s`` armed this deterministically exercises the
+        timeout-observed-then-retry-recovers path; a latency that must
+        defeat every retry is :meth:`handoff_stall`."""
+        self._handoff_latency[int(handoff)] = float(seconds)
+        return self
+
+    def handoff_stall(self, handoff: int) -> "FaultInjector":
+        """Stall hand-off ``handoff``'s transfer indefinitely. The router
+        observes it as a timed-out attempt (the deterministic stand-in for
+        'the operator timeout fired mid-transfer'): retryable, like drop."""
+        self._handoff_stall.add(int(handoff))
+        return self
+
+    def handoff_corrupt(self, handoff: int) -> "FaultInjector":
+        """Corrupt hand-off ``handoff``'s DELIVERED payload (NaN into the
+        K stream — or into the running-absmax scales for quantized
+        payloads): the decode side's inject validation must terminally fail
+        ONE request with typed FAILED(handoff) and scrub the destination
+        line, co-batched rows byte-identical (pinned)."""
+        self._handoff_corrupt.add(int(handoff))
+        return self
+
+    def handoff_truncate(self, handoff: int) -> "FaultInjector":
+        """Truncate hand-off ``handoff``'s payload along the position axis
+        (half the prompt arrives): the shape-vs-declared-length check at
+        inject catches it as terminal FAILED(handoff)."""
+        self._handoff_truncate.add(int(handoff))
         return self
 
     def random_schedule(
@@ -271,6 +346,63 @@ class FaultInjector:
             tokens[slot] = NON_FINITE_TOKEN
             self._fired(step, "nan_tokens", slot=slot)
         return tokens
+
+    # ---- hand-off hooks (router hand-off boundary, not session steps) ----
+
+    def _fired_handoff(self, handoff: int, kind: str, **detail) -> None:
+        self.log.append({"handoff": handoff, "kind": kind, **detail})
+
+    def handoff_transit(self, handoff: int, sleep_fn) -> None:
+        """Called once per hand-off ATTEMPT, between extract and inject.
+        Applies injected latency (through the router's injectable sleep —
+        with ``handoff_timeout_s`` armed the router observes the overrun
+        and fails the attempt) and raises :class:`HandoffTransitError` for
+        armed drop/stall faults. Drop retires per attempt (a retry can
+        succeed); stall stays armed for every attempt of its hand-off (a
+        stalled transfer never completes — the bounded retry exhausts)."""
+        idx = int(handoff)
+        delay = self._handoff_latency.pop(idx, None)
+        if delay is not None:
+            sleep_fn(delay)
+            self._fired_handoff(idx, "handoff_latency", seconds=delay)
+        if idx in self._handoff_stall:
+            self._fired_handoff(idx, "handoff_stall")
+            raise HandoffTransitError(
+                f"injected hand-off stall (hand-off {idx}: transfer never "
+                f"completed; observed as a timed-out attempt)"
+            )
+        remaining = self._handoff_drop.get(idx, 0)
+        if remaining > 0:
+            self._handoff_drop[idx] = remaining - 1
+            self._fired_handoff(idx, "handoff_drop")
+            raise HandoffTransitError(
+                f"injected hand-off payload loss (hand-off {idx})"
+            )
+
+    def corrupt_handoff_payload(self, handoff: int, kv: Dict) -> Dict:
+        """Transform hand-off ``handoff``'s delivered payload: truncate the
+        position axis and/or write NaN into the K stream (quantized
+        payloads corrupt the fp32 scales instead — int8 codes have no NaN).
+        Fires once per armed hand-off; the inject-side validation must turn
+        either into a terminal typed FAILED(handoff)."""
+        idx = int(handoff)
+        if idx in self._handoff_truncate:
+            self._handoff_truncate.discard(idx)
+            kv = dict(kv)
+            S = int(kv["k"].shape[2])
+            keep = max(1, S // 2)
+            kv["k"] = kv["k"][:, :, :keep]
+            kv["v"] = kv["v"][:, :, :keep]
+            self._fired_handoff(idx, "handoff_truncate", kept=keep, of=S)
+        if idx in self._handoff_corrupt:
+            self._handoff_corrupt.discard(idx)
+            kv = dict(kv)
+            if kv.get("quantized"):
+                kv["k_scale"] = kv["k_scale"].at[0, 0].set(float("nan"))
+            else:
+                kv["k"] = kv["k"].at[0, 0, 0, 0, 0].set(float("nan"))
+            self._fired_handoff(idx, "handoff_corrupt")
+        return kv
 
 
 # ---------------------------------------------------------------------------

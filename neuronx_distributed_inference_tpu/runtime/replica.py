@@ -46,7 +46,51 @@ HEALTH_GAUGE = {HEALTH_HEALTHY: 2, HEALTH_DEGRADED: 1, HEALTH_DEAD: 0}
 EWMA_ALPHA = 0.2
 
 
-class ReplicaHandle:
+class _HealthStateMachine:
+    """The HEALTHY -> DEGRADED -> DEAD machine shared by decode
+    :class:`ReplicaHandle` and prefill-tier :class:`PrefillReplicaHandle`
+    members: a bounded-retry exhaustion observed against the member
+    (:meth:`note_give_up`) degrades it, ``dead_after_give_ups`` of them
+    kill it, ``kill()`` is the operator/chaos switch, and death is
+    terminal. Subclasses set :attr:`GIVE_UP_REASON` (the health_reason a
+    give-up records) and implement :meth:`_reset_clean` (recovery progress
+    restarts when a give-up lands); each owns its own recovery clock —
+    clean STEPS for decode replicas, clean HAND-OFFS for tier members."""
+
+    GIVE_UP_REASON = "dispatch_error"
+
+    @property
+    def alive(self) -> bool:
+        return self.health != HEALTH_DEAD
+
+    def kill(self, reason: str = "killed") -> None:
+        """Operator/chaos switch: declare this member DEAD. Decode
+        replicas: the router harvests + fails over their live requests on
+        the next step. Prefill-tier members own no requests — queued work
+        flows through the survivors (or local-prefill fallback)."""
+        self._set_health(HEALTH_DEAD, reason)
+
+    def _set_health(self, state: str, reason: Optional[str]) -> None:
+        if self.health == HEALTH_DEAD:
+            return  # death is terminal
+        self.health = state
+        self.health_reason = reason
+
+    def note_give_up(self) -> None:
+        """A bounded retry exhausted against this member: first occurrence
+        degrades it, ``dead_after_give_ups`` occurrences kill it."""
+        self.give_ups += 1
+        self._reset_clean()
+        if self.give_ups >= self.dead_after_give_ups:
+            self._set_health(HEALTH_DEAD, self.GIVE_UP_REASON)
+        else:
+            self._set_health(HEALTH_DEGRADED, self.GIVE_UP_REASON)
+
+    def _reset_clean(self) -> None:
+        raise NotImplementedError
+
+
+class ReplicaHandle(_HealthStateMachine):
     def __init__(
         self,
         session,
@@ -93,34 +137,10 @@ class ReplicaHandle:
         # at a time, so the write is replica-confined)
         self.last_step_ms = 0.0
 
-    # ---- health ----------------------------------------------------------
+    # ---- health (machine shared via _HealthStateMachine) -----------------
 
-    @property
-    def alive(self) -> bool:
-        return self.health != HEALTH_DEAD
-
-    def kill(self, reason: str = "killed") -> None:
-        """Operator/test switch: declare this replica DEAD. The router
-        harvests and fails over its live requests on the next step."""
-        self._set_health(HEALTH_DEAD, reason)
-
-    def _set_health(self, state: str, reason: Optional[str]) -> None:
-        if self.health == HEALTH_DEAD:
-            return  # death is terminal
-        self.health = state
-        self.health_reason = reason
-
-    def note_give_up(self) -> None:
-        """The session's bounded dispatch retry exhausted on this replica
-        (observed by the router as terminally FAILED(dispatch_error) rows):
-        first occurrence degrades the replica, ``dead_after_give_ups``
-        occurrences kill it."""
-        self.give_ups += 1
+    def _reset_clean(self) -> None:
         self._clean_steps = 0
-        if self.give_ups >= self.dead_after_give_ups:
-            self._set_health(HEALTH_DEAD, "dispatch_error")
-        else:
-            self._set_health(HEALTH_DEGRADED, "dispatch_error")
 
     # ---- stepping --------------------------------------------------------
 
@@ -247,3 +267,136 @@ class ReplicaHandle:
         self._placed_t.clear()
         sess._readmit.clear()
         return out
+
+
+class PrefillReplicaHandle(_HealthStateMachine):
+    """One member of the disaggregated PREFILL tier behind the router
+    (``TpuConfig.router_prefill_replicas``; docs/SERVING.md "Disaggregated
+    prefill tier").
+
+    Wraps a prefill-stage :class:`~.application.TpuModelForCausalLM`
+    (``is_prefill_stage=True`` — CTE programs only — though a full app
+    works too) on its OWN mesh. Unlike a decode :class:`ReplicaHandle` it
+    owns NO serving session and NO requests: a hand-off is synchronous —
+    the router (on the router thread, during the placement phase) asks it
+    to ``run_prefill`` a prompt, extracts the populated KV line, and
+    injects it into the chosen decode replica. Nothing is decoded here, so
+    a member dying mid-hand-off loses only in-flight work the victim
+    request replays from its prompt (the PR-10 failover argument, one tier
+    over).
+
+    Health mirrors :class:`ReplicaHandle`: HEALTHY -> DEGRADED -> DEAD. A
+    hand-off retry exhaustion observed against this member
+    (:meth:`note_give_up`) degrades it, a second kills it; ``kill()`` is
+    the operator/chaos switch; a DEGRADED member recovers to HEALTHY after
+    ``recovery_handoffs`` consecutive clean hand-offs — hand-offs RESUME on
+    it throughout (DEGRADED is alive); only a fully-DEAD tier flips the
+    router to local monolithic prefill."""
+
+    def __init__(
+        self,
+        app,
+        replica_id: int,
+        fault_injector=None,
+        dead_after_give_ups: int = 2,
+        recovery_handoffs: int = 8,
+    ):
+        tc = app.config.tpu_config
+        if tc.is_prefill_stage is False:
+            raise ValueError(
+                "PrefillReplicaHandle needs a prefill-capable app "
+                "(is_prefill_stage=True, or a full app) — a decode-stage "
+                "app compiles no context-encoding programs"
+            )
+        from neuronx_distributed_inference_tpu.runtime.disaggregated import (
+            _plain_cache,
+        )
+
+        _plain_cache(app)  # raises NotImplementedError for paged/ring caches
+        self.app = app
+        self.replica_id = int(replica_id)
+        self.faults = fault_injector
+        self.dead_after_give_ups = int(dead_after_give_ups)
+        self.recovery_handoffs = int(recovery_handoffs)
+        self.health = HEALTH_HEALTHY
+        self.health_reason: Optional[str] = None
+        self.give_ups = 0
+        self._clean_handoffs = 0
+        self.handoffs = 0  # completed prefill+extract passes
+
+    # ---- health (machine shared via _HealthStateMachine; a give-up here
+    # is a hand-off retry exhaustion, recorded as reason "handoff") -------
+
+    GIVE_UP_REASON = "handoff"
+
+    def _reset_clean(self) -> None:
+        self._clean_handoffs = 0
+
+    def note_clean(self) -> None:
+        """One hand-off completed cleanly through this member; enough of
+        them recover a DEGRADED member to HEALTHY."""
+        if self.health == HEALTH_DEGRADED and self.give_ups < self.dead_after_give_ups:
+            self._clean_handoffs += 1
+            if self._clean_handoffs >= self.recovery_handoffs:
+                self.give_ups = 0
+                self._set_health(HEALTH_HEALTHY, None)
+
+    # ---- the prefill half of a hand-off ----------------------------------
+
+    def run_prefill(self, input_ids) -> Tuple[int, dict]:
+        """Context-encode ``input_ids`` (1-D) into this member's cache line
+        0 and extract the populated KV as a hand-off payload. Returns
+        ``(first_token, payload)`` — ``first_token`` may be the non-finite
+        sentinel (< 0), which the decode session's admission quarantines
+        exactly like a local prefill would.
+
+        Prompts longer than one context program run the windowed path
+        (chunk 0 via CTE, later chunks as multi-token prior-KV passes —
+        application._windowed_prefill at B=1 on line 0, where the TKG
+        row==line convention holds trivially). The extracted payload is a
+        device-level COPY, so the line is immediately reusable for the next
+        hand-off. Transient dispatch errors propagate to the router's
+        bounded hand-off retry (RETRYABLE_DISPATCH_ERRORS).
+
+        Deliberately mirrors the prefill leg of
+        ``DisaggregatedPipeline.generate`` (CTE-vs-windowed branch,
+        non-blocking first-token copy started before extract, np.asarray
+        consume) at B=1 without its batched sampling plumbing — change the
+        two together."""
+        import numpy as np
+
+        from neuronx_distributed_inference_tpu.modules.sampling import (
+            prepare_sampling_params,
+        )
+        from neuronx_distributed_inference_tpu.runtime.disaggregated import (
+            extract_request_kv,
+        )
+
+        app = self.app
+        ids = np.asarray(input_ids, np.int32).reshape(1, -1)
+        S = ids.shape[1]
+        mask = np.ones((1, S), np.int32)
+        seq_ids = np.zeros((1,), np.int32)
+        if app.validate_prefill_length(S):
+            tokens_dev, _ = app._windowed_prefill(
+                ids, mask, seq_ids, prepare_sampling_params(1), None
+            )
+        else:
+            pos = np.arange(S, dtype=np.int32)[None, :]
+            inputs, _ = app.context_encoding_model.prepare(
+                ids, mask, pos, seq_ids
+            )
+            out = app.context_encoding_model(
+                app.params, app.kv_cache, inputs, None
+            )
+            app.kv_cache = out.cache
+            tokens_dev = out.tokens
+        # non-blocking first-token copy: it overlaps the extract below and
+        # the router's inject; consumed via np.asarray (PR-8 pattern)
+        start_copy = getattr(tokens_dev, "copy_to_host_async", None)
+        if start_copy is not None:
+            start_copy()
+        payload = extract_request_kv(app, seq_ids, upto=S)
+        first = int(np.asarray(tokens_dev)[0, -1])
+        self.handoffs += 1
+        return first, payload

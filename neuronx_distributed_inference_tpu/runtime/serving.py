@@ -69,8 +69,11 @@ STATUS_FAILED = "failed"
 STATUS_REJECTED = "rejected"
 
 #: finish reasons that mark a request FAILED rather than FINISHED
+#: ("handoff" = a disaggregated KV hand-off delivered a corrupt/truncated
+#: payload, or exhausted its bounded retry — one request, typed, contained)
 FAILURE_REASONS = frozenset(
-    {"non_finite", "dispatch_error", "deadline_exceeded", "preempted"}
+    {"non_finite", "dispatch_error", "deadline_exceeded", "preempted",
+     "handoff"}
 )
 
 #: capped exponential backoff for transient dispatch retries:
@@ -151,6 +154,12 @@ class Request:
 
 
 class ServingSession:
+    #: class marker the router's tier validation reads: True when this
+    #: session class supports add_prefilled_request (the disaggregated KV
+    #: hand-off); the speculative session overrides it (the hand-off
+    #: carries target KV only — the draft cache needs its own prefill)
+    prefilled_admission = True
+
     def __init__(
         self,
         app,
@@ -348,8 +357,17 @@ class ServingSession:
         ``admission_validation=False`` restores the legacy raise-late
         behavior. ``deadline_s`` overrides the config-wide
         ``request_deadline_s`` wall-clock TTL for this request."""
+        req = self._new_request(req_id, input_ids, max_new_tokens,
+                                eos_token_id, deadline_s)
+        bounce = self._front_door(req)
+        if bounce is not None:
+            return bounce
+        return self._admit(req, self.free_slots[0])
+
+    def _new_request(self, req_id, input_ids, max_new_tokens, eos_token_id,
+                     deadline_s) -> Request:
         self.tel.request_submitted(req_id)
-        req = Request(
+        return Request(
             req_id=req_id,
             input_ids=np.asarray(input_ids, np.int32).reshape(-1),
             max_new_tokens=max_new_tokens,
@@ -357,22 +375,113 @@ class ServingSession:
             deadline_s=deadline_s if deadline_s is not None else self.deadline_s,
             t_submit=self._clock(),
         )
+
+    def _front_door(self, req: Request) -> Optional[AdmissionResult]:
+        """The ONE admission gate both doors (:meth:`add_request` and
+        :meth:`add_prefilled_request`) run: typed validation, re-admission
+        aging (preempted requests re-enter AHEAD of new arrivals — a new
+        request may not claim the capacity an older evicted one is waiting
+        for, so repeated pool exhaustion cannot starve it), then capacity.
+        Returns a falsy :class:`AdmissionResult` to bounce the request, or
+        None when a free slot is available (``self.free_slots[0]``)."""
         if self.admission_validation:
             reason = self._validate_request(req)
             if reason is not None:
                 return self._reject(req, reason)
-        # aging: preempted requests re-enter AHEAD of new arrivals — a new
-        # request may not claim the capacity an older evicted one is
-        # waiting for (repeated pool exhaustion cannot starve it)
         self._readmit_preempted()
         if self._readmit:
-            self.tel.request_dropped(req_id, "backlog")
+            self.tel.request_dropped(req.req_id, "backlog")
             return AdmissionResult(False, "backlog")
-        free = self.free_slots
-        if not free:
-            self.tel.request_dropped(req_id, "no_slot")
+        if not self.free_slots:
+            self.tel.request_dropped(req.req_id, "no_slot")
             return AdmissionResult(False, "no_slot")
-        return self._admit(req, free[0])
+        return None
+
+    def admission_capacity(self) -> Optional[str]:
+        """Capacity pre-check for callers that must pay for work BEFORE
+        admitting (the router's disaggregated hand-off runs a whole prefill
+        pass before ``add_prefilled_request``): the aging + capacity legs
+        of :meth:`_front_door` without a request — ``"backlog"`` /
+        ``"no_slot"`` / None (would admit). Advisory only: the admission
+        call re-runs the full gate."""
+        self._readmit_preempted()
+        if self._readmit:
+            return "backlog"
+        if not self.free_slots:
+            return "no_slot"
+        return None
+
+    def add_prefilled_request(
+        self,
+        req_id: str,
+        input_ids: np.ndarray,
+        kv_payload: Dict,
+        first_token: int,
+        max_new_tokens: int = 64,
+        eos_token_id: Optional[int] = None,
+        deadline_s: Optional[float] = None,
+    ) -> AdmissionResult:
+        """Admit a request whose prompt was ALREADY context-encoded on a
+        disaggregated prefill replica: validate the handed-over KV payload,
+        scatter it into a free cache line (:func:`~.disaggregated.
+        inject_request_kv`), and commit ``first_token`` as the request's
+        first generated token — decode proceeds exactly as if this session
+        had prefilled locally (byte-identical, pinned by
+        tests/test_disagg_router.py).
+
+        Containment contract (docs/SERVING.md "Disaggregated prefill
+        tier"): a payload that fails validation (corrupt / truncated /
+        wrong format — :func:`~.disaggregated.validate_handoff_payload`)
+        admits and then TERMINALLY fails ONLY this request with typed
+        ``FAILED(handoff)``, its destination cache line zero-scrubbed;
+        co-batched rows are untouched. The truthy return then carries a
+        request whose terminal verdict is already readable in
+        ``session.requests`` — the router's terminal sync folds it like any
+        other session-side failure. Capacity refusals (``backlog`` /
+        ``no_slot``) and validation rejects behave exactly like
+        :meth:`add_request`."""
+        from neuronx_distributed_inference_tpu.runtime.disaggregated import (
+            inject_request_kv,
+            validate_handoff_payload,
+        )
+
+        if self.block_mode:
+            raise ValueError(
+                "add_prefilled_request scatters whole contiguous cache "
+                "lines: the paged cache is not supported (config validation "
+                "forbids router_prefill_replicas with is_block_kv_layout)"
+            )
+        req = self._new_request(req_id, input_ids, max_new_tokens,
+                                eos_token_id, deadline_s)
+        bounce = self._front_door(req)
+        if bounce is not None:
+            return bounce
+        slot = self.free_slots[0]
+        req.slot = slot
+        req.status = STATUS_ACTIVE
+        self.slots[slot] = req
+        self.requests[req.req_id] = req
+        self.tel.request_admitted(req.req_id)
+        bad = validate_handoff_payload(self.app, kv_payload, 1, req.prompt_len)
+        if bad is not None:
+            # ONE request dies, typed; the destination line (never written —
+            # scrubbed anyway, it is about to recycle) cannot leak payload
+            # garbage to a later occupant; co-batched rows byte-identical.
+            # The validator's typed cause (handoff_corrupt / _truncated /
+            # _format / _malformed / _shape) labels the failure counter —
+            # the request record keeps the FAILURE_REASONS verdict "handoff"
+            self.tel.handoff_failure(req.req_id, bad)
+            self._finish(req, "handoff", scrub=True)
+            return ADMITTED
+        inject_request_kv(self.app, np.array([slot], np.int32), kv_payload)
+        req.prefill_pos = req.prompt_len
+        self._note_prefill(req, req.prompt_len)
+        self.tel.step("prefill")
+        self.tel.pool_gauges(
+            len(self.active), self.kv_pool_bytes, self.kv_free_bytes
+        )
+        self._finish_prefill(req, first_token)
+        return ADMITTED
 
     def _validate_request(self, req: Request) -> Optional[str]:
         """Typed admission checks; returns a reject reason or None. Every
@@ -2044,6 +2153,16 @@ class SpeculativeServingSession(ServingSession):
         #: later rounds; only measured acceptance (and with it the adaptive
         #: draft-length policy and the router's acceptance signal) moves.
         self.draft_accept_cap = None
+
+    prefilled_admission = False  # see ServingSession.prefilled_admission
+
+    def add_prefilled_request(self, *args, **kwargs) -> AdmissionResult:
+        raise NotImplementedError(
+            "the disaggregated prefill tier does not support speculative "
+            "decode sessions: the hand-off carries TARGET KV only, and the "
+            "draft app's cache needs its own prompt prefill (draft_ready) "
+            "— route speculative traffic to non-tier replicas"
+        )
 
     def _capped_accept(self, req: Request, count: int, drafted: int) -> int:
         """Apply the draft-agreement gate (if installed) to one verify

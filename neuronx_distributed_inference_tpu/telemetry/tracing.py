@@ -277,6 +277,44 @@ class TelemetrySession:
             "max - min live rows across alive replicas per router step "
             "(0 == perfectly balanced; the rebalance signal)",
             buckets=metrics_mod.ROUTER_SPREAD_BUCKETS)
+        # --- disaggregated prefill tier (router_prefill_replicas) ----------
+        # the KV hand-off as a failure domain: attempt/retry/failure census
+        # (failures by typed reason — handoff_corrupt / handoff_truncated /
+        # handoff_exhausted / ...), per-hand-off wall time, tier member
+        # health, and the LOUD local-prefill fallback counter (every
+        # placement served by a decode replica's own prefill because the
+        # whole tier is dead)
+        self._handoff_attempts = r.counter(
+            "nxdi_handoff_attempts_total",
+            "KV hand-off attempts (prefill + extract + inject; retries of "
+            "one hand-off count individually)")
+        self._handoff_retries = r.counter(
+            "nxdi_handoff_retries_total",
+            "hand-off attempts that failed in transit and were retried "
+            "with capped backoff (bounded by handoff_max_retries)")
+        self._handoff_failures = r.counter(
+            "nxdi_handoff_failures_total",
+            "hand-offs that terminally failed their ONE in-flight request "
+            "(typed FAILED(handoff)), by reason",
+            labels=("reason",))
+        self._handoff_ms = r.histogram(
+            "nxdi_handoff_ms",
+            "wall time of one completed hand-off (prefill dispatch through "
+            "inject, retries included)",
+            buckets=metrics_mod.LATENCY_MS_BUCKETS)
+        self._handoff_local = r.counter(
+            "nxdi_handoff_local_prefill_total",
+            "placements served by the decode replica's LOCAL monolithic "
+            "prefill because no prefill-tier member was alive (tier-wide "
+            "graceful degradation — loud by design)")
+        self._handoff_tier_health = r.gauge(
+            "nxdi_handoff_tier_health",
+            "prefill-tier member health (2 = healthy, 1 = degraded, "
+            "0 = dead)", labels=("replica",))
+        self._handoff_tier_alive = r.gauge(
+            "nxdi_handoff_tier_alive",
+            "alive (healthy + degraded) prefill-tier members; 0 means "
+            "every placement falls back to local monolithic prefill")
         # --- thread-per-replica stepping (TpuConfig.router_threading) -----
         # per-replica step wall time + the router's replica-stepping-phase
         # span: overlap_frac = 1 - phase_wall / sum(replica walls) is the
@@ -675,6 +713,52 @@ class TelemetrySession:
             return
         self._router_queue.set(queue_depth)
         self._router_spread.observe(spread)
+
+    # ---- disaggregated prefill tier (router_prefill_replicas) ------------
+
+    def handoff_attempt(self) -> None:
+        """One KV hand-off attempt started (retries count individually)."""
+        if not self.enabled:
+            return
+        self._handoff_attempts.inc()
+
+    def handoff_retry(self) -> None:
+        """One hand-off attempt failed in transit and will retry."""
+        if not self.enabled:
+            return
+        self._handoff_retries.inc()
+
+    def handoff_failure(self, req_id: str, reason: str) -> None:
+        """One hand-off terminally failed its in-flight request (typed
+        FAILED(handoff)): corrupt/truncated payload, or retry exhaustion."""
+        if not self.enabled:
+            return
+        self._handoff_failures.child((reason,)).inc()
+        self.event("handoff_failure", req_id=req_id, reason=reason)
+
+    def handoff_done(self, ms: float) -> None:
+        """One hand-off completed (prefill through inject), wall ms."""
+        if not self.enabled:
+            return
+        self._handoff_ms.observe(ms)
+
+    def handoff_local_prefill(self, req_id: str) -> None:
+        """Tier-wide degradation: this placement ran the decode replica's
+        LOCAL monolithic prefill because no prefill-tier member is alive."""
+        if not self.enabled:
+            return
+        self._handoff_local.inc()
+        self.event("handoff_local_prefill", req_id=req_id)
+
+    def handoff_tier_gauges(self, replica_id: int, health: int) -> None:
+        if not self.enabled:
+            return
+        self._handoff_tier_health.child((str(int(replica_id)),)).set(health)
+
+    def handoff_tier_alive(self, alive: int) -> None:
+        if not self.enabled:
+            return
+        self._handoff_tier_alive.set(alive)
 
     def replica_step(self, replica_id: int, step_ms: float) -> None:
         """One replica's session.step() wall time (recorded on the ROUTER

@@ -85,13 +85,31 @@ class VirtualClock:
 
 @dataclass(frozen=True)
 class ChaosPlan:
-    """Kill one alive replica at ``kill_step`` (driver step index).
-    ``replica=None`` picks the victim with a seeded draw among the replicas
-    alive at that step — reproducible chaos."""
+    """Seeded replica-kill schedule (driver step indices).
+
+    The base plan kills ONE alive replica at ``kill_step``; ``replica=None``
+    picks the victim with a seeded draw among the members alive at that
+    step — reproducible chaos. Two extensions (ISSUE 15):
+
+    - **tier targeting**: ``tier="prefill"`` draws victims from the
+      router's disaggregated prefill tier (``router.prefill_replicas``)
+      instead of the decode replicas — the tier-kill scenario whose goodput
+      must NOT dip like a decode kill (decode capacity survives; placements
+      degrade to local prefill / surviving tier members).
+    - **multi-kill**: ``kills=N`` fires N sequential kills starting at
+      ``kill_step``, ``gap_steps`` apart, each drawing a fresh seeded
+      victim from the tier's then-alive set (kills with nobody left alive
+      are skipped, recorded as exhausted).
+
+    Same seed + same trace => byte-identical kill schedule and outputs
+    (pinned by tests/test_workload.py)."""
 
     kill_step: int
     replica: Optional[int] = None
     seed: int = 0
+    tier: str = "decode"  # or "prefill" (disaggregated prefill tier)
+    kills: int = 1
+    gap_steps: int = 1
 
 
 @dataclass
@@ -153,6 +171,20 @@ class WorkloadDriver:
         self._is_router = hasattr(target, "replicas")
         if chaos is not None and not self._is_router:
             raise ValueError("ChaosPlan needs a router target (replica kill)")
+        if chaos is not None:
+            if chaos.tier not in ("decode", "prefill"):
+                raise ValueError(
+                    f"unknown ChaosPlan tier {chaos.tier!r} (decode/prefill)"
+                )
+            if chaos.tier == "prefill" and not getattr(
+                target, "prefill_replicas", None
+            ):
+                raise ValueError(
+                    "ChaosPlan(tier='prefill') needs a router with a "
+                    "disaggregated prefill tier (router_prefill_replicas)"
+                )
+            if chaos.kills < 1 or chaos.gap_steps < 1:
+                raise ValueError("ChaosPlan needs kills >= 1, gap_steps >= 1")
         self._chaos_rng = np.random.RandomState(
             chaos.seed if chaos is not None else 0
         )
@@ -244,25 +276,47 @@ class WorkloadDriver:
     # ---- chaos -----------------------------------------------------------
 
     def _maybe_kill(self) -> None:
-        if self.chaos is None or self._step != self.chaos.kill_step:
+        """Fire the chaos schedule: kill i (0-based) lands at
+        ``kill_step + i * gap_steps``, each drawing a fresh seeded victim
+        from the targeted tier's then-alive set. ``result.chaos`` keeps the
+        first kill's fields (the scorer's dip anchor) plus the full
+        ``events`` list for multi-kill schedules."""
+        if self.chaos is None:
             return
-        alive = [h for h in self.target.replicas if h.alive]
-        if not alive:
+        c = self.chaos
+        offset = self._step - c.kill_step
+        if offset < 0 or offset % c.gap_steps != 0:
             return
-        if self.chaos.replica is not None:
-            victims = [
-                h for h in alive if h.replica_id == self.chaos.replica
-            ]
+        if offset // c.gap_steps >= c.kills:
+            return
+        if c.tier == "prefill":
+            pool = list(getattr(self.target, "prefill_replicas", ()))
         else:
-            victims = [alive[int(self._chaos_rng.randint(len(alive)))]]
-        if not victims:
-            return
-        victims[0].kill("chaos")
-        self.result.chaos = {
-            "step": self._step,
-            "replica": victims[0].replica_id,
-            "alive_before": len(alive),
-        }
+            pool = list(self.target.replicas)
+        alive = [h for h in pool if h.alive]
+        event = {"step": self._step, "tier": c.tier, "alive_before": len(alive)}
+        if not alive:
+            event["exhausted"] = True  # schedule outlived the tier
+        else:
+            if c.replica is not None and offset == 0:
+                victims = [h for h in alive if h.replica_id == c.replica]
+            else:
+                victims = [alive[int(self._chaos_rng.randint(len(alive)))]]
+            if not victims:
+                return
+            victims[0].kill("chaos")
+            event["replica"] = victims[0].replica_id
+        if self.result.chaos is None:
+            self.result.chaos = {
+                **event,
+                # a prefill-tier kill leaves decode capacity INTACT (the
+                # router degrades to local prefill / surviving members), so
+                # the scorer's capacity-adjusted recovery target must not
+                # assume (N-1)/N decode capacity
+                "alive_frac": 1.0 if c.tier == "prefill" else None,
+                "events": [],
+            }
+        self.result.chaos["events"].append(event)
 
     # ---- commit attribution ----------------------------------------------
 

@@ -250,10 +250,21 @@ def score(
     if result.chaos is not None:
         af = alive_frac
         if af is None:
-            # capacity left after the kill: (N-1)/N of the replicas that
-            # were alive when the chaos plan fired
+            # the driver may pin capacity explicitly: a PREFILL-tier kill
+            # leaves decode capacity intact (alive_frac 1.0 — the router
+            # degrades to local prefill), so the recovery target must not
+            # assume a decode replica died
+            af = result.chaos.get("alive_frac")
+        if af is None:
+            # capacity left after the kill(s): (N-k)/N of the replicas that
+            # were alive when the chaos plan first fired (k = kills that
+            # actually landed; multi-kill schedules record them in events)
             n_before = max(1, int(result.chaos.get("alive_before", 2)))
-            af = max(1, n_before - 1) / n_before
+            events = result.chaos.get("events") or [{}]
+            n_killed = max(
+                1, sum(1 for e in events if not e.get("exhausted"))
+            )
+            af = max(1, n_before - n_killed) / n_before
         dip = extract_dip(
             series,
             result.chaos["step"] // bucket_steps,

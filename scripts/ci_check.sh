@@ -36,8 +36,8 @@ echo "== robustness (serving fault-containment) pytest subset =="
 python -m pytest tests -q -m robustness -p no:cacheprovider || rc=$?
 
 echo
-echo "== router (multi-replica front-end + threaded stepping) pytest subset =="
-python -m pytest tests/test_router.py tests/test_router_threaded.py -q -m 'not slow' -p no:cacheprovider || rc=$?
+echo "== router (multi-replica front-end + threaded stepping + disaggregated prefill tier) pytest subset =="
+python -m pytest tests/test_router.py tests/test_router_threaded.py tests/test_disagg_router.py -q -m 'not slow' -p no:cacheprovider || rc=$?
 
 echo
 echo "== workload (open-loop traffic + SLO goodput) pytest subset =="

@@ -27,17 +27,18 @@ ALL_POINTS = {
     "bf16_1b_bs1", "bf16_1b_bs4", "int8_1b_bs1", "serving_1b_int8",
     "serving_1b_int8_ragged", "serving_1b_int8_ragged_async",
     "serving_1b_int8_spec_ragged", "serving_1b_int8_router",
-    "serving_1b_int8_router_threaded",
+    "serving_1b_int8_router_threaded", "serving_1b_int8_disagg",
     "serving_1b_int8_goodput", "serving_1b_int8_goodput_burst",
-    "serving_1b_int8_goodput_chaos", "int8_8b_bs1",
+    "serving_1b_int8_goodput_chaos", "serving_1b_int8_disagg_chaos",
+    "int8_8b_bs1",
     "bf16_1b_8k", "bf16_1b_8k_kvq8", "bf16_1b_16k", "bf16_1b_16k_kvq8",
 }
 SERVING_POINTS = {
     "serving_1b_int8", "serving_1b_int8_ragged", "serving_1b_int8_ragged_async",
     "serving_1b_int8_spec_ragged", "serving_1b_int8_router",
-    "serving_1b_int8_router_threaded",
+    "serving_1b_int8_router_threaded", "serving_1b_int8_disagg",
     "serving_1b_int8_goodput", "serving_1b_int8_goodput_burst",
-    "serving_1b_int8_goodput_chaos",
+    "serving_1b_int8_goodput_chaos", "serving_1b_int8_disagg_chaos",
 }
 
 
@@ -111,6 +112,33 @@ def test_bench_suite_tiny(monkeypatch):
     # rejected key excludes reason=backlog by design), and the chaos row's
     # seeded replica kill shows a NONZERO goodput dip with a FINITE
     # recovery read off the time-bucketed goodput series
+    # ISSUE 15: the disaggregated-prefill-tier rows — the SAME routed mix
+    # with every prompt context-encoded on a dedicated prefill replica and
+    # handed over the contained KV hand-off. Clean traffic: every prompt
+    # handed off exactly once, ZERO hand-off failures, ZERO local-prefill
+    # fallbacks, the usual 0/0/0 containment deltas, both decode replicas
+    # served
+    disagg = points["serving_1b_int8_disagg"]
+    assert disagg["n_replicas"] == 2
+    assert disagg["n_prefill_replicas"] == 1
+    assert disagg["handoffs"] == disagg["n_requests"]
+    assert disagg["handoff_failures"] == 0
+    assert disagg["handoff_local_prefill"] == 0
+    assert disagg["failover"] == 0 and disagg["rejected"] == 0
+    assert all(t > 0 for t in disagg["tokens_per_replica"])
+    # the disagg CHAOS row: a seeded PREFILL-TIER kill mid-run — decode
+    # capacity survives, placements degrade LOUDLY to local prefill, every
+    # request completes with attainment intact (containment, not capacity
+    # loss: the kill must not read as a decode dip against a reduced
+    # target — alive_frac stays 1.0)
+    dchaos = points["serving_1b_int8_disagg_chaos"]
+    assert dchaos["n_replicas"] == 2
+    assert dchaos["chaos"]["tier"] == "prefill"
+    assert dchaos["chaos"]["alive_frac"] == 1.0
+    assert dchaos["handoff_local_prefill"] > 0  # the tier died -> fallback
+    assert dchaos["handoff_failures"] == 0
+    assert dchaos["slo_attainment"] == 1.0
+    assert dchaos["goodput_tok_s"] > 0
     goodput = points["serving_1b_int8_goodput"]
     assert goodput["slo_attainment"] == 1.0
     assert goodput["goodput_tok_s"] == goodput["decode_tok_s"] > 0
@@ -184,6 +212,14 @@ def test_bench_suite_tiny(monkeypatch):
     assert final["router_threaded_tok_s"] > 0
     assert final["router_step_overlap_frac"] is not None
     assert 0.0 <= final["router_step_overlap_frac"] < 1.0
+    # disaggregated-tier summary keys (ISSUE 15)
+    assert final["disagg_tok_s"] > 0
+    assert final["disagg_handoffs"] > 0
+    assert final["disagg_handoff_failures"] == 0
+    assert final["disagg_local_prefill"] == 0
+    assert final["disagg_chaos_goodput_tok_s"] > 0
+    assert final["disagg_chaos_attainment"] == 1.0
+    assert final["disagg_chaos_local_prefill"] > 0
     # goodput summary keys (ISSUE 14)
     assert final["goodput_tok_s"] > 0
     assert final["slo_attainment"] == 1.0

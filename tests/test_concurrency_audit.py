@@ -521,6 +521,13 @@ def test_clean_tree_router_session_touch_allowlist():
         "runtime/router.py::session.app.config",
         "runtime/router.py::session.kv_free_bytes",
         "runtime/router.py::session.requests",
+        # ISSUE 15, disaggregated prefill tier: the hand-off's capacity
+        # pre-check, the prefilled-admission door, and the two tier
+        # construction-time validation reads
+        "runtime/router.py::session.add_prefilled_request",
+        "runtime/router.py::session.admission_capacity",
+        "runtime/router.py::session.block_mode",
+        "runtime/router.py::session.prefilled_admission",
     }
 
 

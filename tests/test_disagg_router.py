@@ -1,0 +1,448 @@
+"""Disaggregated prefill tier in the router (ISSUE 15): dedicated prefill
+replicas context-encode and hand per-request KV over to decode replicas,
+with the hand-off as a CONTAINED failure domain.
+
+The acceptance pins:
+- a 2-decode + 1-prefill routed drain is BYTE-IDENTICAL (greedy) to a
+  single session serving the same request set — clean traffic, both
+  placement policies, sequential AND thread-per-replica stepping;
+- prompts longer than one context program hand off through the WINDOWED
+  disaggregated prefill (the retired disaggregated.py NotImplementedError
+  fence) byte-identically;
+- a prefill replica killed mid-drain: queued work flows through the
+  surviving tier member (or local fallback), outputs byte-identical;
+- the FULL tier killed: decode replicas degrade to LOCAL monolithic
+  prefill — loud (nxdi_handoff_local_prefill_total + one warning), every
+  request completes, byte-identical;
+- a DEGRADED tier member keeps serving hand-offs and recovers to HEALTHY
+  after enough clean ones;
+- the nxdi_handoff_* metric family is recorded host-side;
+- config validation fences (router_prefill_replicas vs paged cache, knob
+  ranges) are loud.
+
+Per-fault-mode containment (every handoff_* injector mode x byte-identity
+x retry-exhaust x tier-dead degradation) lives in
+tests/test_serving_faults.py's disaggregated-tier section.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_tiny_config, make_random_hf_state_dict
+
+from neuronx_distributed_inference_tpu.parallel.mesh import mesh_from_config
+from neuronx_distributed_inference_tpu.runtime.application import TpuModelForCausalLM
+from neuronx_distributed_inference_tpu.runtime.faults import FaultInjector
+from neuronx_distributed_inference_tpu.runtime.replica import (
+    HEALTH_DEAD,
+    HEALTH_DEGRADED,
+    HEALTH_HEALTHY,
+    PrefillReplicaHandle,
+    ReplicaHandle,
+)
+from neuronx_distributed_inference_tpu.runtime.router import (
+    ServingRouter,
+    partition_devices,
+)
+from neuronx_distributed_inference_tpu.runtime.serving import ServingSession
+from neuronx_distributed_inference_tpu.telemetry import TelemetrySession
+
+pytestmark = [pytest.mark.router, pytest.mark.robustness]
+
+#: the standard request set: mixed prompt lengths, one EOS hit; r2 is long
+#: enough to need several decode steps
+REQS = {
+    "d1": dict(ids=[5, 17, 92, 41], gen=6),
+    "d2": dict(ids=list(range(30, 52)), gen=6),
+    "d3": dict(ids=[7, 7, 7], gen=5),
+    "d4": dict(ids=[11, 23, 5, 99, 100, 3], gen=6),
+    "d5": dict(ids=[64, 2, 90, 14], gen=5),
+    "d6": dict(ids=[33, 88, 2], gen=6),
+}
+
+
+def _cfg(stage=None, **extra):
+    """Contiguous-cache continuous-batching config (the hand-off scatters
+    whole cache lines, so the tier forbids the paged layout)."""
+    tpu = dict(
+        is_continuous_batching=True, batch_size=4, ctx_batch_size=1,
+        seq_len=64, is_prefill_stage=stage,
+    )
+    tpu.update(extra)
+    return make_tiny_config(tpu=tpu)
+
+
+@pytest.fixture(scope="module")
+def state_dict():
+    return make_random_hf_state_dict(_cfg())
+
+
+@pytest.fixture(scope="module")
+def apps(state_dict):
+    """2 decode apps (full programs — the local-prefill degradation needs
+    CTE) + 1 prefill-stage app, each on its own device partition."""
+    parts = partition_devices(3)
+    out = []
+    for i, stage in enumerate([None, None, True]):
+        cfg = _cfg(stage)
+        out.append(TpuModelForCausalLM(
+            None, cfg, mesh=mesh_from_config(cfg.tpu_config, devices=parts[i])
+        ).load(state_dict=state_dict))
+    return out
+
+
+def _single_session_drain(app, reqs):
+    app.init_kv_cache()
+    sess = ServingSession(app)
+    items = list(reqs.items())
+    i = 0
+    guard = 0
+    while i < len(items):
+        rid, spec = items[i]
+        if sess.add_request(rid, spec["ids"], max_new_tokens=spec["gen"],
+                            eos_token_id=spec.get("eos")):
+            i += 1
+        else:
+            sess.step()
+        guard += 1
+        assert guard < 500
+    sess.run_to_completion()
+    return {rid: list(sess.requests[rid].generated) for rid, _ in items}
+
+
+@pytest.fixture(scope="module")
+def reference(apps):
+    return _single_session_drain(apps[0], REQS)
+
+
+def _make_router(apps, reqs, *, policy="least_loaded", telemetry=None,
+                 prefill_injector=None, n_prefill=1, **router_kw):
+    for app in apps:
+        app.init_kv_cache()
+    sessions = [
+        ServingSession(app, telemetry=telemetry) for app in apps[:2]
+    ]
+    tier = [
+        PrefillReplicaHandle(apps[2], i, fault_injector=prefill_injector)
+        for i in range(n_prefill)
+    ]
+    router = ServingRouter(sessions, policy=policy, telemetry=telemetry,
+                           prefill_replicas=tier, **router_kw)
+    for rid, spec in reqs.items():
+        assert router.add_request(rid, spec["ids"],
+                                  max_new_tokens=spec["gen"],
+                                  eos_token_id=spec.get("eos")), rid
+    return router
+
+
+# ---------------------------------------------------------------------------
+# byte-identity: disaggregated drain == single session
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("policy", ["least_loaded", "round_robin"])
+def test_disagg_drain_byte_identical_to_single_session(apps, reference, policy):
+    with _make_router(apps, REQS, policy=policy) as router:
+        out = router.run_to_completion()
+    assert out == reference
+    assert all(r.status == "finished" for r in router.requests.values())
+    # every prompt actually took the hand-off path (no silent local prefill)
+    assert router.prefill_replicas[0].handoffs == len(REQS)
+    assert all(h.tokens_served > 0 for h in router.replicas)
+
+
+def test_disagg_drain_byte_identical_threaded(apps, reference):
+    """Thread-per-replica stepping composes with the tier: hand-offs run on
+    the router thread during the placement phase (CONC601-604 confinement),
+    workers only step decode replicas — outputs byte-identical."""
+    with _make_router(apps, REQS, threaded=True) as router:
+        assert router.threaded
+        out = router.run_to_completion()
+    assert out == reference
+    assert router.prefill_replicas[0].handoffs == len(REQS)
+
+
+def test_disagg_windowed_long_prompt(apps, state_dict):
+    """A prompt LONGER than one context program hands off through the
+    windowed disaggregated prefill (CTE chunk 0 + multi-token prior-KV
+    chunks on the prefill replica) — byte-identical to the single session's
+    own windowed admission. The retired disaggregated.py fence."""
+    long_reqs = {
+        "w1": dict(ids=[(7 * i + 3) % 118 for i in range(40)], gen=5),
+        "w2": dict(ids=[5, 17, 92, 41], gen=5),
+    }
+    # max_context_length < seq_len forces the windowed path for w1
+    parts = partition_devices(3)
+    wapps = []
+    for i, stage in enumerate([None, None, True]):
+        cfg = _cfg(stage, max_context_length=32,
+                   context_encoding_buckets=[32], token_generation_buckets=[64])
+        wapps.append(TpuModelForCausalLM(
+            None, cfg, mesh=mesh_from_config(cfg.tpu_config, devices=parts[i])
+        ).load(state_dict=state_dict))
+    ref = _single_session_drain(wapps[0], long_reqs)
+    with _make_router(wapps, long_reqs) as router:
+        out = router.run_to_completion()
+    assert out == ref
+    assert router.prefill_replicas[0].handoffs == len(long_reqs)
+
+
+# ---------------------------------------------------------------------------
+# tier failure domains
+# ---------------------------------------------------------------------------
+
+
+def test_prefill_replica_kill_mid_drain(apps, reference):
+    """Kill the only prefill replica mid-drain: requests already handed off
+    keep decoding untouched; still-queued requests degrade to LOCAL prefill
+    on their decode replica — every request completes byte-identically and
+    the fallback is loudly counted."""
+    with TelemetrySession() as tel:
+        for app in apps:
+            app.init_kv_cache()
+        sessions = [ServingSession(app, telemetry=tel) for app in apps[:2]]
+        ph = PrefillReplicaHandle(apps[2], 0)
+        router = ServingRouter(sessions, telemetry=tel, prefill_replicas=[ph])
+        items = list(REQS.items())
+        # admit half, kill the tier, admit the rest
+        for rid, spec in items[:3]:
+            assert router.add_request(rid, spec["ids"],
+                                      max_new_tokens=spec["gen"],
+                                      eos_token_id=spec.get("eos"))
+        ph.kill("chaos")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for rid, spec in items[3:]:
+                assert router.add_request(rid, spec["ids"],
+                                          max_new_tokens=spec["gen"],
+                                          eos_token_id=spec.get("eos"))
+            out = router.run_to_completion()
+    assert out == reference
+    assert ph.health == HEALTH_DEAD
+    snap = tel.registry.snapshot()
+    local = snap["nxdi_handoff_local_prefill_total"]["samples"][0]["value"]
+    assert local == 3  # exactly the post-kill admissions fell back
+    assert snap["nxdi_handoff_tier_alive"]["samples"][0]["value"] == 0
+
+
+def test_full_tier_dead_local_fallback_is_loud(apps, reference):
+    """Every placement with the tier dead runs local monolithic prefill:
+    byte-identical drain, one warning, per-placement counter."""
+    with TelemetrySession() as tel:
+        for app in apps:
+            app.init_kv_cache()
+        sessions = [ServingSession(app, telemetry=tel) for app in apps[:2]]
+        ph = PrefillReplicaHandle(apps[2], 0)
+        ph.kill()
+        router = ServingRouter(sessions, telemetry=tel, prefill_replicas=[ph])
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            for rid, spec in REQS.items():
+                assert router.add_request(rid, spec["ids"],
+                                          max_new_tokens=spec["gen"],
+                                          eos_token_id=spec.get("eos"))
+            out = router.run_to_completion()
+    assert out == reference
+    assert sum(
+        "prefill tier is DEAD" in str(w.message) for w in rec
+    ) == 1  # loud exactly once, not once per placement
+    snap = tel.registry.snapshot()
+    local = snap["nxdi_handoff_local_prefill_total"]["samples"][0]["value"]
+    assert local == len(REQS)
+
+
+def test_degraded_member_keeps_serving_and_recovers(apps, reference):
+    """One give-up degrades the member; hand-offs RESUME on it (DEGRADED is
+    alive) and enough clean ones recover it to HEALTHY."""
+    inj = FaultInjector(0).handoff_drop(0, attempts=5)
+    for app in apps:
+        app.init_kv_cache()
+    sessions = [ServingSession(app) for app in apps[:2]]
+    ph = PrefillReplicaHandle(apps[2], 0, fault_injector=inj,
+                              recovery_handoffs=3)
+    with ServingRouter(sessions, prefill_replicas=[ph],
+                       handoff_max_retries=1) as router:
+        for rid, spec in REQS.items():
+            router.add_request(rid, spec["ids"], max_new_tokens=spec["gen"],
+                               eos_token_id=spec.get("eos"))
+        out = router.run_to_completion()
+        assert ph.health in (HEALTH_DEGRADED, HEALTH_HEALTHY)
+    # the first hand-off exhausted: its request FAILED(handoff), the member
+    # degraded — then served the remaining 5 hand-offs cleanly and recovered
+    failed = [r for r in router.requests.values() if r.status == "failed"]
+    assert len(failed) == 1 and failed[0].fail_reason == "handoff"
+    for rid in REQS:
+        if rid != failed[0].req_id:
+            assert out[rid] == reference[rid]
+    assert ph.health == HEALTH_HEALTHY  # recovered through clean hand-offs
+    assert ph.give_ups == 0
+
+
+def test_handoff_metrics_recorded(apps):
+    with TelemetrySession() as tel:
+        with _make_router(apps, REQS, telemetry=tel) as router:
+            router.run_to_completion()
+    snap = tel.registry.snapshot()
+    n = len(REQS)
+    assert snap["nxdi_handoff_attempts_total"]["samples"][0]["value"] == n
+    assert snap["nxdi_handoff_ms"]["samples"][0]["count"] == n
+    assert "nxdi_handoff_retries_total" in snap
+    assert "nxdi_handoff_failures_total" in snap
+    health = {
+        s["labels"]["replica"]: s["value"]
+        for s in snap["nxdi_handoff_tier_health"]["samples"]
+    }
+    assert health == {"0": 2}  # healthy
+    assert snap["nxdi_handoff_tier_alive"]["samples"][0]["value"] == 1
+
+
+def test_disagg_snapshot_carries_tier(apps):
+    with _make_router(apps, REQS) as router:
+        router.run_to_completion()
+        snap = router.diagnostic_snapshot()
+    tier = snap["prefill_tier"]
+    assert len(tier) == 1
+    assert tier[0]["health"] == HEALTH_HEALTHY
+    assert tier[0]["handoffs"] == len(REQS)
+
+
+# ---------------------------------------------------------------------------
+# fences
+# ---------------------------------------------------------------------------
+
+
+def test_config_knob_validation():
+    from neuronx_distributed_inference_tpu.config import TpuConfig
+
+    with pytest.raises(ValueError, match="at least one decode replica"):
+        TpuConfig(serving_replicas=2, is_continuous_batching=True,
+                  router_prefill_replicas=2).validate()
+    with pytest.raises(ValueError, match="contiguous"):
+        TpuConfig(serving_replicas=3, is_continuous_batching=True,
+                  is_block_kv_layout=True,
+                  router_prefill_replicas=1).validate()
+    with pytest.raises(ValueError, match="handoff_max_retries"):
+        TpuConfig(handoff_max_retries=-1).validate()
+    with pytest.raises(ValueError, match="handoff_timeout_s"):
+        TpuConfig(handoff_timeout_s=0.0).validate()
+    with pytest.raises(ValueError, match="router_prefill_replicas"):
+        TpuConfig(router_prefill_replicas=-1).validate()
+    # the valid carve-out passes
+    TpuConfig(serving_replicas=3, is_continuous_batching=True,
+              router_prefill_replicas=1, handoff_max_retries=0,
+              handoff_timeout_s=2.0).validate()
+
+
+def test_router_rejects_paged_decode_sessions(state_dict):
+    from neuronx_distributed_inference_tpu.config import ChunkedPrefillConfig
+
+    cfg = make_tiny_config(tpu=dict(
+        is_continuous_batching=True, batch_size=4, ctx_batch_size=1,
+        is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=24,
+        is_chunked_prefill=True,
+        chunked_prefill_config=ChunkedPrefillConfig(
+            max_num_seqs=2, kernel_q_tile_size=16
+        ),
+        seq_len=64,
+    ))
+    app = TpuModelForCausalLM(None, cfg).load(state_dict=state_dict)
+    app.init_kv_cache()
+    pre_cfg = _cfg(True)
+    pre = TpuModelForCausalLM(None, pre_cfg).load(state_dict=state_dict)
+    with pytest.raises(ValueError, match="contiguous cache lines"):
+        ServingRouter([ServingSession(app)],
+                      prefill_replicas=[PrefillReplicaHandle(pre, 0)])
+
+
+def test_prefill_handle_rejects_decode_stage_and_paged(state_dict):
+    dec_cfg = _cfg(False)
+    dec = TpuModelForCausalLM(None, dec_cfg).load(state_dict=state_dict)
+    with pytest.raises(ValueError, match="prefill-capable"):
+        PrefillReplicaHandle(dec, 0)
+
+
+def test_spec_session_prefilled_admission_fence(state_dict):
+    from neuronx_distributed_inference_tpu.runtime.serving import (
+        SpeculativeServingSession,
+    )
+
+    cfg_t, cfg_d = _cfg(), _cfg()
+    target = TpuModelForCausalLM(None, cfg_t).load(state_dict=state_dict)
+    draft = TpuModelForCausalLM(None, cfg_d).load(state_dict=state_dict)
+    sess = SpeculativeServingSession(target, draft, speculation_length=3)
+    with pytest.raises(NotImplementedError, match="speculative"):
+        sess.add_prefilled_request("x", [1, 2, 3], {}, 5)
+
+
+def test_degraded_member_recovers_beside_a_healthy_one(apps, state_dict):
+    """A DEGRADED tier member must keep receiving hand-offs while a HEALTHY
+    sibling exists — hand-offs are its only recovery clock (unlike decode
+    replicas, which accrue clean steps regardless of placement), so a
+    healthy-preferred pick would freeze it one give-up from death forever."""
+    inj = FaultInjector(0).handoff_stall(0)  # member 0 exhausts hand-off #0
+    for app in apps:
+        app.init_kv_cache()
+    sessions = [ServingSession(app) for app in apps[:2]]
+    # two tier members SHARING the prefill app (hand-offs are synchronous
+    # on the router thread, so sharing line 0 is safe): only member 0
+    # carries the injector
+    ph0 = PrefillReplicaHandle(apps[2], 0, fault_injector=inj,
+                               recovery_handoffs=2)
+    ph1 = PrefillReplicaHandle(apps[2], 1)
+    with ServingRouter(sessions, prefill_replicas=[ph0, ph1],
+                       handoff_max_retries=0) as router:
+        for rid, spec in REQS.items():
+            router.add_request(rid, spec["ids"], max_new_tokens=spec["gen"],
+                               eos_token_id=spec.get("eos"))
+        router.run_to_completion()
+    # member 0 exhausted once (degraded), then KEPT serving via round-robin
+    # and recovered after recovery_handoffs clean hand-offs
+    assert ph0.give_ups == 0 and ph0.health == HEALTH_HEALTHY
+    assert ph0.handoffs >= 2  # it genuinely served after degrading
+    failed = [r for r in router.requests.values() if r.status == "failed"]
+    assert len(failed) == 1 and failed[0].fail_reason == "handoff"
+
+
+def test_tier_dead_fallback_rebills_deadline(apps):
+    """The local-prefill fallback re-bills the TTL against the request's
+    ORIGINAL t_submit before admitting (the mid-hand-off defensive branch:
+    if the retry loop's wall time consumed the deadline, the fallback must
+    refuse typed instead of admitting with a silently-extended TTL). The
+    e2e paths recompute deadline_left fresh, so this pins the invariant at
+    the unit level with a stale value injected directly."""
+    from neuronx_distributed_inference_tpu.runtime.router import RouterRequest
+
+    class FakeClock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            return self.t
+
+        def sleep(self, s):
+            self.t += float(s)
+
+    clock = FakeClock()
+    for app in apps:
+        app.init_kv_cache()
+    sessions = [ServingSession(app, clock=clock, sleep_fn=clock.sleep)
+                for app in apps[:2]]
+    ph = PrefillReplicaHandle(apps[2], 0)
+    ph.kill()
+    with ServingRouter(sessions, prefill_replicas=[ph], clock=clock,
+                       sleep_fn=clock.sleep) as router:
+        rreq = RouterRequest(req_id="late", input_ids=np.asarray(
+            REQS["d1"]["ids"], np.int32), max_new_tokens=6,
+            deadline_s=2.0, t_submit=clock())
+        clock.sleep(3.0)  # the hand-off wall time the TTL must absorb
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            # deadline_left=2.0 is the STALE pre-hand-off value; the
+            # fallback must re-bill and refuse typed, never admit
+            res = router._local_prefill(
+                router.replicas[0], rreq, "late", 2.0
+            )
+    assert not res and res.reason == "deadline_exceeded"
+    assert "late" not in router.replicas[0].session.requests

@@ -145,3 +145,97 @@ def test_disaggregated_attention_dp_decode_stage():
         PROMPTS, MASK, max_new_tokens=10
     )
     np.testing.assert_array_equal(out.sequences, ref)
+
+
+def test_disaggregated_windowed_long_prompt_matches_monolithic():
+    """ISSUE 15: prompts LONGER than one context program run the WINDOWED
+    disaggregated prefill (chunk 0 via CTE, later chunks as multi-token
+    prior-KV passes on the prefill stage) — the retired NotImplementedError
+    fence — byte-identical to the monolithic application's own windowed
+    path."""
+    rng = np.random.RandomState(3)
+    prompts = rng.randint(1, 118, size=(2, 48))
+    mask = np.ones_like(prompts)
+    mask[1, 40:] = 0
+    prompts = prompts * mask
+    sd = None
+    apps = {}
+    for name, stage in (("mono", None), ("pre", True), ("dec", False)):
+        cfg = make_tiny_config(tpu=dict(
+            is_prefill_stage=stage, seq_len=128, max_context_length=32,
+            context_encoding_buckets=[32], token_generation_buckets=[64, 128],
+        ))
+        if sd is None:
+            sd = make_random_hf_state_dict(cfg)
+        apps[name] = TpuModelForCausalLM(None, cfg)
+        apps[name].load(state_dict=sd)
+    ref = apps["mono"].generate(prompts, mask, max_new_tokens=10).sequences
+    out = DisaggregatedPipeline(apps["pre"], apps["dec"]).generate(
+        prompts, mask, max_new_tokens=10
+    )
+    np.testing.assert_array_equal(out.sequences, ref)
+
+
+def test_handoff_path_is_fetch_free(monkeypatch):
+    """ISSUE 15 satellite (host-stall fix): extract + inject perform ZERO
+    blocking host syncs — the line mapping is pure numpy and the payload's
+    device->host leg starts non-blocking at dispatch (copy_to_host_async).
+    The pipeline's remaining fetches are the designated consume points
+    (first token after the hand-off, one per decode chunk)."""
+    import jax
+
+    from neuronx_distributed_inference_tpu.runtime import disaggregated
+
+    apps = _apps()
+    seq_ids = np.arange(2, dtype=np.int32)
+    # prefill so extract has real content
+    DisaggregatedPipeline(apps["pre"], apps["dec"]).generate(
+        PROMPTS, MASK, max_new_tokens=2
+    )
+    calls = []
+    real = jax.device_get
+
+    def spy(x):
+        calls.append(1)
+        return real(x)
+
+    monkeypatch.setattr(jax, "device_get", spy)
+    monkeypatch.setattr(disaggregated.jax, "device_get", spy)
+    kv = disaggregated.extract_request_kv(apps["pre"], seq_ids, upto=8)
+    disaggregated.inject_request_kv(apps["dec"], seq_ids, kv)
+    assert calls == []  # the hand-off itself never blocks on the host
+    # validation's finiteness reduce is the ONE designated hand-off sync
+    assert disaggregated.validate_handoff_payload(
+        apps["dec"], kv, 2, 8
+    ) is None
+    assert len(calls) == 1
+
+
+def test_validate_handoff_payload_reasons():
+    """The inject-side validation returns TYPED reasons for every malformed
+    payload class — the decode session turns any of them into one
+    FAILED(handoff), never a poisoned batch."""
+    from neuronx_distributed_inference_tpu.runtime.disaggregated import (
+        extract_request_kv,
+        validate_handoff_payload,
+    )
+
+    apps = _apps()
+    seq_ids = np.arange(2, dtype=np.int32)
+    DisaggregatedPipeline(apps["pre"], apps["dec"]).generate(
+        PROMPTS, MASK, max_new_tokens=2
+    )
+    kv = extract_request_kv(apps["pre"], seq_ids, upto=8)
+    dec = apps["dec"]
+    assert validate_handoff_payload(dec, kv, 2, 8) is None
+    assert validate_handoff_payload(dec, {}, 2, 8) == "handoff_malformed"
+    assert validate_handoff_payload(dec, kv, 1, 8) == "handoff_shape"
+    assert validate_handoff_payload(dec, kv, 2, 12) == "handoff_truncated"
+    short = dict(kv, k=kv["k"][:, :, :4], v=kv["v"][:, :, :4])
+    assert validate_handoff_payload(dec, short, 2, 8) == "handoff_truncated"
+    q = dict(kv, quantized=True)
+    assert validate_handoff_payload(dec, q, 2, 8) == "handoff_format"
+    import jax.numpy as jnp
+
+    bad = dict(kv, k=kv["k"].at[0, 0, 0, 0, 0].set(jnp.nan))
+    assert validate_handoff_payload(dec, bad, 2, 8) == "handoff_corrupt"

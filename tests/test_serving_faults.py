@@ -1170,3 +1170,266 @@ def test_spec_ragged_deadline_exceeded(spec_ragged_bundle):
     assert out["r2"] == golden["r2"]
     assert out["r3"] == golden["r3"]
     assert len(sess.free_slots) == sess.num_slots
+
+
+# ---------------------------------------------------------------------------
+# disaggregated prefill tier (ISSUE 15): the KV hand-off as a failure domain
+# — every handoff_* injector mode x victim-typed containment x co-batched
+# byte-identity x retry-exhaust x tier-dead degradation
+# ---------------------------------------------------------------------------
+
+
+DISAGG_REQS = {
+    "d1": dict(ids=[5, 17, 92, 41], gen=6),
+    "d2": dict(ids=list(range(30, 52)), gen=6),
+    "d3": dict(ids=[7, 7, 7], gen=5),
+    "d4": dict(ids=[11, 23, 5, 99, 100, 3], gen=6),
+}
+
+
+def _disagg_cfg(stage=None):
+    return make_tiny_config(tpu=dict(
+        is_continuous_batching=True, batch_size=4, ctx_batch_size=1,
+        seq_len=64, is_prefill_stage=stage,
+    ))
+
+
+@pytest.fixture(scope="module")
+def disagg_tier_apps():
+    """2 contiguous-cache decode apps + 1 prefill-stage app on partitioned
+    devices, shared weights — the hand-off containment target."""
+    from neuronx_distributed_inference_tpu.parallel.mesh import mesh_from_config
+    from neuronx_distributed_inference_tpu.runtime.router import (
+        partition_devices,
+    )
+
+    sd = make_random_hf_state_dict(_disagg_cfg())
+    parts = partition_devices(3)
+    apps = []
+    for i, stage in enumerate([None, None, True]):
+        cfg = _disagg_cfg(stage)
+        apps.append(TpuModelForCausalLM(
+            None, cfg,
+            mesh=mesh_from_config(cfg.tpu_config, devices=parts[i]),
+        ).load(state_dict=sd))
+    return apps
+
+
+@pytest.fixture(scope="module")
+def disagg_reference(disagg_tier_apps):
+    app = disagg_tier_apps[0]
+    app.init_kv_cache()
+    sess = ServingSession(app)
+    for rid, spec in DISAGG_REQS.items():
+        assert sess.add_request(rid, spec["ids"], max_new_tokens=spec["gen"])
+    sess.run_to_completion()
+    return {rid: list(sess.requests[rid].generated) for rid in DISAGG_REQS}
+
+
+def _disagg_drain(apps, injector=None, retries=2, timeout=None, clock=None,
+                  sleep=None, telemetry=None):
+    from neuronx_distributed_inference_tpu.runtime.replica import (
+        PrefillReplicaHandle,
+    )
+    from neuronx_distributed_inference_tpu.runtime.router import ServingRouter
+
+    for app in apps:
+        app.init_kv_cache()
+    sessions = [ServingSession(app, telemetry=telemetry) for app in apps[:2]]
+    ph = PrefillReplicaHandle(apps[2], 0, fault_injector=injector)
+    with ServingRouter(sessions, prefill_replicas=[ph], telemetry=telemetry,
+                       handoff_max_retries=retries, handoff_timeout_s=timeout,
+                       clock=clock, sleep_fn=sleep) as router:
+        for rid, spec in DISAGG_REQS.items():
+            router.add_request(rid, spec["ids"], max_new_tokens=spec["gen"])
+        out = router.run_to_completion()
+    return router, ph, out
+
+
+@pytest.mark.parametrize("mode", ["handoff_corrupt", "handoff_truncate"])
+def test_handoff_payload_fault_fails_one_request(
+    disagg_tier_apps, disagg_reference, mode
+):
+    """A corrupt/truncated payload that ARRIVES is caught by the decode
+    session's inject validation: exactly ONE request dies, typed
+    FAILED(handoff), destination line scrubbed — every co-batched request's
+    stream is byte-identical to a clean run, and the slot recycles."""
+    inj = FaultInjector(0)
+    getattr(inj, mode)(0)  # hand-off #0 == the first placed request
+    router, ph, out = _disagg_drain(disagg_tier_apps, injector=inj)
+    failed = [r for r in router.requests.values() if r.status == "failed"]
+    assert len(failed) == 1
+    assert failed[0].fail_reason == "handoff"
+    assert failed[0].tokens == []  # nothing was decoded from the bad payload
+    for rid in DISAGG_REQS:
+        if rid != failed[0].req_id:
+            assert out[rid] == disagg_reference[rid], (mode, rid)
+    assert any(f["kind"] == mode for f in inj.log)
+    # the tier member is NOT penalized for transit corruption
+    assert ph.health == "healthy"
+    # the victim's slot recycled: decode sessions drained empty
+    for h in router.replicas:
+        assert len(h.session.free_slots) == h.session.num_slots
+
+
+@pytest.mark.parametrize("mode", ["handoff_drop", "handoff_latency"])
+def test_handoff_transit_fault_retries_and_recovers(
+    disagg_tier_apps, disagg_reference, mode
+):
+    """A transit fault within the retry budget is invisible in the output:
+    the bounded retry re-extracts and re-sends, the drain stays
+    byte-identical, and the member stays HEALTHY."""
+    clock = FakeClock()
+    inj = FaultInjector(0)
+    if mode == "handoff_drop":
+        inj.handoff_drop(0, attempts=1)
+    else:
+        # latency past the 1s timeout: the attempt is observed as timed
+        # out (retryable); the retry runs latency-free and succeeds
+        inj.handoff_latency(0, 5.0)
+    router, ph, out = _disagg_drain(
+        disagg_tier_apps, injector=inj, retries=2, timeout=1.0,
+        clock=clock, sleep=clock.sleep,
+    )
+    assert out == disagg_reference
+    assert all(r.status == "finished" for r in router.requests.values())
+    assert ph.health == "healthy"
+    assert any(f["kind"] == mode for f in inj.log)
+
+
+@pytest.mark.parametrize("mode", ["handoff_drop", "handoff_stall"])
+def test_handoff_retry_exhaustion_fails_one_and_degrades_member(
+    disagg_tier_apps, disagg_reference, mode
+):
+    """Exhausting the bounded hand-off retry fails ONLY the in-flight
+    request (typed FAILED(handoff)) and degrades the tier member like a
+    dispatch give-up — the drain continues through the degraded member,
+    co-batched requests byte-identical."""
+    inj = FaultInjector(0)
+    if mode == "handoff_drop":
+        inj.handoff_drop(0, attempts=5)
+    else:
+        inj.handoff_stall(0)  # stays armed: every attempt of #0 stalls
+    router, ph, out = _disagg_drain(disagg_tier_apps, injector=inj, retries=1)
+    failed = [r for r in router.requests.values() if r.status == "failed"]
+    assert len(failed) == 1 and failed[0].fail_reason == "handoff"
+    assert ph.health == "degraded"
+    assert ph.give_ups == 1
+    for rid in DISAGG_REQS:
+        if rid != failed[0].req_id:
+            assert out[rid] == disagg_reference[rid], (mode, rid)
+
+
+def test_handoff_second_exhaustion_kills_member_tier_degrades(
+    disagg_tier_apps, disagg_reference
+):
+    """Two give-ups kill the (only) tier member: its in-flight requests'
+    verdicts are typed, the tier reads DEAD, and every later placement
+    degrades to LOCAL monolithic prefill — the remaining requests complete
+    byte-identically (the tier-wide graceful-degradation pin)."""
+    import warnings
+
+    inj = FaultInjector(0).handoff_stall(0).handoff_stall(1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        router, ph, out = _disagg_drain(disagg_tier_apps, injector=inj,
+                                        retries=0)
+    assert ph.health == "dead" and ph.health_reason == "handoff"
+    failed = sorted(
+        r.req_id for r in router.requests.values() if r.status == "failed"
+    )
+    assert len(failed) == 2  # exactly the two stalled hand-offs' victims
+    for rid in DISAGG_REQS:
+        if rid not in failed:
+            assert out[rid] == disagg_reference[rid]
+            assert router.requests[rid].status == "finished"
+
+
+@pytest.mark.parametrize("mode,reason", [
+    ("handoff_corrupt", "handoff_corrupt"),
+    ("handoff_truncate", "handoff_truncated"),
+])
+def test_handoff_failure_counter_carries_typed_reason(
+    disagg_tier_apps, mode, reason
+):
+    """The inject validator's TYPED cause labels
+    nxdi_handoff_failures_total — an operator can tell a truncated transfer
+    from NaN corruption from a format mismatch in the metric stream (retry
+    exhaustion labels `handoff_exhausted`, covered above)."""
+    inj = FaultInjector(0)
+    getattr(inj, mode)(0)
+    with TelemetrySession() as tel:
+        _disagg_drain(disagg_tier_apps, injector=inj, telemetry=tel)
+    snap = tel.registry.snapshot()
+    reasons = {
+        s["labels"]["reason"]: s["value"]
+        for s in snap["nxdi_handoff_failures_total"]["samples"]
+    }
+    assert reasons == {reason: 1}
+
+
+def test_handoff_wall_time_bills_against_deadline(disagg_tier_apps):
+    """The hand-off's own wall time (prefill, retries, backoff) counts
+    against the request's TTL — a hand-off that consumes the whole deadline
+    yields a typed FAILED(deadline_exceeded), never a request that decodes
+    past its SLA on a silently-extended deadline (the local-prefill path
+    bills its prefill the same way)."""
+    from neuronx_distributed_inference_tpu.runtime.replica import (
+        PrefillReplicaHandle,
+    )
+    from neuronx_distributed_inference_tpu.runtime.router import ServingRouter
+
+    clock = FakeClock()
+    # 10s injected hand-off latency with NO transfer timeout armed: the
+    # attempt itself succeeds, but the request's 2s TTL is long gone
+    inj = FaultInjector(0).handoff_latency(0, 10.0)
+    for app in disagg_tier_apps:
+        app.init_kv_cache()
+    sessions = [
+        ServingSession(app, clock=clock, sleep_fn=clock.sleep)
+        for app in disagg_tier_apps[:2]
+    ]
+    ph = PrefillReplicaHandle(disagg_tier_apps[2], 0, fault_injector=inj)
+    with ServingRouter(sessions, prefill_replicas=[ph], clock=clock,
+                       sleep_fn=clock.sleep) as router:
+        assert router.add_request("slow", DISAGG_REQS["d1"]["ids"],
+                                  max_new_tokens=6, deadline_s=2.0)
+        assert router.add_request("ok", DISAGG_REQS["d3"]["ids"],
+                                  max_new_tokens=5)
+        out = router.run_to_completion()
+    slow = router.requests["slow"]
+    assert slow.status == "failed"
+    assert slow.fail_reason == "deadline_exceeded"
+    assert slow.tokens == []  # never decoded past its SLA
+    assert router.requests["ok"].status == "finished"
+    assert len(out["ok"]) == 5
+
+
+def test_total_outage_publishes_dead_gauges(disagg_tier_apps):
+    """A step() on a fully-dead fleet still publishes gauges: every
+    replica's health gauge must read 0 (dead) and the global queue gauge
+    must read the drained (cleared) queue — a dashboard must never show a
+    healthy fleet during a total outage."""
+    from neuronx_distributed_inference_tpu.runtime.router import ServingRouter
+
+    with TelemetrySession() as tel:
+        for app in disagg_tier_apps[:2]:
+            app.init_kv_cache()
+        sessions = [
+            ServingSession(app, telemetry=tel) for app in disagg_tier_apps[:2]
+        ]
+        with ServingRouter(sessions, telemetry=tel) as router:
+            assert router.add_request("x", DISAGG_REQS["d1"]["ids"],
+                                      max_new_tokens=6)
+            router.step()
+            for h in router.replicas:
+                h.kill("outage")  # incl. one killed while IDLE
+            router.step()  # the early-return path must still publish
+            snap = tel.registry.snapshot()
+    health = {
+        s["labels"]["replica"]: s["value"]
+        for s in snap["nxdi_router_replica_health"]["samples"]
+    }
+    assert health == {"0": 0, "1": 0}
+    assert snap["nxdi_router_queue_depth"]["samples"][0]["value"] == 0
+    assert router.requests["x"].status == "failed"

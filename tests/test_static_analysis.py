@@ -1403,17 +1403,28 @@ def test_rule_step_hot_path_stale_name_is_loud(tmp_path):
     assert "_consume_ragged" in stale[0].message
 
 
-def _router_hot_snippet(omit=()):
-    """A fixture router.py defining every ROUTER_HOT_PATH function (minus
-    ``omit``), with a hot-path fetch in _place_pending and an
-    admission-path fetch in add_request."""
+def _router_hot_snippet(omit=(), handoff_fetch=False):
+    """A fixture router.py defining every ROUTER_HOT_PATH and
+    ROUTER_HANDOFF_HOT_PATH function (minus ``omit``), with a hot-path
+    fetch in _place_pending and an admission-path fetch in add_request;
+    ``handoff_fetch`` adds a fetch in _handoff (the handoff-hot-path
+    bucket's detector)."""
     from neuronx_distributed_inference_tpu.analysis.tpulint import (
+        ROUTER_HANDOFF_HOT_PATH,
         ROUTER_HOT_PATH,
     )
 
+    defined = {"_place_pending"} | ({"_handoff"} if handoff_fetch else set())
     stubs = "\n".join(
         f"    def {name}(self):\n        pass"
-        for name in sorted(ROUTER_HOT_PATH - {"_place_pending"} - set(omit))
+        for name in sorted(
+            (ROUTER_HOT_PATH | ROUTER_HANDOFF_HOT_PATH) - defined - set(omit)
+        )
+    )
+    handoff = (
+        "\n    def _handoff(self, payload):\n"
+        "        return jax.device_get(payload)  # BUG: fetch in hand-off\n"
+        if handoff_fetch else ""
     )
     return textwrap.dedent(
         """
@@ -1426,7 +1437,7 @@ def _router_hot_snippet(omit=()):
             def add_request(self, ids):
                 return jax.device_get(ids)     # admission: file bucket only
         """
-    ) + "\n" + stubs + "\n"
+    ) + handoff + "\n" + stubs + "\n"
 
 
 def _lint_router_snippet(tmp_path, source):
@@ -1466,10 +1477,42 @@ def test_rule_route_hot_path_stale_name_is_loud(tmp_path):
     assert "_sync_terminals" in stale[0].message
 
 
+def test_rule_handoff_hot_path_census(tmp_path):
+    """ISSUE 15: a blocking `jax.device_get` inside a ServingRouter
+    hand-off function earns a SECOND TPU102 finding in the separately-
+    pinned `runtime/router.py::handoff-hot-path` bucket (pinned at ZERO
+    entries — the designated hand-off sync lives in
+    disaggregated.validate_handoff_payload, not in router.py). The
+    placement-loop fetch lands in the route-hot-path bucket, not this one:
+    the two buckets pin independently."""
+    findings = _lint_router_snippet(
+        tmp_path, _router_hot_snippet(handoff_fetch=True)
+    )
+    census = [x for x in findings if x.rule == "TPU102"]
+    handoff = [x for x in census if x.key.endswith("::handoff-hot-path")]
+    assert len(handoff) == 1
+    route = [x for x in census if x.key.endswith("::route-hot-path")]
+    assert len(route) == 1  # the placement fetch did NOT leak into handoff
+
+
+def test_rule_handoff_hot_path_stale_name_is_loud(tmp_path):
+    findings = _lint_router_snippet(
+        tmp_path, _router_hot_snippet(omit=("_pick_prefill",))
+    )
+    stale = [
+        x for x in findings
+        if x.rule == "TPU102" and x.key.endswith("::handoff-hot-path-stale")
+    ]
+    assert len(stale) == 1
+    assert stale[0].severity == "error"
+    assert "_pick_prefill" in stale[0].message
+
+
 def test_router_tree_route_hot_path_is_clean():
-    """The REAL runtime/router.py carries ZERO route-hot-path census
-    entries (and zero file-level host syncs): the router is host
-    bookkeeping only, by contract."""
+    """The REAL runtime/router.py carries ZERO route-hot-path AND zero
+    handoff-hot-path census entries (and zero file-level host syncs): the
+    router is host bookkeeping only, by contract — the one designated
+    hand-off sync lives in disaggregated.validate_handoff_payload."""
     findings = tpulint.run()
     router = [
         f for f in findings

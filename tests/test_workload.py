@@ -671,3 +671,128 @@ def test_accept_profiles_move_adaptive_draft_lengths_spec_ragged():
     # and verify programs can cost the odd round, so "always maximum" is
     # not pinned)
     assert np.mean(list(lens_plain.values())) > np.mean(code_lens)
+
+
+# ---------------------------------------------------------------------------
+# ChaosPlan schedules (ISSUE 15 satellite): tier targeting + multi-kill
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def disagg_apps(state_dict):
+    """2 CONTIGUOUS-cache decode apps + 1 prefill-stage app on partitioned
+    devices — the disaggregated-tier workload target (the KV hand-off
+    scatters whole cache lines, so the tier forbids the paged layout)."""
+    parts = partition_devices(3)
+    apps = []
+    for i, stage in enumerate([None, None, True]):
+        cfg = make_tiny_config(tpu=dict(
+            is_continuous_batching=True, batch_size=4, ctx_batch_size=1,
+            seq_len=64, is_prefill_stage=stage,
+        ))
+        apps.append(TpuModelForCausalLM(
+            None, cfg, mesh=mesh_from_config(cfg.tpu_config, devices=parts[i])
+        ).load(state_dict=state_dict))
+    return apps
+
+
+def _run_disagg(apps, trace, *, chaos=None):
+    from neuronx_distributed_inference_tpu.runtime.replica import (
+        PrefillReplicaHandle,
+    )
+
+    for app in apps:
+        app.init_kv_cache()
+    vc = VirtualClock()
+    with TelemetrySession(clock=vc.now) as tel:
+        sessions = [
+            ServingSession(app, telemetry=tel, clock=vc.now)
+            for app in apps[:2]
+        ]
+        handles = [
+            ReplicaHandle(s, i, clock=vc.now) for i, s in enumerate(sessions)
+        ]
+        with ServingRouter(
+            handles, policy="least_loaded", telemetry=tel, clock=vc.now,
+            prefill_replicas=[PrefillReplicaHandle(apps[2], 0)],
+        ) as router:
+            drv = WorkloadDriver(router, trace, clock=vc, telemetry=tel,
+                                 chaos=chaos)
+            result = drv.run()
+    return result, tel
+
+
+def test_chaos_tier_validation(replica_apps):
+    trace = generate(_spec(seed=6, n=4))
+    for app in replica_apps:
+        app.init_kv_cache()
+    sessions = [ServingSession(app) for app in replica_apps]
+    with ServingRouter(sessions) as router:
+        with pytest.raises(ValueError, match="prefill tier"):
+            WorkloadDriver(router, trace,
+                           chaos=ChaosPlan(kill_step=2, tier="prefill"))
+        with pytest.raises(ValueError, match="tier"):
+            WorkloadDriver(router, trace,
+                           chaos=ChaosPlan(kill_step=2, tier="gpu"))
+        with pytest.raises(ValueError, match="kills"):
+            WorkloadDriver(router, trace,
+                           chaos=ChaosPlan(kill_step=2, kills=0))
+
+
+def test_chaos_multi_kill_schedule_seeded_replay(replica_apps):
+    """kills=2 gap_steps=6 on a 2-replica router: both decode replicas die
+    in sequence — the first kill fails over, the second is a total outage
+    whose remaining requests surface as typed verdicts (never a raise) —
+    and the seeded schedule replays byte-identically."""
+    trace = generate(_spec(seed=7, n=10, rate=1.0, min_output_len=8,
+                           max_output_len=12))
+    chaos = ChaosPlan(kill_step=6, kills=2, gap_steps=6, seed=11)
+    res, tel = _run_router(replica_apps, trace, chaos=chaos)
+    events = res.chaos["events"]
+    assert [e["step"] for e in events] == [6, 12]
+    killed = {e["replica"] for e in events if "replica" in e}
+    assert killed == {0, 1}  # the whole decode fleet died
+    assert res.chaos["alive_before"] == 2
+    # every request reached a TYPED terminal state (finished before the
+    # outage, or failed with a verdict afterwards)
+    assert set(res.statuses.values()) <= {"finished", "failed"}
+    assert "failed" in set(res.statuses.values())
+    # seeded replay: byte-identical outputs, commits, and kill schedule
+    res2, _ = _run_router(replica_apps, trace, chaos=chaos)
+    assert res2.outputs == res.outputs
+    assert res2.step_commits == res.step_commits
+    assert res2.chaos == res.chaos
+
+
+def test_chaos_prefill_tier_kill_degrades_not_dips(disagg_apps):
+    """ChaosPlan(tier='prefill') kills the ONLY tier member mid-run: decode
+    capacity survives, placements degrade to local monolithic prefill
+    (loud counter), EVERY request still finishes, attainment holds, and
+    the scorer's capacity adjustment knows no decode replica died
+    (alive_frac pinned 1.0). Seeded replay byte-identical."""
+    trace = generate(_spec(seed=8, n=10, rate=1.0, min_output_len=8,
+                           max_output_len=12))
+    chaos = ChaosPlan(kill_step=4, tier="prefill", seed=3)
+    import warnings as _w
+
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        res, tel = _run_disagg(disagg_apps, trace, chaos=chaos)
+    assert res.chaos["tier"] == "prefill"
+    assert res.chaos["alive_frac"] == 1.0
+    assert all(st == "finished" for st in res.statuses.values())
+    rep = score(res, tel, bucket_steps=4)
+    assert rep.attainment == 1.0
+    # the degradation was LOUD: local-prefill fallbacks were counted
+    snap = tel.registry.snapshot()
+    fallback = snap["nxdi_handoff_local_prefill_total"]["samples"][0]["value"]
+    assert fallback > 0
+    # finite recovery: decode capacity never left, so the series holds at
+    # (or quickly returns to) its baseline under the UNREDUCED target
+    if rep.dip is not None:
+        assert rep.dip.recovery_steps is not None
+    with _w.catch_warnings():
+        _w.simplefilter("ignore")
+        res2, _ = _run_disagg(disagg_apps, trace, chaos=chaos)
+    assert res2.outputs == res.outputs
+    assert res2.chaos == res.chaos
