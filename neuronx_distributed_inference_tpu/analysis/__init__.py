@@ -22,6 +22,12 @@ with ``scripts/run_static_analysis.py`` via :mod:`.cli`):
   ``interpret`` plumbing, mutable defaults, and large unsharded in-graph
   constants (rules TPU1xx).
 - :mod:`.flag_audit` — no silently-ignored config flags (rule FLAG301).
+- :mod:`.kernel_audit` — kernel contracts over the :mod:`.kernel_registry`
+  enumeration of every ``pallas_call`` in ``ops/``: static VMEM budget,
+  Mosaic tile legality, fallback/parity/lowering census, the committed
+  tuning table (``tuning_table.json``) kernels read tile defaults through,
+  and the MXU-occupancy floor (rules KERN70x), with ``legal_tiles()`` as
+  the pruned autotuner search space.
 
 This module stays import-light (no jax) so the retrace-guard hooks can be
 wired into the runtime without pulling the analyzers in.

@@ -32,7 +32,7 @@ from neuronx_distributed_inference_tpu.analysis.findings import Baseline, Findin
 _ANALYSIS_DIR = os.path.dirname(__file__)
 TPULINT_BASELINE = os.path.join(_ANALYSIS_DIR, "tpulint_baseline.json")
 
-ALL_SUITES = ("lint", "flags", "graph", "shard", "memory", "cost", "conc")
+ALL_SUITES = ("lint", "flags", "graph", "shard", "memory", "cost", "conc", "kernel")
 
 #: every committed baseline file --write-baseline may rewrite (diffed after)
 BASELINE_FILES = (
@@ -42,6 +42,8 @@ BASELINE_FILES = (
     "memory_baseline.json",
     "cost_baseline.json",
     "conc_baseline.json",
+    "kernel_baseline.json",
+    "tuning_table.json",
 )
 
 
@@ -65,7 +67,8 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m neuronx_distributed_inference_tpu.analysis",
         description=(
             "Static-analysis gate: tpulint + flag audit + graph audit + "
-            "shard audit + memory audit + cost audit + concurrency audit"
+            "shard audit + memory audit + cost audit + concurrency audit + "
+            "kernel audit"
         ),
     )
     parser.add_argument("--json", action="store_true", help="JSON report")
@@ -128,7 +131,9 @@ def run_suites(
         from neuronx_distributed_inference_tpu.analysis import flag_audit
 
         unbaselined.extend(flag_audit.run())
-    traced_suites = [s for s in ("graph", "shard", "memory", "cost") if s in suites]
+    traced_suites = [
+        s for s in ("graph", "shard", "memory", "cost", "kernel") if s in suites
+    ]
     if traced_suites:
         _prepare_jax_cpu()
     if "graph" in suites:
@@ -155,6 +160,11 @@ def run_suites(
 
         unbaselined.extend(concurrency_audit.run(write_baseline=write_baseline))
         extras["concurrency"] = concurrency_audit.last_report()
+    if "kernel" in suites:
+        from neuronx_distributed_inference_tpu.analysis import kernel_audit
+
+        unbaselined.extend(kernel_audit.run(write_baseline=write_baseline))
+        extras["kernel"] = kernel_audit.last_report()
 
     all_findings = baselined + unbaselined
     if write_baseline and "lint" in suites:
@@ -237,6 +247,10 @@ def main(argv=None) -> int:
         extras_chunks.append(
             concurrency_audit.render_breakdown(extras["concurrency"])
         )
+    if "kernel" in extras:
+        from neuronx_distributed_inference_tpu.analysis import kernel_audit
+
+        extras_chunks.append(kernel_audit.render_breakdown(extras["kernel"]))
     extras_text = "\n".join(c for c in extras_chunks if c) or None
     print(
         findings_mod.render_report(

@@ -45,6 +45,7 @@ class DeviceSpec:
     hbm_bw: float  # bytes/s
     ici_bw: float  # bytes/s per chip (one direction)
     hbm_capacity: int  # bytes
+    vmem_bytes: int  # per-core scoped VMEM a single Pallas kernel may hold
 
     def peak(self, dtype: str) -> float:
         return self.peak_flops.get(_canon_dtype(dtype), self.peak_flops["bfloat16"])
@@ -71,6 +72,13 @@ def _canon_dtype(dtype: str) -> str:
 #: already uses (197 TFLOP/s bf16, 819 GB/s HBM); the others are the public
 #: vendor peaks — correct them from measurements if a hardware session
 #: disagrees (the cost baselines pin FLOPs/bytes, not these constants).
+#:
+#: ``vmem_bytes`` is the per-core scoped-VMEM budget a single Pallas kernel
+#: invocation can hold (operand windows + scratch), i.e. the compiler's
+#: scoped-vmem limit (16 MiB class per the Pallas guide; Mosaic's
+#: ``vmem_limit_bytes`` default). v6e carries the doubled Trillium on-chip
+#: memory. KERN701 budgets against DEFAULT_DEVICE, so the v5e figure is the
+#: binding one — keep it conservative and let a hardware session raise it.
 DEVICE_REGISTRY: Dict[str, DeviceSpec] = {
     "v5e": DeviceSpec(
         name="v5e",
@@ -78,6 +86,7 @@ DEVICE_REGISTRY: Dict[str, DeviceSpec] = {
         hbm_bw=819e9,
         ici_bw=200e9,  # 1600 Gbps
         hbm_capacity=16 * 1024**3,
+        vmem_bytes=16 * 1024**2,  # 16 MiB/core scoped VMEM (+128 KiB SMEM)
     ),
     "v5p": DeviceSpec(
         name="v5p",
@@ -85,6 +94,7 @@ DEVICE_REGISTRY: Dict[str, DeviceSpec] = {
         hbm_bw=2765e9,
         ici_bw=600e9,  # 4800 Gbps
         hbm_capacity=95 * 1024**3,
+        vmem_bytes=16 * 1024**2,  # 16 MiB/core scoped VMEM
     ),
     "v6e": DeviceSpec(
         name="v6e",
@@ -92,6 +102,7 @@ DEVICE_REGISTRY: Dict[str, DeviceSpec] = {
         hbm_bw=1640e9,
         ici_bw=448e9,  # 3584 Gbps
         hbm_capacity=32 * 1024**3,
+        vmem_bytes=32 * 1024**2,  # Trillium doubles per-core on-chip memory
     ),
     "v4": DeviceSpec(
         name="v4",
@@ -99,6 +110,7 @@ DEVICE_REGISTRY: Dict[str, DeviceSpec] = {
         hbm_bw=1228e9,
         ici_bw=300e9,  # 2400 Gbps
         hbm_capacity=32 * 1024**3,
+        vmem_bytes=16 * 1024**2,  # 16 MiB VMEM/core (+128 MiB chip CMEM)
     ),
 }
 
@@ -597,6 +609,7 @@ def render_projection_tables(device: str = DEFAULT_DEVICE) -> str:
         f"{spec.peak_flops['bfloat16'] / 1e12:.0f} TFLOP/s, int8 "
         f"{spec.peak_flops['int8'] / 1e12:.0f}, HBM "
         f"{spec.hbm_bw / 1e9:.0f} GB/s, ICI {spec.ici_bw / 1e9:.0f} GB/s, "
+        f"VMEM {spec.vmem_bytes // (1024 ** 2)} MiB/core, "
         f"ridge {spec.ridge_flops_per_byte:.0f} FLOP/byte.",
         "",
         "| bench row | weights | KV read/step | bound | projected tok/s |",

@@ -1,0 +1,647 @@
+"""Registry of every Pallas kernel in ``ops/`` for the kernel audit (KERN70x).
+
+One :class:`KernelSpec` per ``pl.pallas_call`` site. Instead of hand-mirroring
+each kernel's grid/BlockSpec/scratch layout (which would drift the moment a
+kernel changes), the registry TRACES the real entry point with
+``jax.make_jaxpr`` at the committed bench shapes and reads the truth off the
+``pallas_call`` equation's ``grid_mapping``:
+
+- ``grid_mapping.grid`` — the launch grid;
+- ``grid_mapping.block_mappings`` — one per tensor operand/output (scalar-
+  prefetch operands ride SMEM and are excluded), each carrying
+  ``block_shape`` and ``array_shape_dtype``;
+- the kernel jaxpr's trailing invars — the ``pltpu.VMEM`` scratch avals.
+
+Tracing is abstract (ShapeDtypeStruct args, no compile, no devices), so the
+whole census runs on a CPU-only host in seconds. Tile candidates are
+injected through :func:`ops.tile_defaults.tile_overrides` — the same lookup
+path the kernels use for their committed defaults — so a candidate exercises
+exactly the code a user would hit by editing ``tuning_table.json``.
+
+Each spec also names the kernel's NATIVE FALLBACK and the tests that must
+reference it (KERN703): a new kernel cannot ship unregistered (the audit
+AST-scans ``ops/`` for unclaimed ``pallas_call`` sites) or unreferenced
+(fallback must import, parity/lowering test files must mention the entry).
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import pathlib
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+OPS_DIR = pathlib.Path(__file__).resolve().parent.parent / "ops"
+REPO_ROOT = OPS_DIR.parent.parent
+
+#: committed 1B/8B attention shapes (device_model.LLAMA_1B / LLAMA_8B and
+#: the BENCH_ROW_MODELS kv buckets) — literal here so a registry import
+#: cannot recurse into the traced-suite modules
+_1B = dict(H=2048, I=8192, Hq=32, Hkv=8, D=64, L=16)
+_8B = dict(H=4096, I=14336, Hq=32, Hkv=8, D=128, L=32)
+
+
+@dataclass(frozen=True)
+class KernelCase:
+    """One committed (shape-class, dtype) instantiation of a kernel."""
+
+    shape_class: str
+    dtype: str  # census label AND the tuning-table dtype key
+    build: Callable[[], Tuple[Callable, tuple]]  # -> (fn, abstract args)
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    name: str
+    site: Tuple[str, str]  # (ops file, enclosing function of the pallas_call)
+    entry: str  # public entry point name (test files must mention it)
+    fallback: str  # "dotted.module:attr" native path
+    parity_test: str  # repo-relative test file exercising kernel vs fallback
+    cases: Tuple[KernelCase, ...]
+    lowering_test: str = "tests/test_tpu_lowering.py"
+    tile_params: Tuple[str, ...] = ()  # free tile params read from the table
+    sweep: Tuple[Tuple[str, Tuple[int, ...]], ...] = ()  # param -> candidates
+    table_kernel: Optional[str] = None  # tuning-table key (defaults to name)
+
+    @property
+    def table_key(self) -> str:
+        return self.table_kernel or self.name
+
+
+@dataclass
+class BlockInfo:
+    role: str  # "in" | "out"
+    block_shape: Tuple[int, ...]
+    array_shape: Tuple[int, ...]
+    dtype: str
+    itemsize: int
+
+
+@dataclass
+class KernelInstance:
+    kernel: str
+    shape_class: str
+    dtype: str
+    tiles: Dict[str, int]  # the resolved tile params (empty if none)
+    grid: Tuple[int, ...]
+    blocks: List[BlockInfo]
+    scratch: List[Tuple[Tuple[int, ...], str, int]]  # (shape, dtype, bytes)
+    flops_per_step: int
+    dot_stats: List[Tuple[int, int, int]]  # (flops, contract_depth, out_lanes)
+
+    @property
+    def key(self) -> str:
+        return f"{self.kernel}/{self.shape_class}/{self.dtype}"
+
+    @property
+    def scratch_bytes(self) -> int:
+        return sum(b for _, _, b in self.scratch)
+
+    @property
+    def block_bytes_single(self) -> int:
+        """One copy of every operand/output window (the per-step DMA set)."""
+        out = 0
+        for b in self.blocks:
+            n = 1
+            for d in b.block_shape:
+                n *= d
+            out += n * b.itemsize
+        return out
+
+    @property
+    def vmem_bytes(self) -> int:
+        """Static VMEM model (KERN701): every blocked operand/output window
+        is double-buffered by the Pallas pipeline; scratch is single."""
+        return 2 * self.block_bytes_single + self.scratch_bytes
+
+
+def _sds(shape, dtype):
+    import jax
+
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def _unjit(fn):
+    """The unjitted callable behind a ``jax.jit`` wrapper — tracing through
+    the wrapper would let jit's trace cache return a stale jaxpr when only a
+    tile override (invisible to the cache key) changed."""
+    return getattr(fn, "__wrapped__", fn)
+
+
+# ---------------------------------------------------------------------------
+# case builders (committed bench shapes)
+# ---------------------------------------------------------------------------
+
+
+def _flash_case(S, dtype, *, window=None, packed=False):
+    def build():
+        import jax.numpy as jnp
+
+        from neuronx_distributed_inference_tpu.ops import flash_attention as fa
+
+        dt = jnp.dtype(dtype)
+        m = _1B
+        q = _sds((1, m["Hq"], S, m["D"]), dt)
+        valid = _sds((1, S), jnp.int32)
+        fn = functools.partial(
+            _unjit(fa.flash_attention_bhsd),
+            scale=m["D"] ** -0.5, causal=True, window=window, packed=packed,
+        )
+        return fn, (q, q, q, valid)
+
+    return build
+
+
+def _tkg_case(B, bucket, model, cache_dtype):
+    def build():
+        import jax.numpy as jnp
+
+        from neuronx_distributed_inference_tpu.ops import decode_attention as da
+
+        m = model
+        q = _sds((B, 1, m["Hq"], m["D"]), jnp.bfloat16)
+        cache = _sds((m["L"], B, bucket, m["Hkv"], m["D"]), jnp.dtype(cache_dtype))
+        li = _sds((), jnp.int32)
+        mask = _sds((B, 1, 1, bucket), jnp.bool_)
+        fn = functools.partial(
+            _unjit(da.tkg_decode_attention), scale=m["D"] ** -0.5, n_kv=m["Hkv"]
+        )
+        return fn, (q, cache, cache, li, mask)
+
+    return build
+
+
+def _paged_tkg_case(B, MB, bs, cache_dtype):
+    def build():
+        import jax.numpy as jnp
+
+        from neuronx_distributed_inference_tpu.ops import decode_attention as da
+
+        m = _1B
+        q = _sds((B, 1, m["Hq"], m["D"]), jnp.bfloat16)
+        cache = _sds((m["L"], 65, m["Hkv"], bs, m["D"]), jnp.dtype(cache_dtype))
+        li = _sds((), jnp.int32)
+        bt = _sds((B, MB), jnp.int32)
+        mask = _sds((B, 1, 1, MB * bs), jnp.bool_)
+        fn = functools.partial(
+            _unjit(da.paged_tkg_decode_attention),
+            scale=m["D"] ** -0.5, n_kv=m["Hkv"],
+        )
+        return fn, (q, cache, cache, li, bt, mask)
+
+    return build
+
+
+def _paged_flash_case(Sq, MB, bs, cache_dtype):
+    def build():
+        import jax.numpy as jnp
+
+        from neuronx_distributed_inference_tpu.ops import paged_flash_attention as pf
+
+        m = _1B
+        quant = jnp.dtype(cache_dtype) == jnp.int8
+        q = _sds((1, Sq, m["Hq"], m["D"]), jnp.bfloat16)
+        cache = _sds((65, m["Hkv"], bs, m["D"]), jnp.dtype(cache_dtype))
+        bt = _sds((1, MB), jnp.int32)
+        pos = _sds((1, Sq), jnp.int32)
+        lim = _sds((1,), jnp.int32)
+        raw = _unjit(pf.paged_flash_attention)
+        kw = dict(scale=m["D"] ** -0.5, n_rep=m["Hq"] // m["Hkv"])
+        if quant:
+            scale = _sds((m["Hkv"],), jnp.float32)
+
+            def fn(q, k, v, bt, pos, lim, ks, vs):
+                return raw(q, k, v, bt, pos, lim, k_scale=ks, v_scale=vs, **kw)
+
+            return fn, (q, cache, cache, bt, pos, lim, scale, scale)
+        return functools.partial(raw, **kw), (q, cache, cache, bt, pos, lim)
+
+    return build
+
+
+def _ragged_case(T, R, MB, bs, cache_dtype):
+    def build():
+        import jax.numpy as jnp
+
+        from neuronx_distributed_inference_tpu.ops import ragged_paged_attention as rp
+
+        m = _1B
+        quant = jnp.dtype(cache_dtype) == jnp.int8
+        q = _sds((T, m["Hq"], m["D"]), jnp.bfloat16)
+        cache = _sds((65, m["Hkv"], bs, m["D"]), jnp.dtype(cache_dtype))
+        bt = _sds((R, MB), jnp.int32)
+        row = _sds((R,), jnp.int32)
+        raw = _unjit(rp.ragged_paged_attention)
+        kw = dict(scale=m["D"] ** -0.5, n_rep=m["Hq"] // m["Hkv"])
+        if quant:
+            scale = _sds((m["Hkv"],), jnp.float32)
+
+            def fn(q, k, v, bt, rs, rl, cl, ks, vs):
+                return raw(q, k, v, bt, rs, rl, cl, k_scale=ks, v_scale=vs, **kw)
+
+            return fn, (q, cache, cache, bt, row, row, row, scale, scale)
+        return functools.partial(raw, **kw), (q, cache, cache, bt, row, row, row)
+
+    return build
+
+
+def _fused_attn_case(B, bucket):
+    def build():
+        import jax.numpy as jnp
+
+        from neuronx_distributed_inference_tpu.ops import decode_block as db
+
+        m = _1B
+        H, Hq, Hkv, D, L = m["H"], m["Hq"], m["Hkv"], m["D"], m["L"]
+        N3 = (Hq + 2 * Hkv) * D
+        x = _sds((B, 1, H), jnp.bfloat16)
+        gamma = _sds((H,), jnp.bfloat16)
+        wqkv = _sds((H, N3), jnp.bfloat16)
+        wout = _sds((Hq * D, H), jnp.bfloat16)
+        cs = _sds((B, 1, D // 2), jnp.float32)
+        cache = _sds((L, B, bucket, Hkv, D), jnp.bfloat16)
+        li = _sds((), jnp.int32)
+        slots = _sds((B,), jnp.int32)
+        mask = _sds((B, 1, 1, bucket), jnp.bool_)
+        pos = _sds((B, 1), jnp.int32)
+        fn = functools.partial(
+            _unjit(db.fused_attn_block),
+            scale=D ** -0.5, eps=1e-5, n_kv=Hkv,
+        )
+        return fn, (x, gamma, wqkv, wout, cs, cs, cache, cache, li, slots,
+                    mask, pos)
+
+    return build
+
+
+def _fused_mlp_case(B):
+    def build():
+        import jax.numpy as jnp
+
+        from neuronx_distributed_inference_tpu.ops import decode_block as db
+
+        m = _1B
+        H, I = m["H"], m["I"]
+        x = _sds((B, 1, H), jnp.bfloat16)
+        gamma = _sds((H,), jnp.bfloat16)
+        wg = _sds((H, I), jnp.bfloat16)
+        wd = _sds((I, H), jnp.bfloat16)
+        fn = functools.partial(_unjit(db.fused_mlp_block), eps=1e-5)
+        return fn, (x, gamma, wg, wg, wd)
+
+    return build
+
+
+def _moe_case(T, k, E):
+    def build():
+        import jax.numpy as jnp
+
+        from neuronx_distributed_inference_tpu.ops import moe_decode as md
+
+        m = _1B
+        H, I = m["H"], m["I"]
+        x = _sds((T, H), jnp.bfloat16)
+        idx = _sds((T, k), jnp.int32)
+        w = _sds((T, k), jnp.float32)
+        wg = _sds((E, H, I), jnp.bfloat16)
+        wd = _sds((E, I, H), jnp.bfloat16)
+        fn = _unjit(md.fused_moe_decode)
+        return fn, (x, idx, w, wg, wg, wd)
+
+    return build
+
+
+# ---------------------------------------------------------------------------
+# the registry
+# ---------------------------------------------------------------------------
+
+_ATTN = "neuronx_distributed_inference_tpu.modules.attention"
+
+REGISTRY: Tuple[KernelSpec, ...] = (
+    KernelSpec(
+        name="flash_attention",
+        site=("flash_attention.py", "flash_attention_bhsd"),
+        entry="flash_attention_bhsd",
+        fallback=f"{_ATTN}:_masked_softmax_attention",
+        parity_test="tests/test_flash_attention.py",
+        tile_params=("bq", "bkv"),
+        sweep=(("bq", (128, 256, 512)), ("bkv", (128, 256, 512))),
+        cases=(
+            KernelCase("plain", "bfloat16", _flash_case(8192, "bfloat16")),
+            KernelCase("plain", "float32", _flash_case(512, "float32")),
+            KernelCase(
+                "masked", "bfloat16", _flash_case(8192, "bfloat16", window=128)
+            ),
+        ),
+    ),
+    KernelSpec(
+        name="flash_attention_packed",
+        site=("flash_attention.py", "_packed_flash_call"),
+        entry="flash_attention_bhsd",
+        fallback=f"{_ATTN}:_masked_softmax_attention",
+        parity_test="tests/test_flash_attention.py",
+        tile_params=("bq", "bkv"),
+        table_kernel="flash_attention",  # shares the unpacked tile rule
+        cases=(
+            KernelCase(
+                "plain", "bfloat16", _flash_case(8192, "bfloat16", packed=True)
+            ),
+        ),
+    ),
+    KernelSpec(
+        name="tkg_decode_attention",
+        site=("decode_attention.py", "_common_call"),
+        entry="tkg_decode_attention",
+        fallback=f"{_ATTN}:attention_decode",
+        parity_test="tests/test_decode_attention.py",
+        tile_params=("bs",),
+        sweep=(("bs", (128, 256, 512, 1024)),),
+        cases=(
+            KernelCase("kv512", "bfloat16", _tkg_case(1, 512, _1B, "bfloat16")),
+            KernelCase("kv512", "int8", _tkg_case(1, 512, _1B, "int8")),
+            KernelCase("kv1024", "bfloat16", _tkg_case(8, 1024, _1B, "bfloat16")),
+            KernelCase(
+                "kv16896", "bfloat16", _tkg_case(1, 16896, _1B, "bfloat16")
+            ),
+            KernelCase("kv512", "int8_8b", _tkg_case(1, 512, _8B, "int8")),
+        ),
+    ),
+    KernelSpec(
+        name="paged_tkg_decode_attention",
+        site=("decode_attention.py", "_common_call"),
+        entry="paged_tkg_decode_attention",
+        fallback=f"{_ATTN}:attention_decode",
+        parity_test="tests/test_decode_attention.py",
+        # no free tile: the kv tile IS the paged-cache block size, a cache-
+        # layout decision owned by the serving config, not the tuning table
+        cases=(
+            KernelCase(
+                "kv1024", "bfloat16", _paged_tkg_case(8, 8, 128, "bfloat16")
+            ),
+            KernelCase("kv1024", "int8", _paged_tkg_case(8, 8, 128, "int8")),
+        ),
+    ),
+    KernelSpec(
+        name="paged_flash_attention",
+        site=("paged_flash_attention.py", "paged_flash_attention"),
+        entry="paged_flash_attention",
+        fallback=f"{_ATTN}:attention_decode",
+        parity_test="tests/test_chunked_prefill.py",
+        tile_params=("tq",),
+        sweep=(("tq", (64, 128, 256, 512)),),
+        cases=(
+            KernelCase(
+                "sq512", "bfloat16", _paged_flash_case(512, 16, 128, "bfloat16")
+            ),
+            KernelCase("sq512", "int8", _paged_flash_case(512, 16, 128, "int8")),
+        ),
+    ),
+    KernelSpec(
+        name="ragged_paged_attention",
+        site=("ragged_paged_attention.py", "ragged_paged_attention"),
+        entry="ragged_paged_attention",
+        fallback=(
+            "neuronx_distributed_inference_tpu.ops.ragged_paged_attention"
+            ":ragged_attention_native"
+        ),
+        parity_test="tests/test_ragged_attention.py",
+        tile_params=("tq",),
+        sweep=(("tq", (8, 16, 32)),),
+        cases=(
+            KernelCase(
+                "mixed", "bfloat16", _ragged_case(512, 8, 16, 128, "bfloat16")
+            ),
+            KernelCase("mixed", "int8", _ragged_case(512, 8, 16, 128, "int8")),
+        ),
+    ),
+    KernelSpec(
+        name="fused_attn_block",
+        site=("decode_block.py", "fused_attn_block"),
+        entry="fused_attn_block",
+        fallback="neuronx_distributed_inference_tpu.models.base:decoder_layer",
+        parity_test="tests/test_decode_block.py",
+        tile_params=("ta_cap", "tc_cap", "bs"),
+        sweep=(
+            ("ta_cap", (128, 256, 512)),
+            ("tc_cap", (256, 512)),
+            ("bs", (512,)),
+        ),
+        cases=(KernelCase("h2048", "bfloat16", _fused_attn_case(4, 512)),),
+    ),
+    KernelSpec(
+        name="fused_mlp_block",
+        site=("decode_block.py", "fused_mlp_block"),
+        entry="fused_mlp_block",
+        fallback="neuronx_distributed_inference_tpu.models.base:_decoder_layer_mlp",
+        parity_test="tests/test_decode_block.py",
+        tile_params=("ti_cap",),
+        sweep=(("ti_cap", (128, 256, 512, 1024)),),
+        cases=(KernelCase("i8192", "bfloat16", _fused_mlp_case(4)),),
+    ),
+    KernelSpec(
+        name="fused_moe_decode",
+        site=("moe_decode.py", "fused_moe_decode"),
+        entry="fused_moe_decode",
+        fallback="neuronx_distributed_inference_tpu.modules.moe:expert_mlps_dense",
+        parity_test="tests/test_moe_dispatch.py",
+        tile_params=("ti_cap",),
+        sweep=(("ti_cap", (128, 256, 512)),),
+        cases=(KernelCase("h2048_i8192", "bfloat16", _moe_case(4, 2, 8)),),
+    ),
+)
+
+
+#: in-code fallback tile constants per (table_kernel, param) — the values
+#: the kernels pass as ``tile_default(..., fallback=...)``. KERN704 pins
+#: hand_picked table entries to these, so the table and the code cannot
+#: silently disagree about today's defaults.
+HAND_PICKED: Dict[str, Dict[str, Dict[str, int]]] = {
+    "flash_attention": {
+        "plain": {"bq": 512, "bkv": 512},
+        "masked": {"bq": 128, "bkv": 128},
+    },
+    "tkg_decode_attention": {"*": {"bs": 512}},
+    "paged_flash_attention": {"*": {"tq": 128}},
+    "ragged_paged_attention": {"*": {"tq": 16}},
+    "fused_attn_block": {"*": {"ta_cap": 256, "tc_cap": 512, "bs": 512}},
+    "fused_mlp_block": {"*": {"ti_cap": 512}},
+    "fused_moe_decode": {"*": {"ti_cap": 512}},
+}
+
+
+def hand_picked_tiles(table_kernel: str, shape_class: str) -> Optional[Dict[str, int]]:
+    per = HAND_PICKED.get(table_kernel)
+    if per is None:
+        return None
+    return per.get(shape_class, per.get("*"))
+
+
+# ---------------------------------------------------------------------------
+# trace-based extraction
+# ---------------------------------------------------------------------------
+
+
+def _sub_jaxprs(eqn):
+    """Every sub-jaxpr an equation carries — including tuple-valued params
+    (``cond``'s ``branches``)."""
+    import jax.core as jcore
+
+    out = []
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (tuple, list)) else (v,)
+        for x in vals:
+            if isinstance(x, jcore.ClosedJaxpr):
+                out.append(x.jaxpr)
+            elif isinstance(x, jcore.Jaxpr):
+                out.append(x)
+    return out
+
+
+def _find_pallas_eqns(jaxpr):
+    hits = []
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "pallas_call":
+            hits.append(eqn)
+        for sub in _sub_jaxprs(eqn):
+            hits.extend(_find_pallas_eqns(sub))
+    return hits
+
+
+def _dot_stats(jaxpr, out):
+    """(flops, contraction_depth, out_lane_width) per dot_general, cond
+    branches included (KERN705 MXU-occupancy input)."""
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "dot_general":
+            (lc, _), _ = eqn.params["dimension_numbers"]
+            lhs = eqn.invars[0].aval
+            k = 1
+            for ax in lc:
+                k *= lhs.shape[ax]
+            oshape = eqn.outvars[0].aval.shape
+            n = 1
+            for d in oshape:
+                n *= d
+            lanes = oshape[-1] if oshape else 1
+            out.append((2 * n * k, k, lanes))
+        for sub in _sub_jaxprs(eqn):
+            _dot_stats(sub, out)
+    return out
+
+
+def instantiate(
+    spec: KernelSpec, case: KernelCase, tiles: Optional[Dict[str, int]] = None
+) -> KernelInstance:
+    """Trace one committed case (optionally under tile overrides) and read
+    the kernel's launch truth off the traced ``pallas_call`` equation."""
+    import jax
+    import numpy as np
+
+    from neuronx_distributed_inference_tpu.analysis.cost_audit import jaxpr_flops
+    from neuronx_distributed_inference_tpu.ops.tile_defaults import (
+        table_entry,
+        tile_overrides,
+    )
+
+    fn, args = case.build()
+    if tiles:
+        ctx = tile_overrides(spec.table_key, tiles)
+    else:
+        import contextlib
+
+        ctx = contextlib.nullcontext()
+    with ctx:
+        jaxpr = jax.make_jaxpr(lambda *a: fn(*a))(*args)
+    eqns = _find_pallas_eqns(jaxpr.jaxpr)
+    if not eqns:
+        raise RuntimeError(f"{spec.name}/{case.shape_class}: no pallas_call traced")
+    eqn = eqns[0]
+    gm = eqn.params["grid_mapping"]
+    blocks: List[BlockInfo] = []
+    for i, bm in enumerate(gm.block_mappings):
+        sd = bm.array_shape_dtype
+        blocks.append(
+            BlockInfo(
+                role="in" if i < gm.num_inputs else "out",
+                block_shape=tuple(int(d) for d in bm.block_shape),
+                array_shape=tuple(int(d) for d in sd.shape),
+                dtype=str(sd.dtype),
+                itemsize=int(np.dtype(sd.dtype).itemsize),
+            )
+        )
+    kj = eqn.params["jaxpr"]
+    scratch = []
+    if gm.num_scratch_operands:
+        for v in kj.invars[-gm.num_scratch_operands:]:
+            shape = tuple(int(d) for d in v.aval.shape)
+            n = 1
+            for d in shape:
+                n *= d
+            scratch.append(
+                (shape, str(v.aval.dtype), n * int(np.dtype(v.aval.dtype).itemsize))
+            )
+    resolved: Dict[str, int] = {}
+    if tiles:
+        resolved = dict(tiles)
+    elif spec.tile_params:
+        entry = table_entry(spec.table_key, case.shape_class, case.dtype) or {}
+        hand = hand_picked_tiles(spec.table_key, case.shape_class) or {}
+        for p in spec.tile_params:
+            v = (entry.get("tiles") or {}).get(p, hand.get(p))
+            if v is not None:
+                resolved[p] = int(v)
+    return KernelInstance(
+        kernel=spec.name,
+        shape_class=case.shape_class,
+        dtype=case.dtype,
+        tiles=resolved,
+        grid=tuple(int(g) for g in gm.grid),
+        blocks=blocks,
+        scratch=scratch,
+        flops_per_step=int(jaxpr_flops(kj)),
+        dot_stats=_dot_stats(kj, []),
+    )
+
+
+@functools.lru_cache(maxsize=1)
+def collect_instances() -> Tuple[KernelInstance, ...]:
+    """Every registered kernel traced at its committed cases with the
+    (table-routed) default tiles. Memoized: the suite, ``legal_tiles`` and
+    the tests all share one trace pass."""
+    out = []
+    for spec in REGISTRY:
+        for case in spec.cases:
+            out.append(instantiate(spec, case))
+    return tuple(out)
+
+
+def reset_cache() -> None:
+    collect_instances.cache_clear()
+
+
+# ---------------------------------------------------------------------------
+# AST census of pallas_call sites (KERN703's "no unregistered kernel")
+# ---------------------------------------------------------------------------
+
+
+def pallas_sites() -> List[Tuple[str, str, int]]:
+    """Every ``pl.pallas_call`` call expression under ``ops/`` as
+    (file, enclosing function, line)."""
+    sites = []
+    for path in sorted(OPS_DIR.glob("*.py")):
+        tree = ast.parse(path.read_text())
+
+        def walk(node, fn_name):
+            for child in ast.iter_child_nodes(node):
+                name = fn_name
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    name = child.name
+                if isinstance(child, ast.Call):
+                    f = child.func
+                    callee = f.attr if isinstance(f, ast.Attribute) else getattr(f, "id", "")
+                    if callee == "pallas_call":
+                        sites.append((path.name, fn_name or "<module>", child.lineno))
+                walk(child, name)
+
+        walk(tree, None)
+    return sites

@@ -47,6 +47,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from neuronx_distributed_inference_tpu.ops.tile_defaults import tile_default
+
 from neuronx_distributed_inference_tpu.modules.kvcache import (
     QuantizedKV,
     layer_dequant_factors,
@@ -231,7 +233,7 @@ def tkg_decode_attention(
     *,
     scale: float,
     n_kv: int,
-    bs: int = 512,
+    bs: int = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Decode attention straight off the stacked contiguous cache (batch row b
@@ -240,6 +242,11 @@ def tkg_decode_attention(
     dequantize in-register (see module docstring). Returns (B, K, Hq, D)."""
     B, K, Hq, D = q.shape
     S_kv = mask.shape[-1]
+    if bs is None:
+        # default kv tile through the tuning table (KERN704): keyed by the
+        # kv bucket and the CACHE dtype (a quantized cache DMAs int8 tiles)
+        cache_dt = k_cache.data.dtype if isinstance(k_cache, QuantizedKV) else k_cache.dtype
+        bs = tile_default("tkg_decode_attention", f"kv{S_kv}", cache_dt, "bs", 512)
     bs = min(bs, S_kv)
     nkv = S_kv // bs
     n_rep = Hq // n_kv

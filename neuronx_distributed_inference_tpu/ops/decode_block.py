@@ -47,6 +47,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from neuronx_distributed_inference_tpu.ops.decode_attention import _mask_tiles
+from neuronx_distributed_inference_tpu.ops.tile_defaults import tile_default
 
 try:  # pallas TPU backend
     from jax.experimental.pallas import tpu as pltpu
@@ -295,7 +296,7 @@ def fused_attn_block(
     scale: float,
     eps: float,
     n_kv: int,
-    bs: int = 512,
+    bs: int = None,
     interpret: bool = False,
 ):
     """Fused decode attention block. Returns (hidden (B,K,H) with residual
@@ -308,17 +309,21 @@ def fused_attn_block(
     Hq = N3 // D - 2 * Hkv
     HqD = Hq * D
     S_kv = mask.shape[-1]
+    if bs is None:
+        bs = tile_default("fused_attn_block", f"h{H}", x.dtype, "bs", 512)
     bs = min(bs, S_kv)
     nkv = S_kv // bs
 
     # tile widths trade per-step pipeline overhead against the ~16M
     # scoped-VMEM budget (TA=TC=512 at 1B shapes measured 16.27M — over);
-    # TA=256/TC=512 keeps the big operand windows at 1M/2M double-buffered
-    TA = min(256, N3)
+    # TA=256/TC=512 keeps the big operand windows at 1M/2M double-buffered.
+    # Caps read through the tuning table (KERN704); the while-loops stay as
+    # the divisibility guard whatever the table says.
+    TA = min(tile_default("fused_attn_block", f"h{H}", x.dtype, "ta_cap", 256), N3)
     while N3 % TA:
         TA //= 2
     nA = N3 // TA
-    TC = min(512, H)
+    TC = min(tile_default("fused_attn_block", f"h{H}", x.dtype, "tc_cap", 512), H)
     while H % TC:
         TC //= 2
     nC = H // TC
@@ -488,8 +493,10 @@ def fused_mlp_block(
     I = w_gate.shape[1]
     # the MLP kernel is its own pallas_call with its own VMEM budget: three
     # (·, TI) streams at TI=512 double-buffer to ~12M and halve the step
-    # count (per-step pipeline overhead is the cost driver at K=1)
-    TI = min(512, I)
+    # count (per-step pipeline overhead is the cost driver at K=1); the cap
+    # reads through the tuning table (KERN704), the while-loop guards
+    # divisibility
+    TI = min(tile_default("fused_mlp_block", f"i{I}", x.dtype, "ti_cap", 512), I)
     while I % TI:
         TI //= 2
     nI = I // TI

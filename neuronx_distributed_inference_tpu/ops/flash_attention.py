@@ -51,6 +51,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from neuronx_distributed_inference_tpu.ops.kernel_mode import kernel_interpret
+from neuronx_distributed_inference_tpu.ops.tile_defaults import tile_default
 
 try:  # pallas TPU backend
     from jax.experimental.pallas import tpu as pltpu
@@ -425,10 +426,19 @@ def flash_attention_bhsd(
     config decision explicitly instead."""
     B, H, S, D = q.shape
     masked = window is not None or chunk is not None
+    # defaults read through the committed tuning table (KERN704); the
+    # literals passed as fallbacks are the historical hand-picked rule and
+    # the audit pins table == fallback until a hardware sweep promotes the
+    # entry to provenance "measured"
+    shape_class = "masked" if masked else "plain"
     if bq is None:
-        bq = 128 if masked else 512
+        bq = tile_default(
+            "flash_attention", shape_class, q.dtype, "bq", 128 if masked else 512
+        )
     if bkv is None:
-        bkv = 128 if masked else 512
+        bkv = tile_default(
+            "flash_attention", shape_class, q.dtype, "bkv", 128 if masked else 512
+        )
     bq = min(bq, S)
     bkv = min(bkv, S)
     if packed:

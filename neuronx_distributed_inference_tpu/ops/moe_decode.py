@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from neuronx_distributed_inference_tpu.ops.tile_defaults import tile_default
+
 try:  # pallas TPU backend
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -150,9 +152,12 @@ def fused_moe_decode(
     T, H = x.shape
     k = topk_idx.shape[1]
     E, _, I = w_gate.shape
-    # three double-buffered weight windows must fit the ~16M scoped VMEM
+    # three double-buffered weight windows must fit the ~16M scoped VMEM;
+    # the starting cap reads through the tuning table (KERN704) and the
+    # while-loop remains the VMEM-fit + divisibility guard regardless of
+    # what the table says
     itemsize = jnp.dtype(w_gate.dtype).itemsize
-    TI = 512
+    TI = tile_default("fused_moe_decode", f"h{H}_i{I}", w_gate.dtype, "ti_cap", 512)
     while TI > 16 and (H * TI * itemsize * 2 * 3 > 11 << 20 or I % TI):
         TI //= 2
     if I % TI:

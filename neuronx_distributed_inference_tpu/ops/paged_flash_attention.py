@@ -28,6 +28,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from neuronx_distributed_inference_tpu.ops.tile_defaults import tile_default
+
 try:  # pallas TPU backend
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -136,7 +138,7 @@ def paged_flash_attention(
     *,
     scale: float,
     n_rep: int,
-    tq: int = 128,
+    tq: int = None,
     k_scale: jax.Array = None,  # (Hkv,) per-head dequant factor (scale/qmax)
     v_scale: jax.Array = None,  # for int8/fp8 caches; None = plain cache
     interpret: bool = False,
@@ -157,6 +159,12 @@ def paged_flash_attention(
     B, Sq, Hq, D = q.shape
     _, Hkv, bs, _ = k_cache.shape
     MB = block_table.shape[1]
+    if tq is None:
+        # q-tile default through the tuning table (KERN704), keyed by the
+        # prefill chunk length and the cache dtype (int8 codes DMA narrower)
+        tq = tile_default(
+            "paged_flash_attention", f"sq{Sq}", k_cache.dtype, "tq", 128
+        )
     tq = min(tq, Sq)
     nq = pl.cdiv(Sq, tq)
 

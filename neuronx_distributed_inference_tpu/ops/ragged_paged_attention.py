@@ -51,6 +51,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from neuronx_distributed_inference_tpu.ops.tile_defaults import tile_default
+
 try:  # pallas TPU backend
     from jax.experimental.pallas import tpu as pltpu
 except ImportError:  # pragma: no cover
@@ -181,7 +183,7 @@ def ragged_paged_attention(
     *,
     scale: float,
     n_rep: int,
-    tq: int = RAGGED_Q_TILE,
+    tq: int = None,
     k_scale: jax.Array = None,  # (Hkv,) per-head dequant factor (scale/qmax)
     v_scale: jax.Array = None,  # for int8/fp8 caches; None = plain cache
     interpret: bool = False,
@@ -197,6 +199,14 @@ def ragged_paged_attention(
     T, Hq, D = q.shape
     _, Hkv, bs, _ = k_cache.shape
     R, MB = block_table.shape
+    if tq is None:
+        # default through the tuning table (KERN704). The packing contract
+        # pins tq to a divisor of RAGGED_Q_TILE (row starts are
+        # RAGGED_Q_TILE-aligned, so any divisor tile never spans rows);
+        # KERN702 checks the committed entry against that arithmetic.
+        tq = tile_default(
+            "ragged_paged_attention", "mixed", k_cache.dtype, "tq", RAGGED_Q_TILE
+        )
     if T % tq:
         raise ValueError(f"packed q length {T} not a multiple of tq={tq}")
     NT = T // tq

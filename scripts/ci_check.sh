@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # The pre-PR check: the FULL static-analysis gate (tpulint + flag audit +
 # graph/shard/memory audits + the roofline cost audit COST501-504 + the
-# concurrency audit CONC601-604) plus the static_analysis pytest subset, as
-# one command with a nonzero exit on ANY finding or test failure.
+# concurrency audit CONC601-604 + the kernel-contract audit KERN701-705)
+# plus the static_analysis pytest subset, as one command with a nonzero
+# exit on ANY finding or test failure.
 #
 #   bash scripts/ci_check.sh            # text reports
 #   bash scripts/ci_check.sh --json     # gate report as JSON
@@ -24,7 +25,7 @@ esac
 
 rc=0
 
-echo "== static-analysis gate (lint, flags, graph, shard, memory, cost, conc) =="
+echo "== static-analysis gate (lint, flags, graph, shard, memory, cost, conc, kernel) =="
 python scripts/run_static_analysis.py "$@" || rc=$?
 
 echo
@@ -42,6 +43,10 @@ python -m pytest tests/test_router.py tests/test_router_threaded.py tests/test_d
 echo
 echo "== workload (open-loop traffic + SLO goodput) pytest subset =="
 python -m pytest tests/test_workload.py -q -m 'not slow' -p no:cacheprovider || rc=$?
+
+echo
+echo "== kernel-contract (KERN701-705 detectors + tuning-table pins) pytest subset =="
+python -m pytest tests/test_kernel_audit.py -q -m 'not slow' -p no:cacheprovider || rc=$?
 
 if [ "$rc" -ne 0 ]; then
   echo "ci_check: FAILED (rc=$rc)" >&2

@@ -52,6 +52,49 @@ def measure_bs(app, bs, hf, prompt_len=128, gen=256):
     return out.num_generated * bs / dt
 
 
+def sweep_tkg_tiles(bucket=512, dtype="bfloat16", B=1, n=20):
+    """Standalone TKG-decode kernel timing across the LEGAL kv-tile sizes
+    (``bs``) at the 1B decode shape. Candidates come from the kernel
+    audit's ``legal_tiles`` — the same KERN701/702 arithmetic the gate
+    runs — so this sweep can only ever measure tilings the gate would
+    accept, and its winner is what a hardware session promotes into
+    ``analysis/tuning_table.json`` (provenance ``measured``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from neuronx_distributed_inference_tpu.analysis.kernel_audit import legal_tiles
+    from neuronx_distributed_inference_tpu.ops.decode_attention import (
+        tkg_decode_attention,
+    )
+
+    L, Hq, Hkv, D = 16, 32, 8, 64
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(B, 1, Hq, D), jnp.bfloat16)
+    cache = jnp.asarray(
+        rng.randn(L, B, bucket, Hkv, D), jnp.dtype(dtype)
+    )
+    li = jnp.int32(0)
+    mask = jnp.ones((B, 1, 1, bucket), bool)
+    rows = {}
+    for tiles in legal_tiles("tkg_decode_attention", f"kv{bucket}", dtype):
+        bs = tiles["bs"]
+        try:
+            out = tkg_decode_attention(
+                q, cache, cache, li, mask, scale=D**-0.5, n_kv=Hkv, bs=bs
+            )
+            jax.device_get(out[0, 0, 0, 0])
+            t0 = time.time()
+            for _ in range(n):
+                out = tkg_decode_attention(
+                    q, cache, cache, li, mask, scale=D**-0.5, n_kv=Hkv, bs=bs
+                )
+            jax.device_get(out[0, 0, 0, 0])
+            rows[f"bs{bs}"] = {"us": round((time.time() - t0) / n * 1e6, 1)}
+        except Exception as e:  # a tiling the backend rejects
+            rows[f"bs{bs}"] = {"error": str(e)[:80]}
+    return rows
+
+
 def run(tiny=False):
     import bench
 
@@ -90,6 +133,9 @@ def run(tiny=False):
             100 * row["xla_tok_s"] / row["roofline_tok_s"], 1
         )
         out["rows"].append(row)
+    if not tiny:
+        # kernel-level kv-tile sweep over the gate-legal candidates only
+        out["tkg_tile_sweep_kv512"] = sweep_tkg_tiles(bucket=512)
     return out
 
 

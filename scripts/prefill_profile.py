@@ -114,16 +114,27 @@ def measure_cte(app, S, hf, n=5, profile_dir=None):
     return res
 
 
+def flash_tile_candidates(shape_class="plain", dtype="bfloat16"):
+    """The sweepable (bq, bkv) candidates, from the kernel audit's
+    :func:`legal_tiles` — the SAME KERN701/702 arithmetic the gate runs, so
+    the sweep and the gate can never disagree about what is sweepable."""
+    from neuronx_distributed_inference_tpu.analysis.kernel_audit import legal_tiles
+
+    return [(t["bq"], t["bkv"]) for t in
+            legal_tiles("flash_attention", shape_class, dtype)]
+
+
 def sweep_flash_blocks(S, D=64, H=32, dtype="bfloat16", n=10, packed=False,
                        softmax_bf16=None):
-    """Standalone flash-kernel timing across tile sizes at the 1B attention
-    shape — the actual tuning surface. ``packed`` sweeps the head-pair
-    packed kernel (round 6): the same (bq, bkv) grid at the new arithmetic
-    intensity — packing halves head-grid steps and doubles per-tile lanes,
-    so the winning tile must be re-measured, not assumed. ``softmax_bf16``
-    pins the packed softmax mode: sweep BOTH, because the shipping default
-    (attention_softmax_fp32=True) runs fp32 exp/PV and its winning tile can
-    differ from the bf16 mix."""
+    """Standalone flash-kernel timing across the LEGAL tile sizes at the 1B
+    attention shape — the actual tuning surface (candidates come from
+    ``legal_tiles``; anything VMEM-over-budget or Mosaic-illegal is never
+    timed). ``packed`` sweeps the head-pair packed kernel (round 6): the
+    same (bq, bkv) grid at the new arithmetic intensity — packing halves
+    head-grid steps and doubles per-tile lanes, so the winning tile must be
+    re-measured, not assumed. ``softmax_bf16`` pins the packed softmax mode:
+    sweep BOTH, because the shipping default (attention_softmax_fp32=True)
+    runs fp32 exp/PV and its winning tile can differ from the bf16 mix."""
     import jax
     import jax.numpy as jnp
 
@@ -136,32 +147,31 @@ def sweep_flash_blocks(S, D=64, H=32, dtype="bfloat16", n=10, packed=False,
     kv_valid = jnp.ones((1, S), jnp.int32)
     rows = {}
     flops = 4 * S * S * H * D * 0.5
-    for bq in (128, 256, 512):
-        for bkv in (128, 256, 512):
-            if bq > S or bkv > S:
-                continue
-            try:
+    for bq, bkv in flash_tile_candidates("plain", dtype):
+        if bq > S or bkv > S:
+            continue
+        try:
+            out, _, _ = flash_attention_bhsd(
+                q, q, q, kv_valid, scale=D**-0.5, causal=True,
+                bq=bq, bkv=bkv, packed=packed, softmax_bf16=softmax_bf16,
+            )
+            jax.device_get(out[0, 0, 0])
+            # burst: dispatch n, fetch once — a per-iteration fetch pays
+            # one relay RTT per call and swamps the kernel time
+            t0 = time.time()
+            for _ in range(n):
                 out, _, _ = flash_attention_bhsd(
-                    q, q, q, kv_valid, scale=D**-0.5, causal=True,
+                    out, q, q, kv_valid, scale=D**-0.5, causal=True,
                     bq=bq, bkv=bkv, packed=packed, softmax_bf16=softmax_bf16,
                 )
-                jax.device_get(out[0, 0, 0])
-                # burst: dispatch n, fetch once — a per-iteration fetch pays
-                # one relay RTT per call and swamps the kernel time
-                t0 = time.time()
-                for _ in range(n):
-                    out, _, _ = flash_attention_bhsd(
-                        out, q, q, kv_valid, scale=D**-0.5, causal=True,
-                        bq=bq, bkv=bkv, packed=packed, softmax_bf16=softmax_bf16,
-                    )
-                jax.device_get(out[0, 0, 0])
-                dt = (time.time() - t0) / n
-                rows[f"bq{bq}_bkv{bkv}"] = {
-                    "ms": round(dt * 1e3, 2),
-                    "mfu": round(flops / dt / V5E_BF16_PEAK, 4),
-                }
-            except Exception as e:  # a tiling the backend rejects
-                rows[f"bq{bq}_bkv{bkv}"] = {"error": str(e)[:80]}
+            jax.device_get(out[0, 0, 0])
+            dt = (time.time() - t0) / n
+            rows[f"bq{bq}_bkv{bkv}"] = {
+                "ms": round(dt * 1e3, 2),
+                "mfu": round(flops / dt / V5E_BF16_PEAK, 4),
+            }
+        except Exception as e:  # a tiling the backend rejects
+            rows[f"bq{bq}_bkv{bkv}"] = {"error": str(e)[:80]}
     return rows
 
 

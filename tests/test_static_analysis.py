@@ -609,14 +609,15 @@ def test_cli_full_json_schema(capsys):
 
     report = json.loads(out)
     assert report["suites"] == [
-        "lint", "flags", "graph", "shard", "memory", "cost", "conc"
+        "lint", "flags", "graph", "shard", "memory", "cost", "conc", "kernel"
     ]
     assert report["new"] == 0
     assert {"total", "findings", "new_findings", "memory", "cost",
-            "concurrency"} <= set(report)
+            "concurrency", "kernel"} <= set(report)
     for f in report["findings"]:
         assert {"rule", "severity", "location", "message", "key"} <= set(f)
-        assert f["rule"][:3] in ("TPU", "GRA", "MEM", "FLA", "COS", "CON")
+        assert f["rule"][:3] in ("TPU", "GRA", "MEM", "FLA", "COS", "CON",
+                                 "KER")
         # file:line for source rules, tag/bucket for graph rules
         assert (":" in f["location"]) or ("/" in f["location"])
     mem = report["memory"]
@@ -660,6 +661,17 @@ def test_cli_full_json_schema(capsys):
     }
     assert conc["errors"] == 0
     assert "ReplicaHandle.step" in conc["worker_entries"]
+    # the kernel section (ISSUE 16): per-instance census over every
+    # registered pallas_call instantiation
+    kern = report["kernel"]
+    assert {"device", "vmem_budget", "instances", "n_sites",
+            "n_registered"} <= set(kern)
+    assert kern["n_sites"] > 0 and kern["n_registered"] >= kern["n_sites"]
+    for key, row in kern["instances"].items():
+        assert key.count("/") == 2, key  # kernel/shape_class/dtype
+        assert 0 < row["vmem_bytes"] <= kern["vmem_budget"]
+        assert row["flops_per_step"] > 0
+        assert row["bound"] in ("compute", "memory")
 
 
 # ---------------------------------------------------------------------------

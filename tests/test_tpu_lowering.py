@@ -417,3 +417,87 @@ def test_lower_fused_moe_decode(T, k, H, I, E):
     wd = sds((E, I, H), jnp.bfloat16)
     fn = functools.partial(fused_moe_decode, act="silu", interpret=False)
     lower_tpu(lambda *a: fn(*a), x, idx, w, wg, wg, wd)
+
+
+def test_lower_fused_moe_decode_gelu_clamped():
+    # the GPT-OSS activation flavor takes a different in-kernel branch
+    # (clamped swiglu with bias) — it must lower too, not just silu
+    from neuronx_distributed_inference_tpu.ops.moe_decode import fused_moe_decode
+
+    T, k, H, I, E = 2, 4, 2048, 8192, 8
+    x = sds((T, H), jnp.bfloat16)
+    idx = sds((T, k), jnp.int32)
+    w = sds((T, k), jnp.float32)
+    wg = sds((E, H, I), jnp.bfloat16)
+    wd = sds((E, I, H), jnp.bfloat16)
+    fn = functools.partial(
+        fused_moe_decode, act="gelu", act_scale=1.702, act_bias=1.0,
+        swiglu_limit=7.0, interpret=False,
+    )
+    lower_tpu(lambda *a: fn(*a), x, idx, w, wg, wg, wd)
+
+
+# ---------------------------------------------------------------------------
+# ragged paged attention (mixed prefill-chunk + decode, ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("T,R", [(128, 2), (512, 8)])
+def test_lower_ragged_paged_attention(T, R):
+    from neuronx_distributed_inference_tpu.ops.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    Hq, Hkv, D, MB, bs = 32, 8, 64, 16, 128
+    q = sds((T, Hq, D), jnp.bfloat16)
+    cache = sds((65, Hkv, bs, D), jnp.bfloat16)
+    bt = sds((R, MB), jnp.int32)
+    row = sds((R,), jnp.int32)
+    fn = functools.partial(
+        ragged_paged_attention, scale=D**-0.5, n_rep=Hq // Hkv, interpret=False
+    )
+    lower_tpu(lambda *a: fn(*a), q, cache, cache, bt, row, row, row)
+
+
+def test_lower_ragged_paged_attention_quantized():
+    from neuronx_distributed_inference_tpu.ops.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    T, R, Hq, Hkv, D, MB, bs = 512, 8, 32, 8, 64, 16, 128
+    q = sds((T, Hq, D), jnp.bfloat16)
+    cache = sds((65, Hkv, bs, D), jnp.int8)
+    bt = sds((R, MB), jnp.int32)
+    row = sds((R,), jnp.int32)
+    scale = sds((Hkv,), jnp.float32)
+    fn = functools.partial(
+        ragged_paged_attention, scale=D**-0.5, n_rep=Hq // Hkv, interpret=False
+    )
+    lower_tpu(
+        lambda q, k, v, bt, rs, rl, cl, ks, vs: fn(
+            q, k, v, bt, rs, rl, cl, k_scale=ks, v_scale=vs
+        ),
+        q, cache, cache, bt, row, row, row, scale, scale,
+    )
+
+
+def test_lower_paged_flash_quantized():
+    # int8 paged cache through the chunked-prefill kernel (the dequant
+    # scaling folds into q and the epilogue — must not break lowering)
+    B, Hkv, NB, bs, MB, Sq, D = 1, 8, 64, 128, 16, 512, 64
+    Hq = Hkv * 4
+    q = sds((B, Sq, Hq, D), jnp.bfloat16)
+    cache = sds((NB + 1, Hkv, bs, D), jnp.int8)
+    bt = sds((B, MB), jnp.int32)
+    pos = sds((B, Sq), jnp.int32)
+    lim = sds((B,), jnp.int32)
+    scale = sds((Hkv,), jnp.float32)
+    fn = functools.partial(
+        paged_flash_attention, scale=D**-0.5, n_rep=4, interpret=False
+    )
+    lower_tpu(
+        lambda q, k, v, b, p, l, ks, vs: fn(
+            q, k, v, b, p, l, k_scale=ks, v_scale=vs
+        ),
+        q, cache, cache, bt, pos, lim, scale, scale,
+    )
