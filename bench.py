@@ -976,6 +976,18 @@ def _suite_params(tiny):
             extra_tpu=dict(serving_ragged=True, serving_ragged_async=False),
             cache_key="int8_1b_ragged" if not tiny else None,
         ),
+        # SAME ragged mix with grouped-int4 weights (ISSUE 17): the serving
+        # side of the weight-streaming pair — decode slots stream packed
+        # int4 projections while prefill rides the same ragged dispatch.
+        # Beside serving_1b_int8_ragged this isolates the weight-bandwidth
+        # term under a mixed CE+TKG serving load. Own artifact key:
+        # weight_dtype is part of the config fingerprint.
+        "serving_1b_int4_ragged": dict(
+            attrs=attrs_1b, quantized=False, serving=serving,
+            extra_tpu=dict(weight_dtype="int4", serving_ragged=True,
+                           serving_ragged_async=False),
+            cache_key="int4_1b_ragged" if not tiny else None,
+        ),
         # SAME mix again with async 1-ahead pipelining on the ragged path
         # (ISSUE 8): step k+1 chains on step k's on-device tokens, the fetch
         # is non-blocking, host bookkeeping overlaps the device — the
@@ -1101,6 +1113,19 @@ def _suite_params(tiny):
             prompt=prompt, gen=gen, long_prompt=None, quantized=True,
             cache_key="int8_8b" if not tiny else None,
         ),
+        # int4 weight-streaming flagship (ISSUE 17): the SAME 8B shape with
+        # grouped-int4 packed weights (weight_dtype="int4") — decode streams
+        # ~0.53 byte/param (codes + group scales) through the fused-dequant
+        # quant_matmul kernel, vs int8's 1 byte. Beside int8_8b_bs1 this
+        # pair is the weight-bandwidth halving measured where decode is
+        # weight-bound. Own artifact key: weight_dtype joins the config
+        # fingerprint, so sharing int8_8b's would thrash it.
+        "bf16_8b_int4": dict(
+            attrs=attrs_8b, batch=1, seq=seq, ce=ce[:1], tkg=tkg[:1],
+            prompt=prompt, gen=gen, long_prompt=None, quantized=False,
+            extra_tpu=dict(weight_dtype="int4"),
+            cache_key="bf16_8b_int4" if not tiny else None,
+        ),
         # LAST in budget priority: the expensive long-context points are the
         # first casualties of a tight BENCH_BUDGET_S (skippable by design).
         # The 8k/16k bf16 vs *_kvq8 pairs report kv_bytes + decode tok/s so
@@ -1160,7 +1185,10 @@ def _attach_projection(res, attrs, *, batch, kv_width, quantized, extra_tpu,
         attrs,
         batch=batch,
         kv_width=kv_width,
-        weight_dtype="int8" if quantized else "bfloat16",
+        # explicit weight_dtype (the int4 rows) wins over the quantized flag
+        weight_dtype=(extra_tpu or {}).get(
+            "weight_dtype", "int8" if quantized else "bfloat16"
+        ),
         kv_dtype=(extra_tpu or {}).get("kv_cache_dtype", "bfloat16"),
         device=spec,  # None -> DEFAULT_DEVICE inside
     )
@@ -1528,6 +1556,18 @@ def summary_line(points):
                                     "goodput_recovery_steps"),
         "int8_8b_tok_s": g("int8_8b_bs1", "decode_tok_s"),
         "int8_8b_ttft_ms": g("int8_8b_bs1", "ttft_ms"),
+        # grouped-int4 weight-streaming rows (ISSUE 17): the 8B decode pair
+        # against int8_8b_tok_s quantifies the weight-bandwidth halving
+        # (~0.53 vs 1 byte/param), and the int4 ragged serving row sits
+        # beside ragged_tok_s for the mixed-load version. Projections ride
+        # the device model's int4 itemsize (codes + group scales).
+        "w4_tok_s": g("bf16_8b_int4", "decode_tok_s"),
+        "w4_projected_tok_s": g("bf16_8b_int4", "projected_tok_s"),
+        "w4_ttft_ms": g("bf16_8b_int4", "ttft_ms"),
+        "w4_serving_tok_s": g("serving_1b_int4_ragged", "decode_tok_s"),
+        "w4_serving_projected_tok_s": g("serving_1b_int4_ragged",
+                                        "projected_tok_s"),
+        "w4_serving_itl_p50_ms": g("serving_1b_int4_ragged", "itl_ms"),
         # 16k long-context row: TTFT ~= the 16k prefill wall time
         "long_ctx_ttft_ms": g("bf16_1b_16k", "ttft_ms"),
         "long_ctx_tok_s": g("bf16_1b_16k", "decode_tok_s"),

@@ -186,7 +186,12 @@ LLAMA_8B = dict(
 
 
 def _itemsize(dtype: str) -> float:
-    return {"bfloat16": 2, "int8": 1, "float32": 4}[_canon_dtype(dtype)]
+    # int4: packed grouped codes (ops/quant_matmul) — 0.5 byte/param plus
+    # one f32 scale per 128-group per out channel (4/128 byte/param), folded
+    # in so the projection charges what the decode stream actually reads
+    return {"bfloat16": 2, "int8": 1, "float32": 4, "int4": 0.5 + 4 / 128}[
+        _canon_dtype(dtype)
+    ]
 
 
 def matmul_params(attrs: dict) -> Dict[str, int]:
@@ -465,6 +470,14 @@ BENCH_ROW_MODELS: Dict[str, dict] = {
                                          kv_dtype="bfloat16"),
     "int8_8b_bs1": dict(model=LLAMA_8B, kind="decode", batch=1, kv_width=512,
                         weight_dtype="int8", kv_dtype="bfloat16"),
+    # w4 rows (ISSUE 17): grouped-int4 packed weights (ops/quant_matmul).
+    # The 8B decode row is the flagship — weight-read bytes drop ~2x vs the
+    # int8 row above, and the projection's ceiling moves with them.
+    "bf16_8b_int4": dict(model=LLAMA_8B, kind="decode", batch=1, kv_width=512,
+                         weight_dtype="int4", kv_dtype="bfloat16"),
+    "serving_1b_int4_ragged": dict(model=LLAMA_1B, kind="serving", batch=8,
+                                   kv_width=1024, weight_dtype="int4",
+                                   kv_dtype="bfloat16"),
     "bf16_1b_8k": dict(model=LLAMA_1B, kind="decode", batch=1, kv_width=8704,
                        weight_dtype="bfloat16", kv_dtype="bfloat16"),
     "bf16_1b_8k_kvq8": dict(model=LLAMA_1B, kind="decode", batch=1,
@@ -525,6 +538,10 @@ COMPARE_KEYS = (
     # the report line makes an SLO-driven collapse visible offline
     ("goodput_tok_s", "serving_1b_int8_goodput", None),
     ("int8_8b_tok_s", "int8_8b_bs1", None),
+    # w4 rows record their own projections (the run re-derives them at the
+    # measured shape), so the static table is the fallback comparator
+    ("w4_tok_s", "bf16_8b_int4", "w4_projected_tok_s"),
+    ("w4_serving_tok_s", "serving_1b_int4_ragged", "w4_serving_projected_tok_s"),
     ("ctx8k_tok_s", "bf16_1b_8k", None),
     ("kvq8_8k_tok_s", "bf16_1b_8k_kvq8", None),
     ("long_ctx_tok_s", "bf16_1b_16k", None),

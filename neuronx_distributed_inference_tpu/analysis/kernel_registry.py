@@ -293,6 +293,27 @@ def _fused_mlp_case(B):
     return build
 
 
+def _qmm_case(B, model):
+    """Decode-shaped int4 fused-dequant matmul at the model's widest linear
+    (the H -> I up/gate projection — the weight-read roofline term)."""
+
+    def build():
+        import jax.numpy as jnp
+
+        from neuronx_distributed_inference_tpu.ops import quant_matmul as qm
+
+        m = model
+        K, N = m["H"], m["I"]
+        span = 2 * qm.INT4_GROUP
+        Kp = -(-K // span) * span
+        x = _sds((B, K), jnp.bfloat16)
+        w = _sds((Kp // 2, N), jnp.uint8)
+        s = _sds((Kp // qm.INT4_GROUP, N), jnp.float32)
+        return _unjit(qm.quant_matmul), (x, w, s)
+
+    return build
+
+
 def _moe_case(T, k, E):
     def build():
         import jax.numpy as jnp
@@ -449,6 +470,22 @@ REGISTRY: Tuple[KernelSpec, ...] = (
         sweep=(("ti_cap", (128, 256, 512)),),
         cases=(KernelCase("h2048_i8192", "bfloat16", _moe_case(4, 2, 8)),),
     ),
+    KernelSpec(
+        name="quant_matmul",
+        site=("quant_matmul.py", "quant_matmul"),
+        entry="quant_matmul",
+        fallback=(
+            "neuronx_distributed_inference_tpu.ops.quant_matmul"
+            ":int4_matmul_native"
+        ),
+        parity_test="tests/test_quant_matmul.py",
+        tile_params=("bn",),
+        sweep=(("bn", (128, 256, 512)),),
+        cases=(
+            KernelCase("k2048_n8192", "bfloat16", _qmm_case(8, _1B)),
+            KernelCase("k4096_n14336", "bfloat16", _qmm_case(8, _8B)),
+        ),
+    ),
 )
 
 
@@ -467,6 +504,7 @@ HAND_PICKED: Dict[str, Dict[str, Dict[str, int]]] = {
     "fused_attn_block": {"*": {"ta_cap": 256, "tc_cap": 512, "bs": 512}},
     "fused_mlp_block": {"*": {"ti_cap": 512}},
     "fused_moe_decode": {"*": {"ti_cap": 512}},
+    "quant_matmul": {"*": {"bn": 256}},
 }
 
 

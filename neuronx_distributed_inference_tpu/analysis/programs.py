@@ -59,6 +59,13 @@ TAG_MIXED_STEP = "mixed_step"
 # the greedy acceptance count on device — committed so the GRAPH/SHARD/MEM/
 # COST audits see the speculative serving program the same day it ships
 TAG_MIXED_STEP_SPEC = "mixed_step_spec"
+# the w4 family (weight_dtype="int4", ISSUE 17): decode programs whose
+# weights are packed grouped-int4 (uint8 codes + f32 group scales,
+# ops/quant_matmul) — committed so the graph/shard/memory audits cover the
+# packed-weight leaves and the cost audit (COST501) accounts decode
+# weight-read bytes at 0.5 byte/param (~0.25x the bf16 stream)
+TAG_TOKEN_GENERATION_W4 = "token_generation_w4"
+TAG_MIXED_STEP_W4 = "mixed_step_w4"
 
 #: the committed program set (graph + shard audits)
 COMMITTED_TAGS = (
@@ -70,6 +77,8 @@ COMMITTED_TAGS = (
     TAG_FUSED_SPECULATION_KVQ8,
     TAG_MIXED_STEP,
     TAG_MIXED_STEP_SPEC,
+    TAG_TOKEN_GENERATION_W4,
+    TAG_MIXED_STEP_W4,
 )
 #: cache-variant decode programs (memory audit: donation across variants)
 CACHE_VARIANT_TAGS = (
@@ -211,11 +220,13 @@ def _tiny_hf_attrs(vocab: int = 128) -> dict:
     )
 
 
-def tiny_config(**tpu_overrides):
+def tiny_config(hf_attrs: Optional[dict] = None, **tpu_overrides):
     from neuronx_distributed_inference_tpu.config import TpuConfig
     from neuronx_distributed_inference_tpu.models.llama import LlamaInferenceConfig
 
     attrs = _tiny_hf_attrs()
+    if hf_attrs:
+        attrs.update(hf_attrs)
 
     def load_config(cfg):
         for k, v in attrs.items():
@@ -314,7 +325,9 @@ def _record_from_runner(
 
 
 def _build_causal(
-    kv_quant: bool = False, variant: Optional[str] = None
+    kv_quant: bool = False,
+    variant: Optional[str] = None,
+    weight_dtype: Optional[str] = None,
 ) -> Dict[str, Dict[int, ProgramRecord]]:
     """CTE + TKG programs of the tiny causal LM.
 
@@ -323,6 +336,8 @@ def _build_causal(
     (block cache) or "mixed" (the ragged mixed-step serving program on the
     paged cache, serving_ragged) — compiled int8 so the QuantizedKV
     code+scale leaves are covered in every cache variant.
+    ``weight_dtype="int4"``: the w4 family — packed grouped-int4 weights
+    (ops/quant_matmul) through the plain TKG and mixed-step programs.
     """
     from neuronx_distributed_inference_tpu.runtime.application import (
         TpuModelForCausalLM,
@@ -331,6 +346,8 @@ def _build_causal(
     overrides = {}
     if kv_quant or variant:
         overrides["kv_cache_dtype"] = "int8"
+    if weight_dtype:
+        overrides["weight_dtype"] = weight_dtype
     if variant == "ring":
         overrides["sliding_window"] = 32
     elif variant == "paged":
@@ -355,7 +372,15 @@ def _build_causal(
             overrides.update(
                 serving_spec_ragged=True, speculation_length=_SPEC_WIDTH
             )
-    cfg = tiny_config(**overrides)
+    hf_attrs = None
+    if weight_dtype == "int4":
+        # w4 runs the kernel-eligible tiny shape: every decode linear has
+        # K ≥ one double-group (256) so packing isn't padding-dominated and
+        # the COST501 census shows the real weight-byte halving, and
+        # head_dim 64 is lane-aligned so mixed_step_w4 satisfies the
+        # ragged-dispatch gate the sharded kernel serves on hardware
+        hf_attrs = dict(hidden_size=256, intermediate_size=512)
+    cfg = tiny_config(hf_attrs=hf_attrs, **overrides)
     app = TpuModelForCausalLM(None, cfg)
     app.load(random_weights=True)
     declared_pp, declared_cp = app.declared_pspecs()
@@ -364,6 +389,10 @@ def _build_causal(
         pairs = [(TAG_TOKEN_GENERATION_RING, PHASE_TKG, app.token_generation_model)]
     elif variant == "paged":
         pairs = [(TAG_TOKEN_GENERATION_PAGED, PHASE_TKG, app.token_generation_model)]
+    elif variant == "mixed" and weight_dtype == "int4":
+        pairs = [(TAG_MIXED_STEP_W4, PHASE_TKG, app.mixed_step_model)]
+    elif weight_dtype == "int4":
+        pairs = [(TAG_TOKEN_GENERATION_W4, PHASE_TKG, app.token_generation_model)]
     elif variant == "mixed":
         pairs = [(TAG_MIXED_STEP, PHASE_TKG, app.mixed_step_model)]
     elif variant == "mixed_spec":
@@ -391,7 +420,7 @@ def _build_causal(
             layers=cfg.num_hidden_layers,
             vocab=cfg.vocab_size,
         )
-        if tag in (TAG_MIXED_STEP, TAG_MIXED_STEP_SPEC):
+        if tag in (TAG_MIXED_STEP, TAG_MIXED_STEP_SPEC, TAG_MIXED_STEP_W4):
             # packed bucket = query tokens; decode rows read the widest
             # committed kv bucket (the width example_inputs compiles at);
             # the spec variant records its draft length (spec_width - 1) so
@@ -533,6 +562,11 @@ _BUILDERS = (
     ((TAG_FUSED_SPECULATION_KVQ8,), lambda: _build_fused(kv_quant=True)),
     ((TAG_MIXED_STEP,), lambda: _build_causal(variant="mixed")),
     ((TAG_MIXED_STEP_SPEC,), lambda: _build_causal(variant="mixed_spec")),
+    ((TAG_TOKEN_GENERATION_W4,), lambda: _build_causal(weight_dtype="int4")),
+    (
+        (TAG_MIXED_STEP_W4,),
+        lambda: _build_causal(variant="mixed", weight_dtype="int4"),
+    ),
     ((TAG_TOKEN_GENERATION_RING,), lambda: _build_causal(variant="ring")),
     ((TAG_TOKEN_GENERATION_PAGED,), lambda: _build_causal(variant="paged")),
 )

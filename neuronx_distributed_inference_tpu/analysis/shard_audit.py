@@ -180,15 +180,61 @@ def _max_buffer_bytes(line: str) -> int:
     return best
 
 
+def _strip_lead_ones(dims: Tuple[int, ...]) -> Tuple[int, ...]:
+    """Drop leading unit dims: a scan body gathers the per-layer slice as
+    `[1, ...]` before the reshape squeezes it."""
+    i = 0
+    while i < len(dims) - 1 and dims[i] == 1:
+        i += 1
+    return dims[i:]
+
+
+def _result_buffer(line: str) -> Optional[Tuple[str, Tuple[int, ...]]]:
+    """(hlo dtype, dims) of the op's result: the typed buffer right after
+    `=` (`%name = f32[2,128]{...} op(...)`); for a tuple result (async
+    all-gather-start carries (operand, gathered)), the largest element."""
+    m = re.search(r"=\s+(\([^)]*\)|\w+\[[0-9,]*\])", line)
+    if not m:
+        return None
+    best: Optional[Tuple[str, Tuple[int, ...]]] = None
+    best_bytes = -1
+    for bm in re.finditer(r"(\w+)\[([0-9,]*)\]", m.group(1)):
+        dtype, dims_s = bm.group(1), bm.group(2)
+        dims = tuple(int(d) for d in dims_s.split(",") if d)
+        nbytes = _HLO_DTYPE_BYTES.get(dtype, 0)
+        for d in dims:
+            nbytes *= d
+        if nbytes > best_bytes:
+            best, best_bytes = (dtype, dims), nbytes
+    return best
+
+
 def in_loop_gather_findings(
-    hlo_text: str, min_bytes: int, location: str, key: str
+    hlo_text: str,
+    min_bytes: int,
+    location: str,
+    key: str,
+    weight_sigs: Optional[Set[Tuple[str, Tuple[int, ...]]]] = None,
 ) -> List[Finding]:
     """GRAPH303 detector over one compiled module's text: weight-sized
     all-gathers inside while-body-reachable computations. Exposed standalone
-    so the proven-detector test can feed it a deliberately broken program."""
+    so the proven-detector test can feed it a deliberately broken program.
+
+    ``weight_sigs`` — when given, the (dtype, dims) signatures of the
+    program's tp-sharded weight leaves (stacked ``layers/`` leaves both
+    whole and with L divided out): a gather is only weight-MATERIALIZING if
+    its result buffer exactly matches one. Size alone cannot separate
+    weights from activations once per-layer resharding is the declared
+    convention (grouped-int4 shards output-only, so decode activations
+    legitimately re-gather each step and scale with the token bucket)."""
     findings: List[Finding] = []
     comps = _computations(hlo_text)
     in_loop = _loop_reachable(comps)
+    sigs_norm = (
+        {(d, _strip_lead_ones(s)) for d, s in weight_sigs}
+        if weight_sigs is not None
+        else None
+    )
     for name in sorted(in_loop):
         for line in comps[name]:
             if "all-gather(" not in line and "all-gather-start(" not in line:
@@ -196,6 +242,12 @@ def in_loop_gather_findings(
             nbytes = _max_buffer_bytes(line)
             if nbytes < min_bytes:
                 continue
+            if sigs_norm is not None:
+                buf = _result_buffer(line)
+                if buf is None:
+                    continue
+                if (buf[0], _strip_lead_ones(buf[1])) not in sigs_norm:
+                    continue
             findings.append(
                 Finding(
                     rule="GRAPH303",
@@ -213,6 +265,38 @@ def in_loop_gather_findings(
                 )
             )
     return findings
+
+
+_NP_TO_HLO_DTYPE = {
+    "bool": "pred",
+    "int8": "s8", "uint8": "u8",
+    "int16": "s16", "uint16": "u16", "float16": "f16", "bfloat16": "bf16",
+    "int32": "s32", "uint32": "u32", "float32": "f32",
+    "int64": "s64", "uint64": "u64", "float64": "f64",
+}
+
+
+def weight_gather_signatures(rec) -> Set[Tuple[str, Tuple[int, ...]]]:
+    """(hlo dtype, dims) signatures of the program's tp-sharded weight
+    leaves, for the GRAPH303 weight-vs-activation discrimination. Stacked
+    ``layers/...`` leaves contribute both the whole stack and the per-layer
+    slice (an unrolled loop gathers the slice; a pathological one the
+    stack). 1-d leaves (biases/norms) are excluded — too collision-prone
+    with activation shapes."""
+    contract = _flatten_contract(
+        rec.declared_param_pspecs, rec.realized_param_shardings, rec.params
+    )
+    sigs: Set[Tuple[str, Tuple[int, ...]]] = set()
+    for path, spec, _real, leaf in contract or ():
+        if spec is None or not any(e is not None for e in spec):
+            continue
+        dtype = _NP_TO_HLO_DTYPE.get(str(leaf.dtype))
+        if dtype is None or leaf.ndim < 2:
+            continue
+        sigs.add((dtype, tuple(int(d) for d in leaf.shape)))
+        if "layers" in path.split("/") and leaf.ndim >= 3:
+            sigs.add((dtype, tuple(int(d) for d in leaf.shape[1:])))
+    return sigs
 
 
 def weight_gather_threshold(rec) -> int:
@@ -450,11 +534,12 @@ def run(
         # GRAPH303: decode-phase programs must not re-gather weights in-loop
         if ref.phase == programs.PHASE_TKG:
             threshold = weight_gather_threshold(ref)
+            sigs = weight_gather_signatures(ref)
             for b in buckets:
                 findings.extend(
                     in_loop_gather_findings(
                         per_bucket[b].compiled_text, threshold,
-                        f"{tag}/{b}", tag,
+                        f"{tag}/{b}", tag, weight_sigs=sigs,
                     )
                 )
 

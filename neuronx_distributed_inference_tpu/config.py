@@ -498,6 +498,11 @@ class TpuConfig:
     # input-axis block size for quantization_type="blockwise" (reference
     # blockwise_matmul_block_size, config.py:665-713)
     blockwise_matmul_block_size: int = 128
+    # decode weight-storage dtype (docs/WEIGHT_QUANT.md): "bfloat16" keeps
+    # weights in compute dtype; "int8" aliases the established quantize-at-
+    # load path (quantized=True, per-channel int8); "int4" packs grouped
+    # sub-byte codes streamed by the ops/quant_matmul fused-dequant kernel.
+    weight_dtype: str = "bfloat16"
 
     # --- LoRA ------------------------------------------------------------
     lora_config: Optional[LoraServingConfig] = None
@@ -563,6 +568,13 @@ class TpuConfig:
             self.moe_ep_degree = self.ep_degree
         if self.local_ranks_size is None:
             self.local_ranks_size = self.world_size
+        if self.weight_dtype == "bf16":
+            self.weight_dtype = "bfloat16"
+        if self.weight_dtype == "int8" and not self.quantized:
+            # int8 weights already have a first-class path (quantized=True);
+            # the weight_dtype spelling is an alias onto it so the knob is
+            # one dial across {bfloat16, int8, int4}
+            self.quantized = True
         self.validate()
 
     # world size identical to reference config.py:353-355
@@ -586,6 +598,11 @@ class TpuConfig:
     def kv_quantized(self) -> bool:
         """True when the KV cache stores int8/fp8 codes + scales."""
         return self.kv_cache_dtype in KV_QUANT_DTYPE_NAMES
+
+    @property
+    def weight_int4(self) -> bool:
+        """True when weights pack to grouped int4 at load (ops/quant_matmul)."""
+        return self.weight_dtype == "int4"
 
     def validate(self):
         """Feature-interaction validation (reference config.py:567-594)."""
@@ -797,6 +814,25 @@ class TpuConfig:
             "blockwise",
         ):
             raise ValueError(f"unknown quantization_type {self.quantization_type}")
+        if self.weight_dtype not in ("bfloat16", "int8", "int4"):
+            raise ValueError(
+                f"unknown weight_dtype {self.weight_dtype!r}; supported: "
+                "bfloat16 (no conversion), int8 (per-channel quantize-at-"
+                "load), int4 (grouped fused-dequant streaming)"
+            )
+        if self.weight_dtype == "int4":
+            if self.quantized:
+                raise ValueError(
+                    "weight_dtype='int4' and quantized=True are two different "
+                    "weight-conversion recipes applied to the same leaves; "
+                    "pick one (int8 via weight_dtype='int8' IS quantized=True)"
+                )
+            if self.quantized_checkpoints_path:
+                raise NotImplementedError(
+                    "pre-quantized checkpoint artifacts are int8-only; "
+                    "weight_dtype='int4' packs at load (refusing to silently "
+                    "ignore quantized_checkpoints_path)"
+                )
         if self.flash_decoding_enabled and self.cp_degree <= 1:
             raise ValueError(
                 "flash decoding on TPU rides the cp mesh axis (S-sharded KV "

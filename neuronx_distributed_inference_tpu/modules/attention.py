@@ -184,56 +184,13 @@ def _masked_softmax_attention(
     )
 
 
-def _flash_shape_ok(spec: AttnSpec, seq_len: int) -> bool:
-    # q/k tiles are (128, D): seq must tile evenly; D must be a lane-aligned
-    # multiple of 64. D=64 models (Llama-3.2-1B class) normally ride the
-    # head-pair PACKED kernel (two heads fill the 128 lanes, _use_packed);
-    # with packing off they fall back to half-lane tiles — slight waste,
-    # but still kernel-eligible.
-    return seq_len >= 128 and seq_len % 128 == 0 and spec.head_dim % 64 == 0
-
-
-def _use_flash(spec: AttnSpec, seq_len: int) -> bool:
-    if spec.use_flash_kernel is False:
-        return False
-    ok = _flash_shape_ok(spec, seq_len)
-    if spec.use_flash_kernel:  # force-enabled still honors shape guards
-        if not ok:
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "attn_kernel_enabled=True but shape (seq=%d, head_dim=%d) is "
-                "unsupported by the flash kernel; falling back to native path",
-                seq_len,
-                spec.head_dim,
-            )
-        return ok
-    return ok and spec.model_parallel == 1 and jax.default_backend() == "tpu"
-
-
-def _use_packed(spec: AttnSpec) -> bool:
-    """Head-pair packing decision, taken AFTER :func:`_use_flash` says yes
-    (seq-length eligibility is already settled there).
-
-    Auto-on for head_dim <= 64 (the packing exists exactly because D=64
-    half-fills the 128-wide MXU contraction; D=128 tiles are already full).
-    Needs >= 2 heads to pair (H odd pads inside the kernel wrapper, H=1
-    would only add waste). Tri-state ``use_packed_heads`` overrides like the
-    other kernel switches — force-enable still honors the shape guards."""
-    if spec.use_packed_heads is False:
-        return False
-    ok = spec.head_dim <= 64 and spec.num_heads >= 2
-    if spec.use_packed_heads and not ok:
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "attn_packed_kernel_enabled=True but shape (heads=%d, "
-            "head_dim=%d) is unsupported by the packed kernel; using the "
-            "unpacked flash path",
-            spec.num_heads,
-            spec.head_dim,
-        )
-    return ok
+# kernel/native dispatch gates: consolidated in ops/kernel_mode.py (one
+# tested predicate per kernel); the historical names stay importable here
+from neuronx_distributed_inference_tpu.ops.kernel_mode import (  # noqa: E402
+    flash_shape_ok as _flash_shape_ok,
+    use_flash as _use_flash,
+    use_packed as _use_packed,
+)
 
 
 def attention_prefill(

@@ -167,12 +167,34 @@ def _glu_fn(spec: MoESpec):
 
 
 def _has_blockwise_scales(params: dict) -> bool:
+    from neuronx_distributed_inference_tpu.ops.quant_matmul import is_int4_entry
+
     for name in ("gate_proj", "up_proj", "down_proj"):
         entry = params[name]
+        if is_int4_entry(entry):
+            # packed int4 experts dequantize at the matmul site in every
+            # dispatch path (see _expert_entry) — they don't need the
+            # blockwise-einsum restriction
+            continue
         s = entry.get("scale")
         if s is not None and s.ndim == entry["weight"].ndim:
             return True
     return False
+
+
+def _expert_entry(entry: dict, x_in: jax.Array) -> dict:
+    """Resolve a packed-int4 expert entry to a plain weight for the einsum
+    paths: experts stay int4-resident in HBM (0.5 byte/param streamed) and
+    XLA fuses the group-structured dequant into the expert matmul — the
+    (E, in, out) weight never round-trips through HBM in compute dtype.
+    Dense-linear projections take the Pallas fused-dequant kernel instead
+    (ops/quant_matmul via quant.linear); the expert einsums are gather-
+    shaped, so they use this runtime-dequant form."""
+    from neuronx_distributed_inference_tpu.ops.quant_matmul import (
+        maybe_dequantize_int4,
+    )
+
+    return maybe_dequantize_int4(entry, x_in.shape[-1], x_in.dtype)
 
 
 def _sorted_dispatch(affinities: jax.Array, k: int):
@@ -194,6 +216,7 @@ def _grouped_mm(entry: dict, x_rows: jax.Array, row_expert: jax.Array,
     """Ragged grouped matmul over expert-sorted rows — the Megablox-style GMM
     (reference BlockwiseMatmulConfig / nxd ExpertMLPsV2 blockwise path).
     x_rows (R, in) sorted by expert; weight (E, in, out) -> (R, out)."""
+    entry = _expert_entry(entry, x_rows)
     w = entry["weight"]
     y = jax.lax.ragged_dot(x_rows, w.astype(x_rows.dtype), group_sizes)
     s = entry.get("scale")
@@ -257,6 +280,7 @@ def expert_mlps_capacity(
     sww = sw.astype(x.dtype)[:, None]
 
     def mm(entry, x_in, eq):
+        entry = _expert_entry(entry, x_in)
         y = jnp.einsum(eq, x_in, entry["weight"].astype(x_in.dtype))
         s = entry.get("scale")
         if s is not None:
@@ -298,6 +322,7 @@ def expert_mlps_dense(
         Blockwise scales (scale.ndim == weight.ndim; reference
         blockwise_matmul_block_size) apply per input block before the sum —
         the exact dequantized matmul, MXU-shaped."""
+        entry = _expert_entry(entry, x_in)
         w = entry["weight"]
         s = entry.get("scale")
         if s is not None and s.ndim == w.ndim:

@@ -62,30 +62,11 @@ except ImportError:  # pragma: no cover
 NEG_INF = -1e30
 
 
-def use_tkg_kernel(spec, q_len: int, kv_width: int) -> bool:
-    """Gate for the decode kernels. ``spec.use_tkg_kernel`` (config
-    attn_block_tkg_kernel_enabled): None = auto on TPU, True = force
-    (still honoring shape guards), False = native path."""
-    enabled = spec.use_tkg_kernel
-    if enabled is False:
-        return False
-    ok = (
-        q_len <= 16
-        and spec.head_dim % 64 == 0
-        and kv_width >= 128
-        and kv_width % min(512, kv_width) == 0
-    )
-    if enabled:
-        return ok
-    # auto path: single model-parallel shard only — pallas_call has no GSPMD
-    # partitioning rule, so a head-sharded cache operand would be all-gathered
-    # per layer per step (force-enable opts in regardless)
-    return (
-        ok
-        and kv_width >= 512
-        and spec.model_parallel == 1
-        and jax.default_backend() == "tpu"
-    )
+# kernel/native dispatch gate: consolidated in ops/kernel_mode.py (one
+# tested predicate per kernel); the historical name stays importable here
+from neuronx_distributed_inference_tpu.ops.kernel_mode import (  # noqa: E402
+    use_tkg as use_tkg_kernel,
+)
 
 
 def _body(

@@ -1,4 +1,4 @@
-"""Pallas kernel execution-mode switch.
+"""Pallas kernel execution-mode switch + the consolidated dispatch gates.
 
 Off-TPU hosts run every Pallas kernel in interpret mode (pure-Python
 emulation) so the CPU test mesh exercises kernel numerics. That also means no
@@ -8,15 +8,46 @@ BlockSpec). :func:`force_compiled_kernels` flips the wrappers to emit real
 Mosaic kernels regardless of host backend, so the suite can AOT-lower every
 kernel (and whole model programs) for the TPU target from a CPU host via
 ``jax.export(..., platforms=["tpu"])`` — see tests/test_tpu_lowering.py.
+
+Dispatch gates
+--------------
+Every kernel/native auto-gate lives HERE, one tested predicate per kernel
+(tests/test_kernel_mode.py), instead of being scattered across the kernel
+modules: the gates share the same tri-state convention (config None = auto,
+True = force with shape guards + a warning on fallback, False = off) and a
+change to one kernel's auto condition must not silently flip another's.
+The kernel modules re-export their historical names (``_use_flash``,
+``use_tkg_kernel``, ...) as aliases of these predicates.
+
+Gate summary (auto path):
+
+============================  ==============================================
+kernel                        auto condition beyond the shape guards
+============================  ==============================================
+flash / packed prefill        single model-parallel shard, TPU backend
+paged flash prefill           single shard, TPU, q_len >= 64
+TKG decode (contig + paged)   single shard, TPU, kv_width >= 512
+fused MoE decode              OFF (force-only pending hardware wins)
+ragged mixed-step             TPU backend — **sharded meshes included**:
+                              the mixed step wraps the kernel in
+                              ``shard_map`` over the head-parallel grid
+                              axis, so tp>1 no longer forces the native
+                              gather fallback (ISSUE 17)
+int4 quant matmul             TPU backend + single shard (see
+                              :func:`use_quant_matmul`)
+============================  ==============================================
 """
 
 from __future__ import annotations
 
+import logging
 from contextlib import contextmanager
 
 import jax
 
 _FORCE_COMPILED = False
+
+log = logging.getLogger(__name__)
 
 
 @contextmanager
@@ -38,3 +69,239 @@ def kernel_interpret() -> bool:
     if _FORCE_COMPILED:
         return False
     return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# dispatch gates — one predicate per kernel
+# ---------------------------------------------------------------------------
+
+
+def on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def single_shard(spec) -> bool:
+    """One model-parallel shard: the auto condition for kernels whose
+    pallas_call carries no GSPMD partitioning rule — a sharded operand
+    would be all-gathered per launch. The ragged mixed-step kernel is the
+    exception: its dispatch shard_maps over the head axis instead."""
+    return spec.model_parallel == 1
+
+
+def flash_shape_ok(spec, seq_len: int) -> bool:
+    # q/k tiles are (128, D): seq must tile evenly; D must be a lane-aligned
+    # multiple of 64. D=64 models (Llama-3.2-1B class) normally ride the
+    # head-pair PACKED kernel (two heads fill the 128 lanes, use_packed);
+    # with packing off they fall back to half-lane tiles — slight waste,
+    # but still kernel-eligible.
+    return seq_len >= 128 and seq_len % 128 == 0 and spec.head_dim % 64 == 0
+
+
+def use_flash(spec, seq_len: int) -> bool:
+    """Prefill flash attention (modules/attention.attention_prefill)."""
+    if spec.use_flash_kernel is False:
+        return False
+    ok = flash_shape_ok(spec, seq_len)
+    if spec.use_flash_kernel:  # force-enabled still honors shape guards
+        if not ok:
+            log.warning(
+                "attn_kernel_enabled=True but shape (seq=%d, head_dim=%d) is "
+                "unsupported by the flash kernel; falling back to native path",
+                seq_len,
+                spec.head_dim,
+            )
+        return ok
+    return ok and single_shard(spec) and on_tpu()
+
+
+def use_packed(spec) -> bool:
+    """Head-pair packing decision, taken AFTER :func:`use_flash` says yes
+    (seq-length eligibility is already settled there).
+
+    Auto-on for head_dim <= 64 (the packing exists exactly because D=64
+    half-fills the 128-wide MXU contraction; D=128 tiles are already full).
+    Needs >= 2 heads to pair (H odd pads inside the kernel wrapper, H=1
+    would only add waste). Tri-state ``use_packed_heads`` overrides like the
+    other kernel switches — force-enable still honors the shape guards."""
+    if spec.use_packed_heads is False:
+        return False
+    ok = spec.head_dim <= 64 and spec.num_heads >= 2
+    if spec.use_packed_heads and not ok:
+        log.warning(
+            "attn_packed_kernel_enabled=True but shape (heads=%d, "
+            "head_dim=%d) is unsupported by the packed kernel; using the "
+            "unpacked flash path",
+            spec.num_heads,
+            spec.head_dim,
+        )
+    return ok
+
+
+def use_tkg(spec, q_len: int, kv_width: int) -> bool:
+    """Gate for the decode kernels (contiguous + paged TKG).
+    ``spec.use_tkg_kernel`` (config attn_block_tkg_kernel_enabled): None =
+    auto on TPU, True = force (still honoring shape guards), False = native
+    path."""
+    enabled = spec.use_tkg_kernel
+    if enabled is False:
+        return False
+    ok = (
+        q_len <= 16
+        and spec.head_dim % 64 == 0
+        and kv_width >= 128
+        and kv_width % min(512, kv_width) == 0
+    )
+    if enabled:
+        return ok
+    # auto path: single model-parallel shard only — pallas_call has no GSPMD
+    # partitioning rule, so a head-sharded cache operand would be all-gathered
+    # per layer per step (force-enable opts in regardless)
+    return ok and kv_width >= 512 and single_shard(spec) and on_tpu()
+
+
+def use_paged_flash(spec, q_len: int) -> bool:
+    """Gate for the paged prefill kernel: multi-token block attention only
+    (decode q_len==1 rides the TKG kernel), lane-aligned head_dim; auto-on
+    for TPU at kernel-worthy chunk sizes, force-on/off via
+    attn_kernel_enabled."""
+    if spec.use_flash_kernel is False or q_len < 8 or spec.head_dim % 64 != 0:
+        return False
+    if spec.use_flash_kernel:
+        return True
+    # auto path requires one model-parallel shard (see AttnSpec.model_parallel)
+    return q_len >= 64 and single_shard(spec) and on_tpu()
+
+
+def use_moe_tkg(spec, params: dict, n_tokens: int) -> bool:
+    """Gate for the fused MoE decode kernel (``spec`` is a MoESpec). Plain
+    unquantized bias-free GLU experts, decode-sized token counts, single
+    model-parallel shard. AUTO stays OFF pending hardware wins; force-enable
+    still honors these structural guards but WARNS on fallback (the
+    flash-kernel convention)."""
+    enabled = spec.moe_fused_kernel
+    if not enabled:  # None (auto) stays OFF pending broader hardware wins
+        return False
+    plain = all(
+        isinstance(params.get(k), dict)
+        and "weight" in params[k]
+        and "scale" not in params[k]
+        and "bias" not in params[k]
+        for k in ("gate_proj", "up_proj", "down_proj")
+    )
+    ok = (
+        plain
+        and n_tokens * spec.top_k <= 64
+        and spec.ep_degree == 1
+        and single_shard(spec)
+        and not spec.early_affinity_modulation
+    )
+    if not ok:
+        log.warning(
+            "moe_fused_kernel_enabled=True but this configuration is "
+            "unsupported (needs plain unquantized bias-free experts, "
+            "T*k <= 64, ep=1, model_parallel=1, no early affinity "
+            "modulation); falling back to the dense all-experts path"
+        )
+    return ok
+
+
+def use_ragged(spec, total_q: int, ragged_q_tile: int = 16) -> bool:
+    """Kernel/native gate for the ragged mixed-step attention: lane-aligned
+    head_dim and tile-aligned packing; tri-state force via
+    ``use_flash_kernel`` like the other attention kernels.
+
+    Unlike the other gates there is NO single-shard condition: the mixed
+    step dispatches the kernel through ``shard_map`` over the head-parallel
+    grid axis (q heads and paged KV blocks are head-sharded, descriptors
+    are replicated host metadata), so tp>1 meshes run the kernel per-shard
+    with no collectives inside (ISSUE 17). The head counts must divide the
+    model-parallel degree — guaranteed by GQASharding's kv replication, and
+    re-checked here so a hand-built spec degrades to the native path
+    instead of a shard_map error."""
+    if (
+        spec.use_flash_kernel is False
+        or spec.head_dim % 64 != 0
+        or total_q % ragged_q_tile != 0
+    ):
+        return False
+    mp = spec.model_parallel
+    if mp > 1 and (spec.num_heads % mp or spec.num_kv_heads % mp):
+        return False
+    if spec.use_flash_kernel:
+        return True
+    return on_tpu()
+
+
+# --- int4 quant matmul (ops/quant_matmul.py) -------------------------------
+#
+# The decode linears reach the kernel through ops/quant.linear(), which sees
+# only the packed entry and the activations — no AttnSpec/config. The mode
+# is therefore process-level module state, set once by the application at
+# load time ("auto" unless tp>1 forces it off) and overridable in tests via
+# the quant_matmul_mode context.
+
+_QMM_MODE: list = ["auto"]  # stack: [base, *context overrides]
+
+#: default scale-group size along the input axis (two nibble planes of
+#: 2*QMM_GROUP codes per packed byte row — see ops/quant_matmul.py)
+QMM_GROUP = 128
+
+
+def set_quant_matmul_mode(mode) -> None:
+    """Set the process-level base mode: "auto" | True | False. The
+    application calls this at load for weight_dtype="int4" (False on tp>1
+    meshes: pallas_call has no GSPMD rule, so sharded packed weights would
+    be all-gathered per launch — the native int4 path is GSPMD-shardable
+    and serves those meshes instead)."""
+    if mode not in ("auto", True, False):
+        raise ValueError(f"quant matmul mode must be 'auto'/True/False, got {mode!r}")
+    _QMM_MODE[0] = mode
+
+
+@contextmanager
+def quant_matmul_mode(mode):
+    """Temporarily override the quant-matmul dispatch mode (tests force the
+    kernel on CPU hosts with ``quant_matmul_mode(True)`` — it then runs in
+    interpret mode via :func:`kernel_interpret`)."""
+    if mode not in ("auto", True, False):
+        raise ValueError(f"quant matmul mode must be 'auto'/True/False, got {mode!r}")
+    _QMM_MODE.append(mode)
+    try:
+        yield
+    finally:
+        _QMM_MODE.pop()
+
+
+def use_quant_matmul(rows: int, k: int, n: int, group: int = QMM_GROUP) -> bool:
+    """Gate for the int4 fused-dequant matmul kernel: decode-sized row
+    counts (the kernel keeps the full row block resident), lane-aligned
+    output width, at least one full double-group along the input axis.
+    Force-enable (mode True) still honors the shape guards but warns on
+    fallback, the convention every other gate follows."""
+    mode = _QMM_MODE[-1]
+    if mode is False:
+        return False
+    from neuronx_distributed_inference_tpu.parallel.mesh import (
+        ALL_AXES,
+        ambient_mesh,
+    )
+
+    mesh = ambient_mesh()
+    sharded = mesh is not None and any(
+        dict(mesh.shape).get(a, 1) > 1 for a in ALL_AXES
+    )
+    ok = rows <= 64 and n % 128 == 0 and k >= 2 * group and not sharded
+    if mode is True:
+        if not ok:
+            log.warning(
+                "quant matmul forced on but the call (rows=%d, k=%d, n=%d, "
+                "group=%d, model-sharded mesh=%s) is unsupported by the "
+                "kernel; using the native int4 dequant path",
+                rows,
+                k,
+                n,
+                group,
+                sharded,
+            )
+        return ok
+    return ok and on_tpu()

@@ -42,39 +42,11 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 
-def use_moe_tkg_kernel(spec, params: dict, n_tokens: int) -> bool:
-    """Gate (``spec`` is a MoESpec). Plain unquantized bias-free GLU experts,
-    decode-sized token counts, single model-parallel shard (pallas_call has
-    no GSPMD rule — sharded expert weights would be all-gathered per step,
-    defeating the kernel). Force-enable still honors these structural guards
-    but WARNS on fallback (the flash-kernel convention)."""
-    enabled = spec.moe_fused_kernel
-    if not enabled:  # None (auto) stays OFF pending broader hardware wins
-        return False
-    plain = all(
-        isinstance(params.get(k), dict)
-        and "weight" in params[k]
-        and "scale" not in params[k]
-        and "bias" not in params[k]
-        for k in ("gate_proj", "up_proj", "down_proj")
-    )
-    ok = (
-        plain
-        and n_tokens * spec.top_k <= 64
-        and spec.ep_degree == 1
-        and spec.model_parallel == 1
-        and not spec.early_affinity_modulation
-    )
-    if not ok:
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "moe_fused_kernel_enabled=True but this configuration is "
-            "unsupported (needs plain unquantized bias-free experts, "
-            "T*k <= 64, ep=1, model_parallel=1, no early affinity "
-            "modulation); falling back to the dense all-experts path"
-        )
-    return ok
+# kernel/native dispatch gate: consolidated in ops/kernel_mode.py (one
+# tested predicate per kernel); the historical name stays importable here
+from neuronx_distributed_inference_tpu.ops.kernel_mode import (  # noqa: E402
+    use_moe_tkg as use_moe_tkg_kernel,
+)
 
 
 def _moe_kernel(

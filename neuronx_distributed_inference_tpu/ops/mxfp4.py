@@ -57,3 +57,29 @@ def dequantize_packed_state_dict(sd: dict) -> dict:
         scales = sd.pop(name + "_scales")
         sd[name] = dequantize_mxfp4(blocks, scales)
     return sd
+
+
+def repack_mxfp4_to_int4(blocks: np.ndarray, scales: np.ndarray, group_size: int = 128):
+    """MXFP4 -> grouped-int4 runtime repack (per-tensor primitive).
+
+    e2m1 mantissas on a shared e8m0 exponent cannot map exactly onto a
+    single-scale int4 grid (block values {0, .5, 1, 1.5, 2, 3, 4, 6}·2^e span
+    12 steps of the finest spacing but int4 carries 7), so the repack is
+    dequantize -> per-(group, out) absmax REQUANTIZE. Relative error stays
+    bounded by the int4 step (~scale/2 per element, ~7% worst-case on e2m1
+    extremes — measured in tests/test_quant_matmul.py); in exchange the
+    expert streams at 0.5 byte/param through the same grouped-int4 path as
+    every other weight instead of needing an MXFP4-specific kernel.
+
+    In the serving flow this composes as load-time dequant
+    (``dequantize_packed_state_dict``) + the ``weight_dtype="int4"``
+    quantize walk — this function is that composition for ONE tensor,
+    used where an expert must repack without staging the whole model."""
+    from neuronx_distributed_inference_tpu.ops.quant_matmul import (
+        quantize_tensor_int4,
+    )
+
+    # (E, cols, rows) plain layout = (E, in, out) for gate/up; callers feed
+    # whatever orientation their consumer expects — the quantize groups the
+    # -2 axis either way
+    return quantize_tensor_int4(dequantize_mxfp4(blocks, scales), group_size)

@@ -38,21 +38,11 @@ except ImportError:  # pragma: no cover
 NEG_INF = -1e30
 
 
-def _use_paged_flash(spec, q_len: int) -> bool:
-    """Gate for the paged kernel: multi-token block attention only (decode
-    q_len==1 keeps the native path until the TKG kernel lands), lane-aligned
-    head_dim; auto-on for TPU at kernel-worthy chunk sizes, force-on/off via
-    attn_kernel_enabled."""
-    if spec.use_flash_kernel is False or q_len < 8 or spec.head_dim % 64 != 0:
-        return False
-    if spec.use_flash_kernel:
-        return True
-    # auto path requires one model-parallel shard (see AttnSpec.model_parallel)
-    return (
-        q_len >= 64
-        and spec.model_parallel == 1
-        and jax.default_backend() == "tpu"
-    )
+# kernel/native dispatch gate: consolidated in ops/kernel_mode.py (one
+# tested predicate per kernel); the historical name stays importable here
+from neuronx_distributed_inference_tpu.ops.kernel_mode import (  # noqa: E402
+    use_paged_flash as _use_paged_flash,
+)
 
 
 def _paged_kernel(

@@ -139,6 +139,28 @@ def linear(entry: dict, x: jax.Array) -> jax.Array:
     full matmul.
     """
     w = entry["weight"]
+    if jnp.dtype(w.dtype) == jnp.uint8:
+        # packed grouped-int4 (ops/quant_matmul): uint8 is the structural
+        # discriminator — scale.ndim matches the blockwise case but the
+        # weight rows are nibble-packed codes, so it must dispatch FIRST.
+        # Decode-shaped calls stream through the fused-dequant Pallas kernel
+        # (gated in ops/kernel_mode.use_quant_matmul; interpreted on CPU when
+        # forced); everything else — prefill, sharded meshes, odd shapes —
+        # takes the group-structured native path.
+        from neuronx_distributed_inference_tpu.ops import quant_matmul as _qmm
+        from neuronx_distributed_inference_tpu.ops.kernel_mode import (
+            kernel_interpret,
+            use_quant_matmul,
+        )
+
+        s = entry["scale"]
+        rows = 1
+        for d in x.shape[:-1]:
+            rows *= d
+        group = (2 * w.shape[-2]) // s.shape[-2]
+        if w.ndim == 2 and use_quant_matmul(rows, x.shape[-1], w.shape[-1], group):
+            return _qmm.quant_matmul(x, w, s, interpret=kernel_interpret())
+        return _qmm.int4_matmul_native(x, w, s)
     if "scale" in entry:
         s = entry["scale"]
         if s.ndim == w.ndim:  # blockwise: (..., nb, out) for w (..., in, out)
@@ -199,11 +221,19 @@ def quantize_params(
     walk(params, ())
 
     def quantize_one(node):
-        if block_size:
+        if quant_dtype == "int4":
+            from neuronx_distributed_inference_tpu.ops.quant_matmul import (
+                INT4_GROUP,
+                quantize_tensor_int4,
+            )
+
+            q = quantize_tensor_int4(node["weight"], block_size or INT4_GROUP)
+        elif block_size:
             q = quantize_tensor_blockwise(node["weight"], quant_dtype, block_size)
         else:
             q = quantize_tensor(node["weight"], quant_dtype, per_channel)
-        node.update(q)  # drops the source weight's last reference
+        node["weight"] = q["weight"]  # drops the source weight's last reference
+        node["scale"] = q["scale"]
 
     host = bool(eligible) and isinstance(eligible[0]["weight"], np.ndarray)
     workers = int(os.environ.get("TPU_QUANT_WORKERS", "2"))
@@ -241,6 +271,60 @@ def prepare_quantized_params(params: dict, pspecs: dict, tpu_config):
         block_size=(tpu_config.blockwise_matmul_block_size if blockwise else 0),
     )
     return params, quantized_pspecs(pspecs, params)
+
+
+def prepare_int4_params(params: dict, pspecs: dict, tpu_config):
+    """``weight_dtype="int4"`` quantize-at-load: packs every eligible weight
+    leaf to the ops/quant_matmul grouped-int4 format (uint8 nibble codes +
+    per-(group, out) f32 scales) and mirrors pspecs onto the added scale
+    leaves. Same walk/skip-set/donation discipline as the int8 path —
+    weights stream from HBM at 0.5 byte/param in decode (docs/WEIGHT_QUANT.md)."""
+    from neuronx_distributed_inference_tpu.ops.quant_matmul import INT4_GROUP
+
+    skip = (
+        tuple(tpu_config.modules_to_not_convert)
+        if tpu_config.modules_to_not_convert
+        else DEFAULT_SKIP
+    )
+    params = quantize_params(params, "int4", skip=skip, block_size=INT4_GROUP)
+    return params, _int4_output_sharded_pspecs(
+        quantized_pspecs(pspecs, params), params
+    )
+
+
+def _int4_output_sharded_pspecs(pspecs: dict, qparams: dict) -> dict:
+    """Grouped-int4 entries must shard on the OUTPUT axis only (the AWQ/GPTQ
+    tensor-parallel convention): the group structure spans global K, so an
+    input-axis shard splits groups across devices and every decode step
+    re-gathers the packed codes inside the loop (GRAPH303). Rewrite any
+    input-sharded int4 weight/scale spec to put that mesh axis on the output
+    dim instead — weight bytes stay 1/tp per device; resharding moves to the
+    (much smaller) decode activations."""
+    from jax.sharding import PartitionSpec as P
+
+    from neuronx_distributed_inference_tpu.ops.quant_matmul import is_int4_entry
+
+    def walk(spec_node, param_node):
+        if isinstance(param_node, dict) and is_int4_entry(param_node):
+            if not isinstance(spec_node, dict):
+                return spec_node
+            parts = tuple(spec_node.get("weight") or P())
+            if len(parts) < 2 or parts[-2] is None:
+                return spec_node  # already output-only (or replicated)
+            out_ax = parts[-1] if parts[-1] is not None else parts[-2]
+            moved = P(*(parts[:-2] + (None, out_ax)))
+            out = dict(spec_node)
+            out["weight"] = moved
+            out["scale"] = moved
+            return out
+        if isinstance(param_node, dict):
+            return {
+                k: walk(spec_node.get(k) if isinstance(spec_node, dict) else spec_node, v)
+                for k, v in param_node.items()
+            }
+        return spec_node
+
+    return walk(pspecs, qparams)
 
 
 def quantized_pspecs(pspecs: dict, qparams: dict) -> dict:
@@ -322,6 +406,7 @@ def _quant_meta(tpu_config) -> dict:
     return {
         "quantization_type": tpu_config.quantization_type,
         "quantization_dtype": tpu_config.quantization_dtype,
+        "weight_dtype": getattr(tpu_config, "weight_dtype", "bfloat16"),
         "blockwise_matmul_block_size": tpu_config.blockwise_matmul_block_size,
         # WHICH modules were converted is part of the recipe: an artifact
         # saved under an old skip set (e.g. bf16 lm_head) must re-quantize,

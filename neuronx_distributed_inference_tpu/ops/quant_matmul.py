@@ -1,0 +1,246 @@
+"""int4 fused-dequant weight-streaming matmul (ISSUE 17 tentpole b).
+
+Decode is weight-bandwidth-bound: after the int8 halving (PR 3) the next
+step is sub-8-bit codes. This module owns the packed-int4 weight format and
+the Pallas kernel that DMAs the narrow codes + per-group scales and
+dequantizes IN REGISTER into the MXU matmul — the dequantized weight matrix
+is never materialized in HBM or VMEM.
+
+Packed layout (the format every int4 entry in a param tree uses)
+----------------------------------------------------------------
+A logical weight ``(..., K, N)`` quantizes symmetrically per
+``(group, out_channel)`` with groups of :data:`INT4_GROUP` along the input
+axis. K is zero-padded up to ``Kp``, the next multiple of ``2*group`` (pad
+codes dequantize to exactly 0), then stored as
+
+- ``weight``: uint8 ``(..., Kp/2, N)`` — **midpoint split**: byte row ``j``
+  holds code ``k=j`` in the low nibble and code ``k=Kp/2+j`` in the high
+  nibble. Unlike adjacent-pair interleave, both nibble planes are
+  contiguous row ranges, so the kernel slices plain group blocks with no
+  lane-strided shuffles, and ``Kp % 2*group == 0`` keeps every group inside
+  one nibble plane.
+- ``scale``: float32 ``(..., Kp/group, N)`` — groups ``0..Kp/2/group-1``
+  cover the low plane, the rest the high plane.
+
+Codes are ``q + 8`` with ``q = clip(round(w/s), -7, 7)`` — biased uint4 in
+``[1, 15]``; 8 (= q 0) is the pad value. ``w ≈ (code - 8) * s``.
+
+Kernel (``quant_matmul``)
+-------------------------
+Grid ``(N/bn,)`` over output tiles; the full (small, decode-sized) row
+block and the full packed K stay resident per step. Each step unrolls the
+group loop: a ``(rows, group) @ (group, bn)`` MXU dot per nibble plane per
+group, scaled by that group's ``(bn,)`` scale row AFTER the dot — the exact
+K-scale-folding convention of ``ops/decode_attention.py`` (codes through
+the MXU, dequant factors applied outside the contraction). Group = 128
+keeps every contraction MXU-full. The native fallback
+(:func:`int4_matmul_native`) runs the same group-structured math with plain
+einsums so every config serves on CPU and on GSPMD-sharded meshes
+(pallas_call has no partitioning rule — the gate in ops/kernel_mode.py
+keeps the kernel single-shard).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from neuronx_distributed_inference_tpu.ops.tile_defaults import tile_default
+
+try:  # pallas TPU backend
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+#: default scale-group size along the input axis (= one MXU contraction)
+INT4_GROUP = 128
+
+#: symmetric 4-bit range: codes are q+8, q in [-7, 7] (the -8 code is unused
+#: so the grid is symmetric and the pad byte 0x88 dequantizes to exactly 0)
+INT4_QMAX = 7.0
+
+
+def quantize_tensor_int4(w, group_size: int = INT4_GROUP):
+    """Quantize ``(..., K, N)`` to the packed int4 entry
+    ``{"weight": uint8 (..., Kp/2, N), "scale": f32 (..., Kp/group, N)}``.
+
+    Numpy inputs quantize WITH numpy and return numpy (quantize-at-load must
+    not stage fp32 on device — the ops/quant.py convention)."""
+    xp = np if isinstance(w, np.ndarray) else jnp
+    wf = w.astype(xp.float32)
+    *lead, K, N = wf.shape
+    span = 2 * group_size
+    Kp = -(-K // span) * span
+    if Kp != K:
+        wf = xp.pad(wf, [(0, 0)] * len(lead) + [(0, Kp - K), (0, 0)])
+    nG = Kp // group_size
+    wg = wf.reshape(*lead, nG, group_size, N)
+    absmax = xp.maximum(xp.max(xp.abs(wg), axis=-2), 1e-8)
+    scale = (absmax / INT4_QMAX).astype(xp.float32)  # (..., nG, N)
+    q = xp.clip(xp.round(wg / scale[..., None, :]), -INT4_QMAX, INT4_QMAX)
+    codes = (q + 8).astype(xp.uint8).reshape(*lead, Kp, N)
+    k2 = Kp // 2
+    lo = codes[..., :k2, :]
+    hi = codes[..., k2:, :]
+    return {"weight": lo | (hi << 4), "scale": scale}
+
+
+def is_int4_entry(entry) -> bool:
+    """Packed-int4 discriminator: uint8 is structural — no other weight
+    format in the tree stores uint8 codes (int8 weights are jnp.int8)."""
+    return (
+        isinstance(entry, dict)
+        and "scale" in entry
+        and "weight" in entry
+        and jnp.dtype(entry["weight"].dtype) == jnp.uint8
+    )
+
+
+def dequantize_int4(packed, scale, k: int = None, dtype=jnp.float32):
+    """Unpack ``(..., Kp/2, N)`` codes + ``(..., Kp/G, N)`` scales back to
+    the logical ``(..., k, N)`` weight (trailing pad rows sliced off when
+    ``k`` is given). Works on device arrays and numpy alike; leading dims
+    (stacked layers / experts) pass through."""
+    xp = np if isinstance(packed, np.ndarray) else jnp
+    k2, n = packed.shape[-2], packed.shape[-1]
+    kp = 2 * k2
+    n_g = scale.shape[-2]
+    group = kp // n_g
+    codes = packed.astype(xp.int32)
+    w = xp.concatenate(
+        [(codes & 15) - 8, (codes >> 4) - 8], axis=-2
+    ).astype(xp.float32)
+    lead = w.shape[:-2]
+    wg = w.reshape(*lead, n_g, group, n) * scale[..., None, :]
+    w = wg.reshape(*lead, kp, n)
+    if k is not None and k != kp:
+        w = w[..., :k, :]
+    return w.astype(dtype)
+
+
+def maybe_dequantize_int4(entry, k: int, dtype):
+    """Entry-level adapter for weight-consuming paths that don't speak the
+    packed format (MoE expert einsums): packed entries come back as a plain
+    dequantized entry (bias preserved), everything else passes through."""
+    if not is_int4_entry(entry):
+        return entry
+    out = {"weight": dequantize_int4(entry["weight"], entry["scale"], k, dtype)}
+    if "bias" in entry:
+        out["bias"] = entry["bias"]
+    return out
+
+
+def int4_matmul_native(x, packed, scale):
+    """Native fused-dequant matmul: the same group-structured math as the
+    kernel (per-group code dot, scale applied after the dot, f32
+    accumulation) as plain XLA ops — GSPMD-shardable, runs everywhere."""
+    if packed.ndim != 2:
+        raise ValueError(
+            f"int4 matmul takes a 2D packed weight, got {packed.shape} "
+            "(select the layer/expert before the matmul)"
+        )
+    k = x.shape[-1]
+    k2, n = packed.shape
+    kp = 2 * k2
+    n_g = scale.shape[-2]
+    group = kp // n_g
+    codes = packed.astype(jnp.int32)
+    w = jnp.concatenate(
+        [(codes & 15) - 8, (codes >> 4) - 8], axis=-2
+    ).astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    if kp != k:
+        xf = jnp.pad(xf, [(0, 0)] * (x.ndim - 1) + [(0, kp - k)])
+    xg = xf.reshape(*xf.shape[:-1], n_g, group)
+    wg = w.reshape(n_g, group, n)
+    y = jnp.einsum("...ng,ngo->...no", xg, wg)
+    y = jnp.einsum("...no,no->...o", y, scale.astype(jnp.float32))
+    return y.astype(x.dtype)
+
+
+def _qmm_kernel(x_ref, w_ref, s_ref, o_ref, *, n_groups2: int, group: int, k2: int):
+    """One (rows, bn) output tile: unrolled group loop over both nibble
+    planes. Codes go through the MXU as small integers in f32; each group's
+    scale row multiplies its partial product AFTER the dot (exact for the
+    shared per-(group, out) factor)."""
+    acc = jnp.zeros(o_ref.shape, jnp.float32)
+    for g in range(n_groups2):
+        codes = w_ref[g * group : (g + 1) * group, :].astype(jnp.int32)
+        lo = ((codes & 15) - 8).astype(jnp.float32)
+        hi = ((codes >> 4) - 8).astype(jnp.float32)
+        x_lo = x_ref[:, g * group : (g + 1) * group].astype(jnp.float32)
+        x_hi = x_ref[:, k2 + g * group : k2 + (g + 1) * group].astype(jnp.float32)
+        acc += jax.lax.dot_general(
+            x_lo, lo, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ) * s_ref[g, 0, :]
+        acc += jax.lax.dot_general(
+            x_hi, hi, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        ) * s_ref[n_groups2 + g, 0, :]
+    o_ref[:] = acc.astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("bn", "interpret"))
+def quant_matmul(
+    x: jax.Array,  # (..., K) activations (decode-sized leading dims)
+    packed: jax.Array,  # (Kp/2, N) uint8 midpoint-split codes
+    scale: jax.Array,  # (Kp/G, N) f32 per-(group, out) dequant factors
+    *,
+    bn: int = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Fused-dequant ``x @ dequant(packed, scale)`` -> (..., N).
+
+    The codes stream HBM->VMEM at 0.5 byte/param (+ ~1.6% scales) — the
+    bandwidth the decode roofline actually pays. ``bn`` defaults through the
+    tuning table (KERN704, kernel ``quant_matmul``)."""
+    k = x.shape[-1]
+    k2, n = packed.shape
+    kp = 2 * k2
+    n_g = scale.shape[0]
+    if scale.shape != (n_g, n):
+        raise ValueError(f"scale {scale.shape} does not match weight (*, {n})")
+    if kp % n_g or (kp // n_g) % 2 or k2 % (kp // n_g):
+        raise ValueError(
+            f"packed K {kp} is not an even multiple of the group count {n_g}"
+        )
+    group = kp // n_g
+    if n % 128:
+        raise ValueError(f"output width {n} must be lane-aligned (128)")
+    if bn is None:
+        bn = tile_default(
+            "quant_matmul", f"k{kp}_n{n}", x.dtype, "bn", 256
+        )
+    bn = min(bn, n)
+    while n % bn:
+        bn //= 2
+    lead = x.shape[:-1]
+    rows = 1
+    for d in lead:
+        rows *= d
+    x2 = x.reshape(rows, k)
+    if kp != k:
+        x2 = jnp.pad(x2, [(0, 0), (0, kp - k)])
+
+    kernel = functools.partial(
+        _qmm_kernel, n_groups2=k2 // group, group=group, k2=k2
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(n // bn,),
+        in_specs=[
+            pl.BlockSpec((rows, kp), lambda j: (0, 0)),
+            pl.BlockSpec((k2, bn), lambda j: (0, j)),
+            pl.BlockSpec((n_g, 1, bn), lambda j: (0, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((rows, bn), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((rows, n), x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(x2, packed, scale.reshape(n_g, 1, n))
+    return out.reshape(*lead, n)

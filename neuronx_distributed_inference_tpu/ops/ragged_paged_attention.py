@@ -69,19 +69,12 @@ RAGGED_Q_TILE = 16
 
 
 def _use_ragged_kernel(spec, total_q: int) -> bool:
-    """Kernel/native gate for the ragged mixed-step attention: lane-aligned
-    head_dim and tile-aligned packing; auto-on for TPU single-shard meshes,
-    tri-state force via ``use_flash_kernel`` like the other attention
-    kernels (pallas custom calls carry no GSPMD partitioning rule)."""
-    if (
-        spec.use_flash_kernel is False
-        or spec.head_dim % 64 != 0
-        or total_q % RAGGED_Q_TILE != 0
-    ):
-        return False
-    if spec.use_flash_kernel:
-        return True
-    return spec.model_parallel == 1 and jax.default_backend() == "tpu"
+    """Kernel/native gate for the ragged mixed-step attention — consolidated
+    in ops/kernel_mode.py. NO single-shard condition: tp>1 meshes dispatch
+    the kernel per-shard via shard_map (see :func:`ragged_attention`)."""
+    from neuronx_distributed_inference_tpu.ops.kernel_mode import use_ragged
+
+    return use_ragged(spec, total_q, RAGGED_Q_TILE)
 
 
 def _ragged_kernel(
@@ -296,6 +289,64 @@ def ragged_paged_attention(
     return out
 
 
+def _dispatch_ragged_kernel(
+    q3, k_l, v_l, block_table, row_start, row_len, ctx_len,
+    *, scale, n_rep, k_scale, v_scale, interpret,
+):
+    """Launch the ragged kernel, per-shard over the model-parallel axes when
+    the ambient mesh has any (ISSUE 17 tentpole a).
+
+    The kernel grid is already head-parallel: q's head axis and the paged
+    cache's kv-head axis are sharded over the model group (the same
+    ``sharding.TENSOR`` axes the projection weights use), the descriptors
+    (block table, row start/len, context lengths) are replicated host
+    metadata, and GQA replication (parallel/sharding.GQASharding) guarantees
+    both head counts divide the degree — so ``shard_map`` runs the identical
+    per-head math on each shard with NO cross-shard collectives inside, and
+    the tp>1 stream stays byte-identical to tp=1 and to the native fallback
+    (pinned in tests/test_ragged_tp.py)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from neuronx_distributed_inference_tpu.parallel.mesh import (
+        ALL_AXES,
+        ambient_mesh,
+    )
+
+    mesh = ambient_mesh()
+    axes = tuple(a for a in ALL_AXES if mesh is not None and a in mesh.shape)
+    degree = 1
+    for a in axes:
+        degree *= mesh.shape[a]
+    if degree == 1:
+        return ragged_paged_attention(
+            q3, k_l, v_l, block_table, row_start, row_len, ctx_len,
+            scale=scale, n_rep=n_rep, k_scale=k_scale, v_scale=v_scale,
+            interpret=interpret,
+        )
+
+    head = P(None, axes, None)
+    args = [q3, k_l, v_l, block_table, row_start, row_len, ctx_len]
+    in_specs = [head, P(None, axes, None, None), P(None, axes, None, None),
+                P(), P(), P(), P()]
+    if k_scale is not None:
+        args += [k_scale, v_scale]
+        in_specs += [P(axes), P(axes)]
+
+    def per_shard(q_s, k_s, v_s, bt, rs, rl, cl, *scales):
+        ks_s, vs_s = scales if scales else (None, None)
+        return ragged_paged_attention(
+            q_s, k_s, v_s, bt, rs, rl, cl,
+            scale=scale, n_rep=n_rep, k_scale=ks_s, v_scale=vs_s,
+            interpret=interpret,
+        )
+
+    return shard_map(
+        per_shard, mesh=mesh, in_specs=tuple(in_specs), out_specs=head,
+        check_rep=False,
+    )(*args)
+
+
 def ragged_attention_native(
     q: jax.Array,  # (T, Hq, D)
     k_cache,  # full stacked paged cache (L, NB+1, Hkv, bs, D) or QuantizedKV
@@ -377,7 +428,7 @@ def ragged_attention(
             k_arr, v_arr = k_cache, v_cache
         k_l = jax.lax.dynamic_index_in_dim(k_arr, layer_idx, axis=0, keepdims=False)
         v_l = jax.lax.dynamic_index_in_dim(v_arr, layer_idx, axis=0, keepdims=False)
-        out = ragged_paged_attention(
+        out = _dispatch_ragged_kernel(
             q3, k_l, v_l, block_table, row_start, row_len, ctx_len,
             scale=aspec.softmax_scale,
             n_rep=aspec.num_heads // aspec.num_kv_heads,

@@ -191,6 +191,18 @@ def sharding_str(sharding) -> str:
     return pspec_str(spec)
 
 
+def ambient_mesh() -> Optional[Mesh]:
+    """The physical mesh of the enclosing ``jax.set_mesh`` / ``with mesh:``
+    scope, or None outside one. Readable at TRACE time from inside jit —
+    how the ragged mixed-step dispatch finds the mesh to ``shard_map`` the
+    kernel over without threading it through model code
+    (ops/ragged_paged_attention.ragged_attention)."""
+    from jax._src import mesh as _mesh_lib
+
+    m = _mesh_lib.thread_resources.env.physical_mesh
+    return None if m.empty else m
+
+
 def mesh_axis_sizes(mesh: Mesh) -> dict:
     """Machine-readable ``{axis: size}`` declaration of a mesh — recorded in
     the shard-audit census so a baseline diff shows WHICH axis layout the

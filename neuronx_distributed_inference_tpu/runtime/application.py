@@ -241,7 +241,9 @@ class TpuModelForCausalLM:
             if random_weights:
                 # quantize-at-load: generate on host so the full-precision
                 # model never stages in HBM (int8 8B on a 16G chip)
-                params = self.builder.random_params(on_host=tc.quantized)
+                params = self.builder.random_params(
+                    on_host=tc.quantized or tc.weight_int4
+                )
             else:
                 sd = state_dict if state_dict is not None else load_state_dict(
                     model_path or self.model_path
@@ -252,6 +254,16 @@ class TpuModelForCausalLM:
                 params, pspecs = prepare_quantized_params(params, pspecs, tc)
                 if tc.quantized_checkpoints_path and not random_weights:
                     save_quantized_checkpoint(params, tc.quantized_checkpoints_path, tc)
+            elif tc.weight_int4:
+                # weight_dtype=int4: pack grouped sub-byte codes at load
+                # (mxfp4 checkpoints land here too — gpt-oss experts dequant
+                # to fp32 in convert_hf_state_dict, then regroup to int4, so
+                # they stream at 0.5 byte/param like everything else)
+                from neuronx_distributed_inference_tpu.ops.quant import (
+                    prepare_int4_params,
+                )
+
+                params, pspecs = prepare_int4_params(params, pspecs, tc)
         self._pspecs = pspecs
         self.params = shard_pytree(params, pspecs, self.mesh)
         self._random_weights = bool(random_weights)

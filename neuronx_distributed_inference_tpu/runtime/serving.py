@@ -35,7 +35,6 @@ from __future__ import annotations
 
 import json
 import time
-import warnings
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
@@ -302,20 +301,10 @@ class ServingSession:
                 )
                 self._bt_matrix = np.zeros((self.num_slots, mb_max), np.int32)
                 self._bt_count = np.zeros(self.num_slots, np.int64)
-            aspec = app.spec.attn
-            if aspec.model_parallel > 1 and not aspec.use_flash_kernel:
-                # pallas custom calls carry no GSPMD partitioning rule, so
-                # the ragged kernel is single-model-parallel-shard only: on
-                # a tp>1 mesh every mixed step runs the native gather
-                # fallback, which materializes per-token KV views — loudly
-                # flag the degraded path the operator probably didn't want
-                warnings.warn(
-                    "serving_ragged on a model_parallel>1 mesh dispatches "
-                    "the NATIVE ragged fallback (the Pallas ragged kernel "
-                    "requires a single model-parallel shard) — correct but "
-                    "slow; see docs/SERVING.md",
-                    stacklevel=2,
-                )
+            # tp>1 meshes are first-class on the ragged path since ISSUE 17:
+            # the mixed step shard_maps the Pallas kernel over the
+            # head-parallel grid axis, so no warning/fallback here — see
+            # docs/SERVING.md "Sharded meshes"
         self.tel.pool_gauges(0, self.kv_pool_bytes, self.kv_free_bytes)
 
     @property

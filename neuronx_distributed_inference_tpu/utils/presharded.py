@@ -65,6 +65,11 @@ def config_fingerprint(
         # serve each other's weights from a shared compiled dir
         "model_path": model_path,
     }
+    # grouped-int4 packed params are a different artifact than bf16/int8
+    # (ISSUE 17); only added when non-default so existing artifacts stay valid
+    wd = getattr(tc, "weight_dtype", "bfloat16")
+    if wd not in ("bfloat16", "int8"):
+        fields["weight_dtype"] = wd
     if random_weights:
         fields["random_weights"] = True
     return repr(sorted(fields.items()))

@@ -95,6 +95,45 @@ def sweep_tkg_tiles(bucket=512, dtype="bfloat16", B=1, n=20):
     return rows
 
 
+def sweep_quant_matmul_tiles(shape_class="k2048_n8192", B=8, n=20,
+                             interpret=False):
+    """Standalone int4 fused-dequant matmul timing across the LEGAL output
+    tiles (``bn``) at a committed registry shape (ISSUE 17). Same contract
+    as :func:`sweep_tkg_tiles`: candidates come from the kernel audit's
+    ``legal_tiles`` so only gate-acceptable tilings are measured, and a
+    hardware winner is what gets promoted into
+    ``analysis/tuning_table.json`` (provenance ``measured``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from neuronx_distributed_inference_tpu.analysis.kernel_audit import legal_tiles
+    from neuronx_distributed_inference_tpu.ops.quant_matmul import (
+        quant_matmul,
+        quantize_tensor_int4,
+    )
+
+    K, N = (int(p[1:]) for p in shape_class.split("_"))
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, K), jnp.bfloat16)
+    packed = quantize_tensor_int4(rng.randn(K, N).astype(np.float32))
+    w = jnp.asarray(packed["weight"])
+    s = jnp.asarray(packed["scale"])
+    rows = {}
+    for tiles in legal_tiles("quant_matmul", shape_class, "bfloat16"):
+        bn = tiles["bn"]
+        try:
+            out = quant_matmul(x, w, s, bn=bn, interpret=interpret)
+            jax.device_get(out[0, 0])
+            t0 = time.time()
+            for _ in range(n):
+                out = quant_matmul(x, w, s, bn=bn, interpret=interpret)
+            jax.device_get(out[0, 0])
+            rows[f"bn{bn}"] = {"us": round((time.time() - t0) / n * 1e6, 1)}
+        except Exception as e:  # a tiling the backend rejects
+            rows[f"bn{bn}"] = {"error": str(e)[:80]}
+    return rows
+
+
 def run(tiny=False):
     import bench
 
@@ -136,6 +175,9 @@ def run(tiny=False):
     if not tiny:
         # kernel-level kv-tile sweep over the gate-legal candidates only
         out["tkg_tile_sweep_kv512"] = sweep_tkg_tiles(bucket=512)
+        # int4 quant-matmul output-tile sweep at the committed 1B decode
+        # shape (ISSUE 17) — same legal_tiles-sourced candidate contract
+        out["quant_matmul_tile_sweep_1b"] = sweep_quant_matmul_tiles()
     return out
 
 

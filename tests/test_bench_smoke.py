@@ -26,15 +26,17 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 ALL_POINTS = {
     "bf16_1b_bs1", "bf16_1b_bs4", "int8_1b_bs1", "serving_1b_int8",
     "serving_1b_int8_ragged", "serving_1b_int8_ragged_async",
+    "serving_1b_int4_ragged",
     "serving_1b_int8_spec_ragged", "serving_1b_int8_router",
     "serving_1b_int8_router_threaded", "serving_1b_int8_disagg",
     "serving_1b_int8_goodput", "serving_1b_int8_goodput_burst",
     "serving_1b_int8_goodput_chaos", "serving_1b_int8_disagg_chaos",
-    "int8_8b_bs1",
+    "int8_8b_bs1", "bf16_8b_int4",
     "bf16_1b_8k", "bf16_1b_8k_kvq8", "bf16_1b_16k", "bf16_1b_16k_kvq8",
 }
 SERVING_POINTS = {
     "serving_1b_int8", "serving_1b_int8_ragged", "serving_1b_int8_ragged_async",
+    "serving_1b_int4_ragged",
     "serving_1b_int8_spec_ragged", "serving_1b_int8_router",
     "serving_1b_int8_router_threaded", "serving_1b_int8_disagg",
     "serving_1b_int8_goodput", "serving_1b_int8_goodput_burst",
@@ -183,6 +185,18 @@ def test_bench_suite_tiny(monkeypatch):
     assert final["ragged_padded_frac"] is not None
     assert final["ragged_async_tok_s"] > 0
     assert final["ragged_async_itl_p50_ms"] is not None
+    # ISSUE 17: the grouped-int4 weight-streaming rows — the 8B decode row
+    # (packed weights stream ~0.53 byte/param through quant.linear) and the
+    # int4 ragged serving row, each with its own presharded artifact key and
+    # a projection riding the device model's int4 itemsize
+    assert final["w4_tok_s"] > 0 and final["w4_ttft_ms"] > 0
+    assert final["w4_projected_tok_s"] > 0
+    assert final["w4_serving_tok_s"] > 0
+    assert final["w4_serving_projected_tok_s"] > 0
+    assert final["w4_serving_itl_p50_ms"] is not None
+    # int4 streams fewer weight bytes than int8, so the projected ceiling
+    # at the same 8B shape must be strictly higher
+    assert final["w4_projected_tok_s"] > points["int8_8b_bs1"]["projected_tok_s"]
     assert final["serving_host_frac"] is not None
     assert 0.0 < final["serving_host_frac"] <= 1.0
     # ISSUE 7 satellite: containment census rides the serving rows — clean

@@ -1,0 +1,180 @@
+"""Consolidated kernel dispatch gates (ISSUE 17 small fix): one tested
+predicate per kernel in ops/kernel_mode.py.
+
+Every kernel/native auto-gate lives in ONE module with a shared tri-state
+convention (None = auto, True = force with shape guards + a warning on
+fallback, False = off). These tests pin each predicate in isolation so a
+change to one kernel's auto condition cannot silently flip another's — in
+particular, the ISSUE 17 ragged-gate change (sharded meshes now allowed)
+must NOT loosen the single-shard requirement on the flash / paged / TKG /
+MoE gates, whose pallas_calls still carry no GSPMD partitioning rule.
+
+The suite runs on the CPU harness, so ``on_tpu()`` is False throughout:
+auto paths that require TPU are asserted off here and force-enabled paths
+(the shape-guard logic) carry the rest.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from neuronx_distributed_inference_tpu.modules.attention import AttnSpec
+from neuronx_distributed_inference_tpu.modules.moe import MoESpec
+from neuronx_distributed_inference_tpu.ops import kernel_mode as km
+
+
+def _spec(**kw):
+    return AttnSpec(num_heads=8, num_kv_heads=2, head_dim=64, **kw)
+
+
+def test_on_tpu_and_single_shard():
+    assert km.on_tpu() is False  # the CPU harness
+    assert km.single_shard(_spec())
+    assert not km.single_shard(_spec(model_parallel=2))
+
+
+def test_flash_shape_ok():
+    assert km.flash_shape_ok(_spec(), 128)
+    assert not km.flash_shape_ok(_spec(), 127)  # not 128-tiled
+    assert not km.flash_shape_ok(_spec(), 64)  # below one tile
+    assert not km.flash_shape_ok(
+        AttnSpec(num_heads=8, num_kv_heads=2, head_dim=80), 128
+    )  # head_dim not lane-aligned
+
+
+def test_use_flash_tristate():
+    # auto requires TPU: off on this host even for a legal shape
+    assert not km.use_flash(_spec(), 128)
+    # force honors the shape guards (warns on fallback)
+    assert km.use_flash(_spec(use_flash_kernel=True), 128)
+    assert not km.use_flash(_spec(use_flash_kernel=True), 100)
+    assert not km.use_flash(_spec(use_flash_kernel=False), 128)
+    # force-enable ignores the single-shard auto condition deliberately
+    assert km.use_flash(_spec(use_flash_kernel=True, model_parallel=2), 128)
+
+
+def test_use_packed_pairs_small_heads():
+    assert km.use_packed(_spec())  # D=64: auto-on
+    assert not km.use_packed(
+        AttnSpec(num_heads=8, num_kv_heads=2, head_dim=128)
+    )  # full-lane heads don't pack
+    assert not km.use_packed(
+        AttnSpec(num_heads=1, num_kv_heads=1, head_dim=64)
+    )  # nothing to pair
+    assert not km.use_packed(_spec(use_packed_heads=False))
+
+
+def test_use_tkg_shape_guards_and_auto():
+    forced = _spec(use_tkg_kernel=True)
+    assert km.use_tkg(forced, q_len=1, kv_width=512)
+    assert km.use_tkg(forced, q_len=1, kv_width=128)  # force: short kv ok
+    assert not km.use_tkg(forced, q_len=32, kv_width=512)  # not decode-sized
+    assert not km.use_tkg(forced, q_len=1, kv_width=96)  # unaligned kv
+    assert not km.use_tkg(_spec(use_tkg_kernel=False), 1, 512)
+    # auto requires TPU + kv_width >= 512 + single shard
+    assert not km.use_tkg(_spec(), 1, 512)
+    odd_d = AttnSpec(
+        num_heads=8, num_kv_heads=2, head_dim=80, use_tkg_kernel=True
+    )
+    assert not km.use_tkg(odd_d, 1, 512)
+
+
+def test_use_paged_flash_prefill_only():
+    forced = _spec(use_flash_kernel=True)
+    assert km.use_paged_flash(forced, q_len=64)
+    assert km.use_paged_flash(forced, q_len=8)  # force: small chunks ok
+    assert not km.use_paged_flash(forced, q_len=4)  # decode-sized: TKG's job
+    assert not km.use_paged_flash(_spec(use_flash_kernel=False), 64)
+    assert not km.use_paged_flash(_spec(), 64)  # auto requires TPU
+
+
+def _moe_spec(**kw):
+    return MoESpec(num_experts=4, top_k=2, **kw)
+
+
+def _plain_params():
+    w = {"weight": np.ones((4, 8, 16))}
+    return {"gate_proj": dict(w), "up_proj": dict(w), "down_proj": dict(w)}
+
+
+def test_use_moe_tkg_force_only_with_structural_guards():
+    params = _plain_params()
+    assert not km.use_moe_tkg(_moe_spec(), params, 4)  # auto stays OFF
+    assert km.use_moe_tkg(_moe_spec(moe_fused_kernel=True), params, 4)
+    # quantized/biased/int4 experts are structurally excluded
+    q = _plain_params()
+    q["up_proj"]["scale"] = np.ones((4, 16))
+    assert not km.use_moe_tkg(_moe_spec(moe_fused_kernel=True), q, 4)
+    assert not km.use_moe_tkg(
+        _moe_spec(moe_fused_kernel=True), params, 64
+    )  # T*k > 64
+    assert not km.use_moe_tkg(
+        _moe_spec(moe_fused_kernel=True, model_parallel=2), params, 4
+    )
+
+
+def test_use_ragged_allows_sharded_meshes():
+    """The ISSUE 17 gate change: NO single-shard condition — the dispatch
+    shard_maps over the head axis — but head counts must divide the
+    model-parallel degree so a hand-built spec degrades to native."""
+    forced = _spec(use_flash_kernel=True)
+    assert km.use_ragged(forced, total_q=64)
+    assert km.use_ragged(forced, total_q=64) and km.use_ragged(
+        _spec(use_flash_kernel=True, model_parallel=2), 64
+    )
+    assert not km.use_ragged(_spec(use_flash_kernel=True, model_parallel=3), 64)
+    assert not km.use_ragged(forced, total_q=65)  # not q-tile aligned
+    assert not km.use_ragged(_spec(use_flash_kernel=False), 64)
+    assert not km.use_ragged(_spec(), 64)  # auto requires TPU
+
+
+def test_kernel_interpret_and_force_compiled():
+    assert km.kernel_interpret()  # CPU host: interpret
+    with km.force_compiled_kernels():
+        assert not km.kernel_interpret()
+    assert km.kernel_interpret()
+
+
+# ---------------------------------------------------------------------------
+# int4 quant matmul gate (ISSUE 17 tentpole b)
+# ---------------------------------------------------------------------------
+
+
+def test_use_quant_matmul_mode_stack():
+    # auto requires TPU
+    assert not km.use_quant_matmul(8, 512, 512)
+    with km.quant_matmul_mode(True):
+        assert km.use_quant_matmul(8, 512, 512)
+        with km.quant_matmul_mode(False):  # inner override wins
+            assert not km.use_quant_matmul(8, 512, 512)
+        assert km.use_quant_matmul(8, 512, 512)
+    assert not km.use_quant_matmul(8, 512, 512)
+    with pytest.raises(ValueError):
+        km.set_quant_matmul_mode("yes")
+    with pytest.raises(ValueError):
+        with km.quant_matmul_mode("on"):
+            pass
+
+
+def test_use_quant_matmul_shape_guards():
+    with km.quant_matmul_mode(True):
+        assert km.use_quant_matmul(64, 512, 512)
+        assert not km.use_quant_matmul(65, 512, 512)  # not decode-sized
+        assert not km.use_quant_matmul(8, 512, 500)  # n not lane-aligned
+        assert not km.use_quant_matmul(8, 128, 512)  # k < one double-group
+        assert km.use_quant_matmul(8, 128, 512, group=64)
+
+
+def test_use_quant_matmul_refuses_model_sharded_mesh():
+    """pallas_call has no GSPMD rule: under any model-sharded ambient mesh
+    (tp/ep/cp/dp axes > 1) even the FORCED mode falls back to the native
+    GSPMD-shardable int4 path."""
+    from neuronx_distributed_inference_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(tp_degree=2)
+    with km.quant_matmul_mode(True):
+        assert km.use_quant_matmul(8, 512, 512)
+        with mesh:
+            assert not km.use_quant_matmul(8, 512, 512)
+        assert km.use_quant_matmul(8, 512, 512)

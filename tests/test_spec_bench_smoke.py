@@ -51,3 +51,16 @@ def test_decode_scaling_tiny():
     assert [r["bs"] for r in res["rows"]] == [1, 2, 4, 8]
     for r in res["rows"]:
         assert r["xla_tok_s"] > 0 and r["fused_blocks_tok_s"] > 0
+
+
+@pytest.mark.slow
+def test_quant_matmul_tile_sweep():
+    """The int4 quant-matmul bn sweep (ISSUE 17) measures every gate-legal
+    candidate from legal_tiles at the committed 1B shape — interpret mode
+    on CPU, the identical code path hardware runs compiled."""
+    import decode_scaling
+
+    sweep = decode_scaling.sweep_quant_matmul_tiles(n=1, interpret=True)
+    assert set(sweep) == {"bn128", "bn256", "bn512"}
+    for bn, row in sweep.items():
+        assert row.get("us", 0) > 0, (bn, row)

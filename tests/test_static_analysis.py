@@ -364,7 +364,8 @@ def test_graph_audit_clean_and_covers_tags():
     # coverage floor: the audited tag set is the acceptance-criteria set
     # (+ the quantized-cache program set, ISSUE 3; + the ragged mixed-step
     # serving family, ISSUE 6; + the fused-speculation int8 variant,
-    # ISSUE 11 — the spec-decode path the cost model covers)
+    # ISSUE 11 — the spec-decode path the cost model covers; + the int4
+    # weight-streaming decode/mixed programs, ISSUE 17)
     assert set(graph_audit.AUDIT_TAGS) == {
         "context_encoding",
         "token_generation",
@@ -374,6 +375,8 @@ def test_graph_audit_clean_and_covers_tags():
         "fused_speculation_kvq8",
         "mixed_step",
         "mixed_step_spec",
+        "token_generation_w4",
+        "mixed_step_w4",
     }
     baseline = graph_audit.load_census_baseline()
     assert set(baseline) == set(graph_audit.AUDIT_TAGS)
@@ -739,6 +742,8 @@ def test_shard_audit_clean_and_covers_committed_tags():
         "fused_speculation_kvq8",
         "mixed_step",
         "mixed_step_spec",
+        "token_generation_w4",
+        "mixed_step_w4",
     }
     records = programs.collect_programs(shard_audit.SHARD_AUDIT_TAGS)
     for tag, per_bucket in records.items():
@@ -887,6 +892,19 @@ def test_graph303_detects_in_loop_weight_gather():
     assert all(f.rule == "GRAPH303" for f in findings)
     assert "INSIDE the step's loop body" in findings[0].message
     assert shard_audit.in_loop_gather_findings(good, threshold, "toy/64", "toy") == []
+    # weight-signature discrimination: the gathered buffer matches the
+    # per-layer weight shape, so a sig set containing it still flags; a
+    # sig set that doesn't (the gather is then activation-shaped by
+    # elimination) suppresses — output-only int4 sharding legitimately
+    # re-gathers decode activations every step and must not trip GRAPH303
+    sig = ("f32", (256, 256))
+    flagged = shard_audit.in_loop_gather_findings(
+        bad, threshold, "toy/64", "toy", weight_sigs={sig}
+    )
+    assert len(flagged) >= 1
+    assert shard_audit.in_loop_gather_findings(
+        bad, threshold, "toy/64", "toy", weight_sigs={("f32", (31, 17))}
+    ) == []
 
 
 def test_graph304_detects_census_drift(tmp_path):
@@ -934,6 +952,8 @@ def test_memory_audit_clean_and_covers_cache_variants():
         "mixed_step_spec",
         "token_generation_ring",
         "token_generation_paged",
+        "token_generation_w4",
+        "mixed_step_w4",
     }
     records = programs.collect_programs(memory_audit.MEMORY_AUDIT_TAGS)
     # the quantized contiguous/ring/paged programs all donate code AND scale
@@ -1294,8 +1314,8 @@ def test_device_model_projections():
             exp_batch = p["batch"]
         assert row["batch"] == exp_batch, name
         assert row["kv_width"] == exp_kv, name
-        assert row["weight_dtype"] == (
-            "int8" if p["quantized"] else "bfloat16"
+        assert row["weight_dtype"] == (p.get("extra_tpu") or {}).get(
+            "weight_dtype", "int8" if p["quantized"] else "bfloat16"
         ), name
         assert row["kv_dtype"] == (p.get("extra_tpu") or {}).get(
             "kv_cache_dtype", "bfloat16"

@@ -481,6 +481,45 @@ def test_lower_ragged_paged_attention_quantized():
     )
 
 
+# ---------------------------------------------------------------------------
+# int4 fused-dequant weight-streaming matmul (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("bn", [128, 256, 512])
+@pytest.mark.parametrize("K,N", [(2048, 8192), (4096, 14336)])
+def test_lower_quant_matmul_bench_shapes(K, N, bn):
+    """quant_matmul lowers for the TPU target at the committed registry
+    shapes — the 1B MLP up/gate (k2048_n8192) and the 8B (k4096_n14336) —
+    across every gate-legal output tile ``bn`` from the kernel audit."""
+    from neuronx_distributed_inference_tpu.ops.quant_matmul import (
+        INT4_GROUP,
+        quant_matmul,
+    )
+
+    x = sds((8, K), jnp.bfloat16)
+    w = sds((K // 2, N), jnp.uint8)
+    s = sds((K // INT4_GROUP, N), jnp.float32)
+    fn = functools.partial(quant_matmul, bn=bn, interpret=False)
+    lower_tpu(lambda x, w, s: fn(x, w, s), x, w, s)
+
+
+def test_lower_quant_matmul_single_row():
+    # bs=1 decode: a single activation row still occupies one (8, 128) f32
+    # sublane tile — the shape the int4_8b_bs1 bench point streams
+    from neuronx_distributed_inference_tpu.ops.quant_matmul import (
+        INT4_GROUP,
+        quant_matmul,
+    )
+
+    K, N = 2048, 8192
+    x = sds((1, K), jnp.bfloat16)
+    w = sds((K // 2, N), jnp.uint8)
+    s = sds((K // INT4_GROUP, N), jnp.float32)
+    fn = functools.partial(quant_matmul, interpret=False)
+    lower_tpu(lambda x, w, s: fn(x, w, s), x, w, s)
+
+
 def test_lower_paged_flash_quantized():
     # int8 paged cache through the chunked-prefill kernel (the dequant
     # scaling folds into q and the epilogue — must not break lowering)
