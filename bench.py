@@ -757,6 +757,7 @@ def measure_goodput(apps, *, workload, chaos_kill_step=None,
     from neuronx_distributed_inference_tpu.runtime.router import ServingRouter
     from neuronx_distributed_inference_tpu.runtime.serving import ServingSession
     from neuronx_distributed_inference_tpu.telemetry import (
+        SloMonitor,
         TelemetrySession,
         default_registry,
     )
@@ -790,6 +791,10 @@ def measure_goodput(apps, *, workload, chaos_kill_step=None,
             tier.append(PrefillReplicaHandle(papp, i))
         vc = VirtualClock()
         with TelemetrySession(registry=registry, clock=vc.now) as tel:
+            # live windowed SLO attainment / burn rate rides every goodput
+            # run (ISSUE 19) — the nxdi_slo_burn_rate gauges land in the
+            # --metrics-out dump beside the offline scorer's numbers
+            tel.attach_slo_monitor(SloMonitor())
             sessions = [
                 ServingSession(app, telemetry=tel, clock=vc.now)
                 for app in apps
@@ -821,6 +826,12 @@ def measure_goodput(apps, *, workload, chaos_kill_step=None,
                 result = drv.run()
             total_s = time.time() - t_start
             report = score(result, tel, bucket_steps=bucket_steps)
+            trace_out = _trace_out_path()
+            if trace_out and registry is not None:
+                # measured pass only (the warmup pass would overwrite the
+                # real timeline with compile-dominated spans)
+                tel.export_chrome_trace(trace_out)
+                print(f"chrome trace -> {trace_out}", file=sys.stderr)
         return result, report, total_s
 
     run_once()  # warmup / compile pass over every program the trace touches
@@ -1661,6 +1672,18 @@ def run_suite(tiny=False, emit=None):
         if emit:
             emit(points)
     return points
+
+
+def _trace_out_path():
+    """--trace-out PATH: Chrome trace-event JSON (Perfetto-loadable) of the
+    goodput rows' span timeline, written by the measured pass of each
+    ``measure_goodput`` call in THIS process (pass it to a --point
+    invocation of a goodput row; docs/OBSERVABILITY.md walks the file)."""
+    if "--trace-out" in sys.argv:
+        i = sys.argv.index("--trace-out")
+        if i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+    return None
 
 
 def _metrics_out_path():

@@ -109,6 +109,14 @@ SCOPE_SUFFIXES = (
     # and decode apps' caches — their write sites join the census so a
     # future worker-reachable hand-off cannot slip in unclassified
     "runtime/disaggregated.py",
+    # the observability layer (ISSUE 19): the span store and SLO monitor
+    # are written from replica workers (via TelemetrySession record hooks)
+    # AND read by the ops-server scrape thread, so both join the census as
+    # SHARED; the ops server itself adds a third thread kind to the model
+    # (its handler threads, entered at do_GET)
+    "telemetry/spans.py",
+    "telemetry/slo_monitor.py",
+    "telemetry/ops_server.py",
 )
 
 # ---------------------------------------------------------------------------
@@ -141,6 +149,11 @@ REPLICA_OWNED = frozenset({
 ROUTER_OWNED = frozenset({
     "ServingRouter", "RouterRequest",
     "WorkloadDriver", "VirtualClock", "WorkloadResult",
+    # the ops server's lifecycle state (thread handle, bound port) is
+    # written only by whoever starts/stops it — the router/driver thread;
+    # its handler threads read the registry/snapshot callbacks but never
+    # write OpsServer attributes (CONC601 keeps it so)
+    "OpsServer",
 })
 
 #: state shared ACROSS replicas: every worker thread records into one
@@ -149,6 +162,10 @@ ROUTER_OWNED = frozenset({
 SHARED = frozenset({
     "TelemetrySession", "MetricsRegistry", "_Family",
     "Counter", "Gauge", "Histogram",
+    # ISSUE 19: span timelines + SLO windows are recorded from worker
+    # threads through the session's record hooks and scraped by the ops
+    # server's handler threads — every mutation must hold their own lock
+    "SpanStore", "SloMonitor",
 })
 
 #: the worker thread entry points — the ONLY code the thread-per-replica
@@ -157,6 +174,9 @@ SHARED = frozenset({
 WORKER_ENTRIES = (
     ("ReplicaHandle", "step"),
     ("_ReplicaStepWorker", "run"),
+    # the ops server's per-connection handler threads (ThreadingHTTPServer)
+    # — everything a scrape can reach must carry worker discipline
+    ("_OpsHandler", "do_GET"),
 )
 
 # ---------------------------------------------------------------------------
@@ -178,6 +198,8 @@ ATTR_TYPES = {
     ("WorkloadDriver", "clock"): "VirtualClock",
     ("*", "prefill_app"): "TpuApplication",
     ("*", "decode_app"): "TpuApplication",
+    ("*", "spans"): "SpanStore",
+    ("*", "slo_monitor"): "SloMonitor",
 }
 
 #: (owner class or "*", container attribute) -> element/value class
@@ -217,6 +239,7 @@ VAR_NAME_HINTS = {
     "w": "_ReplicaStepWorker",
     "app": "TpuApplication", "draft_app": "TpuApplication",
     "drv": "WorkloadDriver", "vc": "VirtualClock",
+    "mon": "SloMonitor", "store": "SpanStore",
     "ph": "PrefillReplicaHandle",
     "pre": "TpuApplication", "dec": "TpuApplication",
     "pipe": "DisaggregatedPipeline",
@@ -238,6 +261,10 @@ LOCK_LEVELS = {
     "PrefillReplicaHandle": 1, "DisaggregatedPipeline": 1,
     "_HealthStateMachine": 1,
     "TelemetrySession": 2,
+    # the span store and SLO monitor sit BELOW the session: record hooks
+    # take the session lock then the store/monitor lock, never the reverse
+    # (export snapshots under the session lock copy, serialize outside)
+    "SpanStore": 3, "SloMonitor": 3, "OpsServer": 2,
     "MetricsRegistry": 3,
     "_Family": 4,
     "Counter": 5, "Gauge": 5, "Histogram": 5,
@@ -252,6 +279,9 @@ MODULE_LOCK_LEVELS = {
     "runtime/faults.py": 1,
     "telemetry/tracing.py": 2,
     "telemetry/__init__.py": 2,
+    "telemetry/spans.py": 3,
+    "telemetry/slo_monitor.py": 3,
+    "telemetry/ops_server.py": 2,
     "telemetry/metrics.py": 3,
 }
 

@@ -381,6 +381,11 @@ def build_parser() -> argparse.ArgumentParser:
                           "snapshot (bucket census, step counters, token "
                           "counts) to this path at exit; pretty-print with "
                           "scripts/metrics_report.py")
+    run.add_argument("--trace-out", default=None,
+                     help="enable runtime telemetry and export the run's "
+                          "span timeline as Chrome trace-event JSON to this "
+                          "path at exit (load in Perfetto / chrome://tracing; "
+                          "docs/OBSERVABILITY.md)")
     return p
 
 
@@ -707,7 +712,7 @@ def run_inference(args) -> int:
 
         enable_debug_logging()
     metrics_session = metrics_prev = None
-    if args.metrics_out:
+    if args.metrics_out or args.trace_out:
         # a RUN-scoped session over a fresh registry (not the cumulative
         # process-default): the snapshot must describe THIS invocation, not
         # whatever else the embedding process ran earlier
@@ -755,12 +760,17 @@ def run_inference(args) -> int:
             tracing as _tel_tracing,
         )
 
-        with open(args.metrics_out, "w") as f:
-            json.dump(metrics_session.registry.snapshot(), f, indent=2)
+        if args.metrics_out:
+            with open(args.metrics_out, "w") as f:
+                json.dump(metrics_session.registry.snapshot(), f, indent=2)
+            print(f"[inference_demo] metrics snapshot -> {args.metrics_out}",
+                  file=sys.stderr)
+        if args.trace_out:
+            metrics_session.export_chrome_trace(args.trace_out)
+            print(f"[inference_demo] chrome trace -> {args.trace_out}",
+                  file=sys.stderr)
         _tel_tracing.set_default_session(metrics_prev)
         metrics_session.close()
-        print(f"[inference_demo] metrics snapshot -> {args.metrics_out}",
-              file=sys.stderr)
     for i, seq in enumerate(out.sequences):
         text = tok.decode(seq, skip_special_tokens=True) if tok else seq.tolist()
         print(f"--- output {i} ---\n{text}")

@@ -328,9 +328,20 @@ class DisaggregatedPipeline:
             start_copy()
 
         # --- KV hand-off ---------------------------------------------------
+        # recorded under the same hand-off instruments the router's
+        # disaggregated tier uses (nxdi_handoff_attempts_total / _ms), so a
+        # pipeline run's hand-off cost reads off the same dashboard
+        from neuronx_distributed_inference_tpu.telemetry.tracing import (
+            default_session,
+        )
+
+        tel = default_session()
+        tel.handoff_attempt()
+        t0 = tel.clock()
         inject_request_kv(
             dec, seq_ids, extract_request_kv(pre, seq_ids, upto=S_in)
         )
+        tel.handoff_done((tel.clock() - t0) * 1e3)
         first = np.asarray(tokens_dev)[:B, -1]
 
         # --- decode stage: the monolithic application's EOS-path loop

@@ -106,6 +106,9 @@ class ReplicaHandle(_HealthStateMachine):
         to HEALTHY."""
         self.session = session
         self.replica_id = int(replica_id)
+        # stamp the replica id onto the session so its step-timing /
+        # watchdog telemetry lands on this replica's timeline track
+        session._tel_replica = self.replica_id
         self._clock = clock if clock is not None else time.monotonic
         self.dead_after_give_ups = int(dead_after_give_ups)
         self.recovery_steps = int(recovery_steps)

@@ -653,7 +653,10 @@ class ServingRouter:
                     pass
                 else:
                     ph.note_clean()
-                self.tel.handoff_done((self._clock() - t0) * 1e3)
+                self.tel.handoff_done(
+                    (self._clock() - t0) * 1e3,
+                    req_id=sid, replica=ph.replica_id,
+                )
             return res
 
     def _publish_tier_gauges(self) -> None:
@@ -704,7 +707,10 @@ class ServingRouter:
                         else "spill" if spilled
                         else "fresh"
                     )
-                    self.tel.router_placement(self.policy, reason)
+                    self.tel.router_placement(
+                        self.policy, reason,
+                        req_id=sid, replica=h.replica_id,
+                    )
                     break
                 rreq.placements -= 1  # not bound: the id was never admitted
                 if res.reason == "handoff":

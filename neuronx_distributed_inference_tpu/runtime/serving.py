@@ -270,6 +270,10 @@ class ServingSession:
         # accumulated blocking-fetch wait inside the current _ragged_step
         # (host-frac telemetry: step wall minus this is pure host time)
         self._step_fetch_wait_s = 0.0
+        # router-managed sessions carry their replica id (set by
+        # ReplicaHandle) so step-timing/watchdog records land on the
+        # replica's timeline track; standalone sessions stay None
+        self._tel_replica: Optional[int] = None
         if self.ragged:
             self.mixed_runner = getattr(app, "mixed_step_model", None)
             if self.mixed_runner is None:
@@ -757,7 +761,7 @@ class ServingSession:
             self.tel.watchdog_preempted(victim.req_id)
             self._preempt(victim)
             return
-        self.tel.watchdog_tripped(window)
+        self.tel.watchdog_tripped(window, replica=self._tel_replica)
         snap = self.diagnostic_snapshot()
         raise WatchdogError(
             f"serving session made no forward progress for {window} "
@@ -1621,7 +1625,10 @@ class ServingSession:
             return
         total_s = self.tel.clock() - t_step0
         wait_s = min(self._step_fetch_wait_s, total_s)
-        self.tel.step_timing((total_s - wait_s) * 1e3, wait_s * 1e3)
+        self.tel.step_timing(
+            (total_s - wait_s) * 1e3, wait_s * 1e3,
+            replica=self._tel_replica,
+        )
 
     def _dispatch_decode(self, rows, last_override=None):
         """Dispatch ONE batched decode pass for ``rows`` = [(req, pos), ...]
@@ -2500,7 +2507,7 @@ class SpeculativeServingSession(ServingSession):
         # choice shows up as the next rounds' observations) — the histogram
         # sum is then exactly the drafted-token total, which is what the
         # bench's measured-acceptance rate divides by
-        self.tel.spec_round(drafted, req.accept_ewma)
+        self.tel.spec_round(drafted, req.accept_ewma, req_id=req.req_id)
 
     def _dispatch_chained_draft(self, verify_tokens, snap):
         """Dispatch the draft propose for the NEXT round, chained on the

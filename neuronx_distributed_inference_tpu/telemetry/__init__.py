@@ -12,8 +12,18 @@ from neuronx_distributed_inference_tpu.telemetry.metrics import (
     Gauge,
     Histogram,
     LATENCY_MS_BUCKETS,
+    PROMETHEUS_CONTENT_TYPE,
     MetricsRegistry,
     default_registry,
+)
+from neuronx_distributed_inference_tpu.telemetry.ops_server import OpsServer
+from neuronx_distributed_inference_tpu.telemetry.slo_monitor import (
+    SloMonitor,
+    judge,
+)
+from neuronx_distributed_inference_tpu.telemetry.spans import (
+    SpanStore,
+    to_chrome_trace,
 )
 from neuronx_distributed_inference_tpu.telemetry.tracing import (
     RequestTrace,
@@ -31,12 +41,18 @@ __all__ = [
     "Gauge",
     "Histogram",
     "LATENCY_MS_BUCKETS",
+    "PROMETHEUS_CONTENT_TYPE",
     "MetricsRegistry",
+    "OpsServer",
     "RequestTrace",
+    "SloMonitor",
+    "SpanStore",
     "TelemetrySession",
     "default_registry",
     "default_session",
     "enable_default_session",
+    "judge",
     "load_events",
     "set_default_session",
+    "to_chrome_trace",
 ]

@@ -45,6 +45,10 @@ import bisect
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+#: the Content-Type the Prometheus text exposition is served under
+#: (telemetry/ops_server.py /metrics route)
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
 # default latency bounds (milliseconds): spans admission→TTFT on one chip to
 # multi-second queue waits under overload
 LATENCY_MS_BUCKETS = (

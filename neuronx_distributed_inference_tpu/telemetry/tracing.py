@@ -38,8 +38,11 @@ operable counter instead of only an assertion.
 from __future__ import annotations
 
 import json
+import os
+import re
 import threading
 import time
+import warnings
 from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
@@ -49,6 +52,30 @@ import jax
 
 from neuronx_distributed_inference_tpu.analysis import retrace_guard
 from neuronx_distributed_inference_tpu.telemetry import metrics as metrics_mod
+from neuronx_distributed_inference_tpu.telemetry import spans as spans_mod
+
+#: env override for the in-memory event ring AND the span store bound —
+#: long chaos drains must not grow either without limit (ISSUE 19)
+TELEMETRY_EVENT_MAX_ENV = "TELEMETRY_EVENT_MAX"
+
+#: session-side failover-incarnation suffix (runtime/router.py
+#: RouterRequest.session_id): ``{base}~f{N}``
+_INCARNATION_RE = re.compile(r"^(?P<base>.+)~f(?P<inc>\d+)$")
+
+
+def _default_event_max() -> int:
+    try:
+        return max(1, int(os.environ.get(TELEMETRY_EVENT_MAX_ENV, "10000")))
+    except ValueError:
+        return 10000
+
+
+def _split_incarnation(req_id: str):
+    """``base~fN`` -> (base, N); bare ids are incarnation 0."""
+    m = _INCARNATION_RE.match(req_id)
+    if m:
+        return m.group("base"), int(m.group("inc"))
+    return req_id, 0
 
 FINISH_REASONS = (
     "eos", "length", "preempted", "dropped",
@@ -116,7 +143,7 @@ class TelemetrySession:
         enabled: bool = True,
         jsonl_path: Optional[str] = None,
         clock=time.perf_counter,
-        max_events: int = 10000,
+        max_events: Optional[int] = None,
         max_completed: int = 10000,
     ):
         self.enabled = bool(enabled)
@@ -124,6 +151,8 @@ class TelemetrySession:
         self.clock = clock
         self._lock = threading.RLock()
         self.traces: Dict[str, RequestTrace] = {}
+        if max_events is None:
+            max_events = _default_event_max()  # TELEMETRY_EVENT_MAX
         # exact traces are for percentiles and tests; the fleet metrics live
         # in the (bounded) histograms — cap retention so a long-lived
         # serving process cannot grow trace memory linearly with requests
@@ -132,9 +161,30 @@ class TelemetrySession:
         self._jsonl_path = jsonl_path
         self._jsonl_file = None
         self._listener = None
+        #: the causal span timeline (ISSUE 19) — None on a disabled session
+        self.spans: Optional[spans_mod.SpanStore] = None
+        #: optional live SLO monitor (attach_slo_monitor)
+        self.slo_monitor = None
+        # span bookkeeping, all guarded by self._lock: failovers observed
+        # per base request id (incarnation / flow-id numbering), last-seen
+        # health per replica / tier member (transition instants), per-track
+        # step-span counters, and an optional base-id -> tenant map
+        self._failover_count: Dict[str, int] = {}
+        self._replica_health_seen: Dict[int, int] = {}
+        self._tier_health_seen: Dict[int, int] = {}
+        self._replica_step_count: Dict[int, int] = {}
+        self._phase_count: Dict[str, int] = {}
+        self._tenant_of: Dict[str, str] = {}
+        self._dropped_events = 0
         if not self.enabled:
             return
+        self.spans = spans_mod.SpanStore(max_spans=max_events)
         r = self.registry
+        self._tel_dropped = r.counter(
+            "nxdi_telemetry_dropped_total",
+            "oldest telemetry records evicted past the in-memory bound "
+            "(TELEMETRY_EVENT_MAX); the JSONL stream, when enabled, keeps "
+            "everything", labels=("kind",))
         self._submitted = r.counter(
             "nxdi_requests_submitted_total", "requests offered to the session")
         self._admitted = r.counter(
@@ -395,12 +445,100 @@ class TelemetrySession:
             return
         rec = {"ts": self.clock(), "type": etype, **fields}
         with self._lock:
+            if (
+                self.events.maxlen is not None
+                and len(self.events) >= self.events.maxlen
+            ):
+                # the deque would evict silently — count the drop so a
+                # bounded ring on a long drain is an observable condition
+                self._dropped_events += 1
+                self._tel_dropped.child(("events",)).inc()
             self.events.append(rec)
             if self._jsonl_file is not None:
                 # under the lock so concurrent replica threads cannot
                 # interleave half-written JSONL lines
                 self._jsonl_file.write(json.dumps(rec) + "\n")
                 self._jsonl_file.flush()
+
+    # ---- span timeline + SLO monitor plumbing (ISSUE 19) -----------------
+
+    def set_tenants(self, tenant_of: Dict[str, str]) -> None:
+        """Map base request ids to tenant names (WorkloadTrace.tenants_of)
+        so request spans land on the right ``tenant:*`` track. Without it
+        the tenant is parsed from the workload id convention
+        ``{tenant}-{NNNN}`` (non-workload ids land on tenant 'default')."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._tenant_of.update(tenant_of)
+
+    def attach_slo_monitor(self, monitor) -> "TelemetrySession":
+        """Route first-token / token / terminal records into a live
+        :class:`~.slo_monitor.SloMonitor` and bind its gauges to this
+        session's registry."""
+        if not self.enabled:
+            return self
+        monitor.bind(self.registry)
+        with self._lock:
+            self.slo_monitor = monitor
+        return self
+
+    def _tenant_track(self, base: str) -> str:
+        tenant = self._tenant_of.get(base)
+        if tenant is None:
+            tenant = base.rsplit("-", 1)[0] if "-" in base else "default"
+        return f"tenant:{tenant}"
+
+    def _req_ids(self, req_id: str):
+        """(base, incarnation, track, root span id, incarnation span id)."""
+        base, inc = _split_incarnation(req_id)
+        track = self._tenant_track(base)
+        root = f"req:{base}"
+        return base, inc, track, root, f"{root}/i{inc}"
+
+    def _close_request_spans(self, req_id: str, now: float, reason: str) -> None:
+        """Close every open span of one incarnation (phase children first),
+        then the incarnation, then the request root — the terminal record's
+        span-side mirror. Called with self._lock held."""
+        if self.spans is None:
+            return
+        base, _inc, _track, root, inode = self._req_ids(req_id)
+        for phase in ("queue", "prefill", "handoff", "decode"):
+            self.spans.end(f"{inode}/{phase}", now)
+        self.spans.end(inode, now, reason=reason)
+        self.spans.end(root, now, reason=reason)
+
+    def export_chrome_trace(self, path: Optional[str] = None) -> dict:
+        """The whole run as Chrome trace-event JSON (Perfetto-loadable):
+        one process track per tenant / replica / prefill-tier member /
+        driver, spans as complete events, kills and health transitions as
+        instants, failover continuations as flow arrows. Safe against an
+        ACTIVE drain: the span state is snapshotted under the session
+        RLock before any serialization (the ISSUE-19 bugfix — same
+        family-copy pattern as the metrics exposition), so a racing
+        replica thread cannot half-mutate what gets written."""
+        if not self.enabled or self.spans is None:
+            trace = {
+                "traceEvents": [], "displayTimeUnit": "ms",
+                "otherData": {"dropped_spans": 0},
+            }
+        else:
+            with self._lock:
+                now = self.clock()
+                spans, instants, flows = self.spans.snapshot()
+                dropped = self.spans.dropped
+            trace = spans_mod.to_chrome_trace(
+                spans, instants, flows, now=now, dropped=dropped
+            )
+        if path:
+            spans_mod.dump_chrome_trace(trace, path)
+        return trace
+
+    def span_tree(self) -> Dict[str, tuple]:
+        """Order-free comparable span tree (the determinism pin)."""
+        if self.spans is None:
+            return {}
+        return self.spans.span_tree()
 
     @contextmanager
     def span(self, name: str, **fields):
@@ -423,9 +561,31 @@ class TelemetrySession:
             return
         self._submitted.inc()
         with self._lock:
+            now = self.clock()
             self.traces[req_id] = RequestTrace(
-                req_id=req_id, t_submit=self.clock()
+                req_id=req_id, t_submit=now
             )
+            base, inc, track, root, inode = self._req_ids(req_id)
+            self.spans.begin(root, f"request {base}", track, now, lane=base)
+            self.spans.begin(
+                inode, f"incarnation {inc}", track, now,
+                parent_id=root, lane=base, incarnation=inc,
+            )
+            self.spans.begin(
+                f"{inode}/queue", "queue", track, now,
+                parent_id=inode, lane=base,
+            )
+            if inc > 0:
+                # destination endpoint of the failover arrow the matching
+                # router_failover opened (flow ids number by failover index)
+                self.spans.flow(
+                    f"flow:{base}:{inc - 1}", "f", track, now, lane=base
+                )
+            mon = self.slo_monitor
+        if mon is not None:
+            # a retry supersedes any premature non-finished verdict (the
+            # driver re-submits after a `dropped:no_slot` admission refusal)
+            mon.note_submitted(req_id)
         self.event("request_submitted", req_id=req_id)
 
     def request_admitted(self, req_id: str, cached_prefix_tokens: int = 0) -> None:
@@ -439,6 +599,11 @@ class TelemetrySession:
                 # admitted counter) — re-counting would make admitted >
                 # submitted and shift queue-wait/TTFT baselines. Only the
                 # event log records the resumption.
+                base, _inc, track, _root, _inode = self._req_ids(req_id)
+                self.spans.instant(
+                    "readmitted", track, self.clock(), lane=base,
+                    req_id=req_id,
+                )
                 self.event("request_readmitted", req_id=req_id,
                            cached_prefix_tokens=cached_prefix_tokens)
                 return
@@ -454,11 +619,16 @@ class TelemetrySession:
             return
         self._dropped.child((reason,)).inc()
         with self._lock:
+            now = self.clock()
             tr = self.traces.pop(req_id, None)
             if tr is not None:
                 tr.finish_reason = "dropped"
-                tr.t_finish = self.clock()
+                tr.t_finish = now
                 self.completed.append(tr)
+            self._close_request_spans(req_id, now, f"dropped:{reason}")
+            mon = self.slo_monitor
+        if mon is not None:
+            mon.note_finish(req_id, "dropped", now)
         self.event("request_dropped", req_id=req_id, reason=reason)
 
     def request_rejected(self, req_id: str, reason: str) -> None:
@@ -469,11 +639,16 @@ class TelemetrySession:
             return
         self._rejected.child((reason,)).inc()
         with self._lock:
+            now = self.clock()
             tr = self.traces.pop(req_id, None)
             if tr is not None:
                 tr.finish_reason = "rejected"
-                tr.t_finish = self.clock()
+                tr.t_finish = now
                 self.completed.append(tr)
+            self._close_request_spans(req_id, now, f"rejected:{reason}")
+            mon = self.slo_monitor
+        if mon is not None:
+            mon.note_finish(req_id, f"rejected:{reason}", now)
         self.event("request_rejected", req_id=req_id, reason=reason)
 
     def request_preempted(self, req_id: str) -> None:
@@ -483,6 +658,17 @@ class TelemetrySession:
         if not self.enabled:
             return
         self._preempted.inc()
+        with self._lock:
+            base, _inc, track, _root, inode = self._req_ids(req_id)
+            now = self.clock()
+            self.spans.instant(
+                "preempted", track, now, lane=base, req_id=req_id
+            )
+            # the eviction cuts the in-flight phase short — the preempted
+            # gap reads as bare incarnation time between the instant and
+            # the resumed activity
+            for phase in ("prefill", "decode"):
+                self.spans.end(f"{inode}/{phase}", now)
         self.event("request_preempted", req_id=req_id)
 
     def row_quarantined(self, req_id: str) -> None:
@@ -492,6 +678,11 @@ class TelemetrySession:
         if not self.enabled:
             return
         self._quarantined.inc()
+        with self._lock:
+            base, _inc, track, _root, _inode = self._req_ids(req_id)
+            self.spans.instant(
+                "quarantined", track, self.clock(), lane=base, req_id=req_id
+            )
         self.event("row_quarantined", req_id=req_id)
 
     def dispatch_retry(self, label: str) -> None:
@@ -516,10 +707,18 @@ class TelemetrySession:
         self._watchdog_preempt.inc()
         self.event("watchdog_preempted", req_id=req_id)
 
-    def watchdog_tripped(self, no_progress_steps: int) -> None:
+    def watchdog_tripped(
+        self, no_progress_steps: int, replica: Optional[int] = None
+    ) -> None:
         if not self.enabled:
             return
         self._watchdog_trips.inc()
+        if replica is not None:
+            with self._lock:
+                self.spans.instant(
+                    "watchdog_tripped", f"replica:{int(replica)}",
+                    self.clock(), no_progress_steps=no_progress_steps,
+                )
         self.event("watchdog_tripped", no_progress_steps=no_progress_steps)
 
     def prefill_dispatch(self, req_id: str, n_tokens: int) -> None:
@@ -536,6 +735,12 @@ class TelemetrySession:
                     tr.t_first_dispatch = self.clock()
                     self._queue_wait.observe(
                         (tr.t_first_dispatch - tr.t_submit) * 1e3
+                    )
+                    base, _inc, track, _root, inode = self._req_ids(req_id)
+                    self.spans.end(f"{inode}/queue", tr.t_first_dispatch)
+                    self.spans.begin(
+                        f"{inode}/prefill", "prefill", track,
+                        tr.t_first_dispatch, parent_id=inode, lane=base,
                     )
 
     def request_first_token(self, req_id: str) -> None:
@@ -564,6 +769,18 @@ class TelemetrySession:
                 tr.tokens += 1
                 self._ttft.observe((now - tr.t_submit) * 1e3)
                 self._chunks_per_req.observe(max(1, tr.prefill_chunks))
+            base, _inc, track, _root, inode = self._req_ids(req_id)
+            if self.spans.is_open(f"{inode}/prefill"):
+                self.spans.end(f"{inode}/prefill", now)
+            else:
+                self.spans.end(f"{inode}/queue", now)
+            self.spans.begin(
+                f"{inode}/decode", "decode", track, now,
+                parent_id=inode, lane=base,
+            )
+            mon = self.slo_monitor
+        if mon is not None:
+            mon.note_first_token(req_id, now)
         self.event("first_token", req_id=req_id)
 
     def request_tokens(self, req_id: str, n: int) -> None:
@@ -573,6 +790,7 @@ class TelemetrySession:
             return
         now = self.clock()
         self._tokens.inc(n)
+        mon = None
         with self._lock:
             tr = self.traces.get(req_id)
             if tr is not None and tr.t_last_token is not None:
@@ -582,6 +800,9 @@ class TelemetrySession:
                     tr.itl_s.append(per_tok)
                 tr.t_last_token = now
                 tr.tokens += n
+                mon = self.slo_monitor
+        if mon is not None:
+            mon.note_tokens(req_id, n, now)
 
     def tokens_generated(self, n: int) -> None:
         """Bare token count for host loops with no request identity
@@ -598,11 +819,16 @@ class TelemetrySession:
             return
         self._finished.child((reason,)).inc()
         with self._lock:
+            now = self.clock()
             tr = self.traces.pop(req_id, None)
             if tr is not None:
                 tr.finish_reason = reason
-                tr.t_finish = self.clock()
+                tr.t_finish = now
                 self.completed.append(tr)
+            self._close_request_spans(req_id, now, reason)
+            mon = self.slo_monitor
+        if mon is not None:
+            mon.note_finish(req_id, reason, now)
         self.event("request_finished", req_id=req_id, reason=reason)
 
     # ---- step-level ------------------------------------------------------
@@ -624,14 +850,21 @@ class TelemetrySession:
         self._kv_pool.set(kv_pool_bytes)
         self._kv_free.set(kv_free_bytes)
 
-    def step_timing(self, host_ms: float, fetch_wait_ms: float) -> None:
+    def step_timing(
+        self,
+        host_ms: float,
+        fetch_wait_ms: float,
+        replica: Optional[int] = None,
+    ) -> None:
         """Host-vs-device split of ONE serving step, both measured with the
         session clock on the host (no device syncs added — the fetch timed
         here is one the runtime already performs): ``host_ms`` is the step's
         wall time minus the blocking fetch wait. The
         ``nxdi_serving_host_frac`` gauge tracks the cumulative fraction —
         the host-gap number the async-pipelining work drives down
-        (PERF.md)."""
+        (PERF.md). With ``replica`` set (router-managed sessions) the split
+        also lands as host / fetch_wait phase spans on that replica's
+        timeline track."""
         if not self.enabled:
             return
         self._step_host_ms.observe(host_ms)
@@ -645,6 +878,26 @@ class TelemetrySession:
             denom = self._host_ms_sum + self._fetch_wait_ms_sum
             if denom > 0:
                 self._host_frac.set(self._host_ms_sum / denom)
+            if replica is not None:
+                # one worker thread per replica steps its own session, so
+                # the per-replica phase counter is deterministic across
+                # sequential and threaded drains (the determinism pin)
+                track = f"replica:{int(replica)}"
+                pc = self._phase_count.get(track, 0) + 1
+                self._phase_count[track] = pc
+                now = self.clock()
+                h_s = max(0.0, host_ms) / 1e3
+                f_s = max(0.0, fetch_wait_ms) / 1e3
+                self.spans.begin(
+                    f"{track}/t{pc}/host", "host", track,
+                    now - h_s - f_s, lane="phases",
+                )
+                self.spans.end(f"{track}/t{pc}/host", now - f_s)
+                self.spans.begin(
+                    f"{track}/t{pc}/fetch_wait", "fetch_wait", track,
+                    now - f_s, lane="phases",
+                )
+                self.spans.end(f"{track}/t{pc}/fetch_wait", now)
         self.event(
             "step_timing", host_ms=host_ms, fetch_wait_ms=fetch_wait_ms
         )
@@ -674,20 +927,55 @@ class TelemetrySession:
 
     # ---- multi-replica router (runtime/router.py) ------------------------
 
-    def router_placement(self, policy: str, reason: str) -> None:
+    def router_placement(
+        self,
+        policy: str,
+        reason: str,
+        req_id: Optional[str] = None,
+        replica: Optional[int] = None,
+    ) -> None:
         """One placement decision: a request was bound to a replica under
-        ``policy`` (``reason``: fresh / failover / spill)."""
+        ``policy`` (``reason``: fresh / failover / spill). With identity
+        attached, the decision lands as a placement instant on the request's
+        timeline and stamps the replica onto its open incarnation span."""
         if not self.enabled:
             return
         self._router_placements.child((policy, reason)).inc()
-        self.event("router_placement", policy=policy, reason=reason)
+        if req_id is not None:
+            with self._lock:
+                base, _inc, track, _root, inode = self._req_ids(req_id)
+                attrs = {"policy": policy, "reason": reason}
+                if replica is not None:
+                    attrs["replica"] = int(replica)
+                self.spans.instant(
+                    "placement", track, self.clock(), lane=base, **attrs
+                )
+                self.spans.set_attrs(inode, **attrs)
+        self.event("router_placement", policy=policy, reason=reason,
+                   req_id=req_id, replica=replica)
 
     def router_failover(self, req_id: str, cause: str) -> None:
         """One request re-queued off a failed replica; it resumes from its
-        committed host state on a surviving replica (byte-identical greedy)."""
+        committed host state on a surviving replica (byte-identical greedy).
+        ``req_id`` is the BASE id: the failed incarnation's spans close here
+        and a flow arrow opens toward the next incarnation's submit."""
         if not self.enabled:
             return
         self._router_failovers.child((cause,)).inc()
+        with self._lock:
+            now = self.clock()
+            n = self._failover_count.get(req_id, 0)
+            base, _inc, track, root, _inode = self._req_ids(req_id)
+            inode = f"{root}/i{n}"
+            for phase in ("queue", "prefill", "handoff", "decode"):
+                self.spans.end(f"{inode}/{phase}", now)
+            self.spans.end(inode, now, failover_cause=cause)
+            self.spans.instant(
+                "failover", track, now, lane=base, cause=cause,
+                incarnation=n,
+            )
+            self.spans.flow(f"flow:{base}:{n}", "s", track, now, lane=base)
+            self._failover_count[req_id] = n + 1
         self.event("router_failover", req_id=req_id, cause=cause)
 
     def router_rejected(self, req_id: str, reason: str) -> None:
@@ -705,6 +993,14 @@ class TelemetrySession:
         self._router_occ.child(lab).set(occupancy)
         self._router_qd.child(lab).set(queue_depth)
         self._router_health.child(lab).set(health)
+        with self._lock:
+            prev = self._replica_health_seen.get(int(replica_id))
+            if prev is not None and prev != int(health):
+                self.spans.instant(
+                    "health_transition", f"replica:{int(replica_id)}",
+                    self.clock(), **{"from": prev, "to": int(health)},
+                )
+            self._replica_health_seen[int(replica_id)] = int(health)
 
     def router_step_gauges(self, queue_depth: int, spread: int) -> None:
         """Once per router step: global placement-queue depth and the
@@ -734,13 +1030,40 @@ class TelemetrySession:
         if not self.enabled:
             return
         self._handoff_failures.child((reason,)).inc()
+        with self._lock:
+            base, _inc, track, _root, _inode = self._req_ids(req_id)
+            self.spans.instant(
+                "handoff_failure", track, self.clock(), lane=base,
+                reason=reason,
+            )
         self.event("handoff_failure", req_id=req_id, reason=reason)
 
-    def handoff_done(self, ms: float) -> None:
-        """One hand-off completed (prefill through inject), wall ms."""
+    def handoff_done(
+        self,
+        ms: float,
+        req_id: Optional[str] = None,
+        replica: Optional[int] = None,
+    ) -> None:
+        """One hand-off completed (prefill through inject), wall ms. With
+        identity attached, the interval lands as a ``handoff`` span under
+        the request's CURRENT incarnation (its TTFT tax, readable per
+        request in the timeline and joined by scripts/obs_report.py)."""
         if not self.enabled:
             return
         self._handoff_ms.observe(ms)
+        if req_id is not None:
+            with self._lock:
+                now = self.clock()
+                base, _inc, track, root, _inode = self._req_ids(req_id)
+                inc = self._failover_count.get(base, 0)
+                sid = f"{root}/i{inc}/handoff"
+                attrs = {} if replica is None else {"prefill_replica": int(replica)}
+                self.spans.begin(
+                    sid, "handoff", track, now - max(0.0, ms) / 1e3,
+                    parent_id=f"{root}/i{inc}", lane=base, **attrs,
+                )
+                self.spans.end(sid, now)
+        self.event("handoff_done", ms=ms, req_id=req_id, replica=replica)
 
     def handoff_local_prefill(self, req_id: str) -> None:
         """Tier-wide degradation: this placement ran the decode replica's
@@ -748,12 +1071,25 @@ class TelemetrySession:
         if not self.enabled:
             return
         self._handoff_local.inc()
+        with self._lock:
+            base, _inc, track, _root, _inode = self._req_ids(req_id)
+            self.spans.instant(
+                "handoff_local_prefill", track, self.clock(), lane=base
+            )
         self.event("handoff_local_prefill", req_id=req_id)
 
     def handoff_tier_gauges(self, replica_id: int, health: int) -> None:
         if not self.enabled:
             return
         self._handoff_tier_health.child((str(int(replica_id)),)).set(health)
+        with self._lock:
+            prev = self._tier_health_seen.get(int(replica_id))
+            if prev is not None and prev != int(health):
+                self.spans.instant(
+                    "health_transition", f"prefill:{int(replica_id)}",
+                    self.clock(), **{"from": prev, "to": int(health)},
+                )
+            self._tier_health_seen[int(replica_id)] = int(health)
 
     def handoff_tier_alive(self, alive: int) -> None:
         if not self.enabled:
@@ -763,10 +1099,22 @@ class TelemetrySession:
     def replica_step(self, replica_id: int, step_ms: float) -> None:
         """One replica's session.step() wall time (recorded on the ROUTER
         thread after the per-step barrier, so threaded and sequential
-        stepping record through the identical path)."""
+        stepping record through the identical path — and the step-span
+        counter below is router-thread-only, hence deterministic)."""
         if not self.enabled:
             return
         self._replica_step_ms.child((str(int(replica_id)),)).observe(step_ms)
+        with self._lock:
+            track = f"replica:{int(replica_id)}"
+            k = self._replica_step_count.get(int(replica_id), 0) + 1
+            self._replica_step_count[int(replica_id)] = k
+            now = self.clock()
+            sid = f"{track}/step{k}"
+            self.spans.begin(
+                sid, f"step {k}", track, now - max(0.0, step_ms) / 1e3,
+                lane="steps", step_ms=step_ms,
+            )
+            self.spans.end(sid, now)
 
     def router_step_timing(self, phase_wall_ms: float, replica_ms_sum: float) -> None:
         """Wall time of one router step's replica-stepping phase beside the
@@ -795,16 +1143,69 @@ class TelemetrySession:
             return
         self._accept.observe(committed)
 
-    def spec_round(self, draft_len: int, accept_ewma: float) -> None:
+    def spec_round(
+        self,
+        draft_len: int,
+        accept_ewma: float,
+        req_id: Optional[str] = None,
+    ) -> None:
         """Adaptive-draft policy signals of one spec-ragged round: the
         request's NEXT snapped draft length and its acceptance-rate EWMA
-        after the update (docs/OBSERVABILITY.md)."""
+        after the update (docs/OBSERVABILITY.md). With ``req_id`` the round
+        also lands as an instant on the request's timeline."""
         if not self.enabled:
             return
         self._spec_draft_len.observe(draft_len)
         self._spec_ewma.observe(accept_ewma)
+        if req_id is not None:
+            with self._lock:
+                base, _inc, track, _root, _inode = self._req_ids(req_id)
+                self.spans.instant(
+                    "spec_round", track, self.clock(), lane=base,
+                    draft_len=int(draft_len),
+                    accept_ewma=round(float(accept_ewma), 6),
+                )
 
     # ---- workload engine (workload/driver.py + workload/slo.py) ----------
+
+    def chaos_kill(self, replica_id: int, tier: str, step: int) -> None:
+        """The chaos plan killed a replica at driver step ``step`` — the
+        timeline's kill marker (the instant the recovery window in
+        scripts/obs_report.py and the bench chaos row anchor on)."""
+        if not self.enabled:
+            return
+        track = (
+            f"prefill:{int(replica_id)}" if tier == "prefill"
+            else f"replica:{int(replica_id)}"
+        )
+        with self._lock:
+            self.spans.instant(
+                "chaos_kill", track, self.clock(), tier=tier, step=int(step)
+            )
+        self.event("chaos_kill", replica=int(replica_id), tier=tier,
+                   step=int(step))
+
+    def workload_step(self, step: int, commits: Dict[str, int],
+                      dt_s: float) -> None:
+        """One open-loop driver step: per-request decode commits observed
+        this step. Lands as a ``driver`` track span carrying the commit
+        total — the goodput series a trace viewer (and the chaos-agreement
+        test) reads straight off the timeline."""
+        if not self.enabled:
+            return
+        total = int(sum(commits.values()))
+        with self._lock:
+            now = self.clock()
+            sid = f"driver/step{int(step)}"
+            self.spans.begin(
+                sid, f"step {int(step)}", "driver", now,
+                lane="steps", commit_tokens=total,
+            )
+            # the virtual clock advances AFTER _record_step — stamp the
+            # step's nominal width so the timeline shows contiguous steps
+            self.spans.end(sid, now + max(0.0, float(dt_s)))
+        self.event("workload_step", step=int(step), commit_tokens=total,
+                   commits=dict(commits))
 
     def slo_missed(self, kind: str, tenant: str) -> None:
         """One request missed its SLO (scored post-hoc by workload/slo.py):
@@ -858,13 +1259,25 @@ class TelemetrySession:
 
 
 def load_events(jsonl_path: str) -> List[dict]:
-    """Read a session's JSONL event log back for offline replay."""
+    """Read a session's JSONL event log back for offline replay.
+
+    Tolerant of a truncated/corrupt tail: a process killed mid-write (the
+    chaos drains this log exists for) leaves a half-written last line —
+    skip bad lines with a warning instead of losing the whole log."""
     out = []
     with open(jsonl_path) as f:
-        for line in f:
+        for lineno, line in enumerate(f, start=1):
             line = line.strip()
-            if line:
+            if not line:
+                continue
+            try:
                 out.append(json.loads(line))
+            except json.JSONDecodeError:
+                warnings.warn(
+                    f"{jsonl_path}:{lineno}: skipping corrupt JSONL line "
+                    f"({line[:40]!r}...)",
+                    stacklevel=2,
+                )
     return out
 
 

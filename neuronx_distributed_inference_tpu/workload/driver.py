@@ -196,6 +196,14 @@ class WorkloadDriver:
         self._seen: Dict[str, int] = {}
         self._tracked: List[str] = []  # admitted req ids, commit attribution
         self.result = WorkloadResult(trace=trace, step_dt_s=self.step_dt_s)
+        # span-timeline + live-SLO wiring (ISSUE 19): request spans land on
+        # tenant tracks, and an attached SloMonitor learns every arrival's
+        # clock origin and SLO terms before the drain starts
+        if getattr(self.tel, "enabled", False):
+            self.tel.set_tenants(trace.tenants_of)
+            mon = getattr(self.tel, "slo_monitor", None)
+            if mon is not None:
+                mon.register_trace(trace, step_dt_s=self.step_dt_s)
         if any(a.spec_accept_rate is not None for a in trace.arrivals):
             self._install_accept_gate()
 
@@ -306,6 +314,7 @@ class WorkloadDriver:
                 return
             victims[0].kill("chaos")
             event["replica"] = victims[0].replica_id
+            self.tel.chaos_kill(victims[0].replica_id, c.tier, self._step)
         if self.result.chaos is None:
             self.result.chaos = {
                 **event,
@@ -354,6 +363,11 @@ class WorkloadDriver:
         self.result.step_commits.append(commits)
         self.result.live_steps.append(self._has_live_work())
         self.tel.workload_backlog(self._backlog_depth())
+        self.tel.workload_step(self._step, commits, self.step_dt_s)
+        mon = getattr(self.tel, "slo_monitor", None)
+        if mon is not None:
+            # verdicts landed during this step fold into ITS window bucket
+            mon.tick(self._step)
 
     def _has_live_work(self) -> bool:
         if self._is_router:
@@ -389,6 +403,12 @@ class WorkloadDriver:
                     f"steps ({len(self._pending)} arrivals pending)"
                 )
             self.step()
+        mon = getattr(self.tel, "slo_monitor", None)
+        if mon is not None:
+            # judge stragglers that never reached a session terminal
+            # (validation rejects, router-only failures) — the scorer's
+            # failed / never_served taxonomy for the same cases
+            mon.finalize(self._step)
         self._collect()
         return self.result
 

@@ -42,6 +42,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from neuronx_distributed_inference_tpu.telemetry.slo_monitor import judge
 from neuronx_distributed_inference_tpu.workload.driver import WorkloadResult
 from neuronx_distributed_inference_tpu.workload.generator import base_req_id
 
@@ -191,20 +192,21 @@ def score(
             sc.ttft_s = min(firsts) - arrival_s
             if n_tok > 1 and lasts:
                 sc.avg_itl_s = (max(lasts) - min(firsts)) / (n_tok - 1)
-        if not finished:
-            sc.miss_kind = (
-                "never_served" if rid in result.never_served or not firsts
-                else "failed"
-            )
-        else:
-            if arr.ttft_slo_s is not None:
-                sc.ttft_ok = sc.ttft_s is not None and sc.ttft_s <= arr.ttft_slo_s
-            if arr.itl_slo_s is not None and sc.avg_itl_s is not None:
-                sc.itl_ok = sc.avg_itl_s <= arr.itl_slo_s
-            if not sc.ttft_ok:
-                sc.miss_kind = "ttft"
-            elif not sc.itl_ok:
-                sc.miss_kind = "itl"
+        # the per-request verdict routes through the SAME predicate the live
+        # SloMonitor applies mid-run (telemetry/slo_monitor.py) — the two
+        # surfaces can never drift (pinned by tests/test_obs_timeline.py)
+        if arr.ttft_slo_s is not None:
+            sc.ttft_ok = sc.ttft_s is not None and sc.ttft_s <= arr.ttft_slo_s
+        if arr.itl_slo_s is not None and sc.avg_itl_s is not None:
+            sc.itl_ok = sc.avg_itl_s <= arr.itl_slo_s
+        sc.miss_kind = judge(
+            finished=finished,
+            served=rid not in result.never_served and bool(firsts),
+            ttft_s=sc.ttft_s,
+            avg_itl_s=sc.avg_itl_s,
+            ttft_slo_s=arr.ttft_slo_s,
+            itl_slo_s=arr.itl_slo_s,
+        )
         if sc.miss_kind is not None:
             misses[sc.miss_kind] = misses.get(sc.miss_kind, 0) + 1
             if record:

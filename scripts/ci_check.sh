@@ -48,6 +48,10 @@ echo
 echo "== kernel-contract (KERN701-705 detectors + tuning-table pins) pytest subset =="
 python -m pytest tests/test_kernel_audit.py -q -m 'not slow' -p no:cacheprovider || rc=$?
 
+echo
+echo "== observability (span timelines + ops server + SLO burn-rate) pytest subset =="
+python -m pytest tests/test_telemetry.py tests/test_obs_timeline.py tests/test_ops_server.py -q -m 'not slow' -p no:cacheprovider || rc=$?
+
 if [ "$rc" -ne 0 ]; then
   echo "ci_check: FAILED (rc=$rc)" >&2
 else

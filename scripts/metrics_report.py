@@ -24,39 +24,73 @@ def _labels_str(labels: Dict[str, str]) -> str:
     return "{" + ",".join(f"{k}={v}" for k, v in sorted(labels.items())) + "}"
 
 
-def _hist_quantile(buckets: Dict[str, int], count: int, q: float):
-    """Bucket-resolution quantile from a cumulative {le: count} map."""
-    if not count:
-        return None
-    rank = q * count
-    for le, c in buckets.items():
-        if c >= rank:
-            return le
-    return "+Inf"
+def _snapshot_quantile(sample: Dict, q: float):
+    """Quantile of one snapshot histogram sample via the registry's OWN
+    ``Histogram.quantile`` (bucket-resolution; reconstructed from the
+    cumulative {le: count} map so script and exposition can never disagree
+    on quantile semantics)."""
+    from neuronx_distributed_inference_tpu.telemetry.metrics import Histogram
+
+    items = list(sample["buckets"].items())
+    bounds = tuple(
+        float(le) for le, _ in items if le not in ("+Inf", "inf")
+    )
+    h = Histogram(bounds)
+    prev = 0
+    for i, (_le, cum) in enumerate(items):
+        if i < len(h.counts):
+            h.counts[i] = cum - prev
+        prev = cum
+    h.count = sample["count"]
+    h.sum = sample["sum"]
+    return h.quantile(q)
+
+
+def _hist_line(indent: str, label: str, s: Dict) -> str:
+    count = s["count"]
+    mean = (s["sum"] / count) if count else 0.0
+    qs = " ".join(
+        f"p{int(q * 100)}<={_snapshot_quantile(s, q)}"
+        for q in (0.50, 0.95, 0.99)
+    )
+    return (
+        f"{indent}{label:<52} n={count:<8} sum={s['sum']:<12.6g} "
+        f"mean={mean:<10.4g} {qs}"
+    )
 
 
 def render(snapshot: Dict) -> str:
-    """One aligned table per metric kind from a registry snapshot dict."""
+    """One aligned table per metric kind. Families sort by name; a labelled
+    family prints one header line with its per-label children indented
+    beneath it (sorted by label string), so multi-label families read as a
+    group instead of scattering in insertion order."""
     counters: List[str] = []
     gauges: List[str] = []
     hists: List[str] = []
     for name, fam in sorted(snapshot.items()):
         kind = fam.get("type")
-        for s in fam.get("samples", []):
-            label = f"{name}{_labels_str(s.get('labels', {}))}"
-            if kind == "counter":
-                counters.append(f"  {label:<64} {s['value']:>14g}")
-            elif kind == "gauge":
-                gauges.append(f"  {label:<64} {s['value']:>14g}")
-            elif kind == "histogram":
-                count = s["count"]
-                mean = (s["sum"] / count) if count else 0.0
-                p50 = _hist_quantile(s["buckets"], count, 0.50)
-                p99 = _hist_quantile(s["buckets"], count, 0.99)
-                hists.append(
-                    f"  {label:<52} n={count:<8} sum={s['sum']:<12.6g} "
-                    f"mean={mean:<10.4g} p50<={p50} p99<={p99}"
-                )
+        samples = fam.get("samples", [])
+        labelled = [s for s in samples if s.get("labels")]
+        plain = [s for s in samples if not s.get("labels")]
+        sink = {"counter": counters, "gauge": gauges,
+                "histogram": hists}.get(kind)
+        if sink is None:
+            continue
+        for s in plain:
+            if kind == "histogram":
+                sink.append(_hist_line("  ", name, s))
+            else:
+                sink.append(f"  {name:<64} {s['value']:>14g}")
+        if labelled:
+            sink.append(f"  {name}")
+            for s in sorted(
+                labelled, key=lambda s: _labels_str(s["labels"])
+            ):
+                lab = _labels_str(s["labels"])
+                if kind == "histogram":
+                    sink.append(_hist_line("    ", lab, s))
+                else:
+                    sink.append(f"    {lab:<62} {s['value']:>14g}")
     out = []
     if counters:
         out.append("counters:")

@@ -1,0 +1,278 @@
+#!/usr/bin/env python
+"""Post-mortem observability report: one annotated join of a run's
+telemetry artifacts (ISSUE 19 — the artifact hardware session zero
+attaches to every BENCH row).
+
+    python scripts/obs_report.py --events run.jsonl
+    python scripts/obs_report.py --events run.jsonl --metrics metrics.json \
+        --top 5 --bucket-steps 4
+
+Inputs:
+
+- ``--events``  : a TelemetrySession JSONL event log (``jsonl_path=`` /
+  ``enable_default_session``), the primary source — request lifecycle,
+  ``workload_step`` commit totals, ``chaos_kill`` markers, ``handoff_done``
+  taxes and ``slo_missed`` verdicts are all read from it.
+- ``--metrics`` : optional ``--metrics-out`` snapshot JSON; appends the
+  grouped metric table (scripts/metrics_report.py render).
+
+Sections: goodput timeline (per-bucket committed tokens with chaos kills
+and the measured recovery window marked via workload/slo.extract_dip),
+hand-off TTFT-tax distribution, per-tenant SLO attainment, and the top-N
+slowest requests by TTFT with their span breakdown (queue -> prefill/
+hand-off -> decode, failover count).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional
+
+
+def _percentile(vals: List[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    s = sorted(vals)
+    k = min(len(s) - 1, int(round(q * (len(s) - 1))))
+    return s[k]
+
+
+def _base_id(rid: str) -> str:
+    import re
+
+    return re.sub(r"~f\d+$", "", rid)
+
+
+def _tenant_of(rid: str) -> str:
+    base = _base_id(rid)
+    return base.rsplit("-", 1)[0] if "-" in base else "default"
+
+
+class RunJoin:
+    """The joined view of one run's JSONL event log."""
+
+    def __init__(self, events: List[dict]):
+        self.events = events
+        self.step_commits: Dict[int, int] = {}
+        self.kills: List[dict] = []
+        self.handoff_ms: List[float] = []
+        self.misses: Dict[str, Dict[str, int]] = {}  # tenant -> kind -> n
+        self.reqs: Dict[str, dict] = {}
+        for ev in events:
+            et = ev.get("type")
+            rid = ev.get("req_id")
+            base = _base_id(rid) if rid else None
+            if et == "workload_step":
+                self.step_commits[int(ev["step"])] = int(ev["commit_tokens"])
+            elif et == "chaos_kill":
+                self.kills.append(ev)
+            elif et == "handoff_done":
+                self.handoff_ms.append(float(ev["ms"]))
+                if base:
+                    r = self._req(base)
+                    r["handoff_ms"] = r.get("handoff_ms", 0.0) + float(ev["ms"])
+            elif et == "slo_missed":
+                t = self.misses.setdefault(ev.get("tenant", "default"), {})
+                t[ev["kind"]] = t.get(ev["kind"], 0) + 1
+            elif et == "request_submitted":
+                r = self._req(base)
+                r.setdefault("t_submit", ev["ts"])
+                r["incarnations"] = r.get("incarnations", 0) + 1
+            elif et == "first_token":
+                r = self._req(base)
+                if "t_first" not in r:
+                    r["t_first"] = ev["ts"]
+            elif et == "router_failover":
+                self._req(base)["failovers"] = (
+                    self._req(base).get("failovers", 0) + 1
+                )
+            elif et in ("request_finished", "request_dropped",
+                        "request_rejected"):
+                r = self._req(base)
+                r["t_end"] = ev["ts"]
+                r["end"] = ev.get("reason", et)
+
+    def _req(self, base: str) -> dict:
+        return self.reqs.setdefault(base, {})
+
+
+def render_goodput_timeline(join: RunJoin, bucket_steps: int) -> List[str]:
+    out = ["goodput timeline (committed tokens per bucket):"]
+    if not join.step_commits:
+        out.append("  (no workload_step events — not an open-loop run)")
+        return out
+    last = max(join.step_commits)
+    series: List[int] = []
+    for b0 in range(0, last + 1, bucket_steps):
+        series.append(sum(
+            join.step_commits.get(s, 0)
+            for s in range(b0, min(b0 + bucket_steps, last + 1))
+        ))
+    kill_steps = [int(k["step"]) for k in join.kills]
+    dip = None
+    if kill_steps:
+        try:
+            from neuronx_distributed_inference_tpu.workload.slo import (
+                extract_dip,
+            )
+
+            dip = extract_dip(
+                series, kill_steps[0] // bucket_steps,
+                bucket_steps=bucket_steps,
+            )
+        except Exception:
+            dip = None
+    peak = max(series) if series else 1
+    recov_bucket = None
+    if dip is not None and dip.recovery_steps is not None:
+        recov_bucket = (
+            kill_steps[0] // bucket_steps
+            + dip.recovery_steps // bucket_steps
+        )
+    for i, v in enumerate(series):
+        bar = "#" * int(round(24 * v / peak)) if peak else ""
+        marks = []
+        for ks in kill_steps:
+            if ks // bucket_steps == i:
+                marks.append("<- CHAOS KILL")
+        if recov_bucket is not None and i == recov_bucket:
+            marks.append("<- recovered")
+        out.append(
+            f"  step {i * bucket_steps:>4}  {v:>6} {bar:<24} "
+            f"{' '.join(marks)}".rstrip()
+        )
+    if dip is not None:
+        out.append(
+            f"  dip_frac={dip.dip_frac} recovery_steps={dip.recovery_steps} "
+            f"(baseline {dip.baseline:.1f} tok/bucket)"
+        )
+    return out
+
+
+def render_handoff_tax(join: RunJoin) -> List[str]:
+    out = ["hand-off TTFT tax (nxdi_handoff_ms, per completed hand-off):"]
+    hs = join.handoff_ms
+    if not hs:
+        out.append("  (no hand-offs — no disaggregated prefill tier)")
+        return out
+    out.append(
+        f"  n={len(hs)} mean={sum(hs) / len(hs):.3f}ms "
+        f"p50={_percentile(hs, .5):.3f}ms p95={_percentile(hs, .95):.3f}ms "
+        f"max={max(hs):.3f}ms"
+    )
+    return out
+
+
+def render_tenant_attainment(join: RunJoin) -> List[str]:
+    out = ["per-tenant SLO attainment:"]
+    by_tenant: Dict[str, int] = {}
+    for base in join.reqs:
+        by_tenant[_tenant_of(base)] = by_tenant.get(_tenant_of(base), 0) + 1
+    if not by_tenant:
+        out.append("  (no requests in the event log)")
+        return out
+    for tenant in sorted(by_tenant):
+        n = by_tenant[tenant]
+        misses = join.misses.get(tenant, {})
+        n_miss = sum(misses.values())
+        att = (n - n_miss) / n if n else 1.0
+        detail = (
+            " ".join(f"{k}={v}" for k, v in sorted(misses.items()))
+            or "-"
+        )
+        out.append(
+            f"  {tenant:<16} requests={n:<5} attainment={att:.4f} "
+            f"misses: {detail}"
+        )
+    return out
+
+
+def render_slowest(join: RunJoin, top: int) -> List[str]:
+    out = [f"top-{top} slowest requests by TTFT (span breakdown):"]
+    rows = []
+    for base, r in join.reqs.items():
+        if "t_submit" not in r or "t_first" not in r:
+            continue
+        ttft = r["t_first"] - r["t_submit"]
+        decode = (
+            r["t_end"] - r["t_first"] if "t_end" in r else None
+        )
+        rows.append((ttft, base, r, decode))
+    if not rows:
+        out.append("  (no served requests)")
+        return out
+    rows.sort(key=lambda x: (-x[0], x[1]))
+    out.append(
+        f"  {'request':<20} {'ttft_s':>9} {'handoff_ms':>11} "
+        f"{'decode_s':>9} {'failovers':>9}  end"
+    )
+    for ttft, base, r, decode in rows[:top]:
+        out.append(
+            f"  {base:<20} {ttft:>9.3f} "
+            f"{r.get('handoff_ms', 0.0):>11.3f} "
+            f"{(f'{decode:.3f}' if decode is not None else '-'):>9} "
+            f"{r.get('failovers', 0):>9}  {r.get('end', 'open')}"
+        )
+    return out
+
+
+def render_report(events: List[dict], *, metrics: Optional[dict] = None,
+                  bucket_steps: int = 4, top: int = 10) -> str:
+    join = RunJoin(events)
+    n_req = len(join.reqs)
+    finished = sum(1 for r in join.reqs.values() if "t_end" in r)
+    total_commits = sum(join.step_commits.values())
+    out = [
+        "== observability report ==",
+        f"requests={n_req} terminal={finished} "
+        f"workload_commit_tokens={total_commits} "
+        f"chaos_kills={len(join.kills)} events={len(events)}",
+        "",
+    ]
+    out.extend(render_goodput_timeline(join, bucket_steps))
+    out.append("")
+    out.extend(render_handoff_tax(join))
+    out.append("")
+    out.extend(render_tenant_attainment(join))
+    out.append("")
+    out.extend(render_slowest(join, top))
+    if metrics is not None:
+        from metrics_report import render as render_metrics
+
+        out.append("")
+        out.append("== metrics snapshot ==")
+        out.append(render_metrics(metrics))
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--events", required=True,
+                   help="TelemetrySession JSONL event log")
+    p.add_argument("--metrics", default=None,
+                   help="optional --metrics-out snapshot JSON to append")
+    p.add_argument("--bucket-steps", type=int, default=4,
+                   help="goodput timeline bucket width in driver steps")
+    p.add_argument("--top", type=int, default=10,
+                   help="slowest-request rows to show")
+    args = p.parse_args(argv)
+    from neuronx_distributed_inference_tpu.telemetry.tracing import (
+        load_events,
+    )
+
+    events = load_events(args.events)
+    metrics = None
+    if args.metrics:
+        with open(args.metrics) as f:
+            metrics = json.load(f)
+    print(render_report(events, metrics=metrics,
+                        bucket_steps=args.bucket_steps, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    sys.exit(main())

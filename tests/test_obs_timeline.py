@@ -1,0 +1,287 @@
+"""Span timelines + SLO burn-rate acceptance pins (ISSUE 19).
+
+Three contracts, each pinned against an independent witness:
+
+- **determinism** — a seeded 2-replica drain produces the IDENTICAL span
+  tree (ids, parents, virtual timestamps) under ``router_threading`` as
+  under sequential stepping, and the exported Chrome trace passes the
+  minimal schema check (every event has ph/ts/pid/tid; every flow id
+  pairs its 's' with its 'f');
+- **chaos agreement** — the chaos row's trace carries the kill instant,
+  the failover incarnation spans, and a driver-track goodput series that
+  reproduces the scorer's ``dip_frac``/``recovery_steps`` EXACTLY;
+- **burn-rate parity** — the live SloMonitor's verdicts over a seeded
+  bursty trace match the offline scorer's per-request ``miss_kind``
+  request-for-request, and the exported burn-rate gauges match a direct
+  recomputation from the monitor's own judgment log.
+"""
+
+import json
+
+import pytest
+
+from tests.conftest import make_tiny_config, make_random_hf_state_dict
+
+from neuronx_distributed_inference_tpu.config import ChunkedPrefillConfig
+from neuronx_distributed_inference_tpu.parallel.mesh import mesh_from_config
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM,
+)
+from neuronx_distributed_inference_tpu.runtime.replica import ReplicaHandle
+from neuronx_distributed_inference_tpu.runtime.router import (
+    ServingRouter,
+    partition_devices,
+)
+from neuronx_distributed_inference_tpu.runtime.serving import ServingSession
+from neuronx_distributed_inference_tpu.telemetry import (
+    SloMonitor,
+    TelemetrySession,
+)
+from neuronx_distributed_inference_tpu.telemetry.slo_monitor import (
+    _base_req_id,
+)
+from neuronx_distributed_inference_tpu.workload import (
+    ChaosPlan,
+    VirtualClock,
+    WorkloadDriver,
+    extract_dip,
+    generate,
+    score,
+    standard_spec,
+)
+from neuronx_distributed_inference_tpu.workload.generator import base_req_id
+
+pytestmark = pytest.mark.telemetry
+
+
+def _paged_cfg():
+    return make_tiny_config(tpu=dict(
+        is_continuous_batching=True, batch_size=4, ctx_batch_size=1,
+        is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=48,
+        is_chunked_prefill=True,
+        chunked_prefill_config=ChunkedPrefillConfig(
+            max_num_seqs=2, kernel_q_tile_size=16
+        ),
+        seq_len=64,
+    ))
+
+
+@pytest.fixture(scope="module")
+def replica_apps():
+    sd = make_random_hf_state_dict(_paged_cfg())
+    parts = partition_devices(2)
+    apps = []
+    for i in range(2):
+        cfg = _paged_cfg()
+        apps.append(TpuModelForCausalLM(
+            None, cfg, mesh=mesh_from_config(cfg.tpu_config, devices=parts[i])
+        ).load(state_dict=sd))
+    return apps
+
+
+def _spec(seed=3, n=8, rate=1.5, **kw):
+    base = dict(
+        seed=seed, n_requests=n, vocab_size=118, rate=rate,
+        max_prompt_len=16, min_output_len=4, max_output_len=8,
+        shared_prefix_len=8, ttft_slo_s=1e4, itl_slo_s=1e3,
+    )
+    base.update(kw)
+    return standard_spec(**base)
+
+
+def _run(apps, trace, *, threaded=False, chaos=None, monitor=False):
+    for app in apps:
+        app.init_kv_cache()
+    vc = VirtualClock()
+    with TelemetrySession(clock=vc.now) as tel:
+        mon = None
+        if monitor:
+            mon = SloMonitor()
+            tel.attach_slo_monitor(mon)
+        sessions = [
+            ServingSession(app, telemetry=tel, clock=vc.now) for app in apps
+        ]
+        handles = [
+            ReplicaHandle(s, i, clock=vc.now) for i, s in enumerate(sessions)
+        ]
+        with ServingRouter(handles, policy="least_loaded", telemetry=tel,
+                           clock=vc.now, threaded=threaded) as router:
+            drv = WorkloadDriver(router, trace, clock=vc, telemetry=tel,
+                                 chaos=chaos)
+            result = drv.run()
+    return result, tel, mon
+
+
+def _schema_check(trace_doc):
+    """The minimal Chrome trace-event schema the export must satisfy."""
+    evs = trace_doc["traceEvents"]
+    assert evs, "empty trace"
+    flow_phases = {}
+    for ev in evs:
+        assert "ph" in ev and "pid" in ev and "name" in ev
+        if ev["ph"] == "M":
+            continue
+        assert "ts" in ev and "tid" in ev
+        assert ev["ts"] >= 0
+        if ev["ph"] == "X":
+            assert ev["dur"] >= 0
+        if ev["ph"] == "i":
+            assert ev["s"] == "t"
+        if ev["ph"] in ("s", "f"):
+            flow_phases.setdefault(ev["id"], set()).add(ev["ph"])
+            if ev["ph"] == "f":
+                assert ev["bp"] == "e"
+    for fid, phases in flow_phases.items():
+        assert phases == {"s", "f"}, f"unpaired flow {fid}: {phases}"
+
+
+# ---------------------------------------------------------------------------
+# determinism: threaded == sequential, span for span
+# ---------------------------------------------------------------------------
+
+
+def test_span_tree_identical_sequential_vs_threaded(replica_apps):
+    trace = generate(_spec(seed=5, n=12, rate=1.0, min_output_len=6,
+                           max_output_len=10))
+    res_a, tel_a, _ = _run(replica_apps, trace, threaded=False)
+    res_b, tel_b, _ = _run(replica_apps, trace, threaded=True)
+    assert res_a.outputs == res_b.outputs  # precondition: same run
+    tree_a, tree_b = tel_a.span_tree(), tel_b.span_tree()
+    assert tree_a  # request + replica + driver spans all landed
+    assert any(sid.startswith("req:") for sid in tree_a)
+    assert any(sid.startswith("replica:") for sid in tree_a)
+    assert any(sid.startswith("driver/") for sid in tree_a)
+    # IDENTICAL: ids, names, parents, tracks, lanes, virtual timestamps
+    assert tree_a == tree_b
+
+    doc_a = tel_a.export_chrome_trace()
+    _schema_check(doc_a)
+    _schema_check(tel_b.export_chrome_trace())
+    # the export itself is deterministic (stable sort, stable ids)
+    assert json.dumps(doc_a, sort_keys=True) == json.dumps(
+        tel_a.export_chrome_trace(), sort_keys=True
+    )
+    # one process track per replica + the driver track
+    names = {
+        (ev["pid"], ev["args"]["name"])
+        for ev in doc_a["traceEvents"] if ev["ph"] == "M"
+    }
+    tracks = {n for _, n in names}
+    assert {"replica:0", "replica:1", "driver"} <= tracks
+    assert any(t.startswith("tenant:") for t in tracks)
+
+
+# ---------------------------------------------------------------------------
+# chaos agreement: the trace carries the same dip the scorer reports
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_trace_agrees_with_scorer_dip(replica_apps):
+    trace = generate(_spec(seed=5, n=14, rate=1.0, min_output_len=12,
+                           max_output_len=16))
+    res, tel, _ = _run(replica_apps, trace, chaos=ChaosPlan(kill_step=8))
+    rep = score(res, tel, bucket_steps=4)
+    assert rep.attainment == 1.0  # generous SLOs: all commits are SLO-met
+    assert rep.dip is not None and rep.dip.dip_frac > 0.0
+    assert rep.dip.recovery_steps is not None
+
+    doc = tel.export_chrome_trace()
+    _schema_check(doc)
+    evs = doc["traceEvents"]
+
+    # the kill marker: one instant on the victim replica's track at the
+    # chaos step
+    kills = [
+        ev for ev in evs if ev["ph"] == "i" and ev["name"] == "chaos_kill"
+    ]
+    assert len(kills) == 1
+    assert kills[0]["args"]["step"] == res.chaos["step"] == 8
+
+    # failover spans: the kill's orphans re-incarnate — every flow pairs
+    incarnations = [
+        ev for ev in evs
+        if ev["ph"] == "X" and ev["name"].startswith("incarnation ")
+    ]
+    assert any(ev["name"] != "incarnation 0" for ev in incarnations)
+    assert any(ev["ph"] == "s" for ev in evs)  # failover hand-off arrows
+
+    # the driver track's per-step commit totals ARE the scorer's series
+    # (attainment 1.0 makes the met-restriction a no-op)
+    step_commits = {}
+    for ev in evs:
+        if ev["ph"] == "X" and ev["args"].get("span_id", "").startswith(
+            "driver/step"
+        ):
+            step_commits[int(ev["args"]["span_id"][len("driver/step"):])] = (
+                ev["args"]["commit_tokens"]
+            )
+    n_steps = len(rep.series) * rep.bucket_steps
+    series = [
+        sum(step_commits.get(s, 0) for s in range(b, b + rep.bucket_steps))
+        for b in range(0, n_steps, rep.bucket_steps)
+    ]
+    # trace-derived series == scorer series, bucket for bucket (the final
+    # bucket may extend past the scorer's trimmed span, never undershoot)
+    assert series[:-1] == rep.series[:-1]
+    assert series[-1] >= rep.series[-1]
+
+    # and the dip read off the TRACE series reproduces the report exactly
+    dip = extract_dip(
+        rep.series, res.chaos["step"] // rep.bucket_steps,
+        bucket_steps=rep.bucket_steps,
+        alive_frac=res.chaos.get("alive_frac") or 0.5,
+    )
+    assert dip is not None
+    assert dip.dip_frac == rep.dip.dip_frac
+    assert dip.recovery_steps == rep.dip.recovery_steps
+
+
+# ---------------------------------------------------------------------------
+# burn-rate parity: live monitor == offline scorer, gauge == recomputation
+# ---------------------------------------------------------------------------
+
+
+def test_burn_rate_matches_offline_scorer(replica_apps):
+    # bursty + tight SLOs: some requests meet, some miss on TTFT
+    trace = generate(_spec(seed=7, n=10, rate=2.5, min_output_len=6,
+                           max_output_len=10, ttft_slo_s=2.0,
+                           itl_slo_s=1e3))
+    res, tel, mon = _run(replica_apps, trace, monitor=True)
+    rep = score(res, tel, bucket_steps=4)
+
+    # the shared-predicate pin: identical id normalization...
+    for arr in trace.arrivals:
+        assert _base_req_id(arr.req_id + "~f1") == base_req_id(
+            arr.req_id + "~f1"
+        ) == arr.req_id
+    # ...and identical verdicts, request for request
+    scorer_verdicts = {s.req_id: s.miss_kind for s in rep.per_request}
+    assert mon.verdicts == scorer_verdicts
+    missed = {r for r, v in scorer_verdicts.items() if v is not None}
+    met = {r for r, v in scorer_verdicts.items() if v is None}
+    assert missed and met  # the row exercises both outcomes
+    assert 0.0 < rep.attainment < 1.0
+
+    # gauges == direct recomputation from the monitor's judgment log
+    snap = tel.registry.snapshot()
+    burn_samples = {
+        (s["labels"]["window"], s["labels"]["tenant"]): s["value"]
+        for s in snap["nxdi_slo_burn_rate"]["samples"]
+    }
+    assert burn_samples  # the monitor minted + refreshed its gauges
+    last = mon.snapshot()["step"]  # the gauges' window anchor
+    for (w, tenant), value in burn_samples.items():
+        rows = [
+            j for j in mon.judgments
+            if j.step > last - int(w)
+            and (tenant == "_all" or j.tenant == tenant)
+        ]
+        attain = (
+            sum(1 for j in rows if j.verdict is None) / len(rows)
+            if rows else 1.0
+        )
+        assert value == pytest.approx((1.0 - attain) / (1.0 - 0.99))
+        assert snap["nxdi_slo_attainment"]["samples"]
+    # the fast/slow pairing covers both alert windows on every tenant
+    assert {w for w, _ in burn_samples} == {"5", "60"}
+    assert {t for _, t in burn_samples} >= {"_all"}

@@ -1,0 +1,111 @@
+"""Live ops surface smoke (ISSUE 19; telemetry/ops_server.py).
+
+Tier-1 pins: the server binds an ephemeral port, all three routes serve
+what they promise (/metrics is the registry's Prometheus text under the
+versioned content type, /health and /slo are the bound callables' JSON),
+unknown routes 404, and ``stop()`` JOINS the serve thread — no daemon
+thread leaks past teardown.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from neuronx_distributed_inference_tpu.telemetry import (
+    OpsServer,
+    PROMETHEUS_CONTENT_TYPE,
+    SloMonitor,
+)
+from neuronx_distributed_inference_tpu.telemetry.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.telemetry
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return r.status, r.headers.get("Content-Type"), r.read().decode()
+
+
+def test_ops_server_three_routes_and_clean_shutdown():
+    reg = MetricsRegistry()
+    reg.counter("nxdi_ops_smoke_total", "route smoke counter").inc(3)
+    mon = SloMonitor(windows=(5, 60), slo_target=0.99)
+    mon.bind(reg)
+    health = {"replicas": [{"replica": 0, "health": "live"}], "queue_depth": 0}
+    srv = OpsServer(reg, health_fn=lambda: health, slo_fn=mon.snapshot)
+    port = srv.start()
+    assert port > 0 and srv.url.endswith(str(port))
+    assert srv.start() == port  # idempotent
+
+    status, ctype, body = _get(f"{srv.url}/metrics")
+    assert status == 200
+    assert ctype == PROMETHEUS_CONTENT_TYPE
+    assert "nxdi_ops_smoke_total 3" in body
+
+    status, ctype, body = _get(f"{srv.url}/health")
+    assert status == 200 and ctype == "application/json"
+    assert json.loads(body) == health
+
+    status, ctype, body = _get(f"{srv.url}/slo/")  # trailing slash tolerated
+    assert status == 200 and ctype == "application/json"
+    slo = json.loads(body)
+    assert slo["slo_target"] == 0.99
+    assert set(slo["windows"]) == {"5", "60"}
+    assert slo["windows"]["5"]["attainment"]["_all"] == 1.0
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(f"{srv.url}/nope")
+    assert ei.value.code == 404
+
+    thread = srv._thread
+    assert thread is not None and thread.is_alive()
+    srv.stop()
+    assert not thread.is_alive()  # stop() joins; no daemon-thread leak
+    srv.stop()  # idempotent
+
+
+def test_ops_server_slo_route_reflects_monitor_state():
+    """A scrape mid-drain sees the monitor's windowed state: a miss judged
+    inside the fast window drives burn above 1 for slo_target=0.99."""
+    reg = MetricsRegistry()
+    mon = SloMonitor(windows=(5, 60), slo_target=0.99)
+    mon.bind(reg)
+
+    class _Arr:
+        def __init__(self, rid):
+            self.req_id = rid
+            self.tenant = "t0"
+            self.step = 0
+            self.ttft_slo_s = 1.0
+            self.itl_slo_s = None
+
+    class _Trace:
+        arrivals = [_Arr("t0-0000"), _Arr("t0-0001")]
+
+    mon.register_trace(_Trace(), step_dt_s=1.0)
+    mon.note_first_token("t0-0000", 0.5)
+    mon.note_finish("t0-0000", "eos", 1.0)   # met
+    mon.note_first_token("t0-0001", 3.0)
+    mon.note_finish("t0-0001", "eos", 4.0)   # ttft miss
+    mon.tick(4)
+
+    with OpsServer(reg, slo_fn=mon.snapshot) as srv:
+        _, _, body = _get(f"{srv.url}/slo")
+        slo = json.loads(body)
+        assert slo["judged"] == 2 and slo["met"] == 1
+        assert slo["misses_by_kind"] == {"ttft": 1}
+        assert slo["windows"]["5"]["attainment"]["_all"] == 0.5
+        assert slo["windows"]["5"]["burn_rate"]["_all"] == pytest.approx(
+            0.5 / 0.01
+        )
+        # the gauges the /metrics route exposes carry the same numbers
+        _, _, text = _get(f"{srv.url}/metrics")
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith('nxdi_slo_burn_rate{window="5",tenant="_all"}')
+        )
+        assert float(line.rsplit(" ", 1)[1]) == pytest.approx(0.5 / 0.01)
+        status, _, _ = _get(f"{srv.url}/health")
+        assert status == 200  # unbound health_fn serves {}

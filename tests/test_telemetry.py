@@ -30,6 +30,7 @@ from neuronx_distributed_inference_tpu.runtime.serving import (
 )
 from neuronx_distributed_inference_tpu.telemetry import (
     MetricsRegistry,
+    SloMonitor,
     TelemetrySession,
     load_events,
 )
@@ -486,8 +487,12 @@ def test_fetch_parity_and_zero_recompiles_with_telemetry(cb_app, monkeypatch):
 
     counter["n"] = 0
     with TelemetrySession() as tel:
+        # ISSUE 19: span recording + live SLO monitor active — both are
+        # host-side consumers of the same records and must stay fetch-neutral
+        tel.attach_slo_monitor(SloMonitor())
         with RetraceGuard() as guard:
             out_on = _run_workload(cb_app, tel)
+        trace_doc = tel.export_chrome_trace()
     fetches_on = counter["n"]
 
     assert out_on == out_off == golden
@@ -501,6 +506,10 @@ def test_fetch_parity_and_zero_recompiles_with_telemetry(cb_app, monkeypatch):
     snap = tel.registry.snapshot()
     assert snap["nxdi_tokens_generated_total"]["samples"][0]["value"] == sum(
         len(v) for v in out_on.values()
+    )
+    # the span timeline landed too, without costing a single extra fetch
+    assert any(
+        ev["ph"] == "X" for ev in trace_doc["traceEvents"]
     )
 
 
@@ -788,3 +797,85 @@ def test_metrics_exposition_safe_during_concurrent_minting():
     # everything minted is visible to a final scrape
     snap = reg.snapshot()
     assert len(snap["t_mint"]["samples"]) == len(fam.children) > 0
+
+
+# ---------------------------------------------------------------------------
+# bounded buffers, corrupt-tail tolerance, export-during-drain (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+
+def test_event_buffer_bounded_with_dropped_counter(monkeypatch):
+    """The in-memory event ring evicts oldest past TELEMETRY_EVENT_MAX and
+    counts every eviction — a long-lived serving process cannot grow event
+    memory linearly with traffic."""
+    monkeypatch.setenv(tel_tracing.TELEMETRY_EVENT_MAX_ENV, "8")
+    with TelemetrySession() as s:
+        for i in range(20):
+            s.event("tick", i=i)
+        assert len(s.events) == 8
+        assert [e["i"] for e in s.events] == list(range(12, 20))
+        sample = next(
+            x
+            for x in s.registry.snapshot()[
+                "nxdi_telemetry_dropped_total"]["samples"]
+            if x["labels"] == {"kind": "events"}
+        )
+        assert sample["value"] == 12
+        # the span store is bounded by the same knob
+        assert s.spans.max_spans == 8
+
+
+def test_load_events_skips_corrupt_trailing_line(tmp_path):
+    """A crash mid-flush leaves a truncated last line; offline replay keeps
+    every intact record and warns instead of raising."""
+    path = str(tmp_path / "events.jsonl")
+    with TelemetrySession(jsonl_path=path) as s:
+        s.event("a")
+        s.event("b")
+    with open(path, "a") as f:
+        f.write('{"type": "c", "ts":')  # truncated mid-write
+    with pytest.warns(UserWarning, match="skipping corrupt JSONL line"):
+        events = load_events(path)
+    assert [e["type"] for e in events] == ["a", "b"]
+
+
+def test_export_chrome_trace_safe_during_active_drain():
+    """The ISSUE-19 bugfix pin: export snapshots span/trace state under the
+    session lock, so exporting WHILE worker threads record produces a
+    consistent, serializable trace every time (no dict-changed-size, no
+    half-written span)."""
+    import threading
+
+    with TelemetrySession() as s:
+        stop = threading.Event()
+        errors = []
+
+        def hammer(k):
+            i = 0
+            try:
+                while not stop.is_set():
+                    rid = f"t{k}-{i:04d}"
+                    s.request_submitted(rid)
+                    s.request_first_token(rid)
+                    s.request_tokens(rid, 2)
+                    s.request_finished(rid, "eos")
+                    i += 1
+            except Exception as e:  # pragma: no cover - the failure signal
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=hammer, args=(k,)) for k in range(4)
+        ]
+        for t in threads:
+            t.start()
+        try:
+            docs = [s.export_chrome_trace() for _ in range(20)]
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert errors == [], errors
+        for doc in docs:
+            json.dumps(doc)  # every snapshot serializes cleanly
+        final = s.export_chrome_trace()
+    assert any(ev["ph"] == "X" for ev in final["traceEvents"])
