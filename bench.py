@@ -579,7 +579,7 @@ def measure_serving_spec(target, draft, *, n_requests, prompt_len, gen_len, k):
 
 
 def measure_router(apps, *, n_requests, prompt_len, gen_len, policy,
-                   prefill_apps=None):
+                   prefill_apps=None, elastic=None):
     """Scale-out serving: the SAME staggered request mix routed over N
     single-chip replica sessions by ServingRouter (ISSUE 10;
     docs/SERVING.md "Multi-replica front-end"). Aggregate tok/s across
@@ -594,6 +594,16 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy,
     census: ``handoffs`` (MUST equal the request count on clean traffic),
     ``handoff_failures`` and ``handoff_local_prefill`` (both MUST be 0 —
     the tier's zero-containment-events proof).
+
+    ``elastic`` (ISSUE 20): ``dict(retire_step=N)`` exercises the elastic
+    fleet primitives mid-drain — at step N the highest-id replica is
+    retired (``retire_replica``, graceful drain), and the moment its drain
+    finalizes a FRESH session over the same warmed app re-joins via
+    ``add_replica`` (zero recompiles: the jit cache is per-app). The row
+    then reports the ``elastic_*`` census: retire/add counts, attainment
+    (finished / submitted — MUST be 1.0), and the leak pins (zero leaked
+    KV blocks across every session incl. the retired one, zero leaked
+    threads across the run).
 
     Containment census matches PR 7's convention: rejected / failover /
     re-admitted are PER-RUN deltas against a pre-run registry snapshot."""
@@ -616,12 +626,17 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy,
     ]
 
     def run_once(registry=None):
+        import threading as _threading
+
+        threads_before = _threading.active_count()
         for app in apps:
             app.init_kv_cache()  # fresh block pool per replica between runs
         tier = []
         for i, papp in enumerate(prefill_apps or ()):
             papp.init_kv_cache()
             tier.append(PrefillReplicaHandle(papp, i))
+        sessions = []
+        elastic_info = None
         with TelemetrySession(registry=registry) as tel:
             # threaded stepping follows TpuConfig.router_threading on the
             # replica apps (the *_router_threaded row sets it); the context
@@ -631,6 +646,11 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy,
                 [ServingSession(app, telemetry=tel) for app in apps],
                 policy=policy, telemetry=tel, prefill_replicas=tier,
             ) as router:
+                sessions = [h.session for h in router.replicas]
+                retire_step = (elastic or {}).get("retire_step")
+                retired_id = None
+                added = False
+                step_i = 0
                 t_start = time.time()
                 next_idx = 0
                 for _ in range(2):
@@ -639,6 +659,25 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy,
                     next_idx += 1
                 while True:
                     router.step()
+                    step_i += 1
+                    if retire_step is not None:
+                        if retired_id is None and step_i >= retire_step:
+                            victim = max(
+                                router.replicas, key=lambda h: h.replica_id
+                            )
+                            retired_id = victim.replica_id
+                            router.retire_replica(retired_id, drain=True)
+                        elif retired_id is not None and not added and all(
+                            h.replica_id != retired_id
+                            for h in router.replicas
+                        ):
+                            # drain finalized: re-join a FRESH session over
+                            # the same warmed app (shared jit cache — zero
+                            # recompiles)
+                            sess = ServingSession(apps[-1], telemetry=tel)
+                            sessions.append(sess)
+                            router.add_replica(sess)
+                            added = True
                     if next_idx < n_requests:
                         router.add_request(str(next_idx), prompts[next_idx],
                                            max_new_tokens=gen_len)
@@ -654,13 +693,35 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy,
                 per_replica = [h.tokens_served for h in router.replicas]
                 threaded = router.threaded
                 handoffs = sum(p.handoffs for p in router.prefill_replicas)
-        return tel, counts, per_replica, total_s, threaded, handoffs
+                if retire_step is not None:
+                    elastic_info = {
+                        "elastic_retired": int(retired_id is not None),
+                        "elastic_added": int(added),
+                        "elastic_attainment": round(
+                            sum(
+                                1 for r in router.requests.values()
+                                if r.status == "finished"
+                            ) / n_requests, 4,
+                        ),
+                        # every session's allocator drained (the retired
+                        # one included): nothing a retired replica owned
+                        # leaks a KV block
+                        "elastic_leaked_blocks": sum(
+                            len(getattr(s.allocator, "seq_blocks", ()) or ())
+                            for s in sessions
+                        ),
+                    }
+        if elastic_info is not None:
+            elastic_info["elastic_leaked_threads"] = (
+                _threading.active_count() - threads_before
+            )
+        return (tel, counts, per_replica, total_s, threaded, handoffs,
+                elastic_info)
 
     run_once()  # warmup / compile pass over every replica's programs
     base_snap = default_registry().snapshot()
-    tel, counts, per_replica, total_s, threaded, handoffs = run_once(
-        default_registry()
-    )
+    (tel, counts, per_replica, total_s, threaded, handoffs,
+     elastic_info) = run_once(default_registry())
     total_tokens = sum(counts.values())
     snap = tel.registry.snapshot()
 
@@ -723,6 +784,12 @@ def measure_router(apps, *, n_requests, prompt_len, gen_len, policy,
         res["handoff_failures"] = _ctr("nxdi_handoff_failures_total")
         res["handoff_local_prefill"] = _ctr("nxdi_handoff_local_prefill_total")
         res["handoff_retries"] = _ctr("nxdi_handoff_retries_total")
+    if elastic_info is not None:
+        # elastic-fleet census (ISSUE 20): retire + add both happened,
+        # every submitted request finished (attainment 1.0) and nothing
+        # leaked — blocks or threads
+        res.update(elastic_info)
+        res["elastic_events"] = _ctr("nxdi_router_elastic_total")
     return res
 
 
@@ -1077,6 +1144,23 @@ def _suite_params(tiny):
             disagg=dict(prefill_replicas=1),
             cache_key="int8_1b_disagg" if not tiny else None,
         ),
+        # SAME routed mix under an ELASTIC fleet (ISSUE 20): at a seeded
+        # step mid-drain one replica is RETIRED (placement stops, its owned
+        # requests drain in place, worker joined on finalize) and a fresh
+        # session over the same warmed app re-joins via add_replica (the
+        # jit cache is per-app — zero recompiles). The elastic_* census
+        # pins attainment == 1.0 with ZERO leaked KV blocks/threads — the
+        # scale-in/scale-out path is free under clean traffic, exactly
+        # what the lifecycle audit (LIFE801/804/805) licenses statically.
+        # Shares the int8_1b serving artifact (identical model config; the
+        # elastic machinery is router bookkeeping above the session).
+        "serving_1b_int8_elastic": dict(
+            attrs=attrs_1b, quantized=True, serving=serving,
+            router=dict(replicas=2, policy="least_loaded",
+                        n_requests=4 if tiny else 8),
+            elastic=dict(retire_step=2),
+            cache_key="int8_1b" if not tiny else None,
+        ),
         # Open-loop SLO goodput rows (ISSUE 14, docs/WORKLOADS.md): a seeded
         # workload trace (Poisson / bursty arrivals, heavy-tailed lengths,
         # shared-prefix tenants) drives the SAME serving config through the
@@ -1322,7 +1406,7 @@ def run_point(name, tiny=False):
         res = measure_router(
             apps, n_requests=r["n_requests"], prompt_len=s["prompt"],
             gen_len=s["gen"], policy=r["policy"],
-            prefill_apps=prefill_apps,
+            prefill_apps=prefill_apps, elastic=p.get("elastic"),
         )
         # router ceiling: each replica serves its share of the mix and
         # streams its OWN weight copy, so the aggregate scales with the
@@ -1545,6 +1629,16 @@ def summary_line(points):
                                    "decode_tok_s"),
         "router_step_overlap_frac": g("serving_1b_int8_router_threaded",
                                       "overlap_frac"),
+        # elastic fleet row (ISSUE 20): seeded retire + add mid-drain —
+        # attainment MUST be 1.0 with ZERO leaked KV blocks/threads (the
+        # lifecycle audit's leak-freedom contract, measured)
+        "elastic_tok_s": g("serving_1b_int8_elastic", "decode_tok_s"),
+        "elastic_attainment": g("serving_1b_int8_elastic",
+                                "elastic_attainment"),
+        "elastic_leaked_blocks": g("serving_1b_int8_elastic",
+                                   "elastic_leaked_blocks"),
+        "elastic_leaked_threads": g("serving_1b_int8_elastic",
+                                    "elastic_leaked_threads"),
         # open-loop SLO goodput rows (ISSUE 14, docs/WORKLOADS.md):
         # goodput_tok_s counts ONLY tokens from requests that met their
         # TTFT/ITL SLOs (measured from arrival — backlog wait counts);
@@ -1706,6 +1800,26 @@ def _dump_metrics(path):
     print(f"metrics snapshot -> {path}", file=sys.stderr)
 
 
+def _ops_server():
+    """--ops-port N: serve this process's live ops surface (/metrics,
+    /healthz, /slo — docs/OBSERVABILITY.md) off the process-default registry
+    for the duration of the run. Returned as a context manager so the serve
+    thread is JOINED even when the run raises mid-drain (the LIFE804
+    thread-lifecycle contract); without the flag it is a no-op context."""
+    import contextlib
+
+    if "--ops-port" in sys.argv:
+        i = sys.argv.index("--ops-port")
+        if i + 1 < len(sys.argv):
+            from neuronx_distributed_inference_tpu.telemetry import default_registry
+            from neuronx_distributed_inference_tpu.telemetry.ops_server import (
+                OpsServer,
+            )
+
+            return OpsServer(default_registry(), port=int(sys.argv[i + 1]))
+    return contextlib.nullcontext()
+
+
 def main():
     if "--cpu" in sys.argv:
         # the container sitecustomize pins jax_platforms to the TPU plugin;
@@ -1714,18 +1828,21 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
     metrics_out = _metrics_out_path()
-    if len(sys.argv) >= 3 and sys.argv[1] == "--point":
-        _wait_for_backend()
-        print(json.dumps(run_point(sys.argv[2], tiny=False)))
+    with _ops_server() as ops:
+        if ops is not None:
+            print(f"ops server -> {ops.url}", file=sys.stderr)
+        if len(sys.argv) >= 3 and sys.argv[1] == "--point":
+            _wait_for_backend()
+            print(json.dumps(run_point(sys.argv[2], tiny=False)))
+            if metrics_out:
+                _dump_metrics(metrics_out)
+            return
+        tiny = "--tiny" in sys.argv
+        # suite mode (non-tiny): do NOT touch the TPU here — the lease is
+        # per-process and each point's subprocess needs it
+        run_suite(tiny=tiny, emit=_emit)
         if metrics_out:
             _dump_metrics(metrics_out)
-        return
-    tiny = "--tiny" in sys.argv
-    # suite mode (non-tiny): do NOT touch the TPU here — the lease is
-    # per-process and each point's subprocess needs it
-    run_suite(tiny=tiny, emit=_emit)
-    if metrics_out:
-        _dump_metrics(metrics_out)
 
 
 if __name__ == "__main__":

@@ -32,7 +32,10 @@ from neuronx_distributed_inference_tpu.analysis.findings import Baseline, Findin
 _ANALYSIS_DIR = os.path.dirname(__file__)
 TPULINT_BASELINE = os.path.join(_ANALYSIS_DIR, "tpulint_baseline.json")
 
-ALL_SUITES = ("lint", "flags", "graph", "shard", "memory", "cost", "conc", "kernel")
+ALL_SUITES = (
+    "lint", "flags", "graph", "shard", "memory", "cost", "conc", "kernel",
+    "life",
+)
 
 #: every committed baseline file --write-baseline may rewrite (diffed after)
 BASELINE_FILES = (
@@ -44,6 +47,7 @@ BASELINE_FILES = (
     "conc_baseline.json",
     "kernel_baseline.json",
     "tuning_table.json",
+    "life_baseline.json",
 )
 
 
@@ -68,7 +72,7 @@ def build_parser() -> argparse.ArgumentParser:
         description=(
             "Static-analysis gate: tpulint + flag audit + graph audit + "
             "shard audit + memory audit + cost audit + concurrency audit + "
-            "kernel audit"
+            "kernel audit + lifecycle audit"
         ),
     )
     parser.add_argument("--json", action="store_true", help="JSON report")
@@ -165,6 +169,12 @@ def run_suites(
 
         unbaselined.extend(kernel_audit.run(write_baseline=write_baseline))
         extras["kernel"] = kernel_audit.last_report()
+    if "life" in suites:
+        # pure-AST like conc: no tracing, runs in milliseconds
+        from neuronx_distributed_inference_tpu.analysis import lifecycle_audit
+
+        unbaselined.extend(lifecycle_audit.run(write_baseline=write_baseline))
+        extras["lifecycle"] = lifecycle_audit.last_report()
 
     all_findings = baselined + unbaselined
     if write_baseline and "lint" in suites:
@@ -251,6 +261,12 @@ def main(argv=None) -> int:
         from neuronx_distributed_inference_tpu.analysis import kernel_audit
 
         extras_chunks.append(kernel_audit.render_breakdown(extras["kernel"]))
+    if "lifecycle" in extras:
+        from neuronx_distributed_inference_tpu.analysis import lifecycle_audit
+
+        extras_chunks.append(
+            lifecycle_audit.render_breakdown(extras["lifecycle"])
+        )
     extras_text = "\n".join(c for c in extras_chunks if c) or None
     print(
         findings_mod.render_report(

@@ -444,6 +444,15 @@ BENCH_ROW_MODELS: Dict[str, dict] = {
     "serving_1b_int8_disagg": dict(model=LLAMA_1B, kind="serving", batch=4,
                                    kv_width=1024, weight_dtype="int8",
                                    kv_dtype="bfloat16"),
+    # elastic add/retire row (ISSUE 20): the DEVICE ceiling is the router
+    # row's — retiring one replica mid-drain and adding a fresh one changes
+    # WHICH replica streams each request, not what a replica's chip streams
+    # per step; the row's own numbers (retired/added counts, leaked blocks
+    # and threads, attainment vs the static drain) are stewardship metrics
+    # the device model does not project
+    "serving_1b_int8_elastic": dict(model=LLAMA_1B, kind="serving", batch=4,
+                                    kv_width=1024, weight_dtype="int8",
+                                    kv_dtype="bfloat16"),
     # open-loop goodput rows (ISSUE 14): the DEVICE ceiling is the same
     # full-slot serving projection — goodput (SLO-met tokens/s) is bounded
     # by throughput, which is bounded by this; the rows' own numbers

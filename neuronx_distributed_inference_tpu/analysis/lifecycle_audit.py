@@ -1,0 +1,1089 @@
+"""Lifecycle & resource-stewardship analyzer (LIFE8xx): every resource a
+request acquires is provably released on every terminal outcome.
+
+The serving stack's containment story (PRs 7/10/15) is a RELEASE story: a
+typed failure degrades to one failed request because ``_finish`` /
+``_preempt`` / ``harvest`` give back everything the request owned — its
+serving slot, its KV blocks, its prefix-cache refcounts, its hand-off
+payload, its telemetry span. The concurrency audit (PR 13) pins WHO may
+write shared state; nothing pinned WHETHER every acquisition reaches a
+paired release. That gap is exactly where elastic fleet changes (grow/
+shrink replicas mid-run — ROADMAP "Elastic fleet") would leak, so — in the
+PR-13 tradition of shipping the analyzer first — this suite proves resource
+stewardship over the AST + traced call graph and pins the census to
+``analysis/life_baseline.json``:
+
+- **LIFE801 acquire/release pairing census** — every acquisition site in
+  scope is mined and classified by resource: serving-slot assignment
+  (``self.slots[i] = req``), KV block allocation (``alloc_seq``),
+  prefix-cache refcount acquisition (``match_prefix``/``commit_seq``),
+  hand-off payload extraction (``extract_request_kv``), telemetry span open
+  (``tel.span(...)``). Gate (zero error budget): a module with acquisitions
+  of a resource must carry paired release sites (``slots[i] = None``,
+  ``free_seq``/``quarantine_seq``, ``inject_request_kv``); every terminal
+  handler (a function assigning STATUS_FINISHED/STATUS_FAILED) and the
+  preemption handler must REACH a slot release over the traced call graph;
+  refcount mutation sites must be symmetric (ref sites without unref sites
+  — or the reverse — is an error); a ``.span(...)`` opened outside a
+  ``with`` leaks the open span on any raise. The acquire/release site
+  census is baseline-pinned: a new acquisition site is reviewed like a new
+  collective.
+- **LIFE802 request state-machine extraction** — every ``<req>.status =
+  STATUS_*`` / ``RSTATUS_*`` transition (including consts passed through
+  ``_terminal``-style helpers) is mined into a pinned (state, function)
+  census. Checks: terminal states (FINISHED/FAILED) are assigned only by
+  functions that reach a slot release (the terminal-releases-everything
+  invariant); re-activation transitions (ACTIVE/WAITING/QUEUED/PLACED) may
+  happen ONLY inside the validated doors (``_admit``,
+  ``add_prefilled_request``, ``_preempt``, ``_readmit_preempted``,
+  ``_failover_request``, ``_place_pending``) — a transition out of a
+  terminal state anywhere else is an error. REJECTED is the door-side
+  verdict (no resources held yet) and carries no release obligation.
+- **LIFE803 exception-flow audit** — every ``raise`` reachable from a
+  worker/step entry (``ReplicaHandle.step``, ``_ReplicaStepWorker.run``,
+  the sessions' ``step``) must be caught at a TYPED boundary somewhere in
+  the worker-reachable set (``except RuntimeError``, ``except
+  RETRYABLE_DISPATCH_ERRORS`` — broad ``except Exception`` /
+  ``BaseException`` handlers are transport, not boundaries, and do not
+  count) or sit on the loud-failure allowlist (``WatchdogError``,
+  ``RetraceError`` — designed to propagate with a diagnostic snapshot).
+  A silent-swallow handler (``except:``/``except Exception:`` whose body is
+  only ``pass``) in runtime/ is an error outright (tpulint TPU110 carries
+  the warning-level version for telemetry/).
+- **LIFE804 thread/server lifecycle** — every ``Thread.start()`` site
+  (``_ReplicaStepWorker`` self-start, the ``OpsServer`` serve thread) must
+  have a matching ``join()`` reachable from a close/context-exit path
+  (``close``/``stop``/``shutdown``/``__exit__``) — an unjoined thread
+  outlives its owner and leaks.
+- **LIFE805 replica-death ownership transfer** — the harvest paths provably
+  release or re-queue everything a dead (or retiring) replica owned:
+  ``_failover_replica`` must reach ``harvest`` AND ``_failover_request``;
+  ``harvest`` must clear ``owned``/``_placed_t``/``_readmit``;
+  ``_fail_total_outage`` must reach ``_failover_replica``; the elastic
+  primitives are licensed here — ``retire_replica`` must reach the
+  finalizer and the finalizer must reach the worker ``shutdown`` (join),
+  ``add_replica`` must reach ``_place_pending`` (a warmed handle that never
+  joins placement is dead weight).
+
+Like the other suites: ``python -m neuronx_distributed_inference_tpu.analysis
+--suites life`` exits 0 on a clean tree, ``--write-baseline`` regenerates
+``life_baseline.json`` and prints the unified diff, and the ``--json``
+report carries a ``"lifecycle"`` section with the stewardship breakdown.
+Suppression: ``# life: ignore[LIFE801]`` on the offending line or its
+``def`` line. See docs/STATIC_ANALYSIS.md "Lifecycle audit".
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from neuronx_distributed_inference_tpu.analysis.findings import (
+    Baseline,
+    Finding,
+    SEV_ERROR,
+    SEV_WARNING,
+)
+
+PACKAGE = "neuronx_distributed_inference_tpu"
+BASELINE_PATH = pathlib.Path(__file__).resolve().parent / "life_baseline.json"
+
+#: the audited surface — the request/replica lifecycle layers, matched by
+#: relpath suffix so fixture trees audit identically
+SCOPE_SUFFIXES = (
+    "runtime/serving.py",
+    "runtime/router.py",
+    "runtime/replica.py",
+    "runtime/faults.py",
+    "runtime/disaggregated.py",
+    # the allocator: refcount symmetry (LIFE801) is proven where the
+    # refcounts live
+    "modules/block_kvcache.py",
+    "telemetry/ops_server.py",
+)
+
+#: worker/step entry points for the LIFE803 reachability walk — the code a
+#: replica's step (threaded or not) actually runs
+WORKER_ENTRIES = (
+    ("ReplicaHandle", "step"),
+    ("_ReplicaStepWorker", "run"),
+    ("ServingSession", "step"),
+    ("SpeculativeServingSession", "step"),
+)
+
+#: exceptions DESIGNED to propagate loudly out of a step: diagnostic
+#: snapshot attached / retrace contract violation / unsupported-config
+#: contract guard (NotImplementedError is the Python convention for "this
+#: path must fail loudly, never be handled")
+LOUD_ALLOWLIST = frozenset({
+    "WatchdogError", "RetraceError", "NotImplementedError",
+})
+
+#: tuple-alias except clauses expanded to their member classes (the typed
+#: retry boundaries of runtime/faults.py and runtime/router.py)
+EXC_TUPLE_ALIASES = {
+    "RETRYABLE_DISPATCH_ERRORS": (
+        "TransientDispatchError", "JaxRuntimeError", "XlaRuntimeError",
+    ),
+    "_HANDOFF_RETRYABLE": (
+        "HandoffTransitError", "TransientDispatchError", "JaxRuntimeError",
+        "XlaRuntimeError",
+    ),
+}
+
+#: state constants mined into the LIFE802 machine
+SESSION_TERMINAL = frozenset({"STATUS_FINISHED", "STATUS_FAILED"})
+SESSION_REJECT = frozenset({"STATUS_REJECTED"})
+ROUTER_TERMINAL = frozenset({"RSTATUS_FINISHED", "RSTATUS_FAILED"})
+ROUTER_REJECT = frozenset({"RSTATUS_REJECTED"})
+REACTIVATION = frozenset({
+    "STATUS_ACTIVE", "STATUS_WAITING", "RSTATUS_QUEUED", "RSTATUS_PLACED",
+})
+STATE_CONSTS = (
+    SESSION_TERMINAL | SESSION_REJECT | ROUTER_TERMINAL | ROUTER_REJECT
+    | REACTIVATION
+)
+
+#: the validated doors: the ONLY functions that may move a request back to
+#: a live state (admission, re-admission after preemption, failover
+#: re-queue, placement binding). Everything else re-activating a request is
+#: a transition out of a terminal state the analyzer cannot prove guarded.
+REACTIVATION_DOORS = frozenset({
+    "_admit", "add_prefilled_request", "_preempt", "_readmit_preempted",
+    "_failover_request", "_place_pending", "__init__", "__post_init__",
+})
+
+#: terminal handlers exempt from the release-reach obligation: the door
+#: verdict — the request was never admitted, so it holds nothing
+RELEASE_EXEMPT_FUNCS = frozenset({"_reject"})
+
+#: LIFE805 ownership-transfer reach obligations, enforced whenever the
+#: source function exists in the audited set (fixtures without it skip).
+#: The elastic primitives (ServingRouter.add_replica / retire_replica) are
+#: licensed by the last three entries.
+REQUIRED_REACH = (
+    (("ServingRouter", "_failover_replica"), ("ReplicaHandle", "harvest"),
+     "a dead replica's owned requests are never harvested"),
+    (("ServingRouter", "_failover_replica"),
+     ("ServingRouter", "_failover_request"),
+     "harvested requests are never re-queued to the survivors"),
+    (("ServingRouter", "_fail_total_outage"),
+     ("ServingRouter", "_failover_replica"),
+     "a total outage strands dead replicas' owned requests"),
+    (("ServingRouter", "retire_replica"),
+     ("ServingRouter", "_finalize_retired"),
+     "a retiring replica is never finalized (mesh + worker leak)"),
+    (("ServingRouter", "_finalize_retired"),
+     ("_ReplicaStepWorker", "shutdown"),
+     "scale-in never joins the retired replica's worker thread"),
+    (("ServingRouter", "add_replica"), ("ServingRouter", "_place_pending"),
+     "a newly added replica never joins placement"),
+)
+
+#: attributes ``ReplicaHandle.harvest`` must clear — the dead replica's
+#: ownership ledger; anything left behind is orphaned state
+HARVEST_MUST_CLEAR = ("owned", "_placed_t", "_readmit")
+
+#: close/context-exit roots for the LIFE804 join-reachability walk
+CLOSE_ROOTS = frozenset({"close", "stop", "shutdown", "__exit__"})
+
+_PRAGMA_RE = re.compile(r"#\s*life:\s*ignore(?:\[([A-Z0-9, ]+)\])?")
+
+#: set by :func:`run` — the stewardship breakdown the CLI embeds in --json
+_LAST_REPORT: Dict = {}
+
+
+# ---------------------------------------------------------------------------
+# module / function indexing (lean sibling of the concurrency audit's)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(eq=False)  # identity semantics: _Func instances key dicts/sets
+class _Func:
+    module: str  # scope-relative path (matched suffix)
+    cls: str  # "" for module-level functions
+    name: str
+    node: ast.AST
+    bases: Tuple[str, ...] = ()
+    calls: Set[Tuple[str, str]] = field(default_factory=set)  # (cls, name)
+    worker: bool = False  # reachable from a WORKER_ENTRY
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.cls, self.name)
+
+    @property
+    def qual(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+class _Module:
+    def __init__(self, path: pathlib.Path, scope_rel: str):
+        self.path = path
+        self.rel = scope_rel
+        self.source = path.read_text()
+        self.tree = ast.parse(self.source, filename=str(path))
+        self.pragmas = self._collect_pragmas()
+
+    def _collect_pragmas(self) -> Dict[int, Set[str]]:
+        out: Dict[int, Set[str]] = {}
+        for i, line in enumerate(self.source.splitlines(), start=1):
+            m = _PRAGMA_RE.search(line)
+            if m:
+                rules = m.group(1)
+                out[i] = {r.strip() for r in rules.split(",")} if rules else {"*"}
+        return out
+
+    def suppressed(self, line: int, rule: str, def_line: Optional[int] = None) -> bool:
+        for ln in (line, def_line):
+            if ln is None:
+                continue
+            rules = self.pragmas.get(ln)
+            if rules and ("*" in rules or rule in rules):
+                return True
+        return False
+
+
+def _base_names(node: ast.ClassDef) -> Tuple[str, ...]:
+    """Base names, ``threading.Thread``-style attribute bases included (by
+    their terminal attr) — LIFE804 needs Thread subclasses recognized."""
+    out = []
+    for b in node.bases:
+        if isinstance(b, ast.Name):
+            out.append(b.id)
+        elif isinstance(b, ast.Attribute):
+            out.append(b.attr)
+    return tuple(out)
+
+
+class _Analyzer:
+    def __init__(self, files: List[Tuple[pathlib.Path, str]]):
+        self.modules: List[_Module] = [_Module(p, rel) for p, rel in files]
+        self.findings: List[Finding] = []
+        self.class_bases: Dict[str, Tuple[str, ...]] = {}
+        self.methods: Dict[Tuple[str, str], List[_Func]] = {}
+        self.funcs: List[_Func] = []
+        # (cls, attr) of attributes assigned a Thread(...) instance
+        self.thread_attrs: Set[Tuple[str, str]] = set()
+        self._index()
+        self._build_calls()
+        self._mark_worker_set()
+
+    # ---- indexing --------------------------------------------------------
+
+    def _index(self):
+        for mod in self.modules:
+            for node in mod.tree.body:
+                if isinstance(node, ast.ClassDef):
+                    bases = _base_names(node)
+                    self.class_bases[node.name] = bases
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                            self._add_func(mod, node.name, sub, bases)
+                elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    self._add_func(mod, "", node, ())
+        # thread-holding attributes: self.<attr> = threading.Thread(...)
+        for f in self.funcs:
+            if not f.cls:
+                continue
+            for n in ast.walk(f.node):
+                if not (isinstance(n, ast.Assign) and isinstance(n.value, ast.Call)):
+                    continue
+                v = n.value.func
+                name = v.attr if isinstance(v, ast.Attribute) else (
+                    v.id if isinstance(v, ast.Name) else None
+                )
+                if name != "Thread" and name not in self._thread_subclasses():
+                    continue
+                for t in n.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        self.thread_attrs.add((f.cls, t.attr))
+
+    def _thread_subclasses(self) -> Set[str]:
+        out = set()
+        for cls in self.class_bases:
+            if "Thread" in self._hierarchy_up(cls):
+                out.add(cls)
+        return out
+
+    def _hierarchy_up(self, cls: str) -> Set[str]:
+        """cls + transitive base names (in-scope bases expand; others — like
+        ``Thread`` — stay as leaf names)."""
+        out = {cls}
+        frontier = [cls]
+        while frontier:
+            c = frontier.pop()
+            for b in self.class_bases.get(c, ()):
+                if b not in out:
+                    out.add(b)
+                    frontier.append(b)
+        return out
+
+    def _hierarchy(self, cls: str) -> Set[str]:
+        """cls + in-scope bases + in-scope subclasses (method resolution
+        fans out over the hierarchy — the conservative direction)."""
+        out = self._hierarchy_up(cls)
+        changed = True
+        while changed:
+            changed = False
+            for c, bases in self.class_bases.items():
+                if c not in out and any(b in out for b in bases):
+                    out.add(c)
+                    changed = True
+        return out
+
+    def _add_func(self, mod: _Module, cls: str, node, bases):
+        f = _Func(module=mod.rel, cls=cls, name=node.name, node=node, bases=bases)
+        f._mod = mod  # type: ignore[attr-defined]
+        self.funcs.append(f)
+        self.methods.setdefault((cls, node.name), []).append(f)
+        # nested defs (dispatch closures): their own functions in the same
+        # class context, with an implicit call edge from the parent
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and sub is not node
+            ):
+                nf = _Func(module=mod.rel, cls=cls, name=sub.name, node=sub,
+                           bases=bases)
+                nf._mod = mod  # type: ignore[attr-defined]
+                self.funcs.append(nf)
+                self.methods.setdefault((cls, sub.name), []).append(nf)
+                f.calls.add((cls, sub.name))
+
+    # ---- call graph ------------------------------------------------------
+
+    def _build_calls(self):
+        by_name: Dict[str, List[Tuple[str, str]]] = {}
+        for (cls, name) in self.methods:
+            if cls:
+                by_name.setdefault(name, []).append((cls, name))
+        for f in self.funcs:
+            for n in ast.walk(f.node):
+                if not isinstance(n, ast.Call):
+                    continue
+                fn = n.func
+                if isinstance(fn, ast.Name):
+                    if ("", fn.id) in self.methods:
+                        f.calls.add(("", fn.id))
+                    continue
+                if not isinstance(fn, ast.Attribute):
+                    continue
+                m = fn.attr
+                recv = fn.value
+                if isinstance(recv, ast.Name) and recv.id == "self" and f.cls:
+                    hit = False
+                    for c in self._hierarchy(f.cls):
+                        if (c, m) in self.methods:
+                            f.calls.add((c, m))
+                            hit = True
+                    if hit:
+                        continue
+                # receiver of unknown type: fan out to every same-named
+                # in-scope method when the candidate set is small — the
+                # conservative direction for reach obligations (`h.harvest()`
+                # must find ReplicaHandle.harvest without a type checker)
+                cands = by_name.get(m, [])
+                if 1 <= len(cands) <= 6:
+                    f.calls.update(cands)
+
+    def _reachable(self, seeds: List[Tuple[str, str]]) -> Set[int]:
+        seen: Set[int] = set()
+        frontier: List[_Func] = []
+        for key in seeds:
+            for g in self.methods.get(key, []):
+                if id(g) not in seen:
+                    seen.add(id(g))
+                    frontier.append(g)
+        while frontier:
+            g = frontier.pop()
+            for key in g.calls:
+                for h in self.methods.get(key, []):
+                    if id(h) not in seen:
+                        seen.add(id(h))
+                        frontier.append(h)
+        return seen
+
+    def _mark_worker_set(self):
+        for fid in self._reachable(list(WORKER_ENTRIES)):
+            pass  # ids only; mark via second pass below
+        worker_ids = self._reachable(list(WORKER_ENTRIES))
+        for f in self.funcs:
+            if id(f) in worker_ids:
+                f.worker = True
+
+    def _func_reaches(self, src: _Func, dst: Tuple[str, str]) -> bool:
+        targets = {id(g) for g in self.methods.get(dst, [])}
+        return bool(targets & self._reachable([src.key])) or src.key == dst
+
+    # ---- emission --------------------------------------------------------
+
+    def _emit(self, f: _Func, node, rule, severity, message, key):
+        line = getattr(node, "lineno", 0)
+        mod: _Module = f._mod  # type: ignore[attr-defined]
+        if mod.suppressed(line, rule, getattr(f.node, "lineno", None)):
+            return
+        self.findings.append(Finding(
+            rule=rule, severity=severity,
+            location=f"{f.module}:{line}", message=message, key=key,
+        ))
+
+    # ---- LIFE801: acquire/release pairing census -------------------------
+
+    @staticmethod
+    def _call_attr(n) -> Optional[str]:
+        if isinstance(n, ast.Call):
+            if isinstance(n.func, ast.Attribute):
+                return n.func.attr
+            if isinstance(n.func, ast.Name):
+                return n.func.id
+        return None
+
+    def _resource_sites(self, f: _Func):
+        """Yield (node, resource, kind) for acquire/release sites in f.
+        kind is 'acquire' | 'release'."""
+        with_items = set()
+        for n in ast.walk(f.node):
+            if isinstance(n, ast.With):
+                for item in n.items:
+                    with_items.add(id(item.context_expr))
+        for n in ast.walk(f.node):
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Attribute)
+                        and t.value.attr == "slots"
+                    ):
+                        is_none = (
+                            isinstance(n.value, ast.Constant)
+                            and n.value.value is None
+                        )
+                        yield (n, "slot", "release" if is_none else "acquire")
+            attr = self._call_attr(n)
+            if attr is None:
+                continue
+            if attr == "alloc_seq":
+                yield (n, "kv_blocks", "acquire")
+            elif attr in ("free_seq", "quarantine_seq"):
+                yield (n, "kv_blocks", "release")
+                yield (n, "prefix_ref", "release")
+            elif attr in ("match_prefix", "commit_seq"):
+                yield (n, "prefix_ref", "acquire")
+            elif attr == "extract_request_kv":
+                yield (n, "handoff_payload", "acquire")
+            elif attr == "inject_request_kv":
+                yield (n, "handoff_payload", "release")
+            elif attr == "span" and isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute
+            ):
+                if id(n) in with_items:
+                    yield (n, "span", "acquire")
+                else:
+                    yield (n, "span", "unscoped")
+
+    def _refcount_sites(self, f: _Func):
+        """Yield (node, 'ref'|'unref') for refcount-table mutations."""
+        for n in ast.walk(f.node):
+            if isinstance(n, ast.AugAssign) and isinstance(
+                n.target, ast.Subscript
+            ) and isinstance(n.target.value, ast.Attribute) and (
+                n.target.value.attr == "refcount"
+            ):
+                yield (n, "ref" if isinstance(n.op, ast.Add) else "unref")
+            elif isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Attribute)
+                        and t.value.attr == "refcount"
+                        and isinstance(n.value, ast.BinOp)
+                    ):
+                        yield (
+                            n,
+                            "ref" if isinstance(n.value.op, ast.Add) else "unref",
+                        )
+            elif isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute
+            ) and n.func.attr == "pop" and isinstance(
+                n.func.value, ast.Attribute
+            ) and n.func.value.attr == "refcount":
+                yield (n, "unref")
+
+    def rule_pairing(self, transitions):
+        by_module_res: Dict[Tuple[str, str], Dict[str, int]] = {}
+        for f in self.funcs:
+            for node, res, kind in self._resource_sites(f):
+                if kind == "unscoped":
+                    self._emit(
+                        f, node, "LIFE801", SEV_ERROR,
+                        f"`.span(...)` opened outside a `with` in `{f.qual}` "
+                        f"— a raise before close leaks the open span (every "
+                        f"span site must be a `with tel.span(...)` item)",
+                        key=f"{f.module}::span-no-with",
+                    )
+                    continue
+                d = by_module_res.setdefault((f.module, res), {})
+                d[kind] = d.get(kind, 0) + 1
+                self._emit(
+                    f, node, "LIFE801", SEV_WARNING,
+                    f"resource census: {res} {kind} in `{f.qual}`",
+                    key=f"{f.module}::{res}-{kind}::{f.qual}",
+                )
+        # per-module pairing: acquisitions demand release sites. The
+        # hand-off payload pairs across modules (extracted tier-side,
+        # injected decode-side), so its zero-release check only applies
+        # when the injecting module (runtime/serving.py) is in the audited
+        # set — single-file fixtures of the extract side stay clean.
+        mods_audited = {m.rel for m in self.modules}
+        for (module, res), d in sorted(by_module_res.items()):
+            if res == "span":
+                continue
+            if d.get("acquire") and not d.get("release"):
+                if res == "handoff_payload":
+                    if "runtime/serving.py" not in mods_audited:
+                        continue
+                    released_anywhere = any(
+                        dd.get("release")
+                        for (_m, r), dd in by_module_res.items()
+                        if r == res
+                    )
+                    if released_anywhere:
+                        continue
+                mod = next(m for m in self.modules if m.rel == module)
+                self.findings.append(Finding(
+                    rule="LIFE801", severity=SEV_ERROR,
+                    location=f"{module}:0",
+                    message=(
+                        f"leaked {res}: {module} acquires {res} "
+                        f"({d['acquire']} site(s)) but carries no paired "
+                        f"release site — every terminal outcome must give "
+                        f"the resource back"
+                    ),
+                    key=f"{module}::{res}-unreleased",
+                ))
+        # terminal/preempt handlers must REACH a slot release
+        release_funcs = set()
+        for f in self.funcs:
+            for _node, res, kind in self._resource_sites(f):
+                if res == "slot" and kind == "release":
+                    release_funcs.add(f.key)
+        for f, consts in transitions.items():
+            if f.name in RELEASE_EXEMPT_FUNCS:
+                continue
+            if not (consts & SESSION_TERMINAL):
+                continue
+            mod_has_slots = any(
+                ff.module == f.module and any(
+                    r == "slot" for _n, r, k in self._resource_sites(ff)
+                )
+                for ff in self.funcs
+            )
+            if not mod_has_slots:
+                continue
+            reach = self._reachable([f.key]) | {id(g) for g in
+                                               self.methods.get(f.key, [])}
+            hit = any(
+                id(g) in reach
+                for key in release_funcs
+                for g in self.methods.get(key, [])
+            )
+            if not hit:
+                self._emit(
+                    f, f.node, "LIFE801", SEV_ERROR,
+                    f"leaked slot: terminal handler `{f.qual}` assigns a "
+                    f"terminal status but never reaches a slot release "
+                    f"(`slots[i] = None`) — the terminal outcome strands "
+                    f"the request's serving slot",
+                    key=f"{f.module}::terminal-no-release::{f.qual}",
+                )
+        # refcount symmetry
+        refs: Dict[str, Dict[str, int]] = {}
+        for f in self.funcs:
+            for node, kind in self._refcount_sites(f):
+                d = refs.setdefault(f.module, {})
+                d[kind] = d.get(kind, 0) + 1
+                self._emit(
+                    f, node, "LIFE801", SEV_WARNING,
+                    f"refcount census: {kind} site in `{f.qual}`",
+                    key=f"{f.module}::refcount-{kind}::{f.qual}",
+                )
+        for module, d in sorted(refs.items()):
+            if d.get("ref") and not d.get("unref"):
+                self.findings.append(Finding(
+                    rule="LIFE801", severity=SEV_ERROR, location=f"{module}:0",
+                    message=(
+                        f"unpaired ref: {module} increments prefix-cache "
+                        f"refcounts ({d['ref']} site(s)) with no decrement "
+                        f"site — shared blocks can never recycle"
+                    ),
+                    key=f"{module}::refcount-unpaired-ref",
+                ))
+            elif d.get("unref") and not d.get("ref"):
+                self.findings.append(Finding(
+                    rule="LIFE801", severity=SEV_ERROR, location=f"{module}:0",
+                    message=(
+                        f"unpaired unref: {module} decrements prefix-cache "
+                        f"refcounts ({d['unref']} site(s)) with no increment "
+                        f"site — refcounts go negative and evict live blocks"
+                    ),
+                    key=f"{module}::refcount-unpaired-unref",
+                ))
+        self._refcount_totals = {
+            "ref_sites": sum(d.get("ref", 0) for d in refs.values()),
+            "unref_sites": sum(d.get("unref", 0) for d in refs.values()),
+        }
+        self._resource_totals = {}
+        for (_m, res), d in by_module_res.items():
+            tot = self._resource_totals.setdefault(
+                res, {"acquire": 0, "release": 0}
+            )
+            for kind in ("acquire", "release"):
+                tot[kind] += d.get(kind, 0)
+
+    # ---- LIFE802: state-machine extraction -------------------------------
+
+    def _mine_transitions(self) -> Dict[_Func, Set[str]]:
+        """(function -> state consts it assigns or passes to a terminal
+        helper). Also emits the pinned (state, function) census."""
+        out: Dict[_Func, Set[str]] = {}
+        for f in self.funcs:
+            consts: Set[str] = set()
+            sites: List[Tuple[ast.AST, str]] = []
+            for n in ast.walk(f.node):
+                if isinstance(n, ast.Assign) and isinstance(
+                    n.value, ast.Name
+                ) and n.value.id in STATE_CONSTS:
+                    for t in n.targets:
+                        if isinstance(t, ast.Attribute) and t.attr == "status":
+                            consts.add(n.value.id)
+                            sites.append((n, n.value.id))
+                elif isinstance(n, ast.Call):
+                    for a in n.args:
+                        if isinstance(a, ast.Name) and a.id in STATE_CONSTS:
+                            consts.add(a.id)
+                            sites.append((n, a.id))
+            if consts:
+                out[f] = consts
+                for node, const in sites:
+                    self._emit(
+                        f, node, "LIFE802", SEV_WARNING,
+                        f"state transition census: -> {const} in `{f.qual}`",
+                        key=f"{f.module}::{const}::{f.qual}",
+                    )
+        return out
+
+    def rule_state_machine(self, transitions: Dict[_Func, Set[str]]):
+        for f, consts in transitions.items():
+            live = consts & REACTIVATION
+            if live and f.name not in REACTIVATION_DOORS:
+                self._emit(
+                    f, f.node, "LIFE802", SEV_ERROR,
+                    f"`{f.qual}` re-activates a request "
+                    f"({', '.join(sorted(live))}) outside the validated "
+                    f"doors ({', '.join(sorted(REACTIVATION_DOORS - {'__init__', '__post_init__'}))}) "
+                    f"— a transition out of a terminal state cannot be "
+                    f"proven guarded; re-admission must re-enter through "
+                    f"the door",
+                    key=f"{f.module}::reactivation-outside-door::{f.qual}",
+                )
+        self._state_totals: Dict[str, int] = {}
+        for consts in transitions.values():
+            for c in consts:
+                self._state_totals[c] = self._state_totals.get(c, 0) + 1
+
+    # ---- LIFE803: exception-flow audit -----------------------------------
+
+    def _exc_class_bases(self, name: str) -> Set[str]:
+        return self._hierarchy_up(name) if name in self.class_bases else {name}
+
+    def rule_exception_flow(self):
+        catchable: Set[str] = set()
+        for f in self.funcs:
+            if not f.worker:
+                continue
+            for n in ast.walk(f.node):
+                if not isinstance(n, ast.ExceptHandler):
+                    continue
+                names = []
+                t = n.type
+                elts = t.elts if isinstance(t, ast.Tuple) else ([t] if t else [])
+                for e in elts:
+                    if isinstance(e, ast.Name):
+                        names.append(e.id)
+                    elif isinstance(e, ast.Attribute):
+                        names.append(e.attr)
+                for name in names:
+                    if name in ("Exception", "BaseException"):
+                        continue  # transport, not a typed boundary
+                    catchable.update(EXC_TUPLE_ALIASES.get(name, (name,)))
+        for f in self.funcs:
+            # silent swallow: broad except whose body is only pass — an
+            # error in runtime/ regardless of worker reachability
+            mod_rel = f.module
+            for n in ast.walk(f.node):
+                if isinstance(n, ast.ExceptHandler) and mod_rel.startswith(
+                    "runtime/"
+                ):
+                    broad = n.type is None or (
+                        isinstance(n.type, ast.Name)
+                        and n.type.id in ("Exception", "BaseException")
+                    )
+                    silent = all(
+                        isinstance(s, ast.Pass)
+                        or (isinstance(s, ast.Expr)
+                            and isinstance(s.value, ast.Constant))
+                        for s in n.body
+                    )
+                    if broad and silent:
+                        self._emit(
+                            f, n, "LIFE803", SEV_ERROR,
+                            f"silent-swallow `except{': ' + n.type.id if isinstance(n.type, ast.Name) else ''}: pass` "
+                            f"in `{f.qual}` — a swallowed failure on a "
+                            f"runtime path is an invisible leak; catch the "
+                            f"typed class or let it propagate loudly",
+                            key=f"{mod_rel}::silent-swallow",
+                        )
+            if not f.worker:
+                continue
+            for n in ast.walk(f.node):
+                if not isinstance(n, ast.Raise):
+                    continue
+                if n.exc is None:
+                    self._emit(
+                        f, n, "LIFE803", SEV_WARNING,
+                        f"raise census: re-raise in `{f.qual}`",
+                        key=f"{f.module}::reraise::{f.qual}",
+                    )
+                    continue
+                exc = n.exc
+                cname = None
+                if isinstance(exc, ast.Call):
+                    fn = exc.func
+                    cname = fn.id if isinstance(fn, ast.Name) else (
+                        fn.attr if isinstance(fn, ast.Attribute) else None
+                    )
+                elif isinstance(exc, ast.Name):
+                    cname = exc.id
+                if cname is None:
+                    continue
+                if cname[:1].islower() and cname not in self.class_bases:
+                    # `raise err`: re-raising a transported/caught exception
+                    # object (the worker->router barrier pattern), not a new
+                    # failure origin — the origin was classified at its own
+                    # raise site
+                    self._emit(
+                        f, n, "LIFE803", SEV_WARNING,
+                        f"raise census: transported re-raise `{cname}` in "
+                        f"`{f.qual}`",
+                        key=f"{f.module}::reraise::{f.qual}",
+                    )
+                    continue
+                if cname in LOUD_ALLOWLIST:
+                    self._emit(
+                        f, n, "LIFE803", SEV_WARNING,
+                        f"raise census: loud `{cname}` in `{f.qual}` "
+                        f"(designed to propagate)",
+                        key=f"{f.module}::loud::{cname}::{f.qual}",
+                    )
+                    continue
+                if self._exc_class_bases(cname) & catchable:
+                    self._emit(
+                        f, n, "LIFE803", SEV_WARNING,
+                        f"raise census: `{cname}` in `{f.qual}` caught at a "
+                        f"typed boundary",
+                        key=f"{f.module}::caught::{cname}::{f.qual}",
+                    )
+                    continue
+                self._emit(
+                    f, n, "LIFE803", SEV_ERROR,
+                    f"uncaught worker raise: `{cname}` in `{f.qual}` is "
+                    f"reachable from a worker/step entry but no typed "
+                    f"boundary in the worker-reachable set catches it and "
+                    f"it is not on the loud-failure allowlist "
+                    f"({', '.join(sorted(LOUD_ALLOWLIST))}) — it would "
+                    f"tear down the replica thread mid-step",
+                    key=f"{f.module}::uncaught::{cname}::{f.qual}",
+                )
+
+    # ---- LIFE804: thread/server lifecycle --------------------------------
+
+    def _thread_start_sites(self, f: _Func):
+        """Yield (node, identity) for Thread start() calls; identity is
+        ('class', cls) for Thread-subclass self-starts and ('attr', attr)
+        for stored thread objects."""
+        threads = self._thread_subclasses()
+        for n in ast.walk(f.node):
+            if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)):
+                continue
+            if n.func.attr != "start":
+                continue
+            recv = n.func.value
+            if isinstance(recv, ast.Name) and recv.id == "self" and f.cls in threads:
+                yield (n, ("class", f.cls))
+            elif (
+                isinstance(recv, ast.Attribute)
+                and isinstance(recv.value, ast.Name)
+                and recv.value.id == "self"
+                and (f.cls, recv.attr) in self.thread_attrs
+            ):
+                yield (n, ("attr", recv.attr))
+
+    def _join_identities(self, f: _Func) -> Set[Tuple[str, str]]:
+        # locals aliasing self-attributes (`thread = self._thread`, incl.
+        # tuple unpacking) count as joins of the aliased attribute
+        alias: Dict[str, str] = {}
+        for n in ast.walk(f.node):
+            if not isinstance(n, ast.Assign) or len(n.targets) != 1:
+                continue
+            t, v = n.targets[0], n.value
+            pairs = []
+            if isinstance(t, ast.Tuple) and isinstance(v, ast.Tuple) and len(
+                t.elts
+            ) == len(v.elts):
+                pairs = list(zip(t.elts, v.elts))
+            else:
+                pairs = [(t, v)]
+            for tt, vv in pairs:
+                if (
+                    isinstance(tt, ast.Name)
+                    and isinstance(vv, ast.Attribute)
+                    and isinstance(vv.value, ast.Name)
+                    and vv.value.id == "self"
+                ):
+                    alias[tt.id] = vv.attr
+        out: Set[Tuple[str, str]] = set()
+        threads = self._thread_subclasses()
+        for n in ast.walk(f.node):
+            if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)):
+                continue
+            if n.func.attr != "join":
+                continue
+            recv = n.func.value
+            if isinstance(recv, ast.Name) and recv.id == "self" and f.cls in threads:
+                out.add(("class", f.cls))
+            elif isinstance(recv, ast.Attribute):
+                out.add(("attr", recv.attr))
+            elif isinstance(recv, ast.Name):
+                if recv.id in alias:
+                    out.add(("attr", alias[recv.id]))
+                out.add(("var", recv.id))
+        return out
+
+    def rule_thread_lifecycle(self):
+        close_seeds = [k for k in self.methods if k[1] in CLOSE_ROOTS]
+        close_reach = self._reachable(close_seeds)
+        joined: Set[Tuple[str, str]] = set()
+        for f in self.funcs:
+            if id(f) not in close_reach:
+                continue
+            joined |= self._join_identities(f)
+        n_starts = 0
+        for f in self.funcs:
+            for node, ident in self._thread_start_sites(f):
+                n_starts += 1
+                self._emit(
+                    f, node, "LIFE804", SEV_WARNING,
+                    f"thread census: start of {ident[1]} in `{f.qual}`",
+                    key=f"{f.module}::thread-start::{ident[1]}",
+                )
+                if ident not in joined:
+                    self._emit(
+                        f, node, "LIFE804", SEV_ERROR,
+                        f"unjoined thread: `{ident[1]}` started in "
+                        f"`{f.qual}` has no `join()` reachable from a "
+                        f"close/stop/shutdown/__exit__ path — the thread "
+                        f"outlives its owner (leak on every teardown)",
+                        key=f"{f.module}::thread-unjoined::{ident[1]}",
+                    )
+        self._thread_starts = n_starts
+
+    # ---- LIFE805: replica-death ownership transfer -----------------------
+
+    def rule_ownership_transfer(self):
+        passed: List[str] = []
+        for src_key, dst_key, why in REQUIRED_REACH:
+            srcs = self.methods.get(src_key, [])
+            if not srcs:
+                continue
+            label = (
+                f"{src_key[0]}.{src_key[1]}->{dst_key[0]}.{dst_key[1]}"
+            )
+            for src in srcs:
+                if self._func_reaches(src, dst_key):
+                    passed.append(label)
+                else:
+                    self._emit(
+                        src, src.node, "LIFE805", SEV_ERROR,
+                        f"ownership transfer broken: `{src.qual}` never "
+                        f"reaches `{dst_key[0]}.{dst_key[1]}` — {why}",
+                        key=f"{src.module}::reach::{label}",
+                    )
+        self._reach_passed = sorted(set(passed))
+        # harvest must clear the whole ownership ledger
+        for f in self.methods.get(("ReplicaHandle", "harvest"), []):
+            cleared = set()
+            for n in ast.walk(f.node):
+                if (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "clear"
+                    and isinstance(n.func.value, ast.Attribute)
+                ):
+                    cleared.add(n.func.value.attr)
+            for attr in HARVEST_MUST_CLEAR:
+                if attr not in cleared:
+                    self._emit(
+                        f, f.node, "LIFE805", SEV_ERROR,
+                        f"orphaned dead-replica state: `{f.qual}` does not "
+                        f"clear `{attr}` — the dead replica's ledger keeps "
+                        f"rows the router believes were transferred",
+                        key=f"{f.module}::harvest-keeps::{attr}",
+                    )
+
+    # ---- driver ----------------------------------------------------------
+
+    def run(self) -> List[Finding]:
+        transitions = self._mine_transitions()
+        self.rule_pairing(transitions)
+        self.rule_state_machine(transitions)
+        self.rule_exception_flow()
+        self.rule_thread_lifecycle()
+        self.rule_ownership_transfer()
+        self.findings.sort(key=lambda f: (f.rule, f.key, f.location))
+        return self.findings
+
+
+# ---------------------------------------------------------------------------
+# entry points (mirrors the concurrency audit's shape)
+# ---------------------------------------------------------------------------
+
+
+def _scope_files(root: Optional[pathlib.Path] = None) -> List[Tuple[pathlib.Path, str]]:
+    if root is None:
+        root = pathlib.Path(__file__).resolve().parents[2]
+    pkg = root / PACKAGE
+    out = []
+    for suffix in SCOPE_SUFFIXES:
+        p = pkg / suffix
+        if p.is_file():
+            out.append((p, suffix))
+    return out
+
+
+def _match_scope(path: pathlib.Path) -> Optional[str]:
+    s = str(path)
+    for suffix in SCOPE_SUFFIXES:
+        if s.endswith(suffix):
+            return suffix
+    # fixture fallback: match by basename so tmp-dir snippets audit as the
+    # file they stand in for
+    for suffix in SCOPE_SUFFIXES:
+        if path.name == pathlib.Path(suffix).name:
+            return suffix
+    return None
+
+
+def audit_paths(paths: List[pathlib.Path]) -> List[Finding]:
+    """Audit arbitrary snippet files (test fixtures): each file is scoped by
+    suffix/basename match against :data:`SCOPE_SUFFIXES` and the RAW
+    findings (census entries included, no baseline filtering) come back."""
+    files = []
+    for p in paths:
+        rel = _match_scope(p)
+        if rel is None:
+            raise ValueError(
+                f"{p}: not a recognizable scope file (expected one of "
+                f"{SCOPE_SUFFIXES} by suffix or basename)"
+            )
+        files.append((p, rel))
+    return _Analyzer(files).run()
+
+
+def _build_report(an: _Analyzer, findings: List[Finding]) -> Dict:
+    census: Dict[str, int] = {}
+    errors = 0
+    raises = {"caught": 0, "loud": 0, "reraise": 0}
+    for f in findings:
+        if f.severity == SEV_ERROR:
+            errors += 1
+            continue
+        census[f.key] = census.get(f.key, 0) + 1
+        if f.rule == "LIFE803":
+            kind = f.key.split("::", 2)[1]
+            if kind in raises:
+                raises[kind] += 1
+    return {
+        "errors": errors,
+        "resources": getattr(an, "_resource_totals", {}),
+        "refcount": getattr(an, "_refcount_totals", {}),
+        "states": dict(sorted(getattr(an, "_state_totals", {}).items())),
+        "raises": raises,
+        "thread_starts": getattr(an, "_thread_starts", 0),
+        "reach_checks": getattr(an, "_reach_passed", []),
+        "census": dict(sorted(census.items())),
+        "worker_entries": [f"{c}.{m}" for c, m in WORKER_ENTRIES],
+    }
+
+
+def last_report() -> Dict:
+    return _LAST_REPORT
+
+
+def render_breakdown(report: Optional[Dict] = None) -> str:
+    rep = report if report is not None else _LAST_REPORT
+    if not rep:
+        return ""
+    res = rep.get("resources", {})
+    lines = [
+        "lifecycle resource-stewardship census "
+        f"({sum(d.get('acquire', 0) for d in res.values())} acquire / "
+        f"{sum(d.get('release', 0) for d in res.values())} release sites; "
+        f"worker entries: {', '.join(rep['worker_entries'])}):"
+    ]
+    for name, d in sorted(res.items()):
+        lines.append(
+            f"  {name:>16}: {d.get('acquire', 0)} acquire / "
+            f"{d.get('release', 0)} release"
+        )
+    rc = rep.get("refcount", {})
+    if rc:
+        lines.append(
+            f"  refcount symmetry: {rc.get('ref_sites', 0)} ref / "
+            f"{rc.get('unref_sites', 0)} unref sites"
+        )
+    rz = rep.get("raises", {})
+    lines.append(
+        f"  worker raises: {rz.get('caught', 0)} caught, "
+        f"{rz.get('loud', 0)} loud, {rz.get('reraise', 0)} re-raise; "
+        f"threads started/joined: {rep.get('thread_starts', 0)}"
+    )
+    if rep.get("reach_checks"):
+        lines.append(
+            "  ownership-transfer reach: " + ", ".join(rep["reach_checks"])
+        )
+    return "\n".join(lines)
+
+
+def run(write_baseline: bool = False) -> List[Finding]:
+    """Audit the real tree against ``life_baseline.json``; returns the NEW
+    (gate-failing) findings. Errors (leaks, unpaired refs, uncaught worker
+    raises, unjoined threads, broken ownership transfer) are never
+    baselined — only the acquire/release, state and raise censuses are."""
+    global _LAST_REPORT
+    an = _Analyzer(_scope_files())
+    findings = an.run()
+    _LAST_REPORT = _build_report(an, findings)
+    warnings = [f for f in findings if f.severity == SEV_WARNING]
+    errors = [f for f in findings if f.severity == SEV_ERROR]
+    if write_baseline:
+        Baseline.from_findings(warnings).save(BASELINE_PATH)
+        return errors
+    return Baseline.load(BASELINE_PATH).filter_new(warnings) + errors

@@ -55,6 +55,15 @@ The rules encode contracts the runtime relies on but Python cannot enforce:
   an owning class (where the CONC601 ownership model classifies it) or
   suppress with a written-down justification (e.g. a decoration-time-only
   registry).
+- **TPU110 silent-swallow** (warning, baselined — zero entries expected):
+  a bare ``except:`` or ``except Exception/BaseException:`` handler whose
+  body is only ``pass`` in ``runtime/`` or ``telemetry/``. A swallowed
+  failure on a serving or observability path is an invisible leak — the
+  containment story (typed degradation, loud failure) depends on every
+  broad catch either handling or re-raising. Catch the typed class (see the
+  narrowed ``compilation_cache`` guard in runtime/application.py) or let it
+  propagate. The lifecycle audit (LIFE803) carries the ERROR-level version
+  for runtime/.
 - **TPU108 large-unsharded-constant** (warning, baselined — zero entries
   expected): a ``jnp.zeros/ones/full/arange/eye/...`` call with a
   STATICALLY-known element count ≥ 2**20 inside a jit-traced body, not
@@ -1008,6 +1017,42 @@ class _Linter:
                                 def_line=info.node.lineno,
                             )
 
+    def rule_silent_swallow(self):
+        """TPU110: `except: pass` / `except Exception: pass` in runtime/ or
+        telemetry/ — a silently swallowed failure on a serving or
+        observability path."""
+        for mod in self.modules.values():
+            if not (
+                "runtime/" in mod.relpath or "telemetry/" in mod.relpath
+            ):
+                continue
+            for n in ast.walk(mod.tree):
+                if not isinstance(n, ast.ExceptHandler):
+                    continue
+                broad = n.type is None or (
+                    isinstance(n.type, ast.Name)
+                    and n.type.id in ("Exception", "BaseException")
+                )
+                silent = all(
+                    isinstance(s, ast.Pass)
+                    or (isinstance(s, ast.Expr)
+                        and isinstance(s.value, ast.Constant))
+                    for s in n.body
+                )
+                if broad and silent:
+                    what = (
+                        n.type.id if isinstance(n.type, ast.Name)
+                        else "bare except"
+                    )
+                    self._emit(
+                        mod, n, "TPU110", SEV_WARNING,
+                        f"silent-swallow `except {what}: pass` — a broad "
+                        f"catch that discards the failure hides leaks and "
+                        f"corruption on a runtime/telemetry path; catch the "
+                        f"typed class or re-raise",
+                        key=f"{mod.relpath}::silent-swallow",
+                    )
+
     def run(self) -> List[Finding]:
         self.index_functions()
         self.seed_traced()
@@ -1020,6 +1065,7 @@ class _Linter:
         self.rule_pallas_interpret()
         self.rule_mutable_defaults()
         self.rule_module_mutable_state()
+        self.rule_silent_swallow()
         self.findings.sort(key=lambda f: (f.location, f.rule))
         return self.findings
 

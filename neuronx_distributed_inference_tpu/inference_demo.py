@@ -386,6 +386,11 @@ def build_parser() -> argparse.ArgumentParser:
                           "span timeline as Chrome trace-event JSON to this "
                           "path at exit (load in Perfetto / chrome://tracing; "
                           "docs/OBSERVABILITY.md)")
+    run.add_argument("--ops-port", type=int, default=None,
+                     help="serve the live ops surface (/metrics, /healthz, "
+                          "/slo; docs/OBSERVABILITY.md) on this port for the "
+                          "duration of the run (0 = ephemeral); the server "
+                          "thread is joined on exit even if the run raises")
     return p
 
 
@@ -741,17 +746,34 @@ def run_inference(args) -> int:
     else:
         profile_ctx = contextlib.nullcontext()
 
-    with profile_ctx:
-        if draft_app is not None:
-            from neuronx_distributed_inference_tpu.runtime.assisted import assisted_generate
+    # the ops HTTP surface rides the run as a CONTEXT MANAGER so its serve
+    # thread is joined even when generation raises (LIFE804)
+    if args.ops_port is not None:
+        from neuronx_distributed_inference_tpu.telemetry import default_registry
+        from neuronx_distributed_inference_tpu.telemetry.ops_server import OpsServer
 
-            out = assisted_generate(
-                app, draft_app, input_ids, attention_mask,
-                max_new_tokens=args.max_new_tokens, eos_token_id=eos_token_id,
-                speculation_length=max(args.speculation_length, 2),
-            )
-        else:
-            out = app.generate(input_ids, attention_mask, **gen_kwargs)
+        ops_ctx = OpsServer(
+            (metrics_session.registry if metrics_session is not None
+             else default_registry()),
+            port=args.ops_port,
+        )
+    else:
+        ops_ctx = contextlib.nullcontext()
+
+    with ops_ctx as ops:
+        if ops is not None:
+            print(f"[inference_demo] ops server -> {ops.url}", file=sys.stderr)
+        with profile_ctx:
+            if draft_app is not None:
+                from neuronx_distributed_inference_tpu.runtime.assisted import assisted_generate
+
+                out = assisted_generate(
+                    app, draft_app, input_ids, attention_mask,
+                    max_new_tokens=args.max_new_tokens, eos_token_id=eos_token_id,
+                    speculation_length=max(args.speculation_length, 2),
+                )
+            else:
+                out = app.generate(input_ids, attention_mask, **gen_kwargs)
     if capture_hook is not None:
         print(f"[inference_demo] captured {len(capture_hook.saved)} input snapshots",
               file=sys.stderr)

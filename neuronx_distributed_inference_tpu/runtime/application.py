@@ -407,11 +407,15 @@ class TpuModelForCausalLM:
             cache_dir = tc.compilation_cache_dir or os.path.join(
                 compiled_model_path, "xla_cache"
             )
+            # best-effort: an unavailable XLA cache only costs compile time
+            # — but only the TYPED unavailability classes are swallowed
+            # (import drift, an already-initialized cache, an unwritable
+            # dir); anything else propagates (tpulint TPU110)
             try:
                 from jax.experimental.compilation_cache import compilation_cache
 
                 compilation_cache.set_cache_dir(cache_dir)
-            except Exception:
+            except (ImportError, RuntimeError, OSError, ValueError):
                 pass
             presharded_dir = os.path.join(compiled_model_path, "presharded")
         # LoRA-attached trees never round-trip through the artifact: adapter

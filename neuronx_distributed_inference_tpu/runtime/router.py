@@ -67,7 +67,7 @@ import time
 import warnings
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Set
 
 import numpy as np
 
@@ -345,6 +345,13 @@ class ServingRouter:
         if self.threaded:
             for h in self.replicas:
                 self._workers[h.replica_id] = _ReplicaStepWorker(h)
+        # --- elastic fleet (add_replica / retire_replica) -----------------
+        # retiring replicas keep stepping (draining their owned requests)
+        # but stop appearing in the placement pool; once drained they are
+        # finalized: worker joined, gauges zeroed, handle removed
+        self._retiring: Set[int] = set()
+        self._closed = False
+        self._next_replica_id = max(ids) + 1
         for h in self.replicas:
             self.tel.router_replica_gauges(
                 h.replica_id, h.occupancy, h.queue_depth,
@@ -357,6 +364,15 @@ class ServingRouter:
     @property
     def alive_replicas(self) -> List[ReplicaHandle]:
         return [h for h in self.replicas if h.alive]
+
+    @property
+    def placeable_replicas(self) -> List[ReplicaHandle]:
+        """Alive replicas that accept NEW placements (retiring ones keep
+        stepping to drain what they own but never take more work)."""
+        return [
+            h for h in self.replicas
+            if h.alive and h.replica_id not in self._retiring
+        ]
 
     @property
     def alive_prefill_replicas(self) -> List[PrefillReplicaHandle]:
@@ -374,7 +390,7 @@ class ServingRouter:
         was accepted (placed immediately when capacity exists, else queued —
         FIFO, aged behind earlier arrivals); falsy with a ``reason`` for
         typed rejects (malformed input, duplicate id, no alive replicas)."""
-        alive = self.alive_replicas
+        alive = self.placeable_replicas
         if not alive:
             return AdmissionResult(False, "no_replicas")
         if req_id in self.requests or req_id in self.rejected:
@@ -425,9 +441,10 @@ class ServingRouter:
 
     def _candidates(self, rreq: RouterRequest) -> List[ReplicaHandle]:
         """Ordered placement candidates (best first) for ``rreq`` under the
-        active policy. DEAD replicas never appear; DEGRADED ones only when
-        no HEALTHY replica exists."""
-        alive = self.alive_replicas
+        active policy. DEAD replicas never appear (nor RETIRING ones —
+        scale-in stops placement first); DEGRADED ones only when no HEALTHY
+        replica exists."""
+        alive = self.placeable_replicas
         healthy = [h for h in alive if h.health == HEALTH_HEALTHY]
         pool = healthy if healthy else alive
         if not pool:
@@ -750,7 +767,7 @@ class ServingRouter:
                 # never-fits terminalization, one level up.
                 if candidates and not any(
                     h.session.active or h.session._readmit
-                    for h in self.alive_replicas
+                    for h in self.placeable_replicas
                 ):
                     self.pending.popleft()
                     self._terminal(rreq, RSTATUS_FAILED, "never_fits")
@@ -829,6 +846,7 @@ class ServingRouter:
         # requests failed over this step re-place immediately so they
         # resume on the next device step, not one router tick later
         self._place_pending()
+        self._finalize_retired()
         self._publish_gauges()
         return results
 
@@ -877,6 +895,7 @@ class ServingRouter:
         falls back to sequential stepping — but the usual lifecycle is
         drain, then close. The thread-leak pin: no worker thread survives
         this call."""
+        self._closed = True
         workers, self._workers = self._workers, {}
         for w in workers.values():
             w.shutdown()
@@ -886,6 +905,117 @@ class ServingRouter:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+    # ---- elastic fleet ---------------------------------------------------
+
+    def add_replica(self, replica, replica_id: Optional[int] = None) -> ReplicaHandle:
+        """Graceful scale-out: join a warmed replica (a ReplicaHandle or a
+        bare serving session, wrapped with the next free id) to the pool
+        and to placement mid-run. The new handle is validated exactly like
+        an __init__-time one (duplicate id, disaggregated-tier cache/
+        admission constraints), gets its own stepping worker under
+        ``router_threading`` (unless the pool is already closed — then it
+        steps sequentially like everyone else), publishes its gauges, and
+        immediately participates in placement — queued head-of-line work
+        binds to it before this call returns."""
+        h = replica if isinstance(replica, ReplicaHandle) else ReplicaHandle(
+            replica,
+            self._next_replica_id if replica_id is None else replica_id,
+        )
+        if any(h.replica_id == o.replica_id for o in self.replicas):
+            raise ValueError(
+                f"duplicate replica id {h.replica_id}: "
+                f"{[o.replica_id for o in self.replicas]}"
+            )
+        if self.prefill_replicas:
+            # same constraints the constructor enforces: the tier hands KV
+            # into contiguous cache lines and cannot feed a draft cache
+            if h.session.block_mode:
+                raise ValueError(
+                    "the disaggregated prefill tier hands KV over into "
+                    "contiguous cache lines: added replica "
+                    f"{h.replica_id} runs the paged cache"
+                )
+            if not h.session.prefilled_admission:
+                raise NotImplementedError(
+                    "the disaggregated prefill tier does not support "
+                    "speculative decode sessions"
+                )
+        self.replicas.append(h)
+        self._next_replica_id = max(self._next_replica_id, h.replica_id + 1)
+        if self.threaded and not self._closed:
+            self._workers[h.replica_id] = _ReplicaStepWorker(h)
+        self.tel.router_replica_gauges(
+            h.replica_id, h.occupancy, h.queue_depth, HEALTH_GAUGE[h.health]
+        )
+        self.tel.router_elastic("add", h.replica_id)
+        self._place_pending()
+        return h
+
+    def retire_replica(self, replica_id: int, drain: bool = True) -> None:
+        """Graceful scale-in: stop placing NEW work on ``replica_id`` and
+        give its replica back. ``drain=True`` (default) lets the replica
+        finish what it owns — it keeps stepping, and once its last request
+        reaches a terminal outcome the handle is finalized (worker joined,
+        gauges zeroed, handle removed; the caller may then free the mesh).
+        ``drain=False`` re-queues everything it owns onto the survivors NOW
+        through the standard failover machinery (committed tokens kept,
+        byte-identical resume) and finalizes immediately. Retiring the last
+        placeable replica is refused — the router must never place itself
+        into a self-inflicted total outage. Idempotent per id."""
+        h = next(
+            (o for o in self.replicas if o.replica_id == replica_id), None
+        )
+        if h is None:
+            raise KeyError(f"unknown replica id {replica_id}")
+        if replica_id in self._retiring:
+            return
+        if not any(
+            o.alive and o.replica_id != replica_id for o in self.replicas
+            if o.replica_id not in self._retiring
+        ):
+            raise ValueError(
+                f"cannot retire replica {replica_id}: it is the last "
+                "placeable replica (add_replica first, or close the router)"
+            )
+        self._retiring.add(replica_id)
+        self.tel.router_elastic("retire", replica_id)
+        if not drain and h.alive:
+            # immediate hand-back: mark it dead like an operator kill and
+            # run the standard dead-replica path — harvest, re-queue onto
+            # the survivors (oldest first), then finalize below
+            h.kill("retired")
+            self._failover_replica(h, "retired")
+            self._place_pending()
+        self._finalize_retired()
+
+    def _finalize_retired(self) -> None:
+        """Finalize every retiring replica whose drain is complete: sync
+        the last terminal outcomes, fail over anything a replica that DIED
+        mid-drain still owned, join its stepping worker, zero its gauges
+        and remove the handle from the pool. Called after every step and
+        from retire_replica; a replica still draining is left alone."""
+        for h in [
+            o for o in self.replicas if o.replica_id in self._retiring
+        ]:
+            if h.alive and (
+                h.owned or h.session.active or h.session._readmit
+            ):
+                continue  # still draining
+            if not h.alive and h.owned:
+                # died mid-drain: its live requests fail over like any
+                # other replica death (LIFE805 ownership transfer)
+                self._failover_replica(h, h.health_reason or "retired")
+            self._sync_terminals(h)
+            w = self._workers.pop(h.replica_id, None)
+            if w is not None:
+                w.shutdown()  # joins the worker thread
+            self.replicas.remove(h)
+            self._retiring.discard(h.replica_id)
+            self.tel.router_replica_gauges(
+                h.replica_id, 0, 0, HEALTH_GAUGE[h.health]
+            )
+            self.tel.router_elastic("retire_done", h.replica_id)
 
     def _sync_terminals(self, h: ReplicaHandle) -> None:
         """Fold this replica's terminal session outcomes into the router

@@ -42,6 +42,7 @@ audit (CONC603) proves that statically.
 from __future__ import annotations
 
 import bisect
+import re
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -341,9 +342,43 @@ class MetricsRegistry:
                         )
         return "\n".join(lines) + "\n"
 
+    def family_names(self) -> List[str]:
+        """Sorted names of every registered family (the code half of the
+        docs/OBSERVABILITY.md catalog-drift check)."""
+        with self._lock:
+            return sorted(self._families)
+
     def reset(self) -> None:
         with self._lock:
             self._families.clear()
+
+
+_FAMILY_NAME_RE = re.compile(r"\bnxdi_[a-z0-9_]+")
+
+#: exposition-format suffixes: a doc mentioning ``nxdi_x_bucket`` /
+#: ``_sum`` / ``_count`` refers to the ``nxdi_x`` histogram family
+_EXPOSITION_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def catalog_drift(
+    doc_text: str, family_names: Sequence[str]
+) -> Tuple[List[str], List[str]]:
+    """Two-way drift between the documented metric catalog and the
+    registered families: returns ``(undocumented, unregistered)`` —
+    families in ``family_names`` that ``doc_text`` never mentions, and
+    ``nxdi_*`` names the doc mentions that no family registers. Both lists
+    empty == the catalog is exact (pinned by tests/test_telemetry.py)."""
+    registered = set(family_names)
+    documented = set()
+    for name in _FAMILY_NAME_RE.findall(doc_text):
+        for suffix in _EXPOSITION_SUFFIXES:
+            if name.endswith(suffix) and name[: -len(suffix)] in registered:
+                name = name[: -len(suffix)]
+                break
+        documented.add(name)
+    undocumented = sorted(registered - documented)
+    unregistered = sorted(documented - registered)
+    return undocumented, unregistered
 
 
 # process-default registry: the demo/bench ``--metrics-out`` target and the

@@ -322,6 +322,12 @@ class TelemetrySession:
             "nxdi_router_replica_health",
             "replica health state (2 = healthy, 1 = degraded, 0 = dead)",
             labels=("replica",))
+        self._router_elastic = r.counter(
+            "nxdi_router_elastic_total",
+            "elastic fleet events: replicas added to / retired from the "
+            "pool mid-run (retire = placement stopped, drain begun; "
+            "retire_done = drained, worker joined, mesh freed)",
+            labels=("event",))
         self._router_spread = r.histogram(
             "nxdi_router_occupancy_spread",
             "max - min live rows across alive replicas per router step "
@@ -983,6 +989,15 @@ class TelemetrySession:
             return
         self._router_rejected.child((reason,)).inc()
         self.event("router_rejected", req_id=req_id, reason=reason)
+
+    def router_elastic(self, event: str, replica: int) -> None:
+        """One elastic fleet event: ``add`` (warmed handle joined pool +
+        placement), ``retire`` (placement stopped, drain begun) or
+        ``retire_done`` (drained, worker joined, replica left the pool)."""
+        if not self.enabled:
+            return
+        self._router_elastic.child((event,)).inc()
+        self.event("router_elastic", event=event, replica=replica)
 
     def router_replica_gauges(
         self, replica_id: int, occupancy: int, queue_depth: int, health: int

@@ -1,9 +1,9 @@
 #!/usr/bin/env bash
 # The pre-PR check: the FULL static-analysis gate (tpulint + flag audit +
 # graph/shard/memory audits + the roofline cost audit COST501-504 + the
-# concurrency audit CONC601-604 + the kernel-contract audit KERN701-705)
-# plus the static_analysis pytest subset, as one command with a nonzero
-# exit on ANY finding or test failure.
+# concurrency audit CONC601-604 + the kernel-contract audit KERN701-705 +
+# the lifecycle audit LIFE801-805) plus the static_analysis pytest subset,
+# as one command with a nonzero exit on ANY finding or test failure.
 #
 #   bash scripts/ci_check.sh            # text reports
 #   bash scripts/ci_check.sh --json     # gate report as JSON
@@ -25,7 +25,7 @@ esac
 
 rc=0
 
-echo "== static-analysis gate (lint, flags, graph, shard, memory, cost, conc, kernel) =="
+echo "== static-analysis gate (lint, flags, graph, shard, memory, cost, conc, kernel, life) =="
 python scripts/run_static_analysis.py "$@" || rc=$?
 
 echo
@@ -37,8 +37,8 @@ echo "== robustness (serving fault-containment) pytest subset =="
 python -m pytest tests -q -m robustness -p no:cacheprovider || rc=$?
 
 echo
-echo "== router (multi-replica front-end + threaded stepping + disaggregated prefill tier) pytest subset =="
-python -m pytest tests/test_router.py tests/test_router_threaded.py tests/test_disagg_router.py -q -m 'not slow' -p no:cacheprovider || rc=$?
+echo "== router (multi-replica front-end + threaded stepping + disaggregated prefill tier + elastic add/retire) pytest subset =="
+python -m pytest tests/test_router.py tests/test_router_threaded.py tests/test_disagg_router.py tests/test_elastic_router.py -q -m 'not slow' -p no:cacheprovider || rc=$?
 
 echo
 echo "== workload (open-loop traffic + SLO goodput) pytest subset =="
@@ -47,6 +47,10 @@ python -m pytest tests/test_workload.py -q -m 'not slow' -p no:cacheprovider || 
 echo
 echo "== kernel-contract (KERN701-705 detectors + tuning-table pins) pytest subset =="
 python -m pytest tests/test_kernel_audit.py -q -m 'not slow' -p no:cacheprovider || rc=$?
+
+echo
+echo "== lifecycle audit (LIFE801-805 detectors + elastic licensing) pytest subset =="
+python -m pytest tests/test_lifecycle_audit.py -q -m 'not slow' -p no:cacheprovider || rc=$?
 
 echo
 echo "== observability (span timelines + ops server + SLO burn-rate) pytest subset =="

@@ -29,6 +29,7 @@ ALL_POINTS = {
     "serving_1b_int4_ragged",
     "serving_1b_int8_spec_ragged", "serving_1b_int8_router",
     "serving_1b_int8_router_threaded", "serving_1b_int8_disagg",
+    "serving_1b_int8_elastic",
     "serving_1b_int8_goodput", "serving_1b_int8_goodput_burst",
     "serving_1b_int8_goodput_chaos", "serving_1b_int8_disagg_chaos",
     "int8_8b_bs1", "bf16_8b_int4",
@@ -39,6 +40,7 @@ SERVING_POINTS = {
     "serving_1b_int4_ragged",
     "serving_1b_int8_spec_ragged", "serving_1b_int8_router",
     "serving_1b_int8_router_threaded", "serving_1b_int8_disagg",
+    "serving_1b_int8_elastic",
     "serving_1b_int8_goodput", "serving_1b_int8_goodput_burst",
     "serving_1b_int8_goodput_chaos", "serving_1b_int8_disagg_chaos",
 }
@@ -120,6 +122,19 @@ def test_bench_suite_tiny(monkeypatch):
     # handed off exactly once, ZERO hand-off failures, ZERO local-prefill
     # fallbacks, the usual 0/0/0 containment deltas, both decode replicas
     # served
+    # ISSUE 20: the elastic fleet row — seeded retire + add mid-drain.
+    # Both events happened, every submitted request finished (attainment
+    # 1.0), zero failovers (drain=True retirement is graceful, not a
+    # failure), and NOTHING leaked: no KV block across every session
+    # (the retired one included), no thread across the run
+    elastic = points["serving_1b_int8_elastic"]
+    assert elastic["elastic_retired"] == 1
+    assert elastic["elastic_added"] == 1
+    assert elastic["elastic_attainment"] == 1.0
+    assert elastic["elastic_leaked_blocks"] == 0
+    assert elastic["elastic_leaked_threads"] == 0
+    assert elastic["failover"] == 0 and elastic["rejected"] == 0
+    assert elastic["elastic_events"] >= 3  # add + retire + retire_done
     disagg = points["serving_1b_int8_disagg"]
     assert disagg["n_replicas"] == 2
     assert disagg["n_prefill_replicas"] == 1

@@ -1,0 +1,328 @@
+"""Elastic replica add/retire (ISSUE 20, licensed by the lifecycle audit).
+
+The contract, pinned behaviorally:
+- a 2-replica drain with `retire_replica(drain=True)` mid-run followed by
+  `add_replica` once the retiree finalizes is BYTE-IDENTICAL to the static
+  2-replica drain — sequential AND `router_threading`, under clean traffic
+  AND when the retiring replica is killed mid-drain;
+- scale-in is graceful (drain=True strands nothing, fails nothing over)
+  and eager (the retired worker thread is joined at FINALIZE time, not at
+  close) while drain=False harvests + re-queues immediately;
+- the fleet never scales to zero (retiring the last placeable replica
+  raises), zero steady-state recompiles across the elastic events, and
+  close() leaks no thread through the add/retire churn.
+
+tests/test_lifecycle_audit.py pins the static side of the same license
+(LIFE805: retire reaches the finalizer, the finalizer joins the worker).
+"""
+
+import threading
+
+import pytest
+
+from tests.conftest import make_tiny_config, make_random_hf_state_dict
+
+from neuronx_distributed_inference_tpu.config import ChunkedPrefillConfig
+from neuronx_distributed_inference_tpu.parallel.mesh import mesh_from_config
+from neuronx_distributed_inference_tpu.runtime.application import (
+    TpuModelForCausalLM,
+)
+from neuronx_distributed_inference_tpu.runtime.router import (
+    ServingRouter,
+    partition_devices,
+)
+from neuronx_distributed_inference_tpu.runtime.serving import ServingSession
+from neuronx_distributed_inference_tpu.telemetry import TelemetrySession
+
+pytestmark = pytest.mark.router
+
+REQS = {
+    "r1": dict(ids=[5, 17, 92, 41], gen=6),
+    "r2": dict(ids=list(range(30, 52)), gen=6),
+    "r3": dict(ids=[7, 7, 7], gen=5),
+    "r4": dict(ids=[11, 23, 5, 99, 100, 3], gen=6),
+    "r5": dict(ids=[64, 2, 90, 14], gen=5),
+    "r6": dict(ids=[33, 88, 2], gen=6),
+}
+
+
+def _paged_cfg(**extra):
+    tpu = dict(
+        is_continuous_batching=True, batch_size=4, ctx_batch_size=1,
+        is_block_kv_layout=True, pa_block_size=16, pa_num_blocks=24,
+        is_chunked_prefill=True,
+        chunked_prefill_config=ChunkedPrefillConfig(
+            max_num_seqs=2, kernel_q_tile_size=16
+        ),
+        seq_len=64,
+    )
+    tpu.update(extra)
+    return make_tiny_config(tpu=tpu)
+
+
+@pytest.fixture(scope="module")
+def replica_apps():
+    sd = make_random_hf_state_dict(_paged_cfg())
+    parts = partition_devices(2)
+    apps = []
+    for i in range(2):
+        cfg = _paged_cfg()
+        app = TpuModelForCausalLM(
+            None, cfg, mesh=mesh_from_config(cfg.tpu_config, devices=parts[i])
+        )
+        apps.append(app.load(state_dict=sd))
+    return apps
+
+
+def _static_drain(apps, threaded, telemetry=None):
+    for app in apps:
+        app.init_kv_cache()
+    router = ServingRouter(
+        [ServingSession(app, telemetry=telemetry) for app in apps],
+        telemetry=telemetry, threaded=threaded,
+    )
+    try:
+        for rid, spec in REQS.items():
+            assert router.add_request(rid, spec["ids"],
+                                      max_new_tokens=spec["gen"])
+        out = router.run_to_completion()
+    finally:
+        router.close()
+    return out
+
+
+@pytest.fixture(scope="module")
+def static_reference(replica_apps):
+    return _static_drain(replica_apps, threaded=False)
+
+
+def _elastic_drain(apps, threaded, telemetry=None, retire_after=2,
+                   kill_at=None):
+    """Drain REQS, retiring the highest-id replica (drain=True) after
+    `retire_after` steps and adding a fresh session on the same mesh as
+    soon as the retiree finalizes. With `kill_at`, the RETIRING replica is
+    killed at that step (death mid-drain) instead of draining out."""
+    for app in apps:
+        app.init_kv_cache()
+    router = ServingRouter(
+        [ServingSession(app, telemetry=telemetry) for app in apps],
+        telemetry=telemetry, threaded=threaded,
+    )
+    retired_id = None
+    added = None
+    retired_worker = None
+    try:
+        for rid, spec in REQS.items():
+            assert router.add_request(rid, spec["ids"],
+                                      max_new_tokens=spec["gen"])
+        steps = 0
+        while router.has_live_work:
+            router.step()
+            steps += 1
+            if steps == retire_after and retired_id is None:
+                victim = max(router.replicas, key=lambda h: h.replica_id)
+                retired_id = victim.replica_id
+                retired_worker = router._workers.get(retired_id)
+                assert victim.owned  # retirement interrupts real work
+                router.retire_replica(retired_id, drain=True)
+                # still placeable-excluded but stepping (draining)
+                assert all(
+                    h.replica_id != retired_id
+                    for h in router.placeable_replicas
+                )
+            if kill_at is not None and steps == kill_at:
+                victim = next(
+                    h for h in router.replicas
+                    if h.replica_id == retired_id
+                )
+                assert victim.owned  # the kill interrupts the drain itself
+                victim.kill()
+            if retired_id is not None and added is None and all(
+                h.replica_id != retired_id for h in router.replicas
+            ):
+                # the retiree finalized: its worker is ALREADY joined
+                # (eager scale-in, not close-time cleanup) ...
+                if retired_worker is not None:
+                    assert not retired_worker.is_alive()
+                # ... so scale back out on the freed mesh
+                added = router.add_replica(
+                    ServingSession(apps[-1], telemetry=telemetry)
+                )
+            assert steps < 500
+        out = {rid: r.tokens for rid, r in router.requests.items()}
+    finally:
+        router.close()
+    assert retired_id is not None and added is not None
+    return out, router, retired_id, added
+
+
+# ---------------------------------------------------------------------------
+# byte-identity vs the static fleet
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("threaded", [False, True])
+def test_retire_then_add_mid_drain_byte_identical(
+    replica_apps, static_reference, threaded
+):
+    out, router, retired_id, added = _elastic_drain(
+        replica_apps, threaded=threaded
+    )
+    assert out == static_reference
+    # graceful: the drained retirement failed nothing over and lost nothing
+    assert all(r.status == "finished" for r in router.requests.values())
+    assert all(r.failovers == 0 for r in router.requests.values())
+    # the fleet really changed shape: the retiree is gone, the added
+    # replica took a fresh id and is placeable
+    assert all(h.replica_id != retired_id for h in router.replicas)
+    assert added.replica_id not in (0, retired_id)
+
+
+@pytest.mark.parametrize("threaded", [False, True])
+def test_kill_retiring_replica_mid_drain_byte_identical(
+    replica_apps, static_reference, threaded
+):
+    """Death DURING the drain: the retiring replica's owned requests are
+    harvested and re-queued (failover), the retiree still finalizes, and
+    the output stays byte-identical to the static fleet."""
+    out, router, retired_id, _added = _elastic_drain(
+        replica_apps, threaded=threaded, kill_at=4
+    )
+    assert out == static_reference
+    assert all(r.status == "finished" for r in router.requests.values())
+    assert any(r.failovers for r in router.requests.values())
+    assert all(h.replica_id != retired_id for h in router.replicas)
+
+
+def test_retire_without_drain_requeues_immediately(
+    replica_apps, static_reference
+):
+    """drain=False is the fast path: harvest + failover + finalize inside
+    retire_replica itself — and the result is still byte-identical."""
+    for app in replica_apps:
+        app.init_kv_cache()
+    router = ServingRouter(
+        [ServingSession(app) for app in replica_apps]
+    )
+    try:
+        for rid, spec in REQS.items():
+            assert router.add_request(rid, spec["ids"],
+                                      max_new_tokens=spec["gen"])
+        for _ in range(2):
+            router.step()
+        victim = router.replicas[1]
+        assert victim.owned
+        router.retire_replica(victim.replica_id, drain=False)
+        # immediate: no draining window, the handle is already gone
+        assert all(h.replica_id != victim.replica_id for h in router.replicas)
+        out = router.run_to_completion()
+    finally:
+        router.close()
+    assert out == static_reference
+    assert all(r.status == "finished" for r in router.requests.values())
+    assert any(r.failovers for r in router.requests.values())
+
+
+# ---------------------------------------------------------------------------
+# guard rails
+# ---------------------------------------------------------------------------
+
+
+def test_retire_last_placeable_replica_raises(replica_apps):
+    for app in replica_apps:
+        app.init_kv_cache()
+    router = ServingRouter([ServingSession(app) for app in replica_apps])
+    try:
+        router.retire_replica(1)  # idle: finalizes immediately
+        assert [h.replica_id for h in router.replicas] == [0]
+        with pytest.raises(ValueError, match="last placeable"):
+            router.retire_replica(0)
+        with pytest.raises(KeyError):
+            router.retire_replica(99)
+    finally:
+        router.close()
+
+
+def test_add_replica_rejects_duplicate_id(replica_apps):
+    for app in replica_apps:
+        app.init_kv_cache()
+    router = ServingRouter([ServingSession(app) for app in replica_apps])
+    try:
+        with pytest.raises(ValueError, match="duplicate replica id"):
+            router.add_replica(ServingSession(replica_apps[0]), replica_id=0)
+    finally:
+        router.close()
+
+
+def test_added_replica_ids_monotonic_after_churn(replica_apps):
+    """Ids are never recycled across add/retire churn — telemetry series
+    and span timelines stay unambiguous."""
+    for app in replica_apps:
+        app.init_kv_cache()
+    router = ServingRouter([ServingSession(app) for app in replica_apps])
+    try:
+        h2 = router.add_replica(ServingSession(replica_apps[0]))
+        assert h2.replica_id == 2
+        router.retire_replica(2)
+        h3 = router.add_replica(ServingSession(replica_apps[0]))
+        assert h3.replica_id == 3  # 2 is gone but never reused
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: threads, recompiles, telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_elastic_threaded_no_thread_leak_on_close(replica_apps):
+    baseline_threads = threading.active_count()
+    out, router, retired_id, added = _elastic_drain(
+        replica_apps, threaded=True
+    )
+    # _elastic_drain closed the router; nothing survives — not the static
+    # workers, not the retiree's (joined at finalize), not the added one's
+    assert threading.active_count() == baseline_threads
+    assert router._workers == {}
+    assert all(r.status == "finished" for r in router.requests.values())
+
+
+def test_elastic_zero_steady_state_recompiles(replica_apps):
+    """After one warming elastic drain, a second drain with the same
+    add/retire schedule traces NOTHING: the added replica reuses the warmed
+    programs of the mesh it lands on."""
+    from neuronx_distributed_inference_tpu.analysis import retrace_guard
+
+    _elastic_drain(replica_apps, threaded=False)  # warm every program
+
+    traces = []
+    lock = threading.Lock()
+
+    def on_trace(tag, sealed):
+        with lock:
+            traces.append(tag)
+
+    retrace_guard.add_trace_listener(on_trace)
+    try:
+        out, _, _, _ = _elastic_drain(replica_apps, threaded=False)
+    finally:
+        retrace_guard.remove_trace_listener(on_trace)
+    assert traces == []
+    assert all(len(v) > 0 for v in out.values())
+
+
+def test_elastic_events_recorded(replica_apps):
+    """nxdi_router_elastic_total carries one increment per lifecycle event
+    (the bench row's elastic_events source) and the retire is graceful in
+    the rejection/failover counters too."""
+    with TelemetrySession() as tel:
+        out, router, _, _ = _elastic_drain(
+            replica_apps, threaded=False, telemetry=tel
+        )
+    snap = tel.registry.snapshot()
+    events = {
+        s["labels"]["event"]: s["value"]
+        for s in snap["nxdi_router_elastic_total"]["samples"]
+    }
+    assert events == {"add": 1.0, "retire": 1.0, "retire_done": 1.0}
+    assert all(r.failovers == 0 for r in router.requests.values())

@@ -1,0 +1,534 @@
+"""Lifecycle & resource-stewardship analyzer (ISSUE 20): LIFE801-805 proven
+detectors + clean-tree gate.
+
+Every rule must (a) FIRE on a synthetic violation fixture and (b) pass on
+the fixed form — an analyzer that never fires proves nothing. The clean-tree
+pins are the actual license for the elastic fleet primitives
+(``ServingRouter.add_replica`` / ``retire_replica``):
+tests/test_elastic_router.py pins the behavior side (byte-identity, leak-
+free teardown); this file pins the static side (every acquisition provably
+released on every terminal outcome, scale-in provably joins its worker).
+"""
+
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from neuronx_distributed_inference_tpu.analysis import lifecycle_audit as la
+from neuronx_distributed_inference_tpu.analysis.findings import Baseline
+
+pytestmark = pytest.mark.static_analysis
+
+
+def _audit(tmp_path, name, source):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return la.audit_paths([f])
+
+
+def _errors(findings, rule=None):
+    return [
+        f for f in findings
+        if f.severity == "error" and (rule is None or f.rule == rule)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# LIFE801: acquire/release pairing census
+# ---------------------------------------------------------------------------
+
+_SLOT_FIXTURE = """
+    STATUS_ACTIVE = "active"
+    STATUS_FINISHED = "finished"
+
+    class ServingSession:
+        def _admit(self, req):
+            self.slots[0] = req
+            req.status = STATUS_ACTIVE
+
+        def _finish(self, req):
+            {finish_body}
+            req.status = STATUS_FINISHED
+"""
+
+
+def test_life801_leaked_slot_fires(tmp_path):
+    """A terminal handler that assigns STATUS_FINISHED without ever
+    releasing the serving slot strands the slot forever."""
+    findings = _audit(
+        tmp_path, "serving.py", _SLOT_FIXTURE.format(finish_body="pass"),
+    )
+    errs = _errors(findings, "LIFE801")
+    keys = {e.key for e in errs}
+    assert "runtime/serving.py::slot-unreleased" in keys
+    assert (
+        "runtime/serving.py::terminal-no-release::ServingSession._finish"
+        in keys
+    )
+
+
+def test_life801_released_slot_classifies_clean(tmp_path):
+    findings = _audit(
+        tmp_path, "serving.py",
+        _SLOT_FIXTURE.format(finish_body="self.slots[0] = None"),
+    )
+    assert _errors(findings) == []
+    census = {f.key for f in findings if f.rule == "LIFE801"}
+    assert (
+        "runtime/serving.py::slot-acquire::ServingSession._admit" in census
+    )
+    assert (
+        "runtime/serving.py::slot-release::ServingSession._finish" in census
+    )
+
+
+def test_life801_unpaired_unref_fires(tmp_path):
+    """Refcount decrements with no increment site anywhere in the allocator
+    go negative and evict live shared blocks."""
+    findings = _audit(
+        tmp_path, "block_kvcache.py",
+        """
+        class BlockAllocator:
+            def free_seq(self, sid):
+                self.refcount[sid] -= 1
+        """,
+    )
+    errs = _errors(findings, "LIFE801")
+    assert len(errs) == 1
+    assert errs[0].key == "modules/block_kvcache.py::refcount-unpaired-unref"
+    assert "go negative" in errs[0].message
+
+
+def test_life801_symmetric_refcounts_classify_clean(tmp_path):
+    findings = _audit(
+        tmp_path, "block_kvcache.py",
+        """
+        class BlockAllocator:
+            def match_prefix(self, sid):
+                self.refcount[sid] += 1
+
+            def free_seq(self, sid):
+                self.refcount[sid] -= 1
+        """,
+    )
+    assert _errors(findings) == []
+    census = {f.key for f in findings if f.rule == "LIFE801"}
+    assert (
+        "modules/block_kvcache.py::refcount-ref::BlockAllocator.match_prefix"
+        in census
+    )
+    assert (
+        "modules/block_kvcache.py::refcount-unref::BlockAllocator.free_seq"
+        in census
+    )
+
+
+def test_life801_span_outside_with_fires(tmp_path):
+    """A `.span(...)` opened without a `with` leaks the open span on any
+    raise between open and close."""
+    findings = _audit(
+        tmp_path, "serving.py",
+        """
+        class ServingSession:
+            def _admit(self, req):
+                span = self.tel.span("admit", request_id=req.request_id)
+                span.close()
+        """,
+    )
+    errs = _errors(findings, "LIFE801")
+    assert len(errs) == 1
+    assert errs[0].key == "runtime/serving.py::span-no-with"
+    findings = _audit(
+        tmp_path, "serving.py",
+        """
+        class ServingSession:
+            def _admit(self, req):
+                with self.tel.span("admit", request_id=req.request_id):
+                    pass
+        """,
+    )
+    assert _errors(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# LIFE802: request state-machine extraction
+# ---------------------------------------------------------------------------
+
+
+def test_life802_reactivation_outside_door_fires(tmp_path):
+    findings = _audit(
+        tmp_path, "router.py",
+        """
+        RSTATUS_QUEUED = "queued"
+
+        class ServingRouter:
+            def sneak_back(self, req):
+                req.status = RSTATUS_QUEUED   # BUG: not a validated door
+        """,
+    )
+    errs = _errors(findings, "LIFE802")
+    assert len(errs) == 1
+    assert errs[0].key.endswith(
+        "reactivation-outside-door::ServingRouter.sneak_back"
+    )
+    assert "validated" in errs[0].message
+
+
+def test_life802_reactivation_through_door_classifies_clean(tmp_path):
+    findings = _audit(
+        tmp_path, "router.py",
+        """
+        RSTATUS_QUEUED = "queued"
+
+        class ServingRouter:
+            def _failover_request(self, req):
+                req.status = RSTATUS_QUEUED
+        """,
+    )
+    assert _errors(findings) == []
+    census = {f.key for f in findings if f.rule == "LIFE802"}
+    assert (
+        "runtime/router.py::RSTATUS_QUEUED::ServingRouter._failover_request"
+        in census
+    )
+
+
+# ---------------------------------------------------------------------------
+# LIFE803: exception-flow audit
+# ---------------------------------------------------------------------------
+
+_RAISE_FIXTURE = """
+    class ReplicaHandle:
+        def step(self):
+            {step_body}
+
+        def _tick(self):
+            raise ValueError("boom")
+"""
+
+
+def test_life803_uncaught_worker_raise_fires(tmp_path):
+    findings = _audit(
+        tmp_path, "replica.py", _RAISE_FIXTURE.format(step_body="self._tick()"),
+    )
+    errs = _errors(findings, "LIFE803")
+    assert len(errs) == 1
+    assert errs[0].key == (
+        "runtime/replica.py::uncaught::ValueError::ReplicaHandle._tick"
+    )
+    assert "tear down the replica thread" in errs[0].message
+
+
+def test_life803_typed_boundary_classifies_clean(tmp_path):
+    findings = _audit(
+        tmp_path, "replica.py",
+        _RAISE_FIXTURE.format(
+            step_body=(
+                "try:\n"
+                "                self._tick()\n"
+                "            except ValueError:\n"
+                "                self.health = 'failed'"
+            )
+        ),
+    )
+    assert _errors(findings) == []
+    census = {f.key for f in findings if f.rule == "LIFE803"}
+    assert (
+        "runtime/replica.py::caught::ValueError::ReplicaHandle._tick"
+        in census
+    )
+
+
+def test_life803_broad_except_is_not_a_boundary(tmp_path):
+    """`except Exception` is transport, not a typed boundary — a raise whose
+    only catcher is broad still counts as uncaught."""
+    findings = _audit(
+        tmp_path, "replica.py",
+        _RAISE_FIXTURE.format(
+            step_body=(
+                "try:\n"
+                "                self._tick()\n"
+                "            except Exception:\n"
+                "                self.health = 'failed'"
+            )
+        ),
+    )
+    errs = _errors(findings, "LIFE803")
+    assert [e.key for e in errs] == [
+        "runtime/replica.py::uncaught::ValueError::ReplicaHandle._tick"
+    ]
+
+
+def test_life803_loud_allowlist_classifies_clean(tmp_path):
+    findings = _audit(
+        tmp_path, "replica.py",
+        """
+        class WatchdogError(RuntimeError):
+            pass
+
+        class ReplicaHandle:
+            def step(self):
+                raise WatchdogError("stalled")
+        """,
+    )
+    assert _errors(findings) == []
+    census = {f.key for f in findings if f.rule == "LIFE803"}
+    assert (
+        "runtime/replica.py::loud::WatchdogError::ReplicaHandle.step"
+        in census
+    )
+
+
+def test_life803_silent_swallow_in_runtime_fires(tmp_path):
+    findings = _audit(
+        tmp_path, "replica.py",
+        """
+        class ReplicaHandle:
+            def probe(self):
+                try:
+                    self.poke()
+                except Exception:
+                    pass
+        """,
+    )
+    errs = _errors(findings, "LIFE803")
+    assert len(errs) == 1
+    assert errs[0].key == "runtime/replica.py::silent-swallow"
+    assert "invisible leak" in errs[0].message
+
+
+def test_life803_pragma_suppresses(tmp_path):
+    findings = _audit(
+        tmp_path, "replica.py",
+        """
+        class ReplicaHandle:
+            def step(self):
+                raise ValueError("boom")  # life: ignore[LIFE803]
+        """,
+    )
+    assert _errors(findings, "LIFE803") == []
+
+
+# ---------------------------------------------------------------------------
+# LIFE804: thread/server lifecycle
+# ---------------------------------------------------------------------------
+
+_THREAD_FIXTURE = """
+    import threading
+
+    class OpsServer:
+        def start(self):
+            self._thread = threading.Thread(target=self._serve, daemon=True)
+            self._thread.start()
+        {stop}
+"""
+
+
+def test_life804_unjoined_thread_fires(tmp_path):
+    findings = _audit(
+        tmp_path, "ops_server.py", _THREAD_FIXTURE.format(stop=""),
+    )
+    errs = _errors(findings, "LIFE804")
+    assert len(errs) == 1
+    assert errs[0].key == "telemetry/ops_server.py::thread-unjoined::_thread"
+    assert "outlives its owner" in errs[0].message
+
+
+def test_life804_joined_thread_classifies_clean(tmp_path):
+    findings = _audit(
+        tmp_path, "ops_server.py",
+        _THREAD_FIXTURE.format(
+            stop=(
+                "\n        def stop(self):\n"
+                "            self._thread.join(timeout=10.0)"
+            )
+        ),
+    )
+    assert _errors(findings) == []
+    census = {f.key for f in findings if f.rule == "LIFE804"}
+    assert "telemetry/ops_server.py::thread-start::_thread" in census
+
+
+def test_life804_join_through_local_alias_classifies_clean(tmp_path):
+    """The real OpsServer.stop() joins via a local alias
+    (`thread = self._thread; ...; thread.join()`) — that must count."""
+    findings = _audit(
+        tmp_path, "ops_server.py",
+        _THREAD_FIXTURE.format(
+            stop=(
+                "\n        def stop(self):\n"
+                "            httpd, thread = self._httpd, self._thread\n"
+                "            thread.join(timeout=10.0)"
+            )
+        ),
+    )
+    assert _errors(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# LIFE805: replica-death ownership transfer (the elastic license)
+# ---------------------------------------------------------------------------
+
+
+def test_life805_harvest_keeping_ledger_rows_fires(tmp_path):
+    findings = _audit(
+        tmp_path, "replica.py",
+        """
+        class ReplicaHandle:
+            def harvest(self):
+                out = dict(self.owned)
+                self.owned.clear()
+                self._placed_t.clear()
+                return out   # BUG: _readmit rows orphaned
+        """,
+    )
+    errs = _errors(findings, "LIFE805")
+    assert [e.key for e in errs] == [
+        "runtime/replica.py::harvest-keeps::_readmit"
+    ]
+
+
+def test_life805_harvest_clearing_everything_classifies_clean(tmp_path):
+    findings = _audit(
+        tmp_path, "replica.py",
+        """
+        class ReplicaHandle:
+            def harvest(self):
+                out = dict(self.owned)
+                self.owned.clear()
+                self._placed_t.clear()
+                self._readmit.clear()
+                return out
+        """,
+    )
+    assert _errors(findings) == []
+
+
+def test_life805_retire_without_finalizer_fires(tmp_path):
+    """retire_replica that never reaches the finalizer leaks the retired
+    replica's mesh and worker thread forever."""
+    findings = _audit(
+        tmp_path, "router.py",
+        """
+        class ServingRouter:
+            def retire_replica(self, rid, drain=True):
+                self._retiring.add(rid)   # BUG: nothing ever finalizes
+        """,
+    )
+    errs = _errors(findings, "LIFE805")
+    assert len(errs) == 1
+    assert errs[0].key.endswith(
+        "reach::ServingRouter.retire_replica->ServingRouter._finalize_retired"
+    )
+
+
+def test_life805_retire_reaching_finalizer_and_shutdown_classifies_clean(
+    tmp_path,
+):
+    findings = _audit(
+        tmp_path, "router.py",
+        """
+        class _ReplicaStepWorker:
+            def run(self):
+                pass
+
+            def shutdown(self):
+                self.join()
+
+            def join(self):
+                pass
+
+        class ServingRouter:
+            def retire_replica(self, rid, drain=True):
+                self._retiring.add(rid)
+                self._finalize_retired()
+
+            def _finalize_retired(self):
+                for w in list(self._workers.values()):
+                    w.shutdown()
+        """,
+    )
+    assert _errors(findings) == []
+
+
+# ---------------------------------------------------------------------------
+# the clean-tree gate + CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_package_lifecycle_clean_vs_baseline():
+    """The real tree audits clean against the committed baseline: zero
+    errors, zero unbaselined census entries. This IS the elastic license —
+    add_replica/retire_replica ship because this gate holds."""
+    assert la.run() == []
+
+
+def test_clean_tree_proves_elastic_reach_obligations():
+    """All six LIFE805 ownership-transfer obligations hold on the real tree
+    — including the three that license the elastic primitives."""
+    la.run()
+    rep = la.last_report()
+    assert rep["errors"] == 0
+    reach = set(rep["reach_checks"])
+    assert {
+        "ServingRouter._failover_replica->ReplicaHandle.harvest",
+        "ServingRouter._failover_replica->ServingRouter._failover_request",
+        "ServingRouter._fail_total_outage->ServingRouter._failover_replica",
+        "ServingRouter.retire_replica->ServingRouter._finalize_retired",
+        "ServingRouter._finalize_retired->_ReplicaStepWorker.shutdown",
+        "ServingRouter.add_replica->ServingRouter._place_pending",
+    } <= reach
+    # the census actually mined something: the analyzer is not vacuous
+    res = rep["resources"]
+    assert res["slot"]["acquire"] >= 1 and res["slot"]["release"] >= 1
+    assert res["kv_blocks"]["release"] >= 1
+    assert rep["thread_starts"] >= 2  # _ReplicaStepWorker + OpsServer serve
+
+
+def test_baseline_census_detects_new_acquisition_site(tmp_path):
+    """A NEW acquisition site must gate (reviewed like a new collective):
+    filter_new against the committed baseline reports it."""
+    findings = la.audit_paths([
+        pathlib.Path(la.__file__).resolve().parents[1]
+        / "runtime" / "serving.py"
+    ])
+    warnings = [f for f in findings if f.severity == "warning"]
+    new = Baseline.load(la.BASELINE_PATH).filter_new(warnings)
+    assert new == []  # serving.py's census is a subset of the pinned one
+
+
+def test_audit_paths_rejects_out_of_scope_file(tmp_path):
+    f = tmp_path / "not_in_scope.py"
+    f.write_text("x = 1\n")
+    with pytest.raises(ValueError, match="not a recognizable scope file"):
+        la.audit_paths([f])
+
+
+def test_cli_life_suite_clean_and_json(capsys):
+    """`--suites life` exits 0 on the clean tree and the --json report
+    grows a "lifecycle" section with the stewardship breakdown."""
+    from neuronx_distributed_inference_tpu.analysis.__main__ import main
+
+    rc = main(["--suites", "life", "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    report = json.loads(out)
+    assert report["suites"] == ["life"]
+    assert report["new"] == 0
+    life = report["lifecycle"]
+    assert life["errors"] == 0
+    assert {"resources", "refcount", "states", "raises", "thread_starts",
+            "reach_checks", "census", "worker_entries"} <= set(life)
+    assert len(life["reach_checks"]) == 6
+
+
+def test_cli_life_suite_text_breakdown(capsys):
+    from neuronx_distributed_inference_tpu.analysis.__main__ import main
+
+    rc = main(["--suites", "life"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "lifecycle resource-stewardship census" in out
+    assert "ownership-transfer reach" in out

@@ -612,15 +612,16 @@ def test_cli_full_json_schema(capsys):
 
     report = json.loads(out)
     assert report["suites"] == [
-        "lint", "flags", "graph", "shard", "memory", "cost", "conc", "kernel"
+        "lint", "flags", "graph", "shard", "memory", "cost", "conc",
+        "kernel", "life"
     ]
     assert report["new"] == 0
     assert {"total", "findings", "new_findings", "memory", "cost",
-            "concurrency", "kernel"} <= set(report)
+            "concurrency", "kernel", "lifecycle"} <= set(report)
     for f in report["findings"]:
         assert {"rule", "severity", "location", "message", "key"} <= set(f)
         assert f["rule"][:3] in ("TPU", "GRA", "MEM", "FLA", "COS", "CON",
-                                 "KER")
+                                 "KER", "LIF")
         # file:line for source rules, tag/bucket for graph rules
         assert (":" in f["location"]) or ("/" in f["location"])
     mem = report["memory"]
@@ -1673,4 +1674,82 @@ def test_tpu109_tree_is_clean():
     from neuronx_distributed_inference_tpu.analysis import tpulint
 
     hits = [f for f in tpulint.run() if f.rule == "TPU109"]
+    assert hits == [], [f.render() for f in hits]
+
+
+# ---------------------------------------------------------------------------
+# TPU110: silent-swallow except handlers in runtime/ + telemetry/
+# ---------------------------------------------------------------------------
+
+
+def _lint_scoped(tmp_path, subdir, source):
+    pkg = tmp_path / "neuronx_distributed_inference_tpu" / subdir
+    pkg.mkdir(parents=True, exist_ok=True)
+    f = pkg / "snippet.py"
+    f.write_text(textwrap.dedent(source))
+    return lint_paths([f], tmp_path)
+
+
+@pytest.mark.parametrize("subdir", ["runtime", "telemetry"])
+def test_tpu110_silent_swallow_fires(tmp_path, subdir):
+    findings = _lint_scoped(
+        tmp_path, subdir,
+        """
+        def probe(server):
+            try:
+                server.poke()
+            except Exception:
+                pass
+        """,
+    )
+    hits = [f for f in findings if f.rule == "TPU110"]
+    assert len(hits) == 1
+    assert hits[0].severity == "warning"
+    assert "swallow" in hits[0].message
+    assert hits[0].key.endswith("::silent-swallow")
+
+
+def test_tpu110_typed_or_handled_does_not_fire(tmp_path):
+    """A narrow class, a handler that DOES something, or a docstring-only
+    body followed by real statements are all out of scope — only broad AND
+    silent fires."""
+    findings = _lint_scoped(
+        tmp_path, "runtime",
+        """
+        import logging
+
+        def probe(server):
+            try:
+                server.poke()
+            except OSError:
+                pass          # typed: the author named the failure
+            try:
+                server.poke()
+            except Exception:
+                logging.exception("poke failed")   # broad but LOUD
+        """,
+    )
+    assert [f for f in findings if f.rule == "TPU110"] == []
+
+
+def test_tpu110_outside_scope_not_audited(tmp_path):
+    """modules/ (pure jitted math, no lifecycle state) is out of scope."""
+    findings = _lint_scoped(
+        tmp_path, "modules",
+        """
+        def probe(server):
+            try:
+                server.poke()
+            except Exception:
+                pass
+        """,
+    )
+    assert [f for f in findings if f.rule == "TPU110"] == []
+
+
+def test_tpu110_tree_is_clean():
+    """ZERO baseline entries: the real runtime/ + telemetry/ trees carry no
+    silent-swallow handlers (the application.py cache-dir handler now names
+    its classes)."""
+    hits = [f for f in tpulint.run() if f.rule == "TPU110"]
     assert hits == [], [f.render() for f in hits]

@@ -879,3 +879,66 @@ def test_export_chrome_trace_safe_during_active_drain():
             json.dumps(doc)  # every snapshot serializes cleanly
         final = s.export_chrome_trace()
     assert any(ev["ph"] == "X" for ev in final["traceEvents"])
+
+
+# ---------------------------------------------------------------------------
+# metric-catalog drift: docs/OBSERVABILITY.md vs the registered families
+# ---------------------------------------------------------------------------
+
+
+def test_catalog_drift_detects_both_directions():
+    """The checker FIRES both ways on a fixture: a registered family the
+    doc never mentions, and a documented name no session registers."""
+    from neuronx_distributed_inference_tpu.telemetry.metrics import (
+        catalog_drift,
+    )
+
+    doc = """
+    | `nxdi_requests_total` | counter | per-status census |
+    | `nxdi_step_ms` | histogram | `nxdi_step_ms_bucket` rides along |
+    | `nxdi_ghost_metric_total` | counter | removed in a refactor |
+    """
+    families = ["nxdi_requests_total", "nxdi_step_ms", "nxdi_secret_gauge"]
+    undocumented, unregistered = catalog_drift(doc, families)
+    assert undocumented == ["nxdi_secret_gauge"]
+    assert unregistered == ["nxdi_ghost_metric_total"]
+    # exposition suffixes of a documented histogram are NOT drift
+    assert "nxdi_step_ms_bucket" not in unregistered
+
+
+def test_catalog_drift_clean_fixture():
+    from neuronx_distributed_inference_tpu.telemetry.metrics import (
+        catalog_drift,
+    )
+
+    doc = "`nxdi_a_total` and `nxdi_b_ms` (with `nxdi_b_ms_sum`)."
+    assert catalog_drift(doc, ["nxdi_a_total", "nxdi_b_ms"]) == ([], [])
+
+
+def test_observability_doc_matches_registered_families():
+    """The REAL contract: every family a fresh TelemetrySession registers
+    (SLO monitor bound, eager registration) appears in
+    docs/OBSERVABILITY.md, and every `nxdi_*` name the doc mentions exists.
+    A metric added without its doc row — or a doc row that outlived its
+    metric — fails here, in both directions."""
+    from neuronx_distributed_inference_tpu.telemetry.metrics import (
+        catalog_drift,
+    )
+
+    doc = (
+        pathlib.Path(__file__).resolve().parents[1]
+        / "docs" / "OBSERVABILITY.md"
+    ).read_text()
+    with TelemetrySession() as tel:
+        SloMonitor().bind(tel.registry)
+        families = tel.registry.family_names()
+    assert len(families) >= 50
+    undocumented, unregistered = catalog_drift(doc, families)
+    assert undocumented == [], (
+        "registered families missing from docs/OBSERVABILITY.md: "
+        f"{undocumented}"
+    )
+    assert unregistered == [], (
+        "docs/OBSERVABILITY.md names families nothing registers: "
+        f"{unregistered}"
+    )
